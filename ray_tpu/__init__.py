@@ -1,0 +1,59 @@
+"""ray_tpu — a TPU-native distributed AI runtime.
+
+A brand-new framework with the capabilities of the reference Ray runtime
+(surveyed in /root/repo/SURVEY.md), re-designed TPU-first: the device data
+plane is XLA collectives over ICI/DCN meshes (pjit/shard_map/pallas), the
+control plane is an ownership-based task/actor runtime with a slice-topology-
+aware scheduler, and the object store understands device residency.
+
+Public surface mirrors the reference's `ray` module
+(/root/reference/python/ray/__init__.py).
+"""
+
+from ray_tpu.core.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    exit_actor,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.placement_group import (
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+    tpu_slice_placement_group,
+)
+from ray_tpu.core.task_spec import (
+    NodeAffinityStrategy,
+    NodeLabelStrategy,
+    SpreadStrategy,
+)
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "exit_actor", "get_runtime_context",
+    "cluster_resources", "available_resources", "nodes",
+    "ObjectRef", "ActorClass", "ActorHandle",
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "placement_group_table", "tpu_slice_placement_group",
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinityStrategy", "NodeLabelStrategy", "SpreadStrategy",
+    "exceptions", "__version__",
+]

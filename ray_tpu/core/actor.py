@@ -1,0 +1,129 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+TPU-native analog of the reference's actor surface
+(/root/reference/python/ray/actor.py — ActorClass:1181, _remote:1492,
+ActorHandle:1851, _actor_method_call:2047, ActorMethod._remote:792).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.remote_function import _build_resources, _build_strategy
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1,
+                 max_task_retries: int | None = None):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+        self._max_task_retries = max_task_retries
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs)
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(
+            self._handle, self._method_name,
+            num_returns=opts.get("num_returns", self._num_returns),
+            max_task_retries=opts.get("max_task_retries", self._max_task_retries))
+
+    def _remote(self, args, kwargs):
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        h = self._handle
+        retries = self._max_task_retries
+        if retries is None:
+            retries = h._max_task_retries
+        refs = rt.submit_actor_task(
+            h._actor_id, self._method_name, args, kwargs,
+            num_returns=self._num_returns, max_task_retries=retries,
+            name=f"{h._class_name}.{self._method_name}")
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Actor method '{self._method_name}' cannot be called directly; "
+            f"use '.{self._method_name}.remote()'.")
+
+
+class ActorHandle:
+    """Serializable handle to a live actor (ref: actor.py:1851). Handles are
+    plain data — any process holding one can submit ordered method calls."""
+
+    def __init__(self, actor_id: ActorID, class_name: str, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name, self._max_task_retries))
+
+    def kill(self, no_restart: bool = True):
+        from ray_tpu.core import api
+        api.kill(self, no_restart=no_restart)
+
+
+class ActorClass:
+    def __init__(self, cls: type, **options):
+        self._cls = cls
+        self._options = options
+        functools.update_wrapper(self, cls, updated=[])
+
+    def options(self, **options) -> "ActorClass":
+        return ActorClass(self._cls, **{**self._options, **options})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, options) -> ActorHandle:
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        actor_id = ActorID.of(rt.job_id)
+        resources = _build_resources(options)
+        if options.get("num_cpus") is None and "CPU" not in (options.get("resources") or {}):
+            # actors default to 0 CPU when running, 1 for placement in the
+            # reference; we reserve 1 CPU unless told otherwise
+            resources.setdefault("CPU", 1.0)
+        is_async = _has_async_methods(self._cls)
+        rt.submit_actor_creation(
+            self._cls, args, kwargs, actor_id=actor_id,
+            resources=resources,
+            name=options.get("name", ""),
+            detached=options.get("lifetime") == "detached",
+            max_restarts=int(options.get("max_restarts", 0)),
+            max_task_retries=int(options.get("max_task_retries", 0)),
+            max_concurrency=int(options.get("max_concurrency", 1000 if is_async else 1)),
+            is_async=is_async,
+            strategy=_build_strategy(options))
+        handle = ActorHandle(actor_id, self._cls.__name__,
+                             max_task_retries=int(options.get("max_task_retries", 0)))
+        return handle
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated directly; "
+            f"use '{self._cls.__name__}.remote()'.")
+
+
+def _has_async_methods(cls: type) -> bool:
+    import inspect
+    return any(inspect.iscoroutinefunction(m)
+               for _, m in inspect.getmembers(cls, predicate=inspect.isfunction))

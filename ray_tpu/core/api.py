@@ -1,0 +1,272 @@
+"""Top-level API: init / shutdown / remote / get / put / wait / kill / cancel.
+
+TPU-native analog of the reference's public surface
+(/root/reference/python/ray/_private/worker.py — init:1422, shutdown:2067,
+get:2815, connect:2444) and the driver bootstrap
+(python/ray/_private/node.py:1340 start_head_processes). Head mode hosts the
+control plane and a node agent in-process (threads); worker processes are real
+subprocesses, so distributed semantics (ownership, borrows, worker death) are
+exercised even on one host.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Any, Sequence
+
+from ray_tpu.core.config import get_config, reset_config
+from ray_tpu.core.ids import JobID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.exceptions import RayTpuError
+
+_lock = threading.RLock()
+_runtime = None
+_head = None  # (control_plane, node_agent) when we started them
+
+
+def _get_runtime():
+    rt = _runtime
+    if rt is None:
+        raise RayTpuError("ray_tpu.init() has not been called")
+    return rt
+
+
+def _try_get_runtime():
+    return _runtime
+
+
+def _set_runtime(rt):
+    global _runtime
+    _runtime = rt
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def init(address: str | None = None, *, num_cpus: float | None = None,
+         resources: dict | None = None, labels: dict | None = None,
+         object_store_memory: int | None = None,
+         _system_config: dict | None = None, log_to_driver: bool = True,
+         job_name: str = "") -> "RuntimeContext":
+    """Start (head mode) or connect to (address=...) a cluster."""
+    global _runtime, _head
+    with _lock:
+        if _runtime is not None:
+            return RuntimeContext(_runtime)
+        reset_config()
+        cfg = get_config()
+        cfg.apply(_system_config)
+        if _system_config:
+            # propagate to spawned worker processes
+            os.environ.update(cfg.to_env(_system_config))
+
+        from ray_tpu.core.worker import WorkerRuntime
+
+        job_id = JobID.from_random()
+        if address is None:
+            from ray_tpu.core.control_plane import ControlPlane
+            from ray_tpu.core.node_agent import NodeAgent
+            res = dict(resources or {})
+            if num_cpus is not None:
+                res["CPU"] = float(num_cpus)
+            elif "CPU" not in res:
+                res["CPU"] = float(os.cpu_count() or 1)
+            cp = ControlPlane()
+            agent = NodeAgent(cp.addr, resources=res, labels=labels,
+                              object_store_memory=object_store_memory)
+            _head = (cp, agent)
+            cp_addr, agent_addr, node_id = cp.addr, agent.addr, agent.node_id
+        else:
+            host, port = address.rsplit(":", 1)
+            cp_addr = (host, int(port))
+            # adopt the first alive node's agent for local store access
+            from ray_tpu.core.rpc import RpcClient
+            probe = RpcClient(cp_addr, name="probe")
+            nodes = probe.call_with_retry("get_nodes", None, timeout=30.0)
+            probe.close()
+            alive = [n for n in nodes if n["alive"]]
+            if not alive:
+                raise RayTpuError(f"no alive nodes in cluster at {address}")
+            agent_addr, node_id = tuple(alive[0]["addr"]), alive[0]["node_id"]
+
+        rt = WorkerRuntime(mode="driver", cp_addr=cp_addr, agent_addr=agent_addr,
+                           job_id=job_id, node_id=node_id)
+        rt.cp_client.call_with_retry(
+            "register_job", {"job_id": job_id, "addr": rt.addr}, timeout=30.0)
+        _runtime = rt
+        atexit.register(_atexit_shutdown)
+        return RuntimeContext(rt)
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    """(ref: worker.py:2067)"""
+    global _runtime, _head
+    with _lock:
+        rt, _runtime = _runtime, None
+        head, _head = _head, None
+        if rt is not None:
+            try:
+                rt.cp_client.call("finish_job", {"job_id": rt.job_id}, timeout=5.0)
+            except Exception:
+                pass
+            rt.shutdown()
+        if head is not None:
+            cp, agent = head
+            agent.stop()
+            cp.stop()
+
+
+def remote(*args, **options):
+    """Decorator: @remote or @remote(num_cpus=..., num_tpus=..., ...)
+    (ref: worker.py remote / remote_function.py:41 / actor.py:1181)."""
+    def decorate(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, **options)
+        return RemoteFunction(obj, **options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return decorate
+
+
+def get(refs, timeout: float | None = None) -> Any:
+    rt = _get_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or list, got {type(refs)}")
+    return rt.get(list(refs), timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return _get_runtime().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: float | None = None):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() expects unique ObjectRefs")
+    num_returns = min(num_returns, len(refs))
+    return _get_runtime().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    rt = _get_runtime()
+    rt.cp_client.call_with_retry(
+        "kill_actor", {"actor_id": actor.actor_id, "no_restart": no_restart},
+        timeout=30.0)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    rt = _get_runtime()
+    spec = rt.task_manager.get_pending_spec(ref.id().task_id())
+    if spec is None:
+        return
+    # best effort: mark cancelled at the executor side isn't addressable until
+    # leased; record locally so queued execution fails fast
+    from ray_tpu.exceptions import TaskCancelledError, TaskError
+    rt.fail_task(spec, TaskError(TaskCancelledError(), task_repr=spec.repr_name()))
+
+
+def get_actor(name: str, timeout: float = 10.0) -> ActorHandle:
+    """(ref: worker.py get_actor — named actors)"""
+    rt = _get_runtime()
+    reply = rt.cp_client.call_with_retry(
+        "get_actor_by_name", {"name": name, "timeout": timeout}, timeout=timeout + 10)
+    if reply is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(reply["actor_id"], reply["spec"].name,
+                       max_task_retries=reply["spec"].max_task_retries)
+
+
+def exit_actor():
+    """Terminate the current actor after the running call returns
+    (ref: ray.actor.exit_actor)."""
+    rt = _get_runtime()
+    if not rt.in_actor():
+        raise RuntimeError("exit_actor() called outside an actor")
+    rt.request_exit_actor()
+
+
+class RuntimeContext:
+    """(ref: python/ray/runtime_context.py)"""
+
+    def __init__(self, rt):
+        self._rt = rt
+
+    @property
+    def job_id(self):
+        return self._rt.job_id
+
+    @property
+    def node_id(self):
+        return self._rt.node_id
+
+    @property
+    def worker_id(self):
+        return self._rt.worker_id
+
+    @property
+    def current_actor_id(self):
+        return self._rt._actor_state.actor_id
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get_task_id(self):
+        return self._rt.current_task_id()
+
+    @property
+    def control_plane_address(self) -> str:
+        return f"{self._rt.cp_addr[0]}:{self._rt.cp_addr[1]}"
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_get_runtime())
+
+
+def cluster_resources() -> dict:
+    rt = _get_runtime()
+    nodes = rt.cp_client.call_with_retry("get_nodes", None, timeout=10.0)
+    total: dict[str, float] = {}
+    for n in nodes:
+        if n["alive"]:
+            for k, v in n["resources"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> dict:
+    rt = _get_runtime()
+    nodes = rt.cp_client.call_with_retry("get_nodes", None, timeout=10.0)
+    total: dict[str, float] = {}
+    for n in nodes:
+        if n["alive"]:
+            for k, v in n["available"].items():
+                total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def nodes() -> list[dict]:
+    rt = _get_runtime()
+    return rt.cp_client.call_with_retry("get_nodes", None, timeout=10.0)

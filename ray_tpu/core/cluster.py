@@ -1,0 +1,68 @@
+"""In-process simulated multi-node cluster for tests.
+
+TPU-native analog of the reference's cluster_utils
+(/root/reference/python/ray/cluster_utils.py — Cluster:135, add_node:202,
+remove_node:286): N real node agents (each with its own shm store and real
+worker subprocesses) against one control plane, all on one host — so
+distributed scheduling and fault-tolerance tests run without hardware
+(SURVEY.md §4 keystone (a)). TPU slice topologies are faked via node labels,
+giving the fake slice-topology provider SURVEY.md §4 calls for.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.core.control_plane import ControlPlane
+from ray_tpu.core.ids import NodeID
+from ray_tpu.core.node_agent import NodeAgent
+
+
+class Cluster:
+    def __init__(self):
+        self.control_plane = ControlPlane()
+        self.nodes: list[NodeAgent] = []
+
+    @property
+    def address(self) -> str:
+        return f"{self.control_plane.addr[0]}:{self.control_plane.addr[1]}"
+
+    def add_node(self, *, num_cpus: float = 1.0, resources: dict | None = None,
+                 labels: dict | None = None,
+                 object_store_memory: int | None = None,
+                 tpu_slice: str | None = None, tpu_worker_id: int = 0,
+                 tpu_chips: int = 4, pod_type: str = "v5p-16") -> NodeAgent:
+        """Add a node. ``tpu_slice`` fakes TPU slice membership via labels."""
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus))
+        lab = dict(labels or {})
+        if tpu_slice is not None:
+            res.setdefault("TPU", float(tpu_chips))
+            lab.update({"slice_name": tpu_slice, "tpu_worker_id": str(tpu_worker_id),
+                        "pod_type": pod_type, "topology": ""})
+        agent = NodeAgent(self.control_plane.addr, resources=res, labels=lab,
+                          object_store_memory=object_store_memory)
+        self.nodes.append(agent)
+        return agent
+
+    def remove_node(self, agent: NodeAgent, graceful: bool = False):
+        """Kill a node (ref: cluster_utils.py:286). Non-graceful stops the
+        agent cold so health checks must detect the death."""
+        if agent in self.nodes:
+            self.nodes.remove(agent)
+        if graceful:
+            try:
+                self.control_plane._h_drain_node({"node_id": agent.node_id})
+            except Exception:
+                pass
+        agent.stop()
+
+    def kill_node_by_id(self, node_id: NodeID):
+        for agent in list(self.nodes):
+            if agent.node_id == node_id:
+                self.remove_node(agent)
+                return
+
+    def shutdown(self):
+        for agent in list(self.nodes):
+            agent.stop()
+        self.nodes.clear()
+        self.control_plane.stop()

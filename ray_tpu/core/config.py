@@ -1,0 +1,123 @@
+"""Config/flag system.
+
+TPU-native analog of the reference's RAY_CONFIG flag table
+(/root/reference/src/ray/common/ray_config_def.h, ray_config.h:60-72): every flag
+has a typed default, is overridable by the environment variable ``RAY_TPU_<name>``,
+and by the ``_system_config`` dict passed to ``ray_tpu.init`` (propagated to all
+spawned processes through the environment).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+_SYSTEM_CONFIG_ENV = "RAY_TPU_SYSTEM_CONFIG"
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return value
+
+
+@dataclass
+class Config:
+    """All runtime flags. Field name == flag name."""
+
+    # --- object store ---
+    # Objects at or below this size are returned inline to the owner's
+    # in-process memory store (ref: ray_config_def.h max_direct_call_object_size).
+    max_inline_object_size: int = 100 * 1024
+    # Default shared-memory store capacity per node (bytes).
+    object_store_memory: int = 512 * 1024 * 1024
+    # Evict-on-full policy headroom fraction.
+    object_store_eviction_headroom: float = 0.1
+    # Use the native C++ shared-memory store if built; fall back to pure python.
+    use_native_object_store: bool = True
+
+    # --- scheduling ---
+    # Max worker processes per node agent (0 = num_cpus).
+    max_workers_per_node: int = 0
+    # Idle worker keep-alive before reaping (seconds).
+    idle_worker_ttl_s: float = 300.0
+    # Lease request timeout.
+    lease_timeout_s: float = 60.0
+    # Hybrid scheduling policy: prefer local node until its utilization
+    # exceeds this threshold, then pack remote nodes by score
+    # (ref: hybrid_scheduling_policy.cc).
+    hybrid_threshold: float = 0.5
+    # Weight of ICI distance in node scoring (TPU-native addition).
+    ici_distance_weight: float = 0.2
+
+    # --- fault tolerance ---
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    # Enable lineage-based reconstruction of lost shared-memory objects
+    # (ref: object_recovery_manager.h:41).
+    enable_object_reconstruction: bool = True
+    # Health-check period/timeout (ref: gcs_health_check_manager.h:45).
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 10.0
+    health_check_failure_threshold: int = 5
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_retries: int = 3
+    # Deterministic fault injection: "method:prob_req:prob_resp,..."
+    # (ref: rpc_chaos.cc, ray_config_def.h:842-849).
+    testing_rpc_failure: str = ""
+
+    # --- task events / observability ---
+    task_events_buffer_size: int = 10000
+    task_events_flush_interval_s: float = 1.0
+
+    # --- misc ---
+    worker_register_timeout_s: float = 30.0
+    log_dir: str = ""
+
+    def __post_init__(self) -> None:
+        # env overrides
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                setattr(self, f.name, _coerce(env, f.type if isinstance(f.type, type) else type(getattr(self, f.name))))
+        # _system_config propagated via env (JSON)
+        blob = os.environ.get(_SYSTEM_CONFIG_ENV)
+        if blob:
+            self.apply(json.loads(blob))
+
+    def apply(self, overrides: dict[str, Any] | None) -> None:
+        if not overrides:
+            return
+        for k, v in overrides.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown system config flag: {k}")
+            setattr(self, k, v)
+
+    def to_env(self, overrides: dict[str, Any] | None = None) -> dict[str, str]:
+        """Serialize overrides for child process environments."""
+        merged = dict(overrides or {})
+        return {_SYSTEM_CONFIG_ENV: json.dumps(merged)} if merged else {}
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+    return _config
+
+
+def reset_config() -> None:
+    global _config
+    _config = None

@@ -1,0 +1,647 @@
+"""Control plane — the cluster's single source of truth.
+
+TPU-native analog of the reference's GCS (/root/reference/src/ray/gcs/ —
+GcsServer gcs_server.h:95): owns node membership, the actor directory and actor
+scheduling (GcsActorManager gcs_actor_manager.h:92, GcsActorScheduler
+gcs_actor_scheduler.h:108), placement groups with 2-phase prepare/commit
+(gcs_placement_group_scheduler.cc), internal KV (store_client_kv.cc), pubsub
+(GcsPublisher), health checks (gcs_health_check_manager.h:45), and the cluster
+resource view (GcsResourceManager + RaySyncer-style reports).
+
+Runs as a thread-hosted RPC server inside the head process (or standalone via
+``python -m ray_tpu.core.control_plane``). State lives in a pluggable store —
+in-memory by default, file-backed for restart fault tolerance (the analog of
+the reference's Redis-backed GCS FT, redis_store_client.cc).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+from ray_tpu.core.rpc import ClientPool, RpcServer
+from ray_tpu.core.scheduler import NodeView, add, pick_node, place_bundles, place_slice_bundles, subtract
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.exceptions import PlacementGroupSchedulingError
+
+logger = logging.getLogger(__name__)
+
+
+class ActorState(enum.Enum):
+    PENDING = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    spec: TaskSpec
+    name: str = ""
+    detached: bool = False
+    state: ActorState = ActorState.PENDING
+    addr: tuple[str, int] | None = None
+    node_id: NodeID | None = None
+    worker_id: WorkerID | None = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""
+    pg_id: PlacementGroupID | None = None
+
+
+class PGState(enum.Enum):
+    PENDING = "PENDING"
+    CREATED = "CREATED"
+    REMOVED = "REMOVED"
+
+
+@dataclass
+class PGInfo:
+    pg_id: PlacementGroupID
+    bundles: list[dict]
+    strategy: str
+    state: PGState = PGState.PENDING
+    name: str = ""
+    node_ids: list[NodeID] = field(default_factory=list)
+    creator_job: JobID | None = None
+
+
+@dataclass
+class _Node:
+    view: NodeView
+    missed_health_checks: int = 0
+
+
+class ControlPlane:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.RLock()
+        self._nodes: dict[NodeID, _Node] = {}
+        self._actors: dict[ActorID, ActorInfo] = {}
+        self._named_actors: dict[str, ActorID] = {}
+        self._pgs: dict[PlacementGroupID, PGInfo] = {}
+        self._kv: dict[str, bytes] = {}
+        self._jobs: dict[JobID, dict] = {}
+        self._subs: dict[str, set[tuple[str, int]]] = {}
+        self._pool = ClientPool("cp")
+        self._pending_actors: list[ActorID] = []
+        self._pending_pgs: list[PlacementGroupID] = []
+        self._wake = threading.Condition()
+        self._stopped = threading.Event()
+        self._task_events: list[dict] = []  # GcsTaskManager-style sink (bounded)
+        self._server = RpcServer(
+            self._handle, host=host, port=port, name="controlplane",
+            blocking_methods={"resolve_actor", "pg_ready", "get_actor_by_name"},
+            pool_size=16)
+        self.addr = self._server.addr
+        self._sched_thread = threading.Thread(
+            target=self._scheduling_loop, name="cp-sched", daemon=True)
+        self._sched_thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="cp-health", daemon=True)
+        self._health_thread.start()
+
+    # ------------------------------------------------------------------
+    def _handle(self, method: str, body, peer):
+        fn = getattr(self, "_h_" + method, None)
+        if fn is None:
+            raise ValueError(f"control plane: unknown method {method}")
+        return fn(body)
+
+    def _wake_scheduler(self):
+        with self._wake:
+            self._wake.notify_all()
+
+    # ---- nodes --------------------------------------------------------
+    def _h_register_node(self, body):
+        view = NodeView(
+            node_id=body["node_id"], addr=tuple(body["addr"]),
+            total=dict(body["resources"]), available=dict(body["resources"]),
+            labels=dict(body.get("labels") or {}))
+        with self._lock:
+            self._nodes[view.node_id] = _Node(view=view)
+        logger.info("node %s registered at %s resources=%s labels=%s",
+                    view.node_id.hex()[:8], view.addr, view.total, view.labels)
+        self._publish("node", {"event": "alive", "node_id": view.node_id})
+        self._wake_scheduler()
+        return {"ok": True}
+
+    def _h_report_resources(self, body):
+        """Versioned resource-view sync (ref: ray_syncer.h:87)."""
+        with self._lock:
+            node = self._nodes.get(body["node_id"])
+            if node is not None:
+                node.view.available = dict(body["available"])
+        self._wake_scheduler()
+
+    def _h_get_nodes(self, body):
+        with self._lock:
+            return [
+                {"node_id": n.view.node_id, "addr": n.view.addr, "alive": n.view.alive,
+                 "resources": dict(n.view.total), "available": dict(n.view.available),
+                 "labels": dict(n.view.labels)}
+                for n in self._nodes.values()]
+
+    def _h_drain_node(self, body):
+        """(ref: node_manager.proto:448 DrainRaylet)"""
+        self._on_node_dead(body["node_id"], "drained")
+        return {"ok": True}
+
+    # ---- jobs ---------------------------------------------------------
+    def _h_register_job(self, body):
+        with self._lock:
+            self._jobs[body["job_id"]] = {"driver_addr": tuple(body["addr"]),
+                                          "start_time": time.time(), "alive": True}
+        return {"ok": True}
+
+    def _h_finish_job(self, body):
+        with self._lock:
+            if body["job_id"] in self._jobs:
+                self._jobs[body["job_id"]]["alive"] = False
+        # non-detached actors of the job die with it (ref: GcsActorManager
+        # OnJobFinished)
+        doomed = []
+        with self._lock:
+            for info in self._actors.values():
+                if (not info.detached and info.spec.job_id == body["job_id"]
+                        and info.state not in (ActorState.DEAD,)):
+                    doomed.append(info.actor_id)
+        for aid in doomed:
+            self._kill_actor(aid, no_restart=True, reason="job finished")
+        return {"ok": True}
+
+    def _h_list_jobs(self, body):
+        with self._lock:
+            return [{"job_id": j, **info} for j, info in self._jobs.items()]
+
+    # ---- kv (function table, serve config, ...) -----------------------
+    def _h_kv_put(self, body):
+        with self._lock:
+            exists = body["key"] in self._kv
+            if body.get("overwrite", True) or not exists:
+                self._kv[body["key"]] = body["value"]
+                return True
+            return False
+
+    def _h_kv_get(self, body):
+        with self._lock:
+            return self._kv.get(body["key"])
+
+    def _h_kv_del(self, body):
+        with self._lock:
+            return self._kv.pop(body["key"], None) is not None
+
+    def _h_kv_exists(self, body):
+        with self._lock:
+            return body["key"] in self._kv
+
+    def _h_kv_keys(self, body):
+        prefix = body.get("prefix", "")
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # ---- pubsub -------------------------------------------------------
+    def _h_subscribe(self, body):
+        with self._lock:
+            self._subs.setdefault(body["channel"], set()).add(tuple(body["addr"]))
+        return {"ok": True}
+
+    def _h_unsubscribe(self, body):
+        with self._lock:
+            self._subs.get(body["channel"], set()).discard(tuple(body["addr"]))
+        return {"ok": True}
+
+    def _h_publish(self, body):
+        self._publish(body["channel"], body["msg"])
+        return {"ok": True}
+
+    def _publish(self, channel: str, msg):
+        with self._lock:
+            targets = list(self._subs.get(channel, ()))
+        for addr in targets:
+            try:
+                self._pool.get(addr).notify("pubsub", {"channel": channel, "msg": msg})
+            except Exception:
+                pass
+
+    # ---- task events (observability sink; ref: gcs_task_manager.cc) ----
+    def _h_report_task_events(self, body):
+        with self._lock:
+            self._task_events.extend(body["events"])
+            overflow = len(self._task_events) - get_config().task_events_buffer_size
+            if overflow > 0:
+                del self._task_events[:overflow]
+        return {"ok": True}
+
+    def _h_list_task_events(self, body):
+        limit = body.get("limit", 1000) if body else 1000
+        with self._lock:
+            return list(self._task_events[-limit:])
+
+    # ---- actors -------------------------------------------------------
+    def _h_create_actor(self, body):
+        spec: TaskSpec = body["spec"]
+        info = ActorInfo(
+            actor_id=spec.actor_id, spec=spec, name=body.get("name", ""),
+            detached=body.get("detached", False), max_restarts=spec.max_restarts,
+            pg_id=getattr(spec.strategy, "pg_id", None))
+        with self._lock:
+            if info.name:
+                if info.name in self._named_actors:
+                    raise ValueError(f"actor name '{info.name}' already taken")
+                self._named_actors[info.name] = info.actor_id
+            self._actors[info.actor_id] = info
+            self._pending_actors.append(info.actor_id)
+        self._wake_scheduler()
+        return {"actor_id": info.actor_id}
+
+    def _h_resolve_actor(self, body):
+        """Blocking resolve: return (state, addr) once ALIVE or DEAD."""
+        deadline = time.monotonic() + body.get("timeout", 60.0)
+        aid = body["actor_id"]
+        while time.monotonic() < deadline and not self._stopped.is_set():
+            with self._lock:
+                info = self._actors.get(aid)
+                if info is None:
+                    raise ValueError(f"unknown actor {aid}")
+                if info.state == ActorState.ALIVE:
+                    return {"state": "ALIVE", "addr": info.addr, "worker_id": info.worker_id}
+                if info.state == ActorState.DEAD:
+                    return {"state": "DEAD", "death_cause": info.death_cause}
+            time.sleep(0.01)
+        return {"state": "TIMEOUT"}
+
+    def _h_get_actor_by_name(self, body):
+        deadline = time.monotonic() + body.get("timeout", 0.0)
+        while True:
+            with self._lock:
+                aid = self._named_actors.get(body["name"])
+                if aid is not None:
+                    info = self._actors[aid]
+                    return {"actor_id": aid, "spec": info.spec}
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.02)
+
+    def _h_kill_actor(self, body):
+        self._kill_actor(body["actor_id"], body.get("no_restart", True), "ray_tpu.kill")
+        return {"ok": True}
+
+    def _h_actor_exited(self, body):
+        """Actor called exit_actor() or its worker exited cleanly."""
+        self._on_actor_down(body["actor_id"], "actor exited", clean=True)
+        return {"ok": True}
+
+    def _h_worker_died(self, body):
+        """Reported by a node agent (ref: GcsActorManager::OnWorkerDead)."""
+        aid = body.get("actor_id")
+        if aid is not None:
+            self._on_actor_down(aid, body.get("reason", "worker died"), clean=False)
+        return {"ok": True}
+
+    def _h_list_actors(self, body):
+        with self._lock:
+            return [
+                {"actor_id": i.actor_id, "name": i.name, "state": i.state.value,
+                 "node_id": i.node_id, "addr": i.addr, "num_restarts": i.num_restarts,
+                 "class_name": i.spec.name, "death_cause": i.death_cause}
+                for i in self._actors.values()]
+
+    def _kill_actor(self, actor_id: ActorID, no_restart: bool, reason: str):
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            addr = info.addr
+            if no_restart:
+                info.max_restarts = info.num_restarts  # exhaust budget
+        if addr is not None:
+            try:
+                self._pool.get(addr).notify("kill_actor", {"actor_id": actor_id})
+            except Exception:
+                pass
+        # clean=False so kill(no_restart=False) consumes the restart budget and
+        # restarts the actor (ref: GcsActorManager::DestroyActor no_restart arg)
+        self._on_actor_down(actor_id, reason, clean=False, force_dead=no_restart)
+
+    def _on_actor_down(self, actor_id: ActorID, reason: str, clean: bool,
+                       force_dead: bool = False):
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                return
+            # release lease resources
+            if info.node_id is not None:
+                self._release_node_resources(info.node_id, info.spec.resources)
+            restartable = (not force_dead and not clean
+                           and (info.max_restarts < 0 or info.num_restarts < info.max_restarts))
+            if restartable:
+                info.state = ActorState.RESTARTING
+                info.num_restarts += 1
+                info.addr = None
+                info.node_id = None
+                self._pending_actors.append(actor_id)
+                state_msg = "RESTARTING"
+            else:
+                info.state = ActorState.DEAD
+                info.death_cause = reason
+                info.addr = None
+                state_msg = "DEAD"
+                if info.name and not restartable:
+                    self._named_actors.pop(info.name, None)
+        self._publish(f"actor:{actor_id.hex()}",
+                      {"state": state_msg, "reason": reason})
+        self._wake_scheduler()
+
+    def _release_node_resources(self, node_id: NodeID, resources: dict):
+        node = self._nodes.get(node_id)
+        if node is not None:
+            add(node.view.available, resources)
+
+    # ---- placement groups ---------------------------------------------
+    def _h_create_pg(self, body):
+        pg = PGInfo(pg_id=body["pg_id"], bundles=body["bundles"],
+                    strategy=body["strategy"], name=body.get("name", ""),
+                    creator_job=body.get("job_id"))
+        with self._lock:
+            self._pgs[pg.pg_id] = pg
+            self._pending_pgs.append(pg.pg_id)
+        self._wake_scheduler()
+        return {"pg_id": pg.pg_id}
+
+    def _h_pg_ready(self, body):
+        deadline = time.monotonic() + body.get("timeout", 60.0)
+        while time.monotonic() < deadline and not self._stopped.is_set():
+            with self._lock:
+                pg = self._pgs.get(body["pg_id"])
+                if pg is None:
+                    raise ValueError("unknown placement group")
+                if pg.state == PGState.CREATED:
+                    return {"state": "CREATED",
+                            "node_ids": pg.node_ids,
+                            "bundles": pg.bundles}
+                if pg.state == PGState.REMOVED:
+                    raise PlacementGroupSchedulingError("placement group removed")
+            time.sleep(0.01)
+        return {"state": "TIMEOUT"}
+
+    def _h_remove_pg(self, body):
+        pg_id = body["pg_id"]
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None or pg.state == PGState.REMOVED:
+                return {"ok": True}
+            pg.state = PGState.REMOVED
+            allocations = list(zip(pg.node_ids, pg.bundles))
+        by_node: dict[NodeID, list] = {}
+        for nid, b in allocations:
+            by_node.setdefault(nid, []).append(b)
+        for nid, bundles in by_node.items():
+            node = self._nodes.get(nid)
+            if node is None:
+                continue
+            try:
+                self._pool.get(node.view.addr).call_with_retry(
+                    "cancel_bundles", {"pg_id": pg_id}, timeout=10.0)
+            except Exception:
+                pass
+        self._wake_scheduler()
+        return {"ok": True}
+
+    def _h_get_pg(self, body):
+        with self._lock:
+            pg = self._pgs.get(body["pg_id"])
+            if pg is None:
+                return None
+            return {"pg_id": pg.pg_id, "state": pg.state.value, "bundles": pg.bundles,
+                    "strategy": pg.strategy, "node_ids": pg.node_ids, "name": pg.name}
+
+    def _h_list_pgs(self, body):
+        with self._lock:
+            return [{"pg_id": p.pg_id, "state": p.state.value, "strategy": p.strategy,
+                     "bundles": p.bundles, "name": p.name} for p in self._pgs.values()]
+
+    # ---- scheduling loop ----------------------------------------------
+    def _scheduling_loop(self):
+        while not self._stopped.is_set():
+            try:
+                progressed = self._schedule_pending_pgs()
+                progressed |= self._schedule_pending_actors()
+            except Exception:
+                logger.exception("scheduling loop error")
+                progressed = False
+            if not progressed:
+                with self._wake:
+                    self._wake.wait(timeout=0.2)
+
+    def _alive_views(self) -> list[NodeView]:
+        with self._lock:
+            return [n.view for n in self._nodes.values() if n.view.alive]
+
+    def _schedule_pending_actors(self) -> bool:
+        """(ref: GcsActorManager::SchedulePendingActors gcs_actor_manager.h:198)"""
+        with self._lock:
+            if not self._pending_actors:
+                return False
+            pending, self._pending_actors = self._pending_actors, []
+        progressed = False
+        for aid in pending:
+            with self._lock:
+                info = self._actors.get(aid)
+                if info is None or info.state not in (ActorState.PENDING, ActorState.RESTARTING):
+                    continue
+            if self._try_schedule_actor(info):
+                progressed = True
+            else:
+                with self._lock:
+                    self._pending_actors.append(aid)
+        return progressed
+
+    def _try_schedule_actor(self, info: ActorInfo) -> bool:
+        """Lease a worker and push the creation task
+        (ref: GcsActorScheduler::LeaseWorkerFromNode gcs_actor_scheduler.h:256,
+        CreateActorOnWorker :316)."""
+        spec = info.spec
+        views = self._alive_views()
+        strategy = spec.strategy
+        pg_id = getattr(strategy, "pg_id", None)
+        resources = dict(spec.resources)
+        if pg_id is not None:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+            if pg is None or pg.state != PGState.CREATED:
+                return False
+            idx = getattr(strategy, "bundle_index", -1)
+            candidates = pg.node_ids if idx < 0 else [pg.node_ids[idx]]
+            views = [v for v in views if v.node_id in candidates]
+            lease_body = {"resources": resources, "pg_id": pg_id,
+                          "bundle_index": idx}
+        else:
+            lease_body = {"resources": resources}
+        node = pick_node(views, resources, strategy)
+        if node is None:
+            return False
+        cp_node = self._nodes.get(node.node_id)
+        try:
+            reply = self._pool.get(node.addr).call_with_retry(
+                "lease_worker", {**lease_body, "for_actor": info.actor_id},
+                timeout=get_config().lease_timeout_s)
+        except Exception as e:
+            logger.warning("lease for actor %s on node %s failed: %s",
+                           info.actor_id.hex()[:8], node.node_id.hex()[:8], e)
+            return False
+        if not reply.get("granted"):
+            return False
+        worker_addr = tuple(reply["worker_addr"])
+        with self._lock:
+            subtract(cp_node.view.available, resources)
+            info.node_id = node.node_id
+            info.worker_id = reply["worker_id"]
+        spec.attempt_number = info.num_restarts
+
+        def on_created(ok, result):
+            if ok and not result.get("error"):
+                with self._lock:
+                    info.state = ActorState.ALIVE
+                    info.addr = worker_addr
+                self._publish(f"actor:{info.actor_id.hex()}",
+                              {"state": "ALIVE", "addr": worker_addr})
+            else:
+                reason = str(result.get("error") if ok else result)
+                logger.warning("actor %s creation failed: %s",
+                               info.actor_id.hex()[:8], reason)
+                self._on_actor_down(info.actor_id, f"creation failed: {reason}",
+                                    clean=True, force_dead=True)
+            self._wake_scheduler()
+
+        try:
+            self._pool.get(worker_addr).call_async(
+                "push_task", {"spec": spec}, callback=on_created)
+        except Exception as e:
+            self._on_actor_down(info.actor_id, f"push failed: {e}", clean=False)
+            return False
+        return True
+
+    def _schedule_pending_pgs(self) -> bool:
+        with self._lock:
+            if not self._pending_pgs:
+                return False
+            pending, self._pending_pgs = self._pending_pgs, []
+        progressed = False
+        for pg_id in pending:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is None or pg.state != PGState.PENDING:
+                    continue
+            if self._try_schedule_pg(pg):
+                progressed = True
+            else:
+                with self._lock:
+                    self._pending_pgs.append(pg_id)
+        return progressed
+
+    def _try_schedule_pg(self, pg: PGInfo) -> bool:
+        """2-phase prepare/commit across node agents
+        (ref: gcs_placement_group_scheduler.cc; node_manager.proto:452-461)."""
+        views = self._alive_views()
+        if pg.strategy == "SLICE":
+            placement = place_slice_bundles(views, pg.bundles)
+        else:
+            placement = place_bundles(views, pg.bundles, pg.strategy)
+        if placement is None:
+            return False
+        by_node: dict[NodeID, list[tuple[int, dict]]] = {}
+        for i, (nid, b) in enumerate(zip(placement, pg.bundles)):
+            by_node.setdefault(nid, []).append((i, b))
+        prepared: list[NodeID] = []
+        ok = True
+        for nid, items in by_node.items():
+            node = self._nodes.get(nid)
+            try:
+                r = self._pool.get(node.view.addr).call_with_retry(
+                    "prepare_bundles", {"pg_id": pg.pg_id, "bundles": items}, timeout=10.0)
+                if not r.get("ok"):
+                    ok = False
+                    break
+                prepared.append(nid)
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for nid in prepared:
+                node = self._nodes.get(nid)
+                try:
+                    self._pool.get(node.view.addr).call_with_retry(
+                        "cancel_bundles", {"pg_id": pg.pg_id}, timeout=10.0)
+                except Exception:
+                    pass
+            return False
+        for nid in by_node:
+            node = self._nodes.get(nid)
+            try:
+                self._pool.get(node.view.addr).call_with_retry(
+                    "commit_bundles", {"pg_id": pg.pg_id}, timeout=10.0)
+            except Exception:
+                pass
+        with self._lock:
+            pg.node_ids = placement
+            pg.state = PGState.CREATED
+            for nid, items in by_node.items():
+                node = self._nodes.get(nid)
+                for _, b in items:
+                    subtract(node.view.available, b)
+        self._publish(f"pg:{pg.pg_id.hex()}", {"state": "CREATED"})
+        return True
+
+    # ---- health checks -------------------------------------------------
+    def _health_loop(self):
+        """(ref: gcs_health_check_manager.h:45)"""
+        cfg = get_config()
+        while not self._stopped.is_set():
+            time.sleep(cfg.health_check_period_s)
+            with self._lock:
+                nodes = list(self._nodes.values())
+            for node in nodes:
+                if not node.view.alive:
+                    continue
+                try:
+                    self._pool.get(node.view.addr).call(
+                        "ping", None, timeout=cfg.health_check_timeout_s)
+                    node.missed_health_checks = 0
+                except Exception:
+                    node.missed_health_checks += 1
+                    if node.missed_health_checks >= cfg.health_check_failure_threshold:
+                        self._on_node_dead(node.view.node_id, "health check failed")
+
+    def _on_node_dead(self, node_id: NodeID, reason: str):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.view.alive:
+                return
+            node.view.alive = False
+            victims = [i.actor_id for i in self._actors.values()
+                       if i.node_id == node_id and i.state == ActorState.ALIVE]
+        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        self._publish("node", {"event": "dead", "node_id": node_id})
+        for aid in victims:
+            self._on_actor_down(aid, f"node died: {reason}", clean=False)
+        self._wake_scheduler()
+
+    # ---- lifecycle ------------------------------------------------------
+    def _h_ping(self, body):
+        return {"ok": True}
+
+    def _h_shutdown(self, body):
+        threading.Thread(target=self.stop, daemon=True).start()
+        return {"ok": True}
+
+    def stop(self):
+        self._stopped.set()
+        self._wake_scheduler()
+        self._server.stop()
+        self._pool.close_all()

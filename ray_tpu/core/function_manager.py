@@ -1,0 +1,55 @@
+"""Function/actor-class export via the control-plane KV.
+
+TPU-native analog of the reference's function manager
+(/root/reference/python/ray/_private/function_manager.py): the driver exports
+cloudpickled functions/classes to the control plane's KV keyed by a content
+hash; executors fetch and cache them on first use.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+import cloudpickle
+
+
+class FunctionManager:
+    def __init__(self, runtime):
+        self._rt = runtime
+        self._cache: dict[str, object] = {}
+        self._exported: set[str] = set()
+        self._lock = threading.Lock()
+
+    def export(self, fn) -> str:
+        blob = cloudpickle.dumps(fn)
+        function_id = hashlib.sha1(blob).hexdigest()
+        with self._lock:
+            if function_id in self._exported:
+                return function_id
+        self._rt.cp_client.call_with_retry(
+            "kv_put", {"key": f"fn:{function_id}", "value": blob, "overwrite": False},
+            timeout=30.0)
+        with self._lock:
+            self._exported.add(function_id)
+            self._cache.setdefault(function_id, cloudpickle.loads(blob))
+        return function_id
+
+    def get(self, function_id: str, timeout: float = 30.0):
+        with self._lock:
+            fn = self._cache.get(function_id)
+        if fn is not None:
+            return fn
+        deadline = time.monotonic() + timeout
+        while True:
+            blob = self._rt.cp_client.call_with_retry(
+                "kv_get", {"key": f"fn:{function_id}"}, timeout=10.0)
+            if blob is not None:
+                fn = cloudpickle.loads(blob)
+                with self._lock:
+                    self._cache[function_id] = fn
+                return fn
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"function {function_id} not found in KV")
+            time.sleep(0.05)

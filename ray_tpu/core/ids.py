@@ -1,0 +1,157 @@
+"""Binary IDs with embedded lineage.
+
+TPU-native analog of the reference's ID scheme (/root/reference/src/ray/common/id.h):
+ObjectIDs embed the TaskID of the task that created them plus a return/put index,
+TaskIDs embed the ActorID (if any) and JobID, so ownership and lineage can be
+derived from an ID alone without a directory lookup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+JOB_ID_LEN = 4
+ACTOR_ID_LEN = 12  # unique part (8) + job (4)
+TASK_ID_LEN = 20   # unique part (8) + actor (12)
+OBJECT_ID_LEN = 24  # task (20) + index (4)
+NODE_ID_LEN = 16
+WORKER_ID_LEN = 16
+PG_ID_LEN = 16
+
+_NIL = b"\xff"
+
+
+class BaseID:
+    LEN = 0
+    __slots__ = ("_bin",)
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.LEN:
+            raise ValueError(f"{type(self).__name__} requires {self.LEN} bytes, got {len(binary)}")
+        self._bin = binary
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.LEN))
+
+    @classmethod
+    def nil(cls):
+        return cls(_NIL * cls.LEN)
+
+    def is_nil(self) -> bool:
+        return self._bin == _NIL * self.LEN
+
+    def binary(self) -> bytes:
+        return self._bin
+
+    def hex(self) -> str:
+        return self._bin.hex()
+
+    def __eq__(self, other) -> bool:
+        return type(other) is type(self) and other._bin == self._bin
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self._bin))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self._bin.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bin,))
+
+
+class JobID(BaseID):
+    LEN = JOB_ID_LEN
+    _counter = 0
+    _lock = threading.Lock()
+
+    @classmethod
+    def from_int(cls, i: int) -> "JobID":
+        return cls(i.to_bytes(JOB_ID_LEN, "little"))
+
+
+class NodeID(BaseID):
+    LEN = NODE_ID_LEN
+
+
+class WorkerID(BaseID):
+    LEN = WORKER_ID_LEN
+
+
+class PlacementGroupID(BaseID):
+    LEN = PG_ID_LEN
+
+
+class ActorID(BaseID):
+    LEN = ACTOR_ID_LEN
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(ACTOR_ID_LEN - JOB_ID_LEN) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bin[-JOB_ID_LEN:])
+
+
+class TaskID(BaseID):
+    LEN = TASK_ID_LEN
+
+    @classmethod
+    def for_task(cls, job_id: JobID, parent: "TaskID | None", counter: int) -> "TaskID":
+        """Deterministically derive a child task id from its parent + counter
+        (ref: id.h TaskID::ForNormalTask)."""
+        h = hashlib.sha1()
+        h.update(parent.binary() if parent else b"driver")
+        h.update(counter.to_bytes(8, "little"))
+        h.update(os.urandom(8))  # jobs may resubmit the same counter after restart
+        unique = h.digest()[: TASK_ID_LEN - ACTOR_ID_LEN]
+        return cls(unique + ActorID.nil().binary()[:-JOB_ID_LEN] + job_id.binary())
+
+    @classmethod
+    def for_actor_task(cls, job_id: JobID, actor_id: ActorID, counter: int) -> "TaskID":
+        h = hashlib.sha1()
+        h.update(actor_id.binary())
+        h.update(counter.to_bytes(8, "little"))
+        h.update(os.urandom(8))
+        unique = h.digest()[: TASK_ID_LEN - ACTOR_ID_LEN]
+        return cls(unique + actor_id.binary())
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        unique = b"\x00" * (TASK_ID_LEN - ACTOR_ID_LEN)
+        actor_part = b"\x01" * (ACTOR_ID_LEN - JOB_ID_LEN)
+        return cls(unique + actor_part + job_id.binary())
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bin[TASK_ID_LEN - ACTOR_ID_LEN:])
+
+    def job_id(self) -> JobID:
+        return JobID(self._bin[-JOB_ID_LEN:])
+
+
+class ObjectID(BaseID):
+    LEN = OBJECT_ID_LEN
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        """index >= 1 for returns (ref: id.h ObjectID::FromIndex)."""
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # puts use the high bit of the index to disambiguate from returns
+        return cls(task_id.binary() + (put_index | 0x80000000).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bin[:TASK_ID_LEN])
+
+    def index(self) -> int:
+        return int.from_bytes(self._bin[TASK_ID_LEN:], "little")
+
+    def is_put(self) -> bool:
+        return bool(self.index() & 0x80000000)
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()

@@ -1,0 +1,110 @@
+"""In-process memory store for small objects.
+
+TPU-native analog of the reference's CoreWorkerMemoryStore
+(/root/reference/src/ray/core_worker/store_provider/memory_store/): holds
+inline-returned small objects and location records for large (shared-memory)
+objects, with blocking waits for pending results.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ray_tpu.core.ids import NodeID, ObjectID
+from ray_tpu.core.serialization import SerializedObject
+
+
+@dataclass
+class ObjectEntry:
+    """Either an inline payload or a pointer to a shared-memory copy."""
+    inline: SerializedObject | None = None
+    # node(s) holding a sealed shm copy; primary first
+    locations: list[NodeID] = None
+    is_error: bool = False
+
+    def in_shm(self) -> bool:
+        return self.inline is None
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: dict[ObjectID, ObjectEntry] = {}
+        self._waiters: dict[ObjectID, list[threading.Event]] = {}
+        self._callbacks: dict[ObjectID, list[Callable[[ObjectEntry], None]]] = {}
+
+    def put_inline(self, object_id: ObjectID, sobj: SerializedObject, is_error: bool = False):
+        self._put(object_id, ObjectEntry(inline=sobj, is_error=is_error))
+
+    def put_location(self, object_id: ObjectID, node_id: NodeID):
+        with self._lock:
+            ent = self._objects.get(object_id)
+            if ent is not None and ent.locations is not None:
+                if node_id not in ent.locations:
+                    ent.locations.append(node_id)
+                return
+        self._put(object_id, ObjectEntry(inline=None, locations=[node_id]))
+
+    def _put(self, object_id: ObjectID, ent: ObjectEntry):
+        with self._lock:
+            self._objects[object_id] = ent
+            waiters = self._waiters.pop(object_id, [])
+            callbacks = self._callbacks.pop(object_id, [])
+        for ev in waiters:
+            ev.set()
+        for cb in callbacks:
+            cb(ent)
+
+    def get(self, object_id: ObjectID) -> ObjectEntry | None:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def wait_for(self, object_id: ObjectID, timeout: float | None = None) -> ObjectEntry | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            ent = self._objects.get(object_id)
+            if ent is not None:
+                return ent
+            ev = threading.Event()
+            self._waiters.setdefault(object_id, []).append(ev)
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        if not ev.wait(remaining):
+            with self._lock:
+                lst = self._waiters.get(object_id)
+                if lst and ev in lst:
+                    lst.remove(ev)
+            return self.get(object_id)
+        return self.get(object_id)
+
+    def on_available(self, object_id: ObjectID, cb: Callable[[ObjectEntry], None]):
+        with self._lock:
+            ent = self._objects.get(object_id)
+            if ent is None:
+                self._callbacks.setdefault(object_id, []).append(cb)
+                return
+        cb(ent)
+
+    def remove_location(self, object_id: ObjectID, node_id: NodeID) -> None:
+        """Drop a shm location record (object evicted/lost on that node)."""
+        with self._lock:
+            ent = self._objects.get(object_id)
+            if ent is not None and ent.locations and node_id in ent.locations:
+                ent.locations.remove(node_id)
+                if not ent.locations and ent.inline is None:
+                    # fully lost: remove so lineage reconstruction can re-create
+                    del self._objects[object_id]
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            self._objects.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)

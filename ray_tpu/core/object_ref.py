@@ -1,0 +1,95 @@
+"""ObjectRef — the distributed future handle.
+
+TPU-native analog of the reference's ObjectRef (/root/reference/python/ray/includes/
+object_ref.pxi and _raylet.pyx). Serializing a ref into a task argument or another
+object registers a borrow with the owner via the runtime's reference counter
+(ref: reference_count.cc borrowing protocol).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any
+
+from ray_tpu.core.ids import ObjectID, WorkerID
+
+if TYPE_CHECKING:
+    from concurrent.futures import Future
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "_owner_addr", "_skip_refcount", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: WorkerID | None = None,
+                 owner_addr: tuple[str, int] | None = None, *, _skip_refcount: bool = False):
+        self._id = object_id
+        self._owner = owner
+        self._owner_addr = owner_addr
+        self._skip_refcount = _skip_refcount
+        if not _skip_refcount:
+            _runtime_add_local_ref(self)
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    @property
+    def owner(self) -> WorkerID | None:
+        return self._owner
+
+    @property
+    def owner_addr(self) -> tuple[str, int] | None:
+        return self._owner_addr
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id.hex()})"
+
+    def __del__(self):
+        if not self._skip_refcount:
+            _runtime_remove_local_ref(self)
+
+    def future(self) -> "Future":
+        """Return a concurrent.futures.Future resolving to the value."""
+        from ray_tpu.core import api
+        return api._get_runtime().as_future(self)
+
+    def __await__(self):
+        import asyncio
+        return asyncio.wrap_future(self.future()).__await__()
+
+    def __reduce__(self):
+        # Plain pickling (outside the runtime's serializer) round-trips the
+        # identity without touching refcounts.
+        return (_deserialize_ref_plain, (self._id, self._owner, self._owner_addr))
+
+
+def _deserialize_ref_plain(object_id, owner, owner_addr):
+    return ObjectRef(object_id, owner, owner_addr, _skip_refcount=True)
+
+
+def _runtime_add_local_ref(ref: ObjectRef) -> None:
+    from ray_tpu.core import api
+    rt = api._try_get_runtime()
+    if rt is not None:
+        rt.reference_counter.add_local_ref(ref.id())
+
+
+def _runtime_remove_local_ref(ref: ObjectRef) -> None:
+    try:
+        from ray_tpu.core import api
+        rt = api._try_get_runtime()
+        if rt is not None:
+            rt.reference_counter.remove_local_ref(ref.id())
+    except Exception:
+        # interpreter shutdown or runtime already gone
+        pass

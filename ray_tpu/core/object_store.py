@@ -1,0 +1,228 @@
+"""Node-local shared-memory object store (plasma equivalent).
+
+TPU-native analog of the reference's plasma store
+(/root/reference/src/ray/object_manager/plasma/store.cc, plasma_allocator.cc,
+eviction_policy.cc): objects live in OS shared memory, readers map them
+zero-copy, the per-node agent owns lifecycle (create/seal/pin/evict/delete) with
+LRU eviction of unpinned sealed objects when capacity is exceeded.
+
+Two backends share the ShmStore interface:
+- this pure-python backend: one ``multiprocessing.shared_memory`` segment per
+  object (simple, portable);
+- the native C++ arena store in ``ray_tpu/_native`` (single mapped arena +
+  free-list allocator), used when built (config.use_native_object_store).
+
+TPU twist (SURVEY.md §7 phase 2): sealed objects carry a ``device_hint`` so a
+get on a TPU host can ``device_put`` straight from shm into HBM without an
+extra host copy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+@dataclass
+class _ObjMeta:
+    shm_name: str
+    size: int
+    sealed: bool = False
+    pinned: bool = True  # pinned on create until the owner unpins (ref: PinObjectIDs)
+    device_hint: str = ""
+    created_at: float = field(default_factory=time.monotonic)
+
+
+class ShmStore:
+    """Agent-side registry + allocator. All mutations go through the node agent's
+    RPC handlers; clients attach to segments by name for zero-copy reads."""
+
+    def __init__(self, capacity_bytes: int, prefix: str = "rtpu"):
+        self.capacity = capacity_bytes
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._objects: OrderedDict[ObjectID, _ObjMeta] = OrderedDict()  # LRU order
+        self._segments: dict[str, shared_memory.SharedMemory] = {}
+        self._used = 0
+        self.num_evicted = 0
+        self.on_evict = None  # callback(ObjectID) — notify owner of lost copy
+
+    # ---- lifecycle ----------------------------------------------------
+    def create(self, object_id: ObjectID, size: int, device_hint: str = "") -> str:
+        with self._lock:
+            if object_id in self._objects:
+                meta = self._objects[object_id]
+                return meta.shm_name
+            self._evict_until(size)
+            if self._used + size > self.capacity:
+                raise ObjectStoreFullError(
+                    f"object of {size} bytes does not fit: {self._used}/{self.capacity} used")
+            name = f"{self.prefix}_{object_id.hex()[:24]}"
+            seg = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+            self._segments[name] = seg
+            self._objects[object_id] = _ObjMeta(shm_name=name, size=size, device_hint=device_hint)
+            self._used += size
+            return name
+
+    def seal(self, object_id: ObjectID):
+        with self._lock:
+            meta = self._objects.get(object_id)
+            if meta is None:
+                raise KeyError(f"seal of unknown object {object_id}")
+            meta.sealed = True
+            self._objects.move_to_end(object_id)
+
+    def get_meta(self, object_id: ObjectID) -> tuple[str, int, str] | None:
+        with self._lock:
+            meta = self._objects.get(object_id)
+            if meta is None or not meta.sealed:
+                return None
+            self._objects.move_to_end(object_id)  # LRU touch
+            return (meta.shm_name, meta.size, meta.device_hint)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            m = self._objects.get(object_id)
+            return m is not None and m.sealed
+
+    def pin(self, object_id: ObjectID, pinned: bool = True):
+        """Owner pins primary copies while refs are live
+        (ref: node_manager.proto:479 PinObjectIDs)."""
+        with self._lock:
+            meta = self._objects.get(object_id)
+            if meta is not None:
+                meta.pinned = pinned
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            self._delete_locked(object_id)
+
+    def _delete_locked(self, object_id: ObjectID):
+        meta = self._objects.pop(object_id, None)
+        if meta is None:
+            return
+        seg = self._segments.pop(meta.shm_name, None)
+        self._used -= meta.size
+        if seg is not None:
+            try:
+                seg.close()
+                seg.unlink()
+            except Exception:
+                pass
+
+    def _evict_until(self, need: int):
+        """Evict unpinned sealed objects in LRU order (ref: eviction_policy.cc)."""
+        if self._used + need <= self.capacity:
+            return
+        victims = [oid for oid, m in self._objects.items() if m.sealed and not m.pinned]
+        for oid in victims:
+            if self._used + need <= self.capacity:
+                break
+            self._delete_locked(oid)
+            self.num_evicted += 1
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(oid)
+                except Exception:
+                    pass
+
+    def read_bytes(self, object_id: ObjectID, offset: int = 0,
+                   size: int | None = None) -> tuple[int, bytes] | None:
+        """Range copy-out for chunked cross-node transfer
+        (ref: object_manager ObjectBufferPool chunking). Returns
+        (total_size, chunk)."""
+        meta = self.get_meta(object_id)
+        if meta is None:
+            return None
+        seg = self._segments.get(meta[0])
+        if seg is None:
+            return None
+        total = meta[1]
+        end = total if size is None else min(total, offset + size)
+        return total, bytes(seg.buf[offset:end])
+
+    def write_bytes(self, object_id: ObjectID, data: bytes):
+        """Write a received remote copy (ref: object_manager.cc chunked push)."""
+        name = self.create(object_id, len(data))
+        seg = self._segments[name]
+        seg.buf[: len(data)] = data
+        self.seal(object_id)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "used_bytes": self._used,
+                "capacity_bytes": self.capacity,
+                "num_evicted": self.num_evicted,
+            }
+
+    def shutdown(self):
+        with self._lock:
+            for oid in list(self._objects):
+                self._delete_locked(oid)
+
+
+class _MappedSegment:
+    """Direct /dev/shm mmap attach. Unlike multiprocessing.SharedMemory this
+    never touches the resource tracker (we don't own the segment — the node
+    agent does) and tolerates still-exported buffer views at close (readers
+    may hold zero-copy numpy arrays into the mapping; the OS reclaims at
+    process exit — same lifetime model as plasma's client-side mappings,
+    plasma/client.cc)."""
+
+    def __init__(self, name: str):
+        import mmap
+        self.path = "/dev/shm/" + name.lstrip("/")
+        self._f = open(self.path, "r+b")
+        self.mm = mmap.mmap(self._f.fileno(), 0)
+        self._f.close()
+
+    def buf(self) -> memoryview:
+        return memoryview(self.mm)
+
+    def close(self):
+        try:
+            self.mm.close()
+        except BufferError:
+            pass  # zero-copy views still alive; leave mapping for process exit
+
+
+class ShmClient:
+    """Client-side zero-copy access to segments created by the agent-side store.
+    Mirrors the reference's plasma client (plasma/client.cc) minus fd-passing:
+    POSIX shm names stand in for the fds (fling.cc)."""
+
+    def __init__(self):
+        self._attached: dict[str, _MappedSegment] = {}
+        self._lock = threading.Lock()
+
+    def map(self, shm_name: str, size: int) -> memoryview:
+        with self._lock:
+            seg = self._attached.get(shm_name)
+            if seg is None:
+                seg = self._attached[shm_name] = _MappedSegment(shm_name)
+        return seg.buf()[:size]
+
+    def write(self, shm_name: str, size: int, writer) -> None:
+        """``writer(memoryview)`` fills the buffer."""
+        mv = self.map(shm_name, size)
+        writer(mv)
+
+    def release(self, shm_name: str):
+        with self._lock:
+            seg = self._attached.pop(shm_name, None)
+        if seg is not None:
+            seg.close()
+
+    def close(self):
+        with self._lock:
+            segs, self._attached = list(self._attached.values()), {}
+        for seg in segs:
+            seg.close()

@@ -1,0 +1,101 @@
+"""Placement groups — gang scheduling of resource bundles.
+
+TPU-native analog of the reference's placement group API
+(/root/reference/python/ray/util/placement_group.py:146; strategies :17-20),
+backed by the control plane's 2-phase prepare/commit scheduler
+(gcs_placement_group_scheduler.cc). Adds the "SLICE" strategy: atomic
+whole-TPU-slice acquisition, one bundle per slice host, the first-class
+replacement for the reference's TPU head-resource trick
+(_private/accelerators/tpu.py:145).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+from ray_tpu.core.ids import PlacementGroupID
+from ray_tpu.core.task_spec import PlacementGroupStrategy
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD", "SLICE")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: list[dict]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    def ready(self, timeout: float = 120.0) -> bool:
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        reply = rt.cp_client.call_with_retry(
+            "pg_ready", {"pg_id": self.id, "timeout": timeout}, timeout=timeout + 10)
+        return reply.get("state") == "CREATED"
+
+    def wait(self, timeout_seconds: float = 120.0) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def bundle_node_ids(self):
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        info = rt.cp_client.call_with_retry("get_pg", {"pg_id": self.id}, timeout=10.0)
+        return info["node_ids"] if info else []
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs))
+
+
+def placement_group(bundles: Sequence[dict], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    """(ref: util/placement_group.py:146)"""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be non-empty dicts of resources")
+    from ray_tpu.core import api
+    rt = api._get_runtime()
+    pg_id = PlacementGroupID.from_random()
+    rt.cp_client.call_with_retry(
+        "create_pg",
+        {"pg_id": pg_id, "bundles": [dict(b) for b in bundles],
+         "strategy": strategy, "name": name, "job_id": rt.job_id},
+        timeout=30.0)
+    return PlacementGroup(pg_id, [dict(b) for b in bundles])
+
+
+def tpu_slice_placement_group(pod_type: str, chips_per_host: int = 4,
+                              extra_cpu: float = 1.0) -> PlacementGroup:
+    """Gang-schedule a whole TPU slice: one bundle per slice host, placed
+    atomically on a single slice (SURVEY.md §7 phase 4 'slice bundle')."""
+    from ray_tpu.parallel.topology import slice_hosts
+    n_hosts = slice_hosts(pod_type)
+    bundles = [{"CPU": extra_cpu, "TPU": float(chips_per_host)} for _ in range(n_hosts)]
+    return placement_group(bundles, strategy="SLICE", name=f"slice-{pod_type}")
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu.core import api
+    rt = api._get_runtime()
+    rt.cp_client.call_with_retry("remove_pg", {"pg_id": pg.id}, timeout=30.0)
+
+
+def placement_group_table() -> list[dict]:
+    from ray_tpu.core import api
+    rt = api._get_runtime()
+    return rt.cp_client.call_with_retry("list_pgs", None, timeout=10.0)
+
+
+class PlacementGroupSchedulingStrategy(PlacementGroupStrategy):
+    """Convenience mirroring the reference's strategy object
+    (scheduling_strategies.py PlacementGroupSchedulingStrategy)."""
+
+    def __init__(self, placement_group: PlacementGroup,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        super().__init__(pg_id=placement_group.id,
+                         bundle_index=placement_group_bundle_index,
+                         capture_child_tasks=placement_group_capture_child_tasks)

@@ -1,0 +1,220 @@
+"""Distributed reference counting with ownership.
+
+TPU-native analog of the reference's ReferenceCounter
+(/root/reference/src/ray/core_worker/reference_count.cc): every object has a
+single owner (the process that created it); the owner's count is the authority
+for the object's lifetime. Counted sources:
+
+- the owner process's local python ``ObjectRef``s,
+- external borrows: any other process holding refs (registered by the *sender*
+  synchronously when a ref is serialized into a message, released by the holder
+  when its local count drops to zero — sender-side registration avoids the
+  inc-after-dec race of receiver-side registration),
+- task dependencies: in-flight tasks using the object as an arg,
+- containment: stored objects whose serialized payload embeds the ref
+  (ref: reference_count.cc nested-ref tracking).
+
+When the owner's total hits zero the on-zero callback fires: the object is
+dropped from the memory store, unpinned/deleted in shared-memory stores, and its
+lineage entry is released (ref: task_manager.cc lineage pinning).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ray_tpu.core.ids import ObjectID
+
+
+@dataclass
+class _Count:
+    local: int = 0
+    borrows: int = 0
+    deps: int = 0
+    contained_in: int = 0
+    deleted: bool = False
+
+    def total(self) -> int:
+        return self.local + self.borrows + self.deps + self.contained_in
+
+
+class ReferenceCounter:
+    def __init__(self, runtime):
+        self._rt = runtime
+        self._lock = threading.RLock()
+        # objects owned by this process
+        self._owned: dict[ObjectID, _Count] = {}
+        # contained refs held alive by an owned stored object
+        self._containing: dict[ObjectID, list] = {}
+        # borrowed (non-owned) refs: local count + owner address for release
+        self._borrowed: dict[ObjectID, list] = {}  # oid -> [count, owner_addr]
+        self._on_zero: Callable[[ObjectID], None] | None = None
+
+    def set_on_zero(self, cb: Callable[[ObjectID], None]):
+        self._on_zero = cb
+
+    # ---- ownership registration --------------------------------------
+    def add_owned(self, object_id: ObjectID, contained_refs=None):
+        with self._lock:
+            c = self._owned.setdefault(object_id, _Count())
+            if contained_refs:
+                self._containing[object_id] = list(contained_refs)
+                for ref in contained_refs:
+                    self._inc_any(ref, "contained_in")
+
+    def is_owned(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._owned
+
+    # ---- local python refs -------------------------------------------
+    def add_local_ref(self, object_id: ObjectID):
+        with self._lock:
+            c = self._owned.get(object_id)
+            if c is not None:
+                c.local += 1
+                return
+            ent = self._borrowed.get(object_id)
+            if ent is not None:
+                ent[0] += 1
+            else:
+                self._borrowed[object_id] = [1, None]
+
+    def remove_local_ref(self, object_id: ObjectID):
+        release_owner = None
+        with self._lock:
+            c = self._owned.get(object_id)
+            if c is not None:
+                c.local -= 1
+                self._maybe_zero(object_id, c)
+                return
+            ent = self._borrowed.get(object_id)
+            if ent is None:
+                return
+            ent[0] -= 1
+            if ent[0] <= 0:
+                self._borrowed.pop(object_id, None)
+                release_owner = ent[1]
+        if release_owner is not None:
+            self._notify_owner_dec(object_id, release_owner)
+
+    def on_ref_deserialized(self, ref):
+        """Record the owner address for later borrow release. The borrow count
+        itself was registered by the sender."""
+        with self._lock:
+            if ref.id() in self._owned:
+                # we own it; the sender's borrow-inc on our behalf is dropped
+                # when our local count (incremented by ObjectRef ctor) drops.
+                return
+            ent = self._borrowed.get(ref.id())
+            if ent is not None:
+                ent[1] = ref.owner_addr
+
+    # ---- borrows (cross-process) -------------------------------------
+    def add_borrow_on_serialize(self, ref):
+        """Sender-side: register a borrow with the owner before the message
+        carrying the ref leaves this process."""
+        oid = ref.id()
+        with self._lock:
+            c = self._owned.get(oid)
+            if c is not None:
+                c.borrows += 1
+                return
+        self._call_owner(oid, ref.owner_addr, "inc_borrow")
+
+    def inc_borrow(self, object_id: ObjectID):
+        """Owner-side RPC handler."""
+        with self._lock:
+            c = self._owned.setdefault(object_id, _Count())
+            c.borrows += 1
+
+    def dec_borrow(self, object_id: ObjectID):
+        with self._lock:
+            c = self._owned.get(object_id)
+            if c is None:
+                return
+            c.borrows -= 1
+            self._maybe_zero(object_id, c)
+
+    def release_borrow_after_send(self, ref):
+        """Sender-side: after handing a ref to another process, the recipient now
+        holds the borrow we registered; if we registered it for an object we own,
+        drop the temporary count once the recipient confirms (v1: recipient's
+        ObjectRef ctor + our dec make the handoff net-zero, so nothing to do)."""
+
+    # ---- task deps ----------------------------------------------------
+    def add_task_dep(self, object_id: ObjectID, owner_addr=None):
+        with self._lock:
+            c = self._owned.get(object_id)
+            if c is not None:
+                c.deps += 1
+                return
+        self._call_owner(object_id, owner_addr, "inc_borrow")
+        with self._lock:
+            self._borrowed.setdefault(object_id, [0, owner_addr])
+
+    def remove_task_dep(self, object_id: ObjectID, owner_addr=None):
+        with self._lock:
+            c = self._owned.get(object_id)
+            if c is not None:
+                c.deps -= 1
+                self._maybe_zero(object_id, c)
+                return
+        if owner_addr is not None:
+            self._notify_owner_dec(object_id, owner_addr)
+
+    # ---- internals -----------------------------------------------------
+    def _inc_any(self, ref, kind: str):
+        oid = ref.id() if hasattr(ref, "id") else ref
+        c = self._owned.get(oid)
+        if c is not None:
+            setattr(c, kind, getattr(c, kind) + 1)
+
+    def _maybe_zero(self, object_id: ObjectID, c: _Count):
+        if c.total() <= 0 and not c.deleted:
+            c.deleted = True
+            self._owned.pop(object_id, None)
+            contained = self._containing.pop(object_id, [])
+            cb = self._on_zero
+            if cb is not None:
+                try:
+                    cb(object_id)
+                except Exception:
+                    pass
+            for ref in contained:
+                with self._lock:
+                    cc = self._owned.get(ref.id())
+                    if cc is not None:
+                        cc.contained_in -= 1
+                        self._maybe_zero(ref.id(), cc)
+                        continue
+                if ref.owner_addr is not None:
+                    self._notify_owner_dec(ref.id(), ref.owner_addr)
+
+    def _call_owner(self, object_id: ObjectID, owner_addr, method: str):
+        if owner_addr is None or self._rt is None:
+            return
+        try:
+            self._rt.peer_pool.get(owner_addr).call_with_retry(
+                method, object_id, timeout=10.0)
+        except Exception:
+            pass
+
+    def _notify_owner_dec(self, object_id: ObjectID, owner_addr):
+        if owner_addr is None or self._rt is None:
+            return
+        try:
+            self._rt.peer_pool.get(owner_addr).notify("dec_borrow", object_id)
+        except Exception:
+            pass
+
+    # ---- introspection -------------------------------------------------
+    def owned_count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            c = self._owned.get(object_id)
+            return c.total() if c else 0
+
+    def num_owned(self) -> int:
+        with self._lock:
+            return len(self._owned)

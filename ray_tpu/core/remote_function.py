@@ -1,0 +1,90 @@
+"""@remote functions.
+
+TPU-native analog of the reference's RemoteFunction
+(/root/reference/python/ray/remote_function.py:41, _remote at :314).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+from ray_tpu.core.task_spec import (
+    DefaultStrategy,
+    NodeAffinityStrategy,
+    NodeLabelStrategy,
+    PlacementGroupStrategy,
+    SpreadStrategy,
+)
+
+_DEFAULT_RESOURCES = {"CPU": 1.0}
+
+
+def _build_strategy(options: dict):
+    strategy = options.get("scheduling_strategy")
+    if strategy is None:
+        return DefaultStrategy()
+    if isinstance(strategy, str):
+        if strategy == "SPREAD":
+            return SpreadStrategy()
+        if strategy == "DEFAULT":
+            return DefaultStrategy()
+        raise ValueError(f"unknown scheduling strategy {strategy!r}")
+    if isinstance(strategy, (DefaultStrategy, SpreadStrategy, NodeAffinityStrategy,
+                             NodeLabelStrategy, PlacementGroupStrategy)):
+        return strategy
+    # placement group objects
+    from ray_tpu.core.placement_group import PlacementGroup
+    if isinstance(strategy, PlacementGroup):
+        return PlacementGroupStrategy(pg_id=strategy.id, bundle_index=-1)
+    raise TypeError(f"bad scheduling_strategy: {strategy!r}")
+
+
+def _build_resources(options: dict) -> dict[str, float]:
+    resources = dict(options.get("resources") or {})
+    if "num_cpus" in options and options["num_cpus"] is not None:
+        resources["CPU"] = float(options["num_cpus"])
+    if "num_tpus" in options and options["num_tpus"] is not None:
+        resources["TPU"] = float(options["num_tpus"])
+    if "num_gpus" in options and options["num_gpus"] is not None:
+        resources["GPU"] = float(options["num_gpus"])
+    if "memory" in options and options["memory"] is not None:
+        resources["memory"] = float(options["memory"])
+    if "CPU" not in resources:
+        resources["CPU"] = 1.0
+    return resources
+
+
+class RemoteFunction:
+    def __init__(self, fn: Callable, **options):
+        self._fn = fn
+        self._options = options
+        functools.update_wrapper(self, fn)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = {**self._options, **options}
+        return RemoteFunction(self._fn, **merged)
+
+    def _remote(self, args, kwargs, options) -> Any:
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        num_returns = options.get("num_returns", 1)
+        refs = rt.submit_task(
+            self._fn, args, kwargs,
+            num_returns=num_returns,
+            resources=_build_resources(options),
+            strategy=_build_strategy(options),
+            max_retries=options.get("max_retries"),
+            retry_exceptions=bool(options.get("retry_exceptions", False)),
+            name=options.get("name", "") or self._fn.__name__)
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._fn.__name__}' cannot be called directly; "
+            f"use '{self._fn.__name__}.remote()'.")

@@ -1,0 +1,367 @@
+"""RPC transport: multiplexed, length-prefixed frames over TCP.
+
+TPU-native analog of the reference's RPC layer (/root/reference/src/ray/rpc/ —
+GrpcServer/ClientCall/RetryableGrpcClient). Control-plane messages are small and
+latency-sensitive; data moves through the shared-memory object store, not RPC.
+Includes deterministic fault injection for tests, mirroring rpc_chaos.cc
+(ray_config_def.h:842-849).
+
+Frame format: [u32 len][u8 kind][payload] where payload is
+pickle((msg_id, method, body)) for requests and pickle((msg_id, ok, body)) for
+responses. kind: 0=request 1=response 2=oneway.
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable
+
+from ray_tpu.core.config import get_config
+
+_REQ, _RESP, _ONEWAY = 0, 1, 2
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class _Chaos:
+    """Deterministic RPC fault injection (ref: rpc_chaos.h:13-19)."""
+
+    def __init__(self, spec: str):
+        self.rules: dict[str, tuple[float, float]] = {}
+        self.rng = random.Random(0xC0FFEE)
+        for item in filter(None, (spec or "").split(",")):
+            parts = item.split(":")
+            self.rules[parts[0]] = (float(parts[1]), float(parts[2]) if len(parts) > 2 else 0.0)
+
+    def drop_request(self, method: str) -> bool:
+        r = self.rules.get(method) or self.rules.get("*")
+        return bool(r) and self.rng.random() < r[0]
+
+    def drop_response(self, method: str) -> bool:
+        r = self.rules.get(method) or self.rules.get("*")
+        return bool(r) and self.rng.random() < r[1]
+
+
+def _chaos() -> _Chaos:
+    global _chaos_inst
+    spec = get_config().testing_rpc_failure
+    if _chaos_inst is None or _chaos_inst_spec != spec:
+        _set_chaos(spec)
+    return _chaos_inst
+
+
+_chaos_inst: _Chaos | None = None
+_chaos_inst_spec: str | None = None
+
+
+def _set_chaos(spec: str):
+    global _chaos_inst, _chaos_inst_spec
+    _chaos_inst = _Chaos(spec)
+    _chaos_inst_spec = spec
+
+
+def _send_frame(sock: socket.socket, kind: int, payload: bytes, lock: threading.Lock):
+    header = struct.pack("<IB", len(payload) + 1, kind)
+    with lock:
+        sock.sendall(header + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionLost("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[int, bytes]:
+    hdr = _recv_exact(sock, 5)
+    ln, kind = struct.unpack("<IB", hdr)
+    return kind, _recv_exact(sock, ln - 1)
+
+
+class RpcServer:
+    """Threaded RPC server. ``handler(method, body, peer)`` returns the response
+    body or raises; the exception is pickled back to the caller."""
+
+    def __init__(self, handler: Callable[[str, Any, tuple], Any], host: str = "127.0.0.1",
+                 port: int = 0, name: str = "rpc", blocking_methods: set[str] | None = None,
+                 pool_size: int = 8):
+        from concurrent.futures import ThreadPoolExecutor
+        self._handler = handler
+        self._name = name
+        # Non-blocking handlers run on a bounded pool; handlers that may block
+        # for long (waits, long-polls) get a dedicated thread each so they
+        # cannot starve the pool (ref: server_call.h io-service separation).
+        self._blocking = blocking_methods or set()
+        self._pool = ThreadPoolExecutor(max_workers=pool_size, thread_name_prefix=f"{name}-h")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(256)
+        self.addr: tuple[str, int] = self._sock.getsockname()
+        self._stopped = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{name}-accept", daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, peer = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._conn_loop, args=(conn, peer),
+                             name=f"{self._name}-conn", daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket, peer):
+        wlock = threading.Lock()
+        try:
+            while not self._stopped.is_set():
+                kind, payload = _recv_frame(conn)
+                msg_id, method, body = pickle.loads(payload)
+                if _chaos().drop_request(method):
+                    continue
+                if method in self._blocking:
+                    threading.Thread(
+                        target=self._dispatch,
+                        args=(conn, wlock, kind, msg_id, method, body, peer),
+                        name=f"{self._name}-h-{method}", daemon=True).start()
+                else:
+                    self._pool.submit(
+                        self._dispatch, conn, wlock, kind, msg_id, method, body, peer)
+        except (ConnectionLost, OSError):
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, wlock, kind, msg_id, method, body, peer):
+        try:
+            result, ok = self._handler(method, body, peer), True
+        except BaseException as e:  # noqa: BLE001 — errors propagate to caller
+            result, ok = e, False
+        if kind == _ONEWAY:
+            return
+        if _chaos().drop_response(method):
+            return
+        try:
+            payload = pickle.dumps((msg_id, ok, result))
+        except Exception as e:
+            payload = pickle.dumps((msg_id, False, RpcError(f"unpicklable response: {e}")))
+        try:
+            _send_frame(conn, _RESP, payload, wlock)
+        except OSError:
+            pass
+
+    def stop(self):
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            for c in list(self._conns):
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+
+class RpcClient:
+    """Persistent multiplexed client with reconnect + retry
+    (ref: retryable_grpc_client.cc)."""
+
+    def __init__(self, addr: tuple[str, int], name: str = "rpc-client"):
+        self.addr = tuple(addr)
+        self._name = name
+        self._sock: socket.socket | None = None
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._pending: dict[int, list] = {}  # msg_id -> [event, ok, body]
+        self._next_id = 0
+        self._closed = False
+
+    def _ensure_conn(self) -> socket.socket:
+        """Returns the live socket (never read self._sock without the lock —
+        the reader thread nulls it on connection loss)."""
+        with self._lock:
+            if self._sock is not None:
+                return self._sock
+            if self._closed:
+                raise ConnectionLost("client closed")
+            cfg = get_config()
+            deadline = time.monotonic() + cfg.rpc_connect_timeout_s
+            last = None
+            while time.monotonic() < deadline:
+                try:
+                    s = socket.create_connection(self.addr, timeout=cfg.rpc_connect_timeout_s)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(None)
+                    self._sock = s
+                    threading.Thread(target=self._read_loop, args=(s,),
+                                     name=f"{self._name}-read", daemon=True).start()
+                    return s
+                except OSError as e:
+                    last = e
+                    time.sleep(0.05)
+            raise ConnectionLost(f"cannot connect to {self.addr}: {last}")
+
+    def _read_loop(self, sock: socket.socket):
+        try:
+            while True:
+                _, payload = _recv_frame(sock)
+                msg_id, ok, body = pickle.loads(payload)
+                with self._lock:
+                    ent = self._pending.pop(msg_id, None)
+                if ent is None:
+                    continue
+                if callable(ent[0]):
+                    try:
+                        ent[0](ok, body)
+                    except Exception:
+                        pass
+                else:
+                    ent[1], ent[2] = ok, body
+                    ent[0].set()
+        except (ConnectionLost, OSError, EOFError):
+            with self._lock:
+                if self._sock is sock:
+                    self._sock = None
+                pending, self._pending = list(self._pending.values()), {}
+            err = ConnectionLost(f"connection to {self.addr} lost")
+            for ent in pending:
+                if callable(ent[0]):
+                    try:
+                        ent[0](False, err)
+                    except Exception:
+                        pass
+                elif not ent[0].is_set():
+                    ent[1], ent[2] = False, err
+                    ent[0].set()
+
+    def call(self, method: str, body: Any = None, timeout: float | None = None) -> Any:
+        ev = threading.Event()
+        with self._lock:
+            self._next_id += 1
+            msg_id = self._next_id
+            self._pending[msg_id] = ent = [ev, None, None]
+        try:
+            sock = self._ensure_conn()
+            try:
+                _send_frame(sock, _REQ, pickle.dumps((msg_id, method, body)), self._wlock)
+            except OSError as e:
+                raise ConnectionLost(f"send to {self.addr} failed: {e}") from e
+            if not ev.wait(timeout):
+                raise TimeoutError(f"rpc {method} to {self.addr} timed out after {timeout}s")
+            ok, result = ent[1], ent[2]
+        finally:
+            with self._lock:
+                self._pending.pop(msg_id, None)
+        if not ok:
+            raise result
+        return result
+
+    def call_async(self, method: str, body: Any = None,
+                   callback: Callable[[bool, Any], None] | None = None):
+        """Fire a request; ``callback(ok, body)`` runs on the reader thread when
+        the response arrives (ref: client_call.h async ClientCall). Keep
+        callbacks short — heavy work must hop to another thread."""
+        with self._lock:
+            self._next_id += 1
+            msg_id = self._next_id
+            if callback is not None:
+                self._pending[msg_id] = [callback, None, None]
+        try:
+            sock = self._ensure_conn()
+            _send_frame(sock, _REQ if callback else _ONEWAY,
+                        pickle.dumps((msg_id, method, body)), self._wlock)
+        except Exception as e:
+            with self._lock:
+                self._pending.pop(msg_id, None)
+            if callback is not None:
+                callback(False, e)
+
+    def call_with_retry(self, method: str, body: Any = None, timeout: float | None = None,
+                        retries: int | None = None) -> Any:
+        retries = get_config().rpc_retries if retries is None else retries
+        last: Exception | None = None
+        for attempt in range(retries + 1):
+            try:
+                return self.call(method, body, timeout)
+            except (ConnectionLost, TimeoutError) as e:
+                last = e
+                time.sleep(min(0.1 * 2 ** attempt, 1.0))
+        raise last  # type: ignore[misc]
+
+    def notify(self, method: str, body: Any = None):
+        with self._lock:
+            self._next_id += 1
+            msg_id = self._next_id
+        sock = self._ensure_conn()
+        try:
+            _send_frame(sock, _ONEWAY, pickle.dumps((msg_id, method, body)), self._wlock)
+        except OSError as e:
+            raise ConnectionLost(f"send to {self.addr} failed: {e}") from e
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class ClientPool:
+    """Cached RpcClients keyed by address."""
+
+    def __init__(self, name: str = "pool"):
+        self._name = name
+        self._clients: dict[tuple[str, int], RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, addr: tuple[str, int]) -> RpcClient:
+        addr = tuple(addr)
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = self._clients[addr] = RpcClient(addr, name=f"{self._name}-{addr[1]}")
+            return c
+
+    def invalidate(self, addr: tuple[str, int]):
+        with self._lock:
+            c = self._clients.pop(tuple(addr), None)
+        if c is not None:
+            c.close()
+
+    def close_all(self):
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()

@@ -1,0 +1,246 @@
+"""Scheduling policies: resource fitting, hybrid node scoring, PG bundle packing.
+
+TPU-native analog of the reference's scheduling policies
+(/root/reference/src/ray/raylet/scheduling/policy/): the hybrid policy
+(hybrid_scheduling_policy.cc) prefers the local node until utilization crosses a
+threshold, then packs by score; spread/affinity/label policies mirror
+scheduling_strategies.py. PG bundle placement mirrors
+bundle_scheduling_policy.cc (PACK/SPREAD/STRICT_PACK/STRICT_SPREAD).
+
+TPU-first addition (SURVEY.md §7 phase 4): node labels carry slice topology
+({"slice_name", "tpu_worker_id", "pod_type", "topology"}) and scoring penalizes
+ICI distance — same slice beats same pod beats cross-DCN — so gang placement
+rides the ICI mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import NodeID
+from ray_tpu.core.task_spec import (
+    DefaultStrategy,
+    NodeAffinityStrategy,
+    NodeLabelStrategy,
+    SchedulingStrategy,
+    SpreadStrategy,
+)
+
+# ---- resource sets ------------------------------------------------------
+
+
+def fits(avail: dict[str, float], req: dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items() if v > 0)
+
+
+def subtract(avail: dict[str, float], req: dict[str, float]) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def add(avail: dict[str, float], req: dict[str, float]) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+@dataclass
+class NodeView:
+    """Scheduler's view of one node (ref: ClusterResourceManager node view)."""
+    node_id: NodeID
+    addr: tuple[str, int]
+    total: dict[str, float]
+    available: dict[str, float]
+    labels: dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+
+    def utilization(self) -> float:
+        utils = []
+        for k, tot in self.total.items():
+            if tot > 0:
+                utils.append(1.0 - self.available.get(k, 0.0) / tot)
+        return max(utils) if utils else 0.0
+
+
+def _ici_distance(a_labels: dict[str, str], b_labels: dict[str, str]) -> float:
+    """0 = same slice (pure ICI), 0.5 = same pod type (fast DCN), 1 = far."""
+    if not a_labels or not b_labels:
+        return 1.0
+    if a_labels.get("slice_name") and a_labels.get("slice_name") == b_labels.get("slice_name"):
+        return 0.0
+    if a_labels.get("pod_type") and a_labels.get("pod_type") == b_labels.get("pod_type"):
+        return 0.5
+    return 1.0
+
+
+def _match_labels(labels: dict[str, str], constraints: dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in constraints.items())
+
+
+def pick_node(
+    nodes: Iterable[NodeView],
+    resources: dict[str, float],
+    strategy: SchedulingStrategy | None = None,
+    local_node_id: NodeID | None = None,
+    affinity_labels: dict[str, str] | None = None,
+) -> NodeView | None:
+    """Pick the best feasible node, or None if infeasible right now."""
+    cfg = get_config()
+    strategy = strategy or DefaultStrategy()
+    feasible = [n for n in nodes if n.alive and fits(n.available, resources)]
+
+    if isinstance(strategy, NodeAffinityStrategy):
+        for n in feasible:
+            if n.node_id.hex() == strategy.node_id_hex:
+                return n
+        if strategy.soft:
+            feasible2 = feasible
+        else:
+            return None
+        feasible = feasible2
+
+    if isinstance(strategy, NodeLabelStrategy):
+        hard = [n for n in feasible if _match_labels(n.labels, strategy.hard)]
+        if not hard:
+            return None
+        soft = [n for n in hard if _match_labels(n.labels, strategy.soft)]
+        feasible = soft or hard
+
+    if not feasible:
+        return None
+
+    if isinstance(strategy, SpreadStrategy):
+        # spread_scheduling_policy.cc: least-utilized first
+        return min(feasible, key=lambda n: (n.utilization(), n.node_id.hex()))
+
+    # hybrid: local first while under threshold, then best-scored
+    if local_node_id is not None:
+        for n in feasible:
+            if n.node_id == local_node_id and n.utilization() < cfg.hybrid_threshold:
+                return n
+
+    def score(n: NodeView) -> tuple:
+        ici = _ici_distance(affinity_labels or {}, n.labels) if affinity_labels else 0.0
+        return (n.utilization() + cfg.ici_distance_weight * ici, n.node_id.hex())
+
+    return min(feasible, key=score)
+
+
+# ---- placement group bundle placement ----------------------------------
+
+
+def place_bundles(
+    nodes: list[NodeView],
+    bundles: list[dict[str, float]],
+    strategy: str,
+) -> list[NodeID] | None:
+    """Return one NodeID per bundle, or None if infeasible
+    (ref: bundle_scheduling_policy.cc). For TPU gang bundles the STRICT_SPREAD
+    + slice-label path places one bundle per slice host atomically
+    (generalizing the head-resource trick of _private/accelerators/tpu.py:145)."""
+    avail = {n.node_id: dict(n.available) for n in nodes if n.alive}
+    order = sorted((n for n in nodes if n.alive),
+                   key=lambda n: (n.utilization(), n.node_id.hex()))
+
+    def try_strict_pack() -> list[NodeID] | None:
+        for n in order:
+            a = dict(avail[n.node_id])
+            if all(_take(a, b) for b in bundles):
+                return [n.node_id] * len(bundles)
+        return None
+
+    def _take(a: dict[str, float], req: dict[str, float]) -> bool:
+        if not fits(a, req):
+            return False
+        subtract(a, req)
+        return True
+
+    if strategy == "STRICT_PACK":
+        return try_strict_pack()
+
+    if strategy == "STRICT_SPREAD":
+        placed: list[NodeID] = []
+        used: set[NodeID] = set()
+        for b in bundles:
+            found = None
+            for n in order:
+                if n.node_id in used:
+                    continue
+                if fits(avail[n.node_id], b):
+                    found = n.node_id
+                    break
+            if found is None:
+                return None
+            subtract(avail[found], b)
+            used.add(found)
+            placed.append(found)
+        return placed
+
+    if strategy == "SPREAD":
+        placed = []
+        rr = list(order)
+        for i, b in enumerate(bundles):
+            found = None
+            # best-effort distinct nodes, round-robin over least utilized
+            for n in rr[i % len(rr):] + rr[: i % len(rr)]:
+                if fits(avail[n.node_id], b):
+                    found = n.node_id
+                    break
+            if found is None:
+                return None
+            subtract(avail[found], b)
+            placed.append(found)
+        return placed
+
+    # PACK (default): prefer one node, fall back to fewest nodes greedily
+    res = try_strict_pack()
+    if res is not None:
+        return res
+    placed = []
+    for b in bundles:
+        found = None
+        # prefer nodes already used
+        for nid in placed:
+            if fits(avail[nid], b):
+                found = nid
+                break
+        if found is None:
+            for n in order:
+                if fits(avail[n.node_id], b):
+                    found = n.node_id
+                    break
+        if found is None:
+            return None
+        subtract(avail[found], b)
+        placed.append(found)
+    return placed
+
+
+def place_slice_bundles(
+    nodes: list[NodeView], bundles: list[dict[str, float]]
+) -> list[NodeID] | None:
+    """Atomic whole-slice placement: all bundles must land on hosts of ONE TPU
+    slice, one bundle per slice worker ordered by tpu_worker_id (SURVEY.md §7
+    phase 4 'slice bundle'; replaces the reference's TPU-{pod}-head resource
+    trick, tpu.py:145)."""
+    slices: dict[str, list[NodeView]] = {}
+    for n in nodes:
+        if n.alive and n.labels.get("slice_name"):
+            slices.setdefault(n.labels["slice_name"], []).append(n)
+    for _, members in sorted(slices.items()):
+        members.sort(key=lambda n: int(n.labels.get("tpu_worker_id", "0")))
+        if len(members) < len(bundles):
+            continue
+        avail = {n.node_id: dict(n.available) for n in members}
+        placed = []
+        ok = True
+        for b, n in zip(bundles, members):
+            if not fits(avail[n.node_id], b):
+                ok = False
+                break
+            subtract(avail[n.node_id], b)
+            placed.append(n.node_id)
+        if ok:
+            return placed
+    return None

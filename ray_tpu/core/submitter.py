@@ -1,0 +1,419 @@
+"""Caller-side task submission pipelines.
+
+TPU-native analog of the reference's task submission layer
+(/root/reference/src/ray/core_worker/task_submission/):
+
+- ``NormalTaskSubmitter`` (normal_task_submitter.h:82): lease workers from the
+  node agent, push tasks caller→executor directly (the agent is not on the data
+  path), cache granted leases and reuse idle workers for queued tasks of the
+  same shape (OnWorkerIdle, normal_task_submitter.cc:139), handle spillback
+  redirects, and retry on worker failure.
+- ``ActorTaskSubmitter`` (actor_task_submitter.cc): per-actor ordered pipeline —
+  sequence numbers assigned at submit, sends over one TCP connection preserve
+  order (sequential_actor_submit_queue.cc), pending tasks resubmitted on actor
+  restart or failed with ActorDiedError on death (SendPendingTasks :223,339).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.task_spec import DefaultStrategy, TaskSpec
+from ray_tpu.exceptions import ActorDiedError, TaskError, WorkerCrashedError
+
+logger = logging.getLogger(__name__)
+
+
+def _shape_key(spec: TaskSpec):
+    """Tasks are queued per (resources, strategy) shape so a cached lease only
+    serves tasks with identical placement constraints."""
+    pg = getattr(spec.strategy, "pg_id", None)
+    idx = getattr(spec.strategy, "bundle_index", -1)
+    s = spec.strategy
+    strat_key: tuple = (type(s).__name__,)
+    if hasattr(s, "node_id_hex"):
+        strat_key += (s.node_id_hex, s.soft)
+    if hasattr(s, "hard"):
+        strat_key += (frozenset(s.hard.items()), frozenset(s.soft.items()))
+    return (frozenset(spec.resources.items()), pg, idx, strat_key)
+
+
+@dataclass
+class _Lease:
+    lease_id: str
+    agent_addr: tuple
+    worker_addr: tuple
+    worker_id: object
+
+
+@dataclass
+class _ShapeState:
+    queue: deque = field(default_factory=deque)
+    idle: list = field(default_factory=list)      # list[_Lease]
+    busy: dict = field(default_factory=dict)       # worker_addr -> _Lease
+    requests_in_flight: int = 0
+    strategy: object = None
+
+
+class NormalTaskSubmitter:
+    MAX_LEASES_PER_SHAPE = 16
+
+    def __init__(self, runtime):
+        self._rt = runtime
+        self._lock = threading.Lock()
+        self._shapes: dict[object, _ShapeState] = {}
+        self._lease_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="lease")
+
+    def submit(self, spec: TaskSpec):
+        key = _shape_key(spec)
+        with self._lock:
+            st = self._shapes.setdefault(key, _ShapeState())
+            st.strategy = spec.strategy
+            st.queue.append(spec)
+        self._pump(key)
+
+    def _pump(self, key):
+        """Dispatch queued tasks onto idle leases; request more leases if the
+        queue is still non-empty."""
+        to_push = []
+        request_lease = False
+        with self._lock:
+            st = self._shapes.get(key)
+            if st is None:
+                return
+            while st.queue and st.idle:
+                lease = st.idle.pop()
+                spec = st.queue.popleft()
+                st.busy[lease.worker_addr] = lease
+                to_push.append((lease, spec))
+            want = min(len(st.queue), self.MAX_LEASES_PER_SHAPE
+                       - len(st.busy) - len(st.idle) - st.requests_in_flight)
+            if want > 0:
+                st.requests_in_flight += 1
+                request_lease = True
+        for lease, spec in to_push:
+            self._push(key, lease, spec)
+        if request_lease:
+            self._lease_pool.submit(self._request_lease, key)
+
+    def _request_lease(self, key):
+        resources, pg_id, bundle_index = dict(key[0]), key[1], key[2]
+        agent_addr = self._rt.agent_addr
+        cfg = get_config()
+        granted = None
+        with self._lock:
+            st0 = self._shapes.get(key)
+            strategy = st0.strategy if st0 else None
+        max_hops = 4
+        try:
+            if pg_id is not None:
+                # PG bundles live on specific nodes; lease at the agent holding
+                # the (committed) bundle (ref: the raylet lease request carries
+                # the bundle id and the GCS placed it, bundle_spec.h)
+                agent_addr = self._resolve_pg_agent(pg_id, bundle_index) or agent_addr
+            elif strategy is not None and not isinstance(strategy, DefaultStrategy):
+                # constrained strategies pick the node up front (the caller-side
+                # analog of the reference's scheduling policies, scheduling/policy/)
+                picked = self._pick_strategy_node(resources, strategy)
+                if picked is None:
+                    # infeasible right now: do NOT fall back to an arbitrary
+                    # node — wait and let the pump retry the pick
+                    time.sleep(0.2)
+                    max_hops = 0
+                else:
+                    agent_addr = picked
+                    max_hops = 1  # do not follow spillback off a constrained node
+            for _ in range(max_hops):
+                body = {"resources": resources, "timeout": cfg.lease_timeout_s}
+                if pg_id is not None:
+                    body["pg_id"] = pg_id
+                    body["bundle_index"] = bundle_index
+                reply = self._rt.peer_pool.get(agent_addr).call(
+                    "lease_worker", body, timeout=cfg.lease_timeout_s + 5)
+                if reply.get("granted"):
+                    granted = _Lease(reply["lease_id"], agent_addr,
+                                     tuple(reply["worker_addr"]), reply["worker_id"])
+                    break
+                if reply.get("redirect"):
+                    agent_addr = tuple(reply["redirect"])
+                    continue
+                break
+        except Exception as e:
+            logger.debug("lease request failed: %s", e)
+        with self._lock:
+            st = self._shapes.get(key)
+            if st is None:
+                return
+            st.requests_in_flight -= 1
+            if granted is not None:
+                if st.queue:
+                    st.idle.append(granted)
+                else:
+                    self._return_lease(granted)
+                    return
+        if granted is not None:
+            self._pump(key)
+        else:
+            with self._lock:
+                st = self._shapes.get(key)
+                retry = st is not None and bool(st.queue) and not st.idle \
+                    and not st.busy and st.requests_in_flight == 0
+            if retry:
+                self._pump(key)
+
+    def _pick_strategy_node(self, resources, strategy):
+        """Apply spread/affinity/label policies against the control plane's
+        cluster view and return the chosen node's agent address."""
+        from ray_tpu.core.scheduler import NodeView, pick_node
+        try:
+            nodes = self._rt.cp_client.call_with_retry("get_nodes", None, timeout=10.0)
+        except Exception:
+            return None
+        views = [NodeView(node_id=n["node_id"], addr=tuple(n["addr"]),
+                          total=n["resources"], available=n["available"],
+                          labels=n["labels"], alive=n["alive"]) for n in nodes]
+        picked = pick_node(views, resources, strategy,
+                           local_node_id=self._rt.node_id)
+        return picked.addr if picked is not None else None
+
+    def _resolve_pg_agent(self, pg_id, bundle_index):
+        """Wait for the PG to be placed, then return the agent address hosting
+        the target bundle (first bundle's node when index is -1)."""
+        try:
+            reply = self._rt.cp_client.call_with_retry(
+                "pg_ready", {"pg_id": pg_id, "timeout": 60.0}, timeout=70.0)
+            if reply.get("state") != "CREATED":
+                return None
+            node_ids = reply["node_ids"]
+            node_id = node_ids[bundle_index if bundle_index >= 0 else 0]
+            return self._rt._node_addr(node_id)
+        except Exception:
+            return None
+
+    def _push(self, key, lease: _Lease, spec: TaskSpec):
+        """(ref: PushNormalTask normal_task_submitter.cc:183)"""
+        client = self._rt.peer_pool.get(lease.worker_addr)
+
+        def on_reply(ok, body):
+            if ok:
+                self._rt.process_task_reply(spec, body)
+                self._on_worker_idle(key, lease)
+            else:
+                self._on_push_failed(key, lease, spec, body)
+
+        client.call_async("push_task", {"spec": spec}, callback=on_reply)
+
+    def _on_worker_idle(self, key, lease: _Lease):
+        """(ref: OnWorkerIdle normal_task_submitter.cc:139)"""
+        next_spec = None
+        with self._lock:
+            st = self._shapes.get(key)
+            if st is None:
+                self._return_lease(lease)
+                return
+            if st.queue:
+                next_spec = st.queue.popleft()
+            else:
+                st.busy.pop(lease.worker_addr, None)
+                self._return_lease(lease)
+        if next_spec is not None:
+            self._push(key, lease, next_spec)
+
+    def _on_push_failed(self, key, lease: _Lease, spec: TaskSpec, err):
+        with self._lock:
+            st = self._shapes.get(key)
+            if st is not None:
+                st.busy.pop(lease.worker_addr, None)
+        self._rt.peer_pool.invalidate(lease.worker_addr)
+        retry_spec = self._rt.task_manager.should_retry_system_failure(spec.task_id)
+        if retry_spec is not None:
+            logger.info("retrying task %s after worker failure (%s)",
+                        spec.repr_name(), err)
+            self.submit(retry_spec)
+        else:
+            self._rt.fail_task(spec, TaskError(
+                WorkerCrashedError(f"worker at {lease.worker_addr} died: {err}"),
+                task_repr=spec.repr_name()))
+        self._pump(key)
+
+    def _return_lease(self, lease: _Lease):
+        try:
+            self._rt.peer_pool.get(lease.agent_addr).notify(
+                "return_lease", {"lease_id": lease.lease_id})
+        except Exception:
+            pass
+
+    def shutdown(self):
+        self._lease_pool.shutdown(wait=False)
+
+
+@dataclass
+class _ActorState:
+    actor_id: ActorID
+    addr: tuple | None = None
+    state: str = "RESOLVING"  # RESOLVING | ALIVE | DEAD
+    seq: int = 0
+    queued: deque = field(default_factory=deque)       # waiting for address
+    inflight: dict = field(default_factory=dict)        # seq -> spec
+    death_cause: str = ""
+    resolving: bool = False
+
+
+class ActorTaskSubmitter:
+    def __init__(self, runtime):
+        self._rt = runtime
+        self._lock = threading.Lock()
+        self._actors: dict[ActorID, _ActorState] = {}
+        self._resolve_pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="actor-resolve")
+
+    def _state(self, actor_id: ActorID) -> _ActorState:
+        st = self._actors.get(actor_id)
+        if st is None:
+            st = self._actors[actor_id] = _ActorState(actor_id)
+        return st
+
+    def submit(self, spec: TaskSpec):
+        send_to = None
+        dead_cause = None
+        with self._lock:
+            st = self._state(spec.actor_id)
+            spec.seq_no = st.seq
+            st.seq += 1
+            if st.state == "DEAD":
+                dead_cause = st.death_cause
+            elif st.state == "ALIVE" and st.addr is not None:
+                st.inflight[spec.seq_no] = spec
+                send_to = st.addr
+            else:
+                st.queued.append(spec)
+                if not st.resolving:
+                    st.resolving = True
+                    self._resolve_pool.submit(self._resolve, spec.actor_id)
+        # _send outside the lock: a synchronous connect failure invokes the
+        # on_reply callback inline, and _on_connection_lost takes self._lock
+        if send_to is not None:
+            self._send(st, send_to, spec)
+        elif dead_cause is not None:
+            self._rt.fail_task(spec, TaskError(
+                ActorDiedError(f"actor is dead: {dead_cause}"), task_repr=spec.repr_name()))
+
+    def _send(self, st: _ActorState, addr, spec: TaskSpec):
+        client = self._rt.peer_pool.get(addr)
+
+        def on_reply(ok, body):
+            if ok:
+                with self._lock:
+                    st.inflight.pop(spec.seq_no, None)
+                self._rt.process_task_reply(spec, body)
+            else:
+                self._on_connection_lost(spec.actor_id, addr, str(body))
+
+        client.call_async("push_task", {"spec": spec}, callback=on_reply)
+
+    def _resolve(self, actor_id: ActorID):
+        """Resolve the actor address from the control plane, then flush the
+        queue (ref: actor_task_submitter.cc ConnectActor)."""
+        try:
+            reply = self._rt.cp_client.call_with_retry(
+                "resolve_actor", {"actor_id": actor_id, "timeout": 120.0}, timeout=130.0)
+        except Exception as e:
+            reply = {"state": "DEAD", "death_cause": f"resolve failed: {e}"}
+        to_send, to_fail = [], []
+        with self._lock:
+            st = self._state(actor_id)
+            st.resolving = False
+            if reply.get("state") == "ALIVE":
+                st.state = "ALIVE"
+                st.addr = tuple(reply["addr"])
+                self._rt.subscribe_actor_events(actor_id)
+                # A (re)started actor instance expects sequence numbers from 0:
+                # renumber the queue in submission order (the reference tracks
+                # this as the caller's per-incarnation sequence window).
+                st.seq = 0
+                while st.queued:
+                    spec = st.queued.popleft()
+                    spec.seq_no = st.seq
+                    st.seq += 1
+                    st.inflight[spec.seq_no] = spec
+                    to_send.append((st.addr, spec))
+            else:
+                st.state = "DEAD"
+                st.death_cause = reply.get("death_cause", reply.get("state", "unknown"))
+                while st.queued:
+                    to_fail.append(st.queued.popleft())
+                inflight = list(st.inflight.values())
+                st.inflight.clear()
+                to_fail.extend(inflight)
+        for addr, spec in to_send:
+            self._send(self._actors[actor_id], addr, spec)
+        for spec in to_fail:
+            self._rt.fail_task(spec, TaskError(
+                ActorDiedError(f"actor is dead: {self._actors[actor_id].death_cause}"),
+                task_repr=spec.repr_name()))
+
+    def _on_connection_lost(self, actor_id: ActorID, addr, err: str):
+        """Push failed: the actor may be restarting. Re-resolve and resubmit
+        in-flight tasks whose retry budget allows (ref: actor_task_submitter.cc
+        DisconnectActor + retry queue)."""
+        with self._lock:
+            st = self._state(actor_id)
+            if st.addr == addr:
+                st.addr = None
+                st.state = "RESOLVING"
+            self._rt.peer_pool.invalidate(addr)
+            inflight = sorted(st.inflight.items())
+            st.inflight.clear()
+            requeue, fail = [], []
+            for _, spec in inflight:
+                retry = self._rt.task_manager.should_retry_system_failure(spec.task_id)
+                if retry is not None:
+                    requeue.append(retry)
+                else:
+                    fail.append(spec)
+            for spec in reversed(requeue):
+                st.queued.appendleft(spec)
+            if not st.resolving:
+                st.resolving = True
+                self._resolve_pool.submit(self._resolve, actor_id)
+        for spec in fail:
+            self._rt.fail_task(spec, TaskError(
+                ActorDiedError(f"actor connection lost: {err}"), task_repr=spec.repr_name()))
+
+    def on_actor_death(self, actor_id: ActorID, reason: str):
+        """Pubsub death notification from the control plane."""
+        to_fail = []
+        with self._lock:
+            st = self._actors.get(actor_id)
+            if st is None:
+                return
+            st.state = "DEAD"
+            st.death_cause = reason
+            st.addr = None
+            while st.queued:
+                to_fail.append(st.queued.popleft())
+            to_fail.extend(st.inflight.values())
+            st.inflight.clear()
+        for spec in to_fail:
+            self._rt.fail_task(spec, TaskError(
+                ActorDiedError(f"actor died: {reason}"), task_repr=spec.repr_name()))
+
+    def on_actor_restart(self, actor_id: ActorID):
+        with self._lock:
+            st = self._actors.get(actor_id)
+            if st is None:
+                return
+            st.addr = None
+            st.state = "RESOLVING"
+            if not st.resolving:
+                st.resolving = True
+                self._resolve_pool.submit(self._resolve, actor_id)
+
+    def shutdown(self):
+        self._resolve_pool.shutdown(wait=False)

@@ -1,0 +1,104 @@
+"""Task manager: pending-task bookkeeping, retries, lineage reconstruction.
+
+TPU-native analog of the reference's TaskManager
+(/root/reference/src/ray/core_worker/task_manager.cc): tracks tasks this
+process submitted, retries them on worker/system failure (max_retries), keeps
+the creating TaskSpec for every owned object while references are live
+(lineage pinning, task_manager.h:184-216), and resubmits the creating task when
+a shared-memory copy is lost (ObjectRecoveryManager semantics,
+object_recovery_manager.h:41).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _PendingTask:
+    spec: TaskSpec
+    retries_left: int
+
+
+class TaskManager:
+    def __init__(self, runtime):
+        self._rt = runtime
+        self._lock = threading.Lock()
+        self._pending: dict[TaskID, _PendingTask] = {}
+        # lineage: owned object -> spec of the task that creates it
+        self._lineage: dict[ObjectID, TaskSpec] = {}
+        # objects currently being reconstructed
+        self._reconstructing: set[TaskID] = set()
+
+    # ---- submission-side bookkeeping ----------------------------------
+    def add_pending(self, spec: TaskSpec):
+        with self._lock:
+            self._pending[spec.task_id] = _PendingTask(spec, spec.max_retries)
+            if get_config().enable_object_reconstruction:
+                for oid in spec.return_ids():
+                    self._lineage[oid] = spec
+
+    def complete(self, task_id: TaskID):
+        with self._lock:
+            self._pending.pop(task_id, None)
+            self._reconstructing.discard(task_id)
+
+    def should_retry_system_failure(self, task_id: TaskID) -> TaskSpec | None:
+        """Worker crash / connection loss: consume one retry
+        (ref: task_manager.cc RetryTaskIfPossible)."""
+        with self._lock:
+            ent = self._pending.get(task_id)
+            if ent is None or ent.retries_left <= 0:
+                return None
+            ent.retries_left -= 1
+            ent.spec.attempt_number += 1
+            return ent.spec
+
+    def should_retry_app_error(self, task_id: TaskID) -> TaskSpec | None:
+        with self._lock:
+            ent = self._pending.get(task_id)
+            if ent is None or not ent.spec.retry_exceptions or ent.retries_left <= 0:
+                return None
+            ent.retries_left -= 1
+            ent.spec.attempt_number += 1
+            return ent.spec
+
+    def get_pending_spec(self, task_id: TaskID) -> TaskSpec | None:
+        with self._lock:
+            ent = self._pending.get(task_id)
+            return ent.spec if ent else None
+
+    # ---- lineage ------------------------------------------------------
+    def release_lineage(self, object_id: ObjectID):
+        """Called when the owned ref count hits zero."""
+        with self._lock:
+            self._lineage.pop(object_id, None)
+
+    def reconstruct_object(self, object_id: ObjectID) -> bool:
+        """Resubmit the creating task of a lost object. Returns True if a
+        resubmission was triggered (ref: object_recovery_manager.h:41)."""
+        with self._lock:
+            spec = self._lineage.get(object_id)
+            if spec is None:
+                return False
+            if spec.task_id in self._reconstructing:
+                return True
+            self._reconstructing.add(spec.task_id)
+            spec.attempt_number += 1
+            self._pending[spec.task_id] = _PendingTask(spec, spec.max_retries)
+        logger.info("reconstructing object %s by resubmitting task %s",
+                    object_id.hex()[:12], spec.repr_name())
+        self._rt.resubmit_spec(spec)
+        return True
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)

@@ -1,0 +1,118 @@
+"""Task specification — the unit shipped from caller to executor.
+
+TPU-native analog of the reference's TaskSpecification
+(/root/reference/src/ray/common/task/task_spec.h) and the proto TaskSpec.
+Args are either inline serialized values (small) or ObjectRefs (resolved by the
+executor before invocation, matching the reference's plasma-arg semantics).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID
+
+
+class TaskType(enum.Enum):
+    NORMAL = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class SchedulingStrategy:
+    """Base scheduling strategy (ref: python/ray/util/scheduling_strategies.py:16)."""
+
+
+@dataclass
+class DefaultStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class SpreadStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class NodeAffinityStrategy(SchedulingStrategy):
+    """(ref: scheduling_strategies.py:42 NodeAffinitySchedulingStrategy)"""
+    node_id_hex: str = ""
+    soft: bool = False
+
+
+@dataclass
+class NodeLabelStrategy(SchedulingStrategy):
+    """Match node labels, e.g. {"slice_name": "...", "tpu_worker_id": "0"}
+    (ref: scheduling_strategies.py:152 NodeLabelSchedulingStrategy; TPU slice
+    selection in _private/accelerators/tpu.py:145)."""
+    hard: dict[str, str] = field(default_factory=dict)
+    soft: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PlacementGroupStrategy(SchedulingStrategy):
+    """(ref: scheduling_strategies.py PlacementGroupSchedulingStrategy)"""
+    pg_id: PlacementGroupID = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskArg:
+    """Either an inline serialized value or a by-reference arg."""
+    is_ref: bool
+    # inline: flat SerializedObject bytes; ref: (ObjectID, owner WorkerID, owner addr)
+    data: Any = None
+    ref: tuple | None = None
+    # refs contained *inside* an inline value (passed through un-resolved)
+    contained: list = field(default_factory=list)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID = None
+    job_id: JobID = None
+    task_type: TaskType = TaskType.NORMAL
+    name: str = ""
+    # function/class payload lives in the control-plane function table, keyed by
+    # descriptor (ref: python/ray/_private/function_manager.py)
+    function_id: str = ""
+    method_name: str = ""  # for actor tasks
+    args: list[TaskArg] = field(default_factory=list)
+    num_returns: int = 1
+    resources: dict[str, float] = field(default_factory=dict)
+    strategy: SchedulingStrategy = field(default_factory=DefaultStrategy)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # ownership (ref: task_spec carries caller/owner address)
+    owner_id: WorkerID = None
+    owner_addr: tuple[str, int] | None = None
+    # actor fields
+    actor_id: ActorID | None = None
+    # ordering: per-caller sequence number (ref: sequential_actor_submit_queue.cc)
+    seq_no: int = -1
+    caller_id: WorkerID | None = None
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    is_async_actor: bool = False
+    allow_out_of_order: bool = False
+    # runtime env / misc
+    runtime_env: dict | None = None
+    depth: int = 0
+    # attempt bookkeeping (set on retries)
+    attempt_number: int = 0
+
+    def return_ids(self) -> list[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]
+
+    def ref_args(self) -> list[tuple]:
+        return [a.ref for a in self.args if a.is_ref]
+
+    def repr_name(self) -> str:
+        if self.task_type == TaskType.ACTOR_TASK:
+            return f"{self.name}.{self.method_name}"
+        return self.name

@@ -1,0 +1,847 @@
+"""Per-process worker runtime — linked into every driver and worker.
+
+TPU-native analog of the reference's CoreWorker
+(/root/reference/src/ray/core_worker/core_worker.h:165): owns task submission
+(SubmitTask :852, SubmitActorTask :934), task execution (ExecuteTask :1481,
+HandlePushTask :1151), Put/Get/Wait (:479,:655,:695), the in-process memory
+store, the shared-memory store client, ownership-based reference counting, and
+actor execution queues (task_execution/actor_scheduling_queue.cc ordering,
+concurrency groups, async actors on an event loop).
+
+Results follow the reference's split: small values ride the push reply into the
+owner's memory store; large values are sealed into the node's shared-memory
+store and fetched on demand (core_worker.cc return-path semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.function_manager import FunctionManager
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.memory_store import MemoryStore
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import ShmClient
+from ray_tpu.core.rpc import ClientPool, RpcClient, RpcServer
+from ray_tpu.core.serialization import SerializationContext, SerializedObject
+from ray_tpu.core.submitter import ActorTaskSubmitter, NormalTaskSubmitter
+from ray_tpu.core.task_manager import TaskManager
+from ray_tpu.core.task_spec import (
+    DefaultStrategy,
+    SchedulingStrategy,
+    TaskArg,
+    TaskSpec,
+    TaskType,
+)
+from ray_tpu.core.refcount import ReferenceCounter
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class _TaskContext(threading.local):
+    task_id: TaskID | None = None
+    put_counter: int = 0
+    child_counter: int = 0
+
+
+@dataclass
+class _ActorExecState:
+    instance: Any = None
+    actor_id: ActorID | None = None
+    pool: ThreadPoolExecutor | None = None
+    loop = None  # asyncio loop for async actors
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    expected_seq: dict[bytes, int] = field(default_factory=dict)
+    pending: dict[bytes, dict[int, tuple]] = field(default_factory=dict)
+    exiting: bool = False
+
+
+class WorkerRuntime:
+    def __init__(self, *, mode: str, cp_addr: tuple, agent_addr: tuple | None,
+                 job_id: JobID, worker_id: WorkerID | None = None,
+                 node_id: NodeID | None = None, host: str = "127.0.0.1"):
+        self.mode = mode  # "driver" | "worker"
+        self.job_id = job_id
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id = node_id
+        self.cp_addr = tuple(cp_addr)
+        self.agent_addr = tuple(agent_addr) if agent_addr else None
+        self.peer_pool = ClientPool(f"{mode}")
+        self.cp_client = RpcClient(self.cp_addr, name="cp-client")
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter(self)
+        self.reference_counter.set_on_zero(self._on_ref_zero)
+        self.serialization = SerializationContext(self)
+        self.function_manager = FunctionManager(self)
+        self.task_manager = TaskManager(self)
+        self.normal_submitter = NormalTaskSubmitter(self)
+        self.actor_submitter = ActorTaskSubmitter(self)
+        self.shm_client = ShmClient()
+        self._ctx = _TaskContext()
+        self._task_counter_lock = threading.Lock()
+        self._task_counter = 0
+        self._node_addr_cache: dict[NodeID, tuple] = {}
+        self._actor_state = _ActorExecState()
+        self._subscribed_actors: set[ActorID] = set()
+        self._cancelled_tasks: set[TaskID] = set()
+        self._running_tasks: dict[TaskID, threading.Event] = {}
+        self._blocked_notified = threading.local()
+        self._shutdown = threading.Event()
+        self._driver_task_id = TaskID.for_driver(job_id)
+        self.task_events: list[dict] = []  # flushed to CP (TaskEventBuffer)
+        self._server = RpcServer(
+            self._handle, host=host, name=f"{mode}-rpc",
+            blocking_methods={"push_task", "get_object_status", "wait_object"},
+            pool_size=8)
+        self.addr = self._server.addr
+
+    # ------------------------------------------------------------------
+    # identity & context
+    def current_task_id(self) -> TaskID:
+        return self._ctx.task_id or self._driver_task_id
+
+    def _next_task_id(self) -> TaskID:
+        with self._task_counter_lock:
+            self._task_counter += 1
+            c = self._task_counter
+        return TaskID.for_task(self.job_id, self.current_task_id(), c)
+
+    def in_actor(self) -> bool:
+        return self._actor_state.instance is not None
+
+    # ------------------------------------------------------------------
+    # public ops: put / get / wait
+    def put(self, value: Any, *, device_hint: str = "") -> ObjectRef:
+        self._ctx.put_counter += 1
+        oid = ObjectID.for_put(self.current_task_id(), self._ctx.put_counter)
+        sobj = self.serialization.serialize(value)
+        self.reference_counter.add_owned(oid, contained_refs=sobj.contained_refs)
+        if sobj.serialized_size() <= get_config().max_inline_object_size or self.agent_addr is None:
+            self.memory_store.put_inline(oid, sobj)
+        else:
+            self._put_shm(oid, sobj, device_hint)
+        return ObjectRef(oid, self.worker_id, self.addr)
+
+    def _put_shm(self, oid: ObjectID, sobj: SerializedObject, device_hint: str = ""):
+        size = sobj.serialized_size()
+        agent = self.peer_pool.get(self.agent_addr)
+        reply = agent.call_with_retry(
+            "store_create",
+            {"object_id": oid, "size": size, "device_hint": device_hint,
+             "owner_addr": self.addr}, timeout=30.0)
+        mv = self.shm_client.map(reply["shm_name"], size)
+        _write_serialized(mv, sobj)
+        agent.call_with_retry("store_seal", {"object_id": oid}, timeout=30.0)
+        self.memory_store.put_location(oid, self.node_id)
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: list[Any] = []
+        for ref in refs:
+            out.append(self._get_one(ref, deadline))
+        return out
+
+    def _remaining(self, deadline) -> float | None:
+        if deadline is None:
+            return None
+        return max(0.0, deadline - time.monotonic())
+
+    def _get_one(self, ref: ObjectRef, deadline) -> Any:
+        oid = ref.id()
+        reconstruction_attempts = 3
+        while True:
+            if self.reference_counter.is_owned(oid) or self.memory_store.contains(oid):
+                ent = self._wait_local(oid, deadline)
+                if ent is None:
+                    raise GetTimeoutError(f"get on {oid.hex()[:12]} timed out")
+                if ent.inline is not None:
+                    return self._materialize(ent.inline, ent.is_error)
+                value, ok = self._read_shm(oid, ent.locations)
+                if ok:
+                    return value
+                # all copies lost: lineage reconstruction
+                if reconstruction_attempts > 0 and self.task_manager.reconstruct_object(oid):
+                    reconstruction_attempts -= 1
+                    continue
+                raise ObjectLostError(oid.hex())
+            # borrowed: ask the owner
+            status = self._owner_status(ref, deadline, wait=True)
+            if status is None:
+                raise GetTimeoutError(f"get on {oid.hex()[:12]} timed out (owner)")
+            kind = status.get("kind")
+            if kind == "inline":
+                return self._materialize(
+                    SerializedObject.from_buffer(status["data"]), status.get("is_error", False))
+            if kind == "shm":
+                self.memory_store.put_location(oid, status["node_id"])
+                value, ok = self._read_shm(oid, [status["node_id"]], owner_addr=ref.owner_addr)
+                if ok:
+                    return value
+                self.memory_store.remove_location(oid, status["node_id"])
+                continue
+            if kind == "lost":
+                raise ObjectLostError(oid.hex())
+            time.sleep(0.005)
+            if deadline is not None and time.monotonic() > deadline:
+                raise GetTimeoutError(f"get on {oid.hex()[:12]} timed out")
+
+    def _wait_local(self, oid: ObjectID, deadline):
+        ent = self.memory_store.get(oid)
+        if ent is not None:
+            return ent
+        self._notify_blocked()
+        return self.memory_store.wait_for(oid, self._remaining(deadline))
+
+    def _notify_blocked(self):
+        """Release our CPU while blocked so nested tasks can schedule
+        (ref: raylet blocked-worker release)."""
+        if self.mode != "worker" or self.agent_addr is None:
+            return
+        if getattr(self._blocked_notified, "sent", False):
+            return
+        self._blocked_notified.sent = True
+        try:
+            self.peer_pool.get(self.agent_addr).notify(
+                "worker_blocked", {"worker_id": self.worker_id})
+        except Exception:
+            pass
+
+    def _materialize(self, sobj: SerializedObject, is_error: bool) -> Any:
+        value = self.serialization.deserialize(sobj)
+        if is_error:
+            raise value if isinstance(value, BaseException) else TaskError(formatted=str(value))
+        return value
+
+    def _read_shm(self, oid: ObjectID, locations, owner_addr=None) -> tuple[Any, bool]:
+        if self.agent_addr is None:
+            return None, False
+        agent = self.peer_pool.get(self.agent_addr)
+        meta = agent.call_with_retry("store_get_meta", {"object_id": oid}, timeout=30.0)
+        if meta is None:
+            # not local: pull from a remote holder (ref: pull_manager.h:49)
+            for node_id in list(locations or []):
+                if node_id == self.node_id:
+                    continue
+                remote_addr = self._node_addr(node_id)
+                if remote_addr is None:
+                    continue
+                r = agent.call_with_retry(
+                    "pull_object",
+                    {"object_id": oid, "from_addr": remote_addr, "owner_addr": owner_addr},
+                    timeout=120.0)
+                if r.get("ok"):
+                    meta = agent.call_with_retry(
+                        "store_get_meta", {"object_id": oid}, timeout=30.0)
+                    break
+            if meta is None:
+                return None, False
+        shm_name, size, _device = meta
+        mv = self.shm_client.map(shm_name, size)
+        sobj = SerializedObject.from_buffer(mv)
+        return self.serialization.deserialize(sobj), True
+
+    def _node_addr(self, node_id: NodeID):
+        addr = self._node_addr_cache.get(node_id)
+        if addr is not None:
+            return addr
+        try:
+            nodes = self.cp_client.call_with_retry("get_nodes", None, timeout=10.0)
+        except Exception:
+            return None
+        for n in nodes:
+            self._node_addr_cache[n["node_id"]] = tuple(n["addr"])
+        return self._node_addr_cache.get(node_id)
+
+    def _owner_status(self, ref: ObjectRef, deadline, wait: bool):
+        owner_addr = ref.owner_addr
+        if owner_addr is None:
+            return None
+        t = self._remaining(deadline)
+        body = {"object_id": ref.id(), "wait": wait,
+                "timeout": min(t, 5.0) if t is not None else 5.0}
+        try:
+            if wait:
+                self._notify_blocked()
+            return self.peer_pool.get(owner_addr).call_with_retry(
+                "get_object_status", body,
+                timeout=(body["timeout"] + 10.0))
+        except Exception as e:
+            return {"kind": "lost", "error": str(e)}
+
+    def is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.id()
+        if self.memory_store.contains(oid):
+            return True
+        if self.reference_counter.is_owned(oid):
+            return False
+        status = self._owner_status(ref, None, wait=False)
+        if status and status.get("kind") in ("inline", "shm"):
+            if status.get("kind") == "shm":
+                self.memory_store.put_location(oid, status["node_id"])
+            elif status.get("kind") == "inline":
+                self.memory_store.put_inline(
+                    oid, SerializedObject.from_buffer(status["data"]),
+                    status.get("is_error", False))
+            return True
+        return False
+
+    def wait(self, refs: list[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None) -> tuple[list[ObjectRef], list[ObjectRef]]:
+        """(ref: CoreWorker::Wait core_worker.h:695)"""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: list[ObjectRef] = []
+        pending = list(refs)
+        sleep = 0.001
+        while len(ready) < num_returns:
+            still = []
+            for ref in pending:
+                if self.is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            self._notify_blocked()
+            time.sleep(sleep)
+            sleep = min(sleep * 1.5, 0.05)
+        order = {ref: i for i, ref in enumerate(refs)}
+        ready.sort(key=lambda r: order[r])
+        return ready, [r for r in refs if r not in set(ready)]
+
+    # ------------------------------------------------------------------
+    # task submission
+    def submit_task(self, fn: Callable, args: tuple, kwargs: dict, *,
+                    num_returns: int = 1, resources: dict | None = None,
+                    strategy: SchedulingStrategy | None = None,
+                    max_retries: int | None = None, retry_exceptions: bool = False,
+                    name: str = "") -> list[ObjectRef]:
+        cfg = get_config()
+        spec = TaskSpec(
+            task_id=self._next_task_id(), job_id=self.job_id,
+            task_type=TaskType.NORMAL, name=name or getattr(fn, "__name__", "task"),
+            function_id=self.function_manager.export(fn),
+            args=self._serialize_args(args, kwargs),
+            num_returns=num_returns, resources=resources or {"CPU": 1.0},
+            strategy=strategy or DefaultStrategy(),
+            max_retries=cfg.task_max_retries if max_retries is None else max_retries,
+            retry_exceptions=retry_exceptions,
+            owner_id=self.worker_id, owner_addr=self.addr,
+            caller_id=self.worker_id, depth=self._depth() + 1)
+        refs = self._register_returns(spec)
+        self.task_manager.add_pending(spec)
+        self._record_task_event(spec, "SUBMITTED")
+        self.normal_submitter.submit(spec)
+        return refs
+
+    def submit_actor_creation(self, cls, args: tuple, kwargs: dict, *, actor_id: ActorID,
+                              resources: dict | None = None, name: str = "",
+                              detached: bool = False, max_restarts: int = 0,
+                              max_task_retries: int = 0, max_concurrency: int = 1,
+                              is_async: bool = False,
+                              strategy: SchedulingStrategy | None = None) -> None:
+        spec = TaskSpec(
+            task_id=self._next_task_id(), job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION, name=cls.__name__,
+            function_id=self.function_manager.export(cls),
+            args=self._serialize_args(args, kwargs),
+            num_returns=0, resources=resources or {"CPU": 1.0},
+            strategy=strategy or DefaultStrategy(),
+            owner_id=self.worker_id, owner_addr=self.addr,
+            actor_id=actor_id, max_restarts=max_restarts,
+            max_task_retries=max_task_retries, max_concurrency=max_concurrency,
+            is_async_actor=is_async, caller_id=self.worker_id)
+        self.cp_client.call_with_retry(
+            "create_actor", {"spec": spec, "name": name, "detached": detached},
+            timeout=60.0)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args: tuple,
+                          kwargs: dict, *, num_returns: int = 1,
+                          max_task_retries: int = 0, name: str = "") -> list[ObjectRef]:
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(self.job_id, actor_id, self._bump_counter()),
+            job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
+            name=name, method_name=method_name,
+            args=self._serialize_args(args, kwargs),
+            num_returns=num_returns, resources={},
+            max_retries=max_task_retries,
+            owner_id=self.worker_id, owner_addr=self.addr,
+            actor_id=actor_id, caller_id=self.worker_id)
+        refs = self._register_returns(spec)
+        self.task_manager.add_pending(spec)
+        self._record_task_event(spec, "SUBMITTED")
+        self.actor_submitter.submit(spec)
+        return refs
+
+    def _bump_counter(self) -> int:
+        with self._task_counter_lock:
+            self._task_counter += 1
+            return self._task_counter
+
+    def _depth(self) -> int:
+        return 0
+
+    def resubmit_spec(self, spec: TaskSpec):
+        if spec.task_type == TaskType.ACTOR_TASK:
+            self.actor_submitter.submit(spec)
+        else:
+            self.normal_submitter.submit(spec)
+
+    def _serialize_args(self, args: tuple, kwargs: dict) -> list[TaskArg]:
+        out: list[TaskArg] = []
+        cfg = get_config()
+        for key, value in [(None, a) for a in args] + list(kwargs.items()):
+            if isinstance(value, ObjectRef):
+                self.reference_counter.add_task_dep(value.id(), value.owner_addr)
+                out.append(TaskArg(is_ref=True,
+                                   ref=(value.id(), value.owner, value.owner_addr,
+                                        key)))
+                continue
+            sobj = self.serialization.serialize(value)
+            if sobj.serialized_size() > cfg.max_inline_object_size and self.agent_addr is not None:
+                ref = self.put(value)
+                self.reference_counter.add_task_dep(ref.id(), ref.owner_addr)
+                out.append(TaskArg(is_ref=True,
+                                   ref=(ref.id(), ref.owner, ref.owner_addr, key),
+                                   contained=[ref]))
+                continue
+            # .contained carries the kwarg name (None = positional); nested refs
+            # inside the value travel via the serializer's borrow protocol.
+            out.append(TaskArg(is_ref=False, data=sobj.to_bytes(), contained=[key]))
+        return out
+
+    def _register_returns(self, spec: TaskSpec) -> list[ObjectRef]:
+        refs = []
+        for oid in spec.return_ids():
+            self.reference_counter.add_owned(oid)
+            refs.append(ObjectRef(oid, self.worker_id, self.addr))
+        return refs
+
+    # ------------------------------------------------------------------
+    # reply processing (owner side)
+    def process_task_reply(self, spec: TaskSpec, reply: dict):
+        # Guard against late replies for tasks already completed (cancelled,
+        # failed via actor death) or superseded by a retry attempt — a stale
+        # reply must not double-release deps or overwrite the recorded result
+        # (ref: task_manager.cc attempt-number checks).
+        pending = self.task_manager.get_pending_spec(spec.task_id)
+        if pending is None:
+            return
+        if reply.get("attempt", spec.attempt_number) != pending.attempt_number:
+            return
+        if reply.get("error"):
+            self.fail_task(spec, TaskError(formatted=str(reply["error"]),
+                                           task_repr=spec.repr_name()))
+            return
+        results = reply.get("results", [])
+        if any(is_err for (_, _, _, is_err) in results):
+            retry = self.task_manager.should_retry_app_error(spec.task_id)
+            if retry is not None:
+                logger.info("retrying task %s after application error", spec.repr_name())
+                self.resubmit_spec(retry)
+                return
+        for oid, kind, data, is_error in results:
+            if kind == "inline":
+                self.memory_store.put_inline(
+                    oid, SerializedObject.from_buffer(data), is_error)
+            else:
+                self.memory_store.put_location(oid, data)
+        self._release_deps(spec)
+        self.task_manager.complete(spec.task_id)
+        self._record_task_event(spec, "FINISHED")
+
+    def fail_task(self, spec: TaskSpec, error: TaskError):
+        if self.task_manager.get_pending_spec(spec.task_id) is None:
+            return  # already completed/failed; don't double-release deps
+        sobj = self.serialization.serialize(error)
+        for oid in spec.return_ids():
+            self.memory_store.put_inline(oid, sobj, is_error=True)
+        self._release_deps(spec)
+        self.task_manager.complete(spec.task_id)
+        self._record_task_event(spec, "FAILED")
+
+    def _release_deps(self, spec: TaskSpec):
+        for a in spec.args:
+            if a.is_ref:
+                self.reference_counter.remove_task_dep(a.ref[0], a.ref[2])
+
+    def _on_ref_zero(self, oid: ObjectID):
+        """Owned count hit zero: drop the value everywhere
+        (ref: reference_count.cc delete path)."""
+        ent = self.memory_store.get(oid)
+        self.memory_store.delete(oid)
+        self.task_manager.release_lineage(oid)
+        if ent is not None and ent.locations:
+            for node_id in ent.locations:
+                addr = self.agent_addr if node_id == self.node_id else self._node_addr(node_id)
+                if addr is not None:
+                    try:
+                        self.peer_pool.get(addr).notify("store_delete", {"object_id": oid})
+                    except Exception:
+                        pass
+
+    def _record_task_event(self, spec: TaskSpec, state: str):
+        self.task_events.append({
+            "task_id": spec.task_id.hex(), "name": spec.repr_name(),
+            "state": state, "ts": time.time(), "attempt": spec.attempt_number,
+            "worker_id": self.worker_id.hex(), "job_id": spec.job_id.hex(),
+            "type": spec.task_type.name,
+        })
+        if len(self.task_events) >= 512:
+            self.flush_task_events()
+
+    def flush_task_events(self):
+        events, self.task_events = self.task_events, []
+        if not events:
+            return
+        try:
+            self.cp_client.notify("report_task_events", {"events": events})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # RPC handlers (executor side)
+    def _handle(self, method: str, body, peer):
+        fn = getattr(self, "_h_" + method, None)
+        if fn is None:
+            raise ValueError(f"worker: unknown method {method}")
+        return fn(body)
+
+    def _h_ping(self, body):
+        return {"ok": True}
+
+    def _h_inc_borrow(self, body):
+        self.reference_counter.inc_borrow(body)
+        return {"ok": True}
+
+    def _h_dec_borrow(self, body):
+        self.reference_counter.dec_borrow(body)
+        return {"ok": True}
+
+    def _h_get_object_status(self, body):
+        """Owner-side status/fetch (ref: core_worker.proto:492 GetObjectStatus)."""
+        oid: ObjectID = body["object_id"]
+        ent = self.memory_store.get(oid)
+        if ent is None and body.get("wait"):
+            ent = self.memory_store.wait_for(oid, body.get("timeout", 5.0))
+        if ent is None:
+            if (not self.reference_counter.is_owned(oid)
+                    and not self.task_manager.get_pending_spec(oid.task_id())):
+                return {"kind": "lost"}
+            return {"kind": "pending"}
+        if ent.inline is not None:
+            return {"kind": "inline", "data": ent.inline.to_bytes(),
+                    "is_error": ent.is_error}
+        if ent.locations:
+            return {"kind": "shm", "node_id": ent.locations[0]}
+        return {"kind": "pending"}
+
+    def _h_object_lost(self, body):
+        """A node evicted/lost our primary copy (ref: object_recovery_manager)."""
+        oid = body["object_id"]
+        self.memory_store.remove_location(oid, body["node_id"])
+        if (self.reference_counter.is_owned(oid)
+                and get_config().enable_object_reconstruction
+                and not self.memory_store.contains(oid)):
+            self.task_manager.reconstruct_object(oid)
+        return {"ok": True}
+
+    def _h_pubsub(self, body):
+        channel, msg = body["channel"], body["msg"]
+        if channel.startswith("actor:"):
+            actor_id = ActorID(bytes.fromhex(channel.split(":", 1)[1]))
+            if msg.get("state") == "DEAD":
+                self.actor_submitter.on_actor_death(actor_id, msg.get("reason", ""))
+            elif msg.get("state") in ("RESTARTING", "ALIVE"):
+                self.actor_submitter.on_actor_restart(actor_id)
+        return {"ok": True}
+
+    def subscribe_actor_events(self, actor_id: ActorID):
+        if actor_id in self._subscribed_actors:
+            return
+        self._subscribed_actors.add(actor_id)
+        try:
+            self.cp_client.notify(
+                "subscribe", {"channel": f"actor:{actor_id.hex()}", "addr": self.addr})
+        except Exception:
+            pass
+
+    def _h_cancel_task(self, body):
+        """(ref: core_worker.proto:540 CancelTask)"""
+        tid: TaskID = body["task_id"]
+        self._cancelled_tasks.add(tid)
+        return {"ok": True}
+
+    def _h_kill_actor(self, body):
+        """(ref: core_worker.proto:536 KillActor)"""
+        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(1)),
+                         daemon=True).start()
+        return {"ok": True}
+
+    def _h_exit_worker(self, body):
+        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)),
+                         daemon=True).start()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # task execution
+    def _h_push_task(self, body):
+        spec: TaskSpec = body["spec"]
+        if spec.task_type == TaskType.NORMAL:
+            return self._execute_normal(spec)
+        if spec.task_type == TaskType.ACTOR_CREATION:
+            return self._execute_actor_creation(spec)
+        return self._enqueue_actor_task(spec)
+
+    def _execute_normal(self, spec: TaskSpec) -> dict:
+        if spec.task_id in self._cancelled_tasks:
+            return self._error_reply(spec, TaskError(
+                TaskCancelledError(), task_repr=spec.repr_name()))
+        self._blocked_notified.sent = False
+        return self._run_task(spec)
+
+    def _run_task(self, spec: TaskSpec) -> dict:
+        prev_task = self._ctx.task_id
+        self._ctx.task_id = spec.task_id
+        self._ctx.put_counter = 0
+        try:
+            fn = self.function_manager.get(spec.function_id)
+            args, kwargs = self._resolve_args(spec)
+            if spec.task_type == TaskType.ACTOR_TASK:
+                method = getattr(self._actor_state.instance, spec.method_name)
+                result = method(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
+            return self._success_reply(spec, result)
+        except BaseException as e:  # noqa: BLE001 — app errors ship to the owner
+            if isinstance(e, TaskError):
+                err = e
+            else:
+                err = TaskError(e, task_repr=spec.repr_name())
+            return self._error_reply(spec, err)
+        finally:
+            self._ctx.task_id = prev_task
+
+    def _resolve_args(self, spec: TaskSpec) -> tuple[tuple, dict]:
+        args, kwargs = [], {}
+        for a in spec.args:
+            if a.is_ref:
+                oid, owner, owner_addr, key = a.ref
+                ref = ObjectRef(oid, owner, owner_addr, _skip_refcount=True)
+                value = self._get_one(ref, deadline=time.monotonic() + 300.0)
+            else:
+                key = a.contained[0] if a.contained else None
+                value = self.serialization.deserialize(
+                    SerializedObject.from_buffer(a.data))
+            if key is None:
+                args.append(value)
+            else:
+                kwargs[key] = value
+        return tuple(args), kwargs
+
+    def _success_reply(self, spec: TaskSpec, result) -> dict:
+        if spec.num_returns == 0:
+            return {"results": [], "error": None}
+        values = [result] if spec.num_returns == 1 else list(result)
+        if spec.num_returns > 1 and len(values) != spec.num_returns:
+            return self._error_reply(spec, TaskError(
+                ValueError(f"task returned {len(values)} values, expected {spec.num_returns}"),
+                task_repr=spec.repr_name()))
+        out = []
+        cfg = get_config()
+        for oid, value in zip(spec.return_ids(), values):
+            sobj = self.serialization.serialize(value)
+            if (sobj.serialized_size() <= cfg.max_inline_object_size
+                    or self.agent_addr is None):
+                out.append((oid, "inline", sobj.to_bytes(), False))
+            else:
+                self._store_return_shm(oid, sobj, spec)
+                out.append((oid, "shm", self.node_id, False))
+        return {"results": out, "error": None, "attempt": spec.attempt_number}
+
+    def _store_return_shm(self, oid: ObjectID, sobj: SerializedObject, spec: TaskSpec):
+        size = sobj.serialized_size()
+        agent = self.peer_pool.get(self.agent_addr)
+        reply = agent.call_with_retry(
+            "store_create", {"object_id": oid, "size": size,
+                             "owner_addr": spec.owner_addr}, timeout=30.0)
+        mv = self.shm_client.map(reply["shm_name"], size)
+        _write_serialized(mv, sobj)
+        agent.call_with_retry("store_seal", {"object_id": oid}, timeout=30.0)
+
+    def _error_reply(self, spec: TaskSpec, err: TaskError) -> dict:
+        sobj = self.serialization.serialize(err)
+        data = sobj.to_bytes()
+        return {"results": [(oid, "inline", data, True) for oid in spec.return_ids()],
+                "error": None, "attempt": spec.attempt_number}
+
+    # ---- actors --------------------------------------------------------
+    def _execute_actor_creation(self, spec: TaskSpec) -> dict:
+        st = self._actor_state
+        try:
+            cls = self.function_manager.get(spec.function_id)
+            args, kwargs = self._resolve_args(spec)
+            prev = self._ctx.task_id
+            self._ctx.task_id = spec.task_id
+            try:
+                instance = cls(*args, **kwargs)
+            finally:
+                self._ctx.task_id = prev
+            st.instance = instance
+            st.actor_id = spec.actor_id
+            st.pool = ThreadPoolExecutor(
+                max_workers=max(1, spec.max_concurrency),
+                thread_name_prefix="actor-exec")
+            if spec.is_async_actor:
+                import asyncio
+                st.loop = asyncio.new_event_loop()
+                threading.Thread(target=st.loop.run_forever,
+                                 name="actor-loop", daemon=True).start()
+            return {"error": None, "addr": self.addr}
+        except BaseException as e:  # noqa: BLE001
+            logger.exception("actor creation failed")
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _enqueue_actor_task(self, spec: TaskSpec) -> dict:
+        """In-order dispatch per caller (ref: actor_scheduling_queue.cc);
+        execution happens on the concurrency pool; this handler thread waits for
+        completion to carry the reply."""
+        st = self._actor_state
+        if st.instance is None:
+            return {"results": [], "error": "actor not initialized"}
+        caller = spec.caller_id.binary()
+        fut: Future = Future()
+        with st.lock:
+            expected = st.expected_seq.get(caller, 0)
+            if spec.seq_no == -1 or spec.allow_out_of_order:
+                self._dispatch_actor_task(spec, fut)
+            elif spec.seq_no == expected:
+                st.expected_seq[caller] = expected + 1
+                self._dispatch_actor_task(spec, fut)
+                pend = st.pending.get(caller, {})
+                nxt = st.expected_seq[caller]
+                while nxt in pend:
+                    pspec, pfut = pend.pop(nxt)
+                    self._dispatch_actor_task(pspec, pfut)
+                    nxt += 1
+                    st.expected_seq[caller] = nxt
+            elif spec.seq_no < expected:
+                # duplicate resubmission after reconnect: re-execute is unsafe;
+                # reply with error so the owner retries via status
+                self._dispatch_actor_task(spec, fut)
+                st.expected_seq[caller] = spec.seq_no + 1
+            else:
+                st.pending.setdefault(caller, {})[spec.seq_no] = (spec, fut)
+        return fut.result()
+
+    def _dispatch_actor_task(self, spec: TaskSpec, fut: Future):
+        st = self._actor_state
+
+        def run():
+            try:
+                fut.set_result(self._run_actor_task(spec))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        st.pool.submit(run)
+
+    def _run_actor_task(self, spec: TaskSpec) -> dict:
+        st = self._actor_state
+        prev = self._ctx.task_id
+        self._ctx.task_id = spec.task_id
+        self._ctx.put_counter = 0
+        try:
+            method = getattr(st.instance, spec.method_name)
+            args, kwargs = self._resolve_args(spec)
+            import inspect
+            if inspect.iscoroutinefunction(method) and st.loop is not None:
+                import asyncio
+                result = asyncio.run_coroutine_threadsafe(
+                    method(*args, **kwargs), st.loop).result()
+            else:
+                result = method(*args, **kwargs)
+            reply = self._success_reply(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, SystemExit):
+                reply = self._exit_actor_reply(spec)
+            else:
+                reply = self._error_reply(
+                    spec, e if isinstance(e, TaskError) else TaskError(e, task_repr=spec.repr_name()))
+        finally:
+            self._ctx.task_id = prev
+        if st.exiting:
+            self._do_exit_actor()
+        return reply
+
+    def _exit_actor_reply(self, spec: TaskSpec) -> dict:
+        self._actor_state.exiting = True
+        return self._success_reply(spec, None)
+
+    def request_exit_actor(self):
+        self._actor_state.exiting = True
+
+    def _do_exit_actor(self):
+        def exit_later():
+            # let the final reply flush to the caller before announcing death
+            time.sleep(0.25)
+            try:
+                self.cp_client.call(
+                    "actor_exited", {"actor_id": self._actor_state.actor_id}, timeout=5.0)
+            except Exception:
+                pass
+            time.sleep(0.1)
+            os._exit(0)
+
+        threading.Thread(target=exit_later, daemon=True).start()
+
+    # ------------------------------------------------------------------
+    def as_future(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+
+        def work():
+            try:
+                fut.set_result(self.get([ref])[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=work, daemon=True).start()
+        return fut
+
+    def shutdown(self):
+        self._shutdown.set()
+        self.flush_task_events()
+        self.normal_submitter.shutdown()
+        self.actor_submitter.shutdown()
+        self._server.stop()
+        self.peer_pool.close_all()
+        self.cp_client.close()
+        self.shm_client.close()
+
+
+def _write_serialized(mv: memoryview, sobj: SerializedObject):
+    class _MvWriter:
+        def __init__(self, mv):
+            self.mv = mv
+            self.off = 0
+
+        def write(self, b):
+            n = len(b)
+            self.mv[self.off:self.off + n] = b
+            self.off += n
+
+    sobj.write_into(_MvWriter(mv))

@@ -1,0 +1,97 @@
+"""Exception hierarchy.
+
+TPU-native analog of the reference's exception surface
+(/root/reference/python/ray/exceptions.py).
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task. Getting the object
+    re-raises at the caller (ref: exceptions.py RayTaskError)."""
+
+    def __init__(self, cause: BaseException | None = None, task_repr: str = "",
+                 formatted: str | None = None):
+        self.cause = cause
+        self.task_repr = task_repr
+        if formatted is None and cause is not None:
+            formatted = "".join(
+                traceback.format_exception(type(cause), cause, cause.__traceback__)
+            )
+        self.formatted = formatted or ""
+        super().__init__(f"task {task_repr} failed:\n{self.formatted}")
+
+    def __reduce__(self):
+        # The cause may not be picklable; keep the formatted traceback.
+        try:
+            import cloudpickle
+            cloudpickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:
+            cause = None
+        return (type(self), (cause, self.task_repr, self.formatted))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died (ref: WorkerCrashedError)."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead; pending and future calls fail
+    (ref: exceptions.py ActorDiedError / RayActorError)."""
+
+    def __init__(self, msg: str = "The actor died.", actor_id=None):
+        super().__init__(msg)
+        self.actor_id = actor_id
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was lost (all copies evicted/failed) and could not be
+    reconstructed (ref: ObjectLostError)."""
+
+    def __init__(self, object_id_hex: str = "", msg: str = ""):
+        super().__init__(msg or f"Object {object_id_hex} was lost.")
+        self.object_id_hex = object_id_hex
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The local shared-memory store is out of memory."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get timed out."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled (ref: TaskCancelledError)."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor max_pending_calls exceeded."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Runtime environment failed to set up."""
+
+
+class NodeDiedError(RayTpuError):
+    """A node (agent) died."""
+
+
+class PlacementGroupSchedulingError(RayTpuError):
+    """Placement group could not be scheduled (infeasible)."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """Worker was killed by the memory monitor (ref: OutOfMemoryError)."""

@@ -1,0 +1,346 @@
+"""Llama-3-family transformer, TPU-first.
+
+The flagship model for the BASELINE configs ("Llama-3-8B pretraining … v5p-64",
+"Llama-3-8B serving … v5e-16"). The reference has no in-tree model — it
+delegates to torch/vLLM; here the model is native JAX so the whole stack
+(sharding, ring attention, pipeline, serving KV cache) composes:
+
+- parameters are a pytree with a stacked layer dim and logical axis names, so
+  any mesh (DP/FSDP/TP/CP) is a rule-table swap (ray_tpu.parallel.sharding);
+- the layer loop is `lax.scan` → O(1) compile size at any depth;
+- attention routes to ring attention over the "context" axis for long
+  sequences (SURVEY.md §5.7) and to the Pallas flash kernel on TPU;
+- GQA + RoPE + RMSNorm + SwiGLU, bf16 activations, fp32 RMSNorm accumulation
+  (MXU-friendly shapes: head_dim 128, ffn multiples of 1024).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # attention implementation: "dense" | "ring" | "flash"
+    attn_impl: str = "dense"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def llama3_8b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama3_1b(**kw) -> LlamaConfig:
+    """~1.2B-param config (bench-friendly on one v5e chip)."""
+    d = dict(dim=2048, n_layers=16, n_heads=16, n_kv_heads=8, ffn_dim=8192,
+             vocab_size=128256)
+    d.update(kw)
+    return LlamaConfig(**d)
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    """Test config: runs on the 8-device CPU mesh in seconds."""
+    d = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+             ffn_dim=128, max_seq_len=256, dtype=jnp.float32, remat=False)
+    d.update(kw)
+    return LlamaConfig(**d)
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    per_layer = (cfg.dim * (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_dim
+                 + cfg.n_heads * cfg.head_dim * cfg.dim
+                 + 3 * cfg.dim * cfg.ffn_dim + 2 * cfg.dim)
+    return (cfg.vocab_size * cfg.dim * 2 + cfg.dim
+            + cfg.n_layers * per_layer)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_params(rng, cfg: LlamaConfig):
+    """Stacked-layer param pytree. Weight layout keeps the contraction dim
+    first so matmuls hit the MXU without transposes."""
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+    hd = cfg.head_dim
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(cfg.dtype)
+
+    def layer(key):
+        ks = jax.random.split(key, 7)
+        return {
+            "attn": {
+                "wq": dense(ks[0], (cfg.dim, cfg.n_heads, hd), cfg.dim),
+                "wk": dense(ks[1], (cfg.dim, cfg.n_kv_heads, hd), cfg.dim),
+                "wv": dense(ks[2], (cfg.dim, cfg.n_kv_heads, hd), cfg.dim),
+                "wo": dense(ks[3], (cfg.n_heads, hd, cfg.dim), cfg.dim),
+            },
+            "mlp": {
+                "w_gate": dense(ks[4], (cfg.dim, cfg.ffn_dim), cfg.dim),
+                "w_up": dense(ks[5], (cfg.dim, cfg.ffn_dim), cfg.dim),
+                "w_down": dense(ks[6], (cfg.ffn_dim, cfg.dim), cfg.ffn_dim),
+            },
+            "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
+        }
+
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    layers = jax.vmap(layer)(layer_keys)
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "lm_head": dense(k_out, (cfg.dim, cfg.vocab_size), cfg.dim),
+    }
+
+
+def logical_axes(cfg: LlamaConfig):
+    """Logical sharding axes, same structure as params (consumed by
+    ray_tpu.parallel.sharding.logical_to_shardings)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn": {
+                "wq": ("layers", "embed", "heads", "head_dim"),
+                "wk": ("layers", "embed", "kv_heads", "head_dim"),
+                "wv": ("layers", "embed", "kv_heads", "head_dim"),
+                "wo": ("layers", "heads", "head_dim", "embed"),
+            },
+            "mlp": {
+                "w_gate": ("layers", "embed", "mlp"),
+                "w_up": ("layers", "embed", "mlp"),
+                "w_down": ("layers", "mlp", "embed"),
+            },
+            "attn_norm": ("layers", None),
+            "mlp_norm": ("layers", None),
+        },
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def rms_norm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale * w).astype(x.dtype)
+
+
+def rope_freqs(cfg: LlamaConfig, positions):
+    """positions: [B, T] → (cos, sin) [B, T, head_dim/2], fp32."""
+    inv = 1.0 / (cfg.rope_theta ** (
+        jnp.arange(0, cfg.head_dim, 2, dtype=jnp.float32) / cfg.head_dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv  # [B,T,hd/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, T, H, D]; rotate pairs (x[..., ::2], x[..., 1::2])."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _gqa_expand(k, n_rep):
+    if n_rep == 1:
+        return k
+    b, t, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, t, h, n_rep, d)).reshape(
+        b, t, h * n_rep, d)
+
+
+def _attention(q, k, v, cfg: LlamaConfig, mesh, *, positions_offset=0):
+    """Causal self-attention dispatch: ring over the context axis, Pallas
+    flash on TPU, einsum fallback."""
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    k = _gqa_expand(k, n_rep)
+    v = _gqa_expand(v, n_rep)
+    if cfg.attn_impl == "ring" and mesh is not None:
+        from ray_tpu.parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, mesh, causal=True)
+    if cfg.attn_impl == "flash":
+        from ray_tpu.ops.attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
+    sm = cfg.head_dim ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm
+    t_q, t_k = q.shape[1], k.shape[1]
+    q_pos = positions_offset + jnp.arange(t_q)
+    mask = q_pos[:, None] >= jnp.arange(t_k)[None, :]
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _layer_fwd(x, layer, cos, sin, cfg: LlamaConfig, mesh):
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
+    k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
+    v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"])
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = _attention(q, k, v, cfg, mesh)
+    x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["mlp"]["w_gate"])
+    up = h @ layer["mlp"]["w_up"]
+    x = x + (gate * up) @ layer["mlp"]["w_down"]
+    return x
+
+
+def forward(params, tokens, cfg: LlamaConfig, mesh=None):
+    """tokens [B, T] → logits [B, T, vocab]."""
+    b, t = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    cos, sin = rope_freqs(cfg, positions)
+
+    def body(x, layer):
+        return _layer_fwd(x, layer, cos, sin, cfg, mesh), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)  # trade FLOPs for HBM (SURVEY §brief)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
+    """Next-token cross-entropy; batch: {"tokens": [B, T+1]} or tokens array."""
+    tokens = batch["tokens"] if isinstance(batch, dict) else batch
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# ---------------------------------------------------------------------------
+# decode path (serving): single-token step against a KV cache
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None):
+    max_len = max_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype),
+            "length": jnp.zeros((batch,), jnp.int32)}
+
+
+def decode_step(params, cache, tokens, cfg: LlamaConfig):
+    """One decode step for a batch of sequences (continuous-batching inner op).
+
+    tokens: [B] current token per sequence; cache holds per-sequence lengths.
+    Returns (logits [B, vocab], new_cache).
+    """
+    b = tokens.shape[0]
+    x = params["embed"][tokens[:, None]].astype(cfg.dtype)  # [B,1,D]
+    positions = cache["length"][:, None]  # [B,1]
+    cos, sin = rope_freqs(cfg, positions)
+    max_len = cache["k"].shape[2]
+    pos_mask = jnp.arange(max_len)[None, :] <= cache["length"][:, None]  # [B,L]
+
+    def body(carry, inputs):
+        x, = carry
+        layer, k_cache, v_cache = inputs
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # write k/v at each sequence's current length
+        onehot = jax.nn.one_hot(cache["length"], max_len, dtype=k.dtype)  # [B,L]
+        k_cache = k_cache * (1 - onehot[..., None, None]) + (
+            onehot[..., None, None] * k[:, 0][:, None])
+        v_cache = v_cache * (1 - onehot[..., None, None]) + (
+            onehot[..., None, None] * v[:, 0][:, None])
+        n_rep = cfg.n_heads // cfg.n_kv_heads
+        k_full = _gqa_expand(k_cache, n_rep)
+        v_full = _gqa_expand(v_cache, n_rep)
+        sm = cfg.head_dim ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(jnp.float32) * sm
+        logits = jnp.where(pos_mask[:, None, None, :], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
+        up = h2 @ layer["mlp"]["w_up"]
+        x = x + (gate * up) @ layer["mlp"]["w_down"]
+        return (x,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    new_cache = {"k": new_k, "v": new_v, "length": cache["length"] + 1}
+    return logits, new_cache
+
+
+def prefill(params, cache, tokens, cfg: LlamaConfig, lengths=None):
+    """Prefill the KV cache with prompt tokens [B, T_prompt]; returns logits of
+    the last position per sequence and the filled cache."""
+    b, t = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    cos, sin = rope_freqs(cfg, positions)
+    max_len = cache["k"].shape[2]
+
+    def body(carry, inputs):
+        x, = carry
+        layer, k_cache, v_cache = inputs
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = _attention(q, k, v, cfg, None)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
+        up = h2 @ layer["mlp"]["w_up"]
+        x = x + (gate * up) @ layer["mlp"]["w_down"]
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        return (x,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "length": lengths}

@@ -1,0 +1,137 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the transformer stack (SURVEY.md TPU-native note: pallas for the
+ops XLA can't fuse). Streaming-softmax tiling keeps the working set in VMEM and
+the (block_q × block_k) score matmuls on the MXU; causal blocks that are fully
+masked are skipped. Used by models/llama.py (attn_impl="flash") and as the
+per-block kernel of parallel/ring_attention.py on TPU.
+
+Falls back to a fused einsum implementation off-TPU; tests run the kernel in
+interpreter mode on CPU (pl.pallas_call(interpret=True)).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_STATS_LANES = 128  # stats tiles are [block_q, 128] to satisfy TPU tiling
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale: float, causal: bool, block_q: int, block_k: int,
+                  num_k_blocks: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # causal: the whole k-block is in the future of the whole q-block → skip
+    needed = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_scr[:, 0]  # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + pv
+        m_scr[:, 0] = m_new
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        l = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_bh(q, k, v, *, causal: bool, sm_scale: float, block_q: int,
+              block_k: int, interpret: bool):
+    """q,k,v: [BH, T, D] → [BH, T, D]."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    if t_q % block_q or t_k % block_k:
+        raise ValueError(f"seq lens ({t_q},{t_k}) must divide blocks "
+                         f"({block_q},{block_k})")
+    num_q = t_q // block_q
+    num_k = t_k // block_k
+    grid = (bh, num_q, num_k)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=num_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),             # output acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: bool | None = None):
+    """q,k,v: [B, T, H, D] (same H — expand GQA before calling)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t, h, d = q.shape
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), causal=causal,
+                    sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+                    interpret=interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def reference_attention(q, k, v, *, causal: bool = True,
+                        sm_scale: float | None = None):
+    """Fused-einsum fallback (XLA fuses softmax into the matmuls well enough
+    off-TPU)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

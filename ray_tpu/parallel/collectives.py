@@ -1,0 +1,116 @@
+"""Host-level (control-plane) collectives between actors/workers.
+
+TPU-native analog of the reference's two host-side collective layers:
+- `ray.util.collective` (/root/reference/python/ray/util/collective/
+  collective.py:166 init_collective_group; allreduce:311, broadcast:426,
+  allgather:476, reducescatter:525, send:584, recv:647) — but ONLY for
+  host/control data: device-to-device traffic is XLA collectives over ICI and
+  never goes through here (SURVEY.md §2.3).
+- Ray Train's SynchronizationActor barrier/broadcast
+  (python/ray/train/collective/collectives.py,
+  train/v2/_internal/execution/collective_impl.py:17,33).
+
+Groups rendezvous through a named actor, like the reference's named-actor
+group store (collective_group/base_collective_group.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=0.1)
+class SyncActor:
+    """Rendezvous actor: barrier / broadcast / allgather / reduce for a fixed
+    world size (ref: checkpoint/sync_actor.py:27)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._round = 0
+        self._arrived: dict[int, Any] = {}
+        self._results: dict[int, list] = {}
+
+    def arrive(self, rank: int, round_id: int, value=None):
+        """Returns (done, gathered values or None)."""
+        self._arrived.setdefault(round_id, {})
+        self._arrived[round_id][rank] = value
+        if len(self._arrived[round_id]) >= self.world_size:
+            vals = [self._arrived[round_id].get(r) for r in range(self.world_size)]
+            self._results[round_id] = vals
+        return self._results.get(round_id)
+
+    def poll(self, round_id: int):
+        return self._results.get(round_id)
+
+    def reset(self):
+        self._arrived.clear()
+        self._results.clear()
+
+
+class CollectiveGroup:
+    """Per-process handle onto a named sync actor."""
+
+    def __init__(self, name: str, world_size: int, rank: int):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self._round = 0
+        self._lock = threading.Lock()
+        if rank == 0:
+            self._actor = SyncActor.options(name=f"collective:{name}").remote(world_size)
+        else:
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    self._actor = ray_tpu.get_actor(f"collective:{name}", timeout=5.0)
+                    break
+                except ValueError:
+                    if time.monotonic() > deadline:
+                        raise
+
+    def _next_round(self) -> int:
+        with self._lock:
+            self._round += 1
+            return self._round
+
+    def _rendezvous(self, value=None, timeout: float = 300.0) -> list:
+        rid = self._next_round()
+        result = ray_tpu.get(self._actor.arrive.remote(self.rank, rid, value),
+                             timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while result is None:
+            time.sleep(0.01)
+            result = ray_tpu.get(self._actor.poll.remote(rid), timeout=timeout)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"collective {self.name} round {rid} timed out")
+        return result
+
+    def barrier(self, timeout: float = 300.0) -> None:
+        self._rendezvous(None, timeout)
+
+    def broadcast(self, value=None, src: int = 0, timeout: float = 300.0):
+        vals = self._rendezvous(value if self.rank == src else None, timeout)
+        return vals[src]
+
+    def allgather(self, value, timeout: float = 300.0) -> list:
+        return self._rendezvous(value, timeout)
+
+    def allreduce(self, value, op=None, timeout: float = 300.0):
+        vals = self._rendezvous(value, timeout)
+        if op is None:
+            out = vals[0]
+            for v in vals[1:]:
+                out = out + v
+            return out
+        import functools
+        return functools.reduce(op, vals)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> CollectiveGroup:
+    """(ref: util/collective/collective.py:166)"""
+    return CollectiveGroup(group_name, world_size, rank)

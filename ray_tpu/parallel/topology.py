@@ -1,0 +1,83 @@
+"""TPU slice topology detection.
+
+TPU-native generalization of the reference's TPU accelerator manager
+(/root/reference/python/ray/_private/accelerators/tpu.py:114 topology inference,
+:199 detection): reads the TPU runtime environment variables (and, on GCE, the
+metadata server) to label this host with its slice identity, so the scheduler
+can do ICI-aware placement and atomic slice gang scheduling (SURVEY.md §7
+phase 4).
+
+A fake provider (``RAY_TPU_FAKE_TOPOLOGY`` env, JSON) lets multi-slice
+scheduling tests run on CPU hosts — the test keystone called out in
+SURVEY.md §4.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+
+# chips per host for common accelerator types (ref: tpu.py topology tables)
+_CHIPS_PER_HOST = {
+    "v2": 4, "v3": 4, "v4": 4, "v5p": 4, "v5litepod": 4, "v5e": 4, "v6e": 4,
+}
+
+
+@dataclass
+class SliceTopology:
+    slice_name: str
+    pod_type: str       # e.g. "v5p-64"
+    topology: str       # e.g. "2x2x4"
+    worker_id: int      # this host's index within the slice
+    num_hosts: int
+    chips_per_host: int
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_hosts * self.chips_per_host
+
+
+def _accelerator_chips_per_host(pod_type: str) -> int:
+    gen = pod_type.split("-")[0].lower()
+    return _CHIPS_PER_HOST.get(gen, 4)
+
+
+def detect_local_topology() -> SliceTopology | None:
+    """Detect this host's slice membership, or None if not a TPU host."""
+    fake = os.environ.get("RAY_TPU_FAKE_TOPOLOGY")
+    if fake:
+        d = json.loads(fake)
+        return SliceTopology(
+            slice_name=d.get("slice_name", "fake-slice"),
+            pod_type=d.get("pod_type", "v5p-8"),
+            topology=d.get("topology", "2x2x1"),
+            worker_id=int(d.get("worker_id", 0)),
+            num_hosts=int(d.get("num_hosts", 1)),
+            chips_per_host=int(d.get("chips_per_host", 4)),
+        )
+    # TPU VM runtime env vars (ref: tpu.py TPU_* env detection)
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if accel is None:
+        return None
+    worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    num_hosts = len(hostnames.split(",")) if hostnames else 1
+    slice_name = os.environ.get("TPU_NAME", os.environ.get("HOSTNAME", "local-slice"))
+    chips = _accelerator_chips_per_host(accel)
+    topology = os.environ.get("TPU_TOPOLOGY", "")
+    return SliceTopology(
+        slice_name=slice_name, pod_type=accel, topology=topology,
+        worker_id=worker_id, num_hosts=num_hosts, chips_per_host=chips,
+    )
+
+
+def slice_hosts(pod_type: str) -> int:
+    """Number of hosts in a full slice of the given pod type, e.g. v5p-64 → 8
+    (4 chips/host on v5p; the suffix counts cores on v2-v4 and chips on v5+)."""
+    try:
+        n = int(pod_type.split("-")[-1])
+    except ValueError:
+        return 1
+    return max(1, n // _accelerator_chips_per_host(pod_type))

@@ -1,0 +1,57 @@
+"""Test fixtures.
+
+Mirrors the reference's conftest keystones
+(/root/reference/python/ray/tests/conftest.py — ray_start_regular:590,
+ray_start_cluster:680): a single-node runtime fixture and an in-process
+multi-node Cluster fixture. JAX tests run on a virtual 8-device CPU mesh
+(SURVEY.md §4: keep everything runnable CPU-only).
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The TPU (axon) PJRT plugin registers itself as the default backend even when
+# JAX_PLATFORMS=cpu is in the env; force the cpu platform explicitly so tests
+# run on the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=4, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+    })
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.core.cluster import Cluster
+    import ray_tpu
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+@pytest.fixture
+def jax_cpu_mesh():
+    import jax
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "need 8 virtual cpu devices"
+    yield devices

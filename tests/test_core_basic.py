@@ -1,0 +1,157 @@
+"""Core API smoke tests: remote/get/put/wait, errors, nesting.
+
+Models the reference's python/ray/tests/test_basic.py coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3], "b": "x"})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3], "b": "x"}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float32)  # 4 MB -> shm path
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_remote_function(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_remote_kwargs_and_refs(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=0, c=0):
+        return a + b + c
+
+    ref_a = ray_tpu.put(10)
+    assert ray_tpu.get(f.remote(ref_a, b=5, c=1)) == 16
+
+
+def test_chained_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(4):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 5
+
+
+def test_many_small_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    r1, r2 = two.remote()
+    assert ray_tpu.get(r1) == 1
+    assert ray_tpu.get(r2) == 2
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(exceptions.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "kaboom" in str(ei.value)
+
+
+def test_error_propagates_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    @ray_tpu.remote
+    def use(x):
+        return x
+
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(use.remote(boom.remote()))
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=20)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(exceptions.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_large_return_value(ray_start_regular):
+    @ray_tpu.remote
+    def big():
+        return np.ones((512, 1024), dtype=np.float32)  # 2 MB
+
+    out = ray_tpu.get(big.remote())
+    assert out.shape == (512, 1024)
+    assert out.dtype == np.float32
+
+
+def test_ref_in_data_structure(ray_start_regular):
+    @ray_tpu.remote
+    def deref(d):
+        return ray_tpu.get(d["ref"]) + 1
+
+    inner_ref = ray_tpu.put(41)
+    assert ray_tpu.get(deref.remote({"ref": inner_ref})) == 42
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU") == 4.0

@@ -1,0 +1,162 @@
+"""Multi-node tests on the in-process simulated cluster.
+
+Models the reference's cluster_utils-based tests (SURVEY.md §4 keystone (a)):
+spillback scheduling, cross-node objects, node death, placement groups,
+TPU slice gang scheduling with fake topology labels.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def _connect(cluster):
+    return ray_tpu.init(address=cluster.address, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+    })
+
+
+def test_two_nodes_spillback(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    _connect(cluster)
+
+    @ray_tpu.remote(num_cpus=1)
+    def whoami():
+        # long enough that the second task cannot just reuse the first lease
+        # after it finishes — it must spill to the second node
+        time.sleep(3.0)
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    refs = [whoami.remote() for _ in range(2)]
+    nodes = set(ray_tpu.get(refs, timeout=60))
+    assert len(nodes) == 2
+
+
+def test_cross_node_object_transfer(ray_start_cluster):
+    import numpy as np
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"a": 1})
+    cluster.add_node(num_cpus=1, resources={"b": 1})
+    _connect(cluster)
+
+    @ray_tpu.remote(resources={"a": 1})
+    def produce():
+        return np.arange(500_000, dtype=np.float64)  # 4 MB -> shm
+
+    @ray_tpu.remote(resources={"b": 1})
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    total = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert total == float(np.arange(500_000, dtype=np.float64).sum())
+
+
+def test_node_death_detected(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    n2 = cluster.add_node(num_cpus=1, resources={"pin": 1})
+    _connect(cluster)
+
+    @ray_tpu.remote(resources={"pin": 1}, max_restarts=0)
+    class Pinned:
+        def ping(self):
+            return "pong"
+
+    a = Pinned.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    cluster.remove_node(n2)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        if len(alive) == 1:
+            break
+        time.sleep(0.2)
+    assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 1
+    with pytest.raises((exceptions.TaskError, exceptions.ActorDiedError)):
+        ray_tpu.get(a.ping.remote(), timeout=30)
+
+
+def test_placement_group_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    _connect(cluster)
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    node_ids = pg.bundle_node_ids()
+    assert len(set(n.hex() for n in node_ids)) == 2
+
+    @ray_tpu.remote(num_cpus=1)
+    def whoami():
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    out = ray_tpu.get(whoami.options(scheduling_strategy=strat).remote(), timeout=60)
+    assert out == node_ids[0].hex()
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_infeasible_pends(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    _connect(cluster)
+    pg = ray_tpu.placement_group([{"CPU": 8}], strategy="PACK")
+    assert not pg.ready(timeout=1.0)
+
+
+def test_tpu_slice_gang_scheduling(ray_start_cluster):
+    """Atomic whole-slice placement with faked slice topology labels."""
+    cluster = ray_start_cluster
+    # two slices of 2 hosts each; one is busy on one host
+    for wid in range(2):
+        cluster.add_node(num_cpus=4, tpu_slice="slice-A", tpu_worker_id=wid)
+    for wid in range(2):
+        cluster.add_node(num_cpus=4, tpu_slice="slice-B", tpu_worker_id=wid)
+    _connect(cluster)
+
+    pg = ray_tpu.placement_group(
+        [{"TPU": 4}, {"TPU": 4}], strategy="SLICE")
+    assert pg.ready(timeout=30)
+    node_ids = pg.bundle_node_ids()
+    by_id = {n["node_id"]: n for n in ray_tpu.nodes()}
+    slices = {by_id[nid]["labels"]["slice_name"] for nid in node_ids}
+    assert len(slices) == 1  # all bundles on ONE slice
+    workers = [by_id[nid]["labels"]["tpu_worker_id"] for nid in node_ids]
+    assert workers == ["0", "1"]  # ordered by slice worker id
+
+    # second gang takes the other slice
+    pg2 = ray_tpu.placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SLICE")
+    assert pg2.ready(timeout=30)
+    slices2 = {by_id[nid]["labels"]["slice_name"] for nid in pg2.bundle_node_ids()}
+    assert len(slices2) == 1
+    assert slices != slices2
+
+    # no third slice available
+    pg3 = ray_tpu.placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SLICE")
+    assert not pg3.ready(timeout=1.0)
+    ray_tpu.remove_placement_group(pg2)
+    # after removal, the gang can be placed again
+    assert pg3.ready(timeout=30)
+
+
+def test_node_label_scheduling(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, labels={"zone": "us-a"})
+    cluster.add_node(num_cpus=1, labels={"zone": "us-b"})
+    _connect(cluster)
+
+    @ray_tpu.remote(num_cpus=1)
+    def whoami():
+        return ray_tpu.get_runtime_context().node_id.hex()
+
+    strat = ray_tpu.NodeLabelStrategy(hard={"zone": "us-b"})
+    out = ray_tpu.get(whoami.options(scheduling_strategy=strat).remote(), timeout=60)
+    node = [n for n in ray_tpu.nodes() if n["node_id"].hex() == out][0]
+    assert node["labels"]["zone"] == "us-b"
