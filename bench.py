@@ -1,0 +1,91 @@
+"""Benchmark: Llama pretraining step throughput (tokens/sec/chip).
+
+North-star metric per BASELINE.json ("Ray Train tokens/sec/chip @
+Llama-3-8B"); the reference repo publishes no number for it ("published": {}),
+so vs_baseline is reported against the theoretical MXU roofline instead:
+model-FLOPs utilization (MFU), where 1.0 = peak bf16 matmul throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Runs on whatever single chip is visible (TPU via axon, else CPU fallback with
+a tiny model so the harness always produces a result).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# bf16 peak TFLOP/s per chip for MFU reporting (best-effort device match)
+_PEAK_TFLOPS = {
+    "v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
+}
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return _PEAK_TFLOPS["v5e"]  # conservative default
+
+
+def main() -> None:
+    from ray_tpu.models import llama
+    from ray_tpu.train import spmd
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = llama.llama3_1b(max_seq_len=2048)
+        batch, seq, steps, warmup = 8, 1024, 10, 3
+    else:
+        cfg = llama.llama_tiny()
+        batch, seq, steps, warmup = 8, 64, 5, 2
+
+    mesh = spmd.make_mesh(1, devices=[dev])
+    opt = spmd.default_optimizer(warmup_steps=10, decay_steps=1000)
+    state, sh = spmd.sharded_create_state(
+        lambda: llama.init_params(jax.random.PRNGKey(0), cfg), opt, mesh,
+        params_logical_axes=llama.logical_axes(cfg))
+    step = spmd.make_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg, mesh), opt, mesh, sh)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
+    batch_data = spmd.shard_batch({"tokens": tokens}, mesh)
+
+    # NOTE: force a device->host transfer as the sync barrier —
+    # block_until_ready is not a reliable fence over the axon tunnel.
+    for _ in range(warmup):
+        state, metrics = step(state, batch_data)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tok_per_s = batch * seq * steps / dt
+    # MFU: 6 * params * tokens/sec forward+backward matmul FLOPs
+    n_params = llama.num_params(cfg)
+    mfu = (6.0 * n_params * tok_per_s) / (_peak_tflops(dev) * 1e12) \
+        if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu, 4) if on_tpu else None,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

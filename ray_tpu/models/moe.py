@@ -1,0 +1,201 @@
+"""Mixtral-family MoE transformer, TPU-first (expert-parallel native).
+
+BASELINE config 5 is "Mixtral 8x7B MoE expert-parallel across Ray actors
+(v5p-128)". The reference has no in-tree MoE execution (SURVEY.md §2.3 row
+EP — it would run one expert per NCCL-grouped actor); here experts are a mesh
+axis: expert weights shard over the "expert" axis and token buckets move with
+`lax.all_to_all` over ICI (ray_tpu.parallel.expert).
+
+Architecture = Llama block with the dense MLP swapped for a top-k router +
+SwiGLU experts (Mixtral): GQA attention, RoPE, RMSNorm, stacked-layer scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import llama as _llama
+from ray_tpu.parallel.expert import moe_layer, top_k_gating
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 8192
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "dense"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def as_llama(self) -> _llama.LlamaConfig:
+        """Attention-side view (reuses llama attention/norm/rope code)."""
+        return _llama.LlamaConfig(
+            vocab_size=self.vocab_size, dim=self.dim, n_layers=self.n_layers,
+            n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
+            ffn_dim=self.ffn_dim, max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+            dtype=self.dtype, attn_impl=self.attn_impl, remat=self.remat)
+
+
+def mixtral_8x7b(**kw) -> MixtralConfig:
+    return MixtralConfig(**kw)
+
+
+def mixtral_tiny(**kw) -> MixtralConfig:
+    """Test config: runs on the 8-device CPU mesh in seconds."""
+    d = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+             ffn_dim=128, num_experts=4, top_k=2, max_seq_len=128,
+             dtype=jnp.float32, remat=False)
+    d.update(kw)
+    return MixtralConfig(**d)
+
+
+def num_params(cfg: MixtralConfig) -> int:
+    attn = cfg.dim * (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_dim \
+        + cfg.n_heads * cfg.head_dim * cfg.dim
+    expert = 3 * cfg.dim * cfg.ffn_dim
+    per_layer = attn + cfg.num_experts * expert + cfg.dim * cfg.num_experts \
+        + 2 * cfg.dim
+    return cfg.vocab_size * cfg.dim * 2 + cfg.dim + cfg.n_layers * per_layer
+
+
+def init_params(rng, cfg: MixtralConfig):
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+    hd = cfg.head_dim
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(cfg.dtype)
+
+    def layer(key):
+        ks = jax.random.split(key, 8)
+        e = cfg.num_experts
+        return {
+            "attn": {
+                "wq": dense(ks[0], (cfg.dim, cfg.n_heads, hd), cfg.dim),
+                "wk": dense(ks[1], (cfg.dim, cfg.n_kv_heads, hd), cfg.dim),
+                "wv": dense(ks[2], (cfg.dim, cfg.n_kv_heads, hd), cfg.dim),
+                "wo": dense(ks[3], (cfg.n_heads, hd, cfg.dim), cfg.dim),
+            },
+            "gate": dense(ks[4], (cfg.dim, e), cfg.dim).astype(jnp.float32),
+            "experts": {
+                "w_gate": dense(ks[5], (e, cfg.dim, cfg.ffn_dim), cfg.dim),
+                "w_up": dense(ks[6], (e, cfg.dim, cfg.ffn_dim), cfg.dim),
+                "w_down": dense(ks[7], (e, cfg.ffn_dim, cfg.dim), cfg.ffn_dim),
+            },
+            "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
+        }
+
+    layers = jax.vmap(layer)(jax.random.split(k_layers, cfg.n_layers))
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, cfg.dim), cfg.dim),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "lm_head": dense(k_out, (cfg.dim, cfg.vocab_size), cfg.dim),
+    }
+
+
+def logical_axes(cfg: MixtralConfig):
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn": {
+                "wq": ("layers", "embed", "heads", "head_dim"),
+                "wk": ("layers", "embed", "kv_heads", "head_dim"),
+                "wv": ("layers", "embed", "kv_heads", "head_dim"),
+                "wo": ("layers", "heads", "head_dim", "embed"),
+            },
+            "gate": ("layers", "embed", None),
+            "experts": {
+                "w_gate": ("layers", "expert", "embed", "mlp"),
+                "w_up": ("layers", "expert", "embed", "mlp"),
+                "w_down": ("layers", "expert", "mlp", "embed"),
+            },
+            "attn_norm": ("layers", None),
+            "mlp_norm": ("layers", None),
+        },
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def _expert_ffn(p, tokens):
+    """One expert's SwiGLU over a token bucket [C, D]."""
+    gate = jax.nn.silu(tokens @ p["w_gate"])
+    up = tokens @ p["w_up"]
+    return (gate * up) @ p["w_down"]
+
+
+def _moe_block(x, layer, cfg: MixtralConfig, mesh):
+    """Router + expert-parallel SwiGLU experts (residual applied by caller)."""
+    b, s, d = x.shape
+    if mesh is not None:
+        return moe_layer(
+            x, layer["gate"].astype(x.dtype), _expert_ffn, layer["experts"],
+            mesh, num_experts=cfg.num_experts, top_k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor)
+    # meshless fallback: dense top-k mixture (exact, no capacity drop)
+    tokens = x.reshape(b * s, d)
+    logits = (tokens @ layer["gate"].astype(x.dtype)).astype(jnp.float32)
+    top_p, top_i = top_k_gating(logits, cfg.top_k)
+    all_out = jax.vmap(lambda p: _expert_ffn(p, tokens))(layer["experts"])
+    picked = jnp.take_along_axis(
+        all_out.transpose(1, 0, 2), top_i[..., None], axis=1)  # [T,k,D]
+    out = jnp.sum(picked * top_p[..., None].astype(x.dtype), axis=1)
+    return out.reshape(b, s, d)
+
+
+def forward(params, tokens, cfg: MixtralConfig, mesh=None):
+    """tokens [B, T] → logits [B, T, vocab]."""
+    lcfg = cfg.as_llama()
+    b, t = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    cos, sin = _llama.rope_freqs(lcfg, positions)
+
+    def body(x, layer):
+        h = _llama.rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"])
+        q = _llama.apply_rope(q, cos, sin)
+        k = _llama.apply_rope(k, cos, sin)
+        attn = _llama._attention(q, k, v, lcfg, mesh)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+        h = _llama.rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _moe_block(h, layer, cfg, mesh)
+        return x, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: MixtralConfig, mesh=None):
+    tokens = batch["tokens"] if isinstance(batch, dict) else batch
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
