@@ -1,0 +1,301 @@
+// Native shared-memory object store: single mmapped arena + free-list
+// allocator + LRU eviction.
+//
+// TPU-native analog of the reference's plasma store internals
+// (/root/reference/src/ray/object_manager/plasma/store.cc,
+//  plasma_allocator.cc + dlmalloc.cc, eviction_policy.cc): one POSIX shm
+// arena per node agent; objects are [offset, size) extents handed out by a
+// best-fit free list with coalescing; sealed+unpinned objects are evicted in
+// LRU order when an allocation needs space. Clients (ray_tpu workers) mmap
+// the arena once and read objects zero-copy at their offsets — the shm name
+// plus offset plays the role of plasma's fd-passing (fling.cc).
+//
+// Exposed as a C ABI consumed via ctypes (ray_tpu/_native/__init__.py); the
+// store object itself lives in the node-agent process only.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <list>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Object {
+  uint64_t offset = 0;
+  uint64_t size = 0;
+  bool sealed = false;
+  bool pinned = true;
+  uint64_t lru_tick = 0;
+};
+
+constexpr uint64_t kAlign = 64;  // cacheline; TPU host DMA likes >=64B
+
+uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+class ShmArenaStore {
+ public:
+  ShmArenaStore(const std::string& name, uint64_t capacity)
+      : name_(name), capacity_(align_up(capacity)) {
+    fd_ = shm_open(name_.c_str(), O_CREAT | O_RDWR | O_EXCL, 0600);
+    if (fd_ < 0 && errno == EEXIST) {
+      shm_unlink(name_.c_str());
+      fd_ = shm_open(name_.c_str(), O_CREAT | O_RDWR | O_EXCL, 0600);
+    }
+    if (fd_ < 0) return;
+    if (ftruncate(fd_, (off_t)capacity_) != 0) {
+      close(fd_);
+      fd_ = -1;
+      return;
+    }
+    base_ = mmap(nullptr, capacity_, PROT_READ | PROT_WRITE, MAP_SHARED, fd_, 0);
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      close(fd_);
+      fd_ = -1;
+      return;
+    }
+    free_list_.push_back({0, capacity_});
+  }
+
+  ~ShmArenaStore() {
+    if (base_ != nullptr) munmap(base_, capacity_);
+    if (fd_ >= 0) {
+      close(fd_);
+      shm_unlink(name_.c_str());
+    }
+  }
+
+  bool ok() const { return base_ != nullptr; }
+
+  // Allocate an extent for `id`. Evicts LRU unpinned sealed objects as
+  // needed. Returns 0 on success (offset in *offset_out), -1 if the object
+  // exists already (offset returned too), -2 if out of memory even after
+  // eviction. Evicted ids are appended newline-separated into evicted_buf.
+  int Put(const std::string& id, uint64_t size, uint64_t* offset_out,
+          char* evicted_buf, uint64_t evicted_cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it != objects_.end()) {
+      *offset_out = it->second.offset;
+      return -1;
+    }
+    uint64_t need = align_up(size == 0 ? kAlign : size);
+    std::string evicted;
+    while (true) {
+      int64_t off = AllocLocked(need);
+      if (off >= 0) {
+        Object obj;
+        obj.offset = (uint64_t)off;
+        obj.size = size;
+        obj.lru_tick = ++tick_;
+        objects_[id] = obj;
+        used_ += need;
+        *offset_out = obj.offset;
+        if (!evicted.empty() && evicted_buf != nullptr && evicted_cap > 0) {
+          size_t n = evicted.size() < evicted_cap - 1 ? evicted.size()
+                                                      : evicted_cap - 1;
+          memcpy(evicted_buf, evicted.data(), n);
+          evicted_buf[n] = '\0';
+        }
+        return 0;
+      }
+      // evict one LRU victim (sealed + unpinned)
+      std::string victim;
+      uint64_t best_tick = UINT64_MAX;
+      for (const auto& kv : objects_) {
+        if (kv.second.sealed && !kv.second.pinned &&
+            kv.second.lru_tick < best_tick) {
+          best_tick = kv.second.lru_tick;
+          victim = kv.first;
+        }
+      }
+      if (victim.empty()) return -2;
+      evicted += victim;
+      evicted += '\n';
+      num_evicted_++;
+      DeleteLocked(victim);
+    }
+  }
+
+  int Seal(const std::string& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    it->second.sealed = true;
+    it->second.lru_tick = ++tick_;
+    return 0;
+  }
+
+  int Get(const std::string& id, uint64_t* offset, uint64_t* size,
+          int* sealed) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    it->second.lru_tick = ++tick_;
+    *offset = it->second.offset;
+    *size = it->second.size;
+    *sealed = it->second.sealed ? 1 : 0;
+    return 0;
+  }
+
+  int Pin(const std::string& id, int pinned) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    it->second.pinned = pinned != 0;
+    return 0;
+  }
+
+  int Delete(const std::string& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return -1;
+    DeleteLocked(id);
+    return 0;
+  }
+
+  void Stats(uint64_t* used, uint64_t* num_objects, uint64_t* num_evicted,
+             uint64_t* capacity) {
+    std::lock_guard<std::mutex> g(mu_);
+    *used = used_;
+    *num_objects = objects_.size();
+    *num_evicted = num_evicted_;
+    *capacity = capacity_;
+  }
+
+  void* base() const { return base_; }
+
+ private:
+  // best-fit with address-ordered free list + coalescing
+  int64_t AllocLocked(uint64_t need) {
+    auto best = free_list_.end();
+    for (auto it = free_list_.begin(); it != free_list_.end(); ++it) {
+      if (it->size >= need &&
+          (best == free_list_.end() || it->size < best->size)) {
+        best = it;
+      }
+    }
+    if (best == free_list_.end()) return -1;
+    uint64_t off = best->offset;
+    if (best->size == need) {
+      free_list_.erase(best);
+    } else {
+      best->offset += need;
+      best->size -= need;
+    }
+    extents_[off] = need;
+    return (int64_t)off;
+  }
+
+  void FreeLocked(uint64_t offset) {
+    auto ext = extents_.find(offset);
+    if (ext == extents_.end()) return;
+    uint64_t size = ext->second;
+    extents_.erase(ext);
+    used_ -= size;
+    // insert address-ordered, coalesce neighbors
+    auto it = free_list_.begin();
+    while (it != free_list_.end() && it->offset < offset) ++it;
+    it = free_list_.insert(it, {offset, size});
+    if (it != free_list_.begin()) {
+      auto prev = std::prev(it);
+      if (prev->offset + prev->size == it->offset) {
+        prev->size += it->size;
+        free_list_.erase(it);
+        it = prev;
+      }
+    }
+    auto next = std::next(it);
+    if (next != free_list_.end() && it->offset + it->size == next->offset) {
+      it->size += next->size;
+      free_list_.erase(next);
+    }
+  }
+
+  void DeleteLocked(const std::string& id) {
+    auto it = objects_.find(id);
+    if (it == objects_.end()) return;
+    FreeLocked(it->second.offset);
+    objects_.erase(it);
+  }
+
+  std::string name_;
+  uint64_t capacity_;
+  int fd_ = -1;
+  void* base_ = nullptr;
+  std::mutex mu_;
+  std::unordered_map<std::string, Object> objects_;
+  std::list<FreeBlock> free_list_;                // address-ordered
+  std::unordered_map<uint64_t, uint64_t> extents_;  // offset -> alloc size
+  uint64_t used_ = 0;
+  uint64_t tick_ = 0;
+  uint64_t num_evicted_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rtpu_store_create(const char* name, uint64_t capacity) {
+  auto* s = new ShmArenaStore(name, capacity);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void rtpu_store_destroy(void* store) {
+  delete static_cast<ShmArenaStore*>(store);
+}
+
+int rtpu_store_put(void* store, const char* id, uint64_t size,
+                   uint64_t* offset_out, char* evicted_buf,
+                   uint64_t evicted_cap) {
+  return static_cast<ShmArenaStore*>(store)->Put(id, size, offset_out,
+                                                 evicted_buf, evicted_cap);
+}
+
+int rtpu_store_seal(void* store, const char* id) {
+  return static_cast<ShmArenaStore*>(store)->Seal(id);
+}
+
+int rtpu_store_get(void* store, const char* id, uint64_t* offset,
+                   uint64_t* size, int* sealed) {
+  return static_cast<ShmArenaStore*>(store)->Get(id, offset, size, sealed);
+}
+
+int rtpu_store_pin(void* store, const char* id, int pinned) {
+  return static_cast<ShmArenaStore*>(store)->Pin(id, pinned);
+}
+
+int rtpu_store_delete(void* store, const char* id) {
+  return static_cast<ShmArenaStore*>(store)->Delete(id);
+}
+
+void rtpu_store_stats(void* store, uint64_t* used, uint64_t* num_objects,
+                      uint64_t* num_evicted, uint64_t* capacity) {
+  static_cast<ShmArenaStore*>(store)->Stats(used, num_objects, num_evicted,
+                                            capacity);
+}
+
+// Direct write/read helpers for the agent process (tests + local fast path).
+void* rtpu_store_base(void* store) {
+  return static_cast<ShmArenaStore*>(store)->base();
+}
+
+}  // extern "C"

@@ -335,8 +335,9 @@ class ControlPlane:
             info = self._actors.get(actor_id)
             if info is None or info.state == ActorState.DEAD:
                 return
-            # release lease resources
-            if info.node_id is not None:
+            # release lease resources (PG actors drew from the bundle
+            # reservation, which stays held by the PG until it is removed)
+            if info.node_id is not None and info.pg_id is None:
                 self._release_node_resources(info.node_id, info.spec.resources)
             restartable = (not force_dead and not clean
                            and (info.max_restarts < 0 or info.num_restarts < info.max_restarts))
@@ -481,6 +482,10 @@ class ControlPlane:
             views = [v for v in views if v.node_id in candidates]
             lease_body = {"resources": resources, "pg_id": pg_id,
                           "bundle_index": idx}
+            # Bundle resources were subtracted from the node view at PG
+            # commit; the actor draws from the bundle's reservation, so the
+            # fit check here must not demand them from `available` again.
+            resources = {}
         else:
             lease_body = {"resources": resources}
         node = pick_node(views, resources, strategy)

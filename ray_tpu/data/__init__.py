@@ -1,0 +1,39 @@
+"""ray_tpu.data — streaming dataset engine (reference: python/ray/data/).
+
+Lazy logical plans over Arrow blocks in the object store, executed by a
+streaming executor with backpressure; `iter_jax_batches` double-buffers
+batches into TPU HBM.
+"""
+
+from ray_tpu.data import aggregate
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import Dataset, MaterializedDataset
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_images,
+    read_json,
+    read_parquet,
+    read_text,
+    read_tfrecords,
+)
+
+__all__ = [
+    "Block", "BlockAccessor", "BlockMetadata", "Count", "DataIterator",
+    "Dataset", "Datasource", "MaterializedDataset", "Max", "Mean", "Min",
+    "ReadTask", "Std", "Sum", "aggregate", "from_arrow", "from_huggingface",
+    "from_items", "from_numpy", "from_pandas", "range", "range_tensor",
+    "read_binary_files", "read_csv", "read_datasource", "read_images",
+    "read_json", "read_parquet", "read_text", "read_tfrecords",
+]

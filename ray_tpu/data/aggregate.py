@@ -1,0 +1,77 @@
+"""Aggregation functions for groupby (reference:
+/root/reference/python/ray/data/aggregate.py — AggregateFn, Count, Sum, Min,
+Max, Mean, Std, plus grouped_data.py's dispatch)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, BlockAccessor, block_from_rows
+
+
+@dataclasses.dataclass
+class AggregateFn:
+    name: str
+    on: Optional[str]
+    compute: Callable[[np.ndarray], float]
+
+    def out_name(self) -> str:
+        return f"{self.name}({self.on})" if self.on else self.name
+
+
+def Count(on: Optional[str] = None) -> AggregateFn:
+    return AggregateFn("count", on, lambda v: int(len(v)))
+
+
+def Sum(on: str) -> AggregateFn:
+    return AggregateFn("sum", on, lambda v: v.sum())
+
+
+def Min(on: str) -> AggregateFn:
+    return AggregateFn("min", on, lambda v: v.min())
+
+
+def Max(on: str) -> AggregateFn:
+    return AggregateFn("max", on, lambda v: v.max())
+
+
+def Mean(on: str) -> AggregateFn:
+    return AggregateFn("mean", on, lambda v: v.mean())
+
+
+def Std(on: str, ddof: int = 1) -> AggregateFn:
+    return AggregateFn("std", on, lambda v: v.std(ddof=ddof))
+
+
+def apply_aggs(table: Block, key: Optional[str], aggs: list[AggregateFn]) -> Block:
+    acc = BlockAccessor.for_block(table)
+    if acc.num_rows() == 0:
+        return pa.table({})
+    if key is None:
+        row = {}
+        for agg in aggs:
+            col = (acc.column_to_numpy(agg.on) if agg.on
+                   else np.arange(acc.num_rows()))
+            row[agg.out_name()] = agg.compute(col)
+        return block_from_rows([row])
+    keys = acc.column_to_numpy(key)
+    order = np.argsort(keys, kind="stable")
+    sorted_keys = keys[order]
+    uniq, starts = np.unique(sorted_keys, return_index=True)
+    rows = []
+    for i, k in enumerate(uniq):
+        lo = starts[i]
+        hi = starts[i + 1] if i + 1 < len(starts) else len(sorted_keys)
+        idx = order[lo:hi]
+        row = {key: k.item() if hasattr(k, "item") else k}
+        for agg in aggs:
+            col = (acc.column_to_numpy(agg.on)[idx] if agg.on
+                   else np.arange(len(idx)))
+            val = agg.compute(col)
+            row[agg.out_name()] = val.item() if hasattr(val, "item") else val
+        rows.append(row)
+    return block_from_rows(rows)

@@ -1,0 +1,218 @@
+"""Block layer: the unit of data movement in ray_tpu.data.
+
+TPU-native analog of the reference's block layer
+(/root/reference/python/ray/data/block.py, _internal/arrow_block.py,
+pandas_block.py, table_block.py): a Block is an Arrow table (columnar,
+zero-copy into the object store) and `BlockAccessor` provides the uniform
+operations the physical operators need. A lightweight BlockMetadata rides
+alongside every block ref so the executor can make scheduling/backpressure
+decisions without fetching data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: list = dataclasses.field(default_factory=list)
+    exec_stats: Optional[dict] = None
+
+
+def _normalize_column(values) -> pa.Array | pa.ChunkedArray:
+    if isinstance(values, (pa.Array, pa.ChunkedArray)):
+        return values
+    if isinstance(values, np.ndarray) and values.ndim > 1:
+        # tensor column: store as fixed-size-list of flattened rows
+        flat = values.reshape(len(values), -1)
+        inner = pa.array(flat.ravel())
+        arr = pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
+        return arr
+    return pa.array(values)
+
+
+def block_from_dict(columns: dict[str, Any]) -> Block:
+    """Build a block from {column: values} (values: list/np/arrow)."""
+    names, arrays, meta = [], [], {}
+    for name, values in columns.items():
+        arr = _normalize_column(values)
+        if isinstance(values, np.ndarray) and values.ndim > 1:
+            meta[name] = values.shape[1:]
+        names.append(name)
+        arrays.append(arr)
+    tbl = pa.table(dict(zip(names, arrays)))
+    if meta:
+        md = {f"tensor_shape:{k}": repr(v) for k, v in meta.items()}
+        tbl = tbl.replace_schema_metadata(
+            {**(tbl.schema.metadata or {}),
+             **{k.encode(): v.encode() for k, v in md.items()}})
+    return tbl
+
+
+def block_from_rows(rows: list[dict]) -> Block:
+    if not rows:
+        return pa.table({})
+    cols: dict[str, list] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r.get(k))
+    return block_from_dict(cols)
+
+
+def block_from_items(items: list) -> Block:
+    """Wrap plain python items as single-column blocks (reference uses the
+    'item' column for from_items, read_api.py from_items)."""
+    if items and isinstance(items[0], dict):
+        return block_from_rows(items)
+    return block_from_dict({"item": list(items)})
+
+
+class BlockAccessor:
+    """Uniform block ops (reference: BlockAccessor in data/block.py)."""
+
+    def __init__(self, block: Block):
+        if isinstance(block, dict):
+            block = block_from_dict(block)
+        elif isinstance(block, list):
+            block = block_from_items(block)
+        self._table = block
+
+    @staticmethod
+    def for_block(block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    @property
+    def table(self) -> pa.Table:
+        return self._table
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def metadata(self, input_files: Optional[list] = None) -> BlockMetadata:
+        return BlockMetadata(num_rows=self.num_rows(),
+                             size_bytes=self.size_bytes(),
+                             schema=self.schema(),
+                             input_files=input_files or [])
+
+    def _tensor_shape(self, name: str):
+        md = self._table.schema.metadata or {}
+        raw = md.get(f"tensor_shape:{name}".encode())
+        if raw is None:
+            return None
+        return tuple(eval(raw.decode()))  # noqa: S307 - repr of int tuple
+
+    def column_to_numpy(self, name: str) -> np.ndarray:
+        col = self._table.column(name)
+        if pa.types.is_fixed_size_list(col.type):
+            flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+            n = len(col)
+            shape = self._tensor_shape(name) or (col.type.list_size,)
+            return flat.reshape((n, *shape))
+        return col.to_numpy(zero_copy_only=False)
+
+    def to_numpy(self, columns: Optional[list[str]] = None) -> dict[str, np.ndarray]:
+        names = columns or self._table.column_names
+        return {n: self.column_to_numpy(n) for n in names}
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_pylist(self) -> list[dict]:
+        return self._table.to_pylist()
+
+    def iter_rows(self) -> Iterator[dict]:
+        for batch in self._table.to_batches():
+            yield from batch.to_pylist()
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take_indices(self, indices) -> Block:
+        return self._table.take(pa.array(indices))
+
+    def select(self, columns: list[str]) -> Block:
+        return self._table.select(columns)
+
+    def drop(self, columns: list[str]) -> Block:
+        keep = [c for c in self._table.column_names if c not in columns]
+        return self._table.select(keep)
+
+    def rename(self, mapping: dict[str, str]) -> Block:
+        names = [mapping.get(c, c) for c in self._table.column_names]
+        return self._table.rename_columns(names)
+
+    def filter_rows(self, predicate: Callable[[dict], bool]) -> Block:
+        mask = [bool(predicate(r)) for r in self.iter_rows()]
+        return self._table.filter(pa.array(mask))
+
+    def sort(self, key: str, descending: bool = False) -> Block:
+        order = "descending" if descending else "ascending"
+        return self._table.sort_by([(key, order)])
+
+    def sample(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.default_rng(seed)
+        n = min(n, self.num_rows())
+        idx = rng.choice(self.num_rows(), size=n, replace=False)
+        return self.take_indices(np.sort(idx))
+
+    @staticmethod
+    def concat(blocks: Iterable[Block]) -> Block:
+        blocks = [b for b in blocks if b is not None and b.num_rows > 0]
+        if not blocks:
+            return pa.table({})
+        # unify metadata (tensor shapes) from the first block
+        out = pa.concat_tables(blocks, promote_options="default")
+        md = blocks[0].schema.metadata
+        if md:
+            out = out.replace_schema_metadata(md)
+        return out
+
+    @staticmethod
+    def batch_to_block(batch) -> Block:
+        """Normalize a user map_batches return value into a Block."""
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, dict):
+            return block_from_dict(batch)
+        if isinstance(batch, list):
+            return block_from_items(batch)
+        try:
+            import pandas as pd
+            if isinstance(batch, pd.DataFrame):
+                return pa.Table.from_pandas(batch, preserve_index=False)
+        except ImportError:
+            pass
+        raise TypeError(
+            f"map_batches fn must return dict/pa.Table/pd.DataFrame/list, "
+            f"got {type(batch)}")
+
+
+def format_batch(block: Block, batch_format: str):
+    """Convert a block to the requested batch format (reference:
+    data/_internal/batcher.py + block accessor to_batch_format)."""
+    acc = BlockAccessor.for_block(block)
+    if batch_format in ("numpy", "default"):
+        return acc.to_numpy()
+    if batch_format == "pandas":
+        return acc.to_pandas()
+    if batch_format in ("pyarrow", "arrow"):
+        return acc.table
+    if batch_format == "rows":
+        return acc.to_pylist()
+    raise ValueError(f"unknown batch_format {batch_format!r}")

@@ -1,0 +1,708 @@
+"""Streaming executor + physical operators.
+
+TPU-native analog of the reference's execution layer
+(/root/reference/python/ray/data/_internal/execution/ — StreamingExecutor
+streaming_executor.py:61/execute:141/_scheduling_loop_step:421, operator
+selection select_operator_to_run streaming_executor_state.py:670, physical
+operators operators/*.py, backpressure resource_manager.py). Blocks flow as
+object-store refs between operators; each map stage is a ray_tpu task (or a
+call on a pooled actor for stateful transforms) returning (block, metadata)
+as two refs so the driver schedules on metadata without fetching data.
+
+Backpressure: each operator has a bounded in-flight task budget and a bounded
+output buffer; the terminal output queue is bounded and consumer-driven, so a
+slow consumer stalls the whole pipeline instead of buffering the dataset in
+memory (the reference's resource_manager budget, simplified to counts).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata, format_batch
+from ray_tpu.data.logical import (
+    AbstractMap,
+    Aggregate,
+    FusedMap,
+    InputData,
+    Limit,
+    LogicalPlan,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    Union,
+    Write,
+    Zip,
+    optimize,
+)
+
+# A bundle is (block_ref, BlockMetadata)
+Bundle = tuple
+
+
+# ---- remote transform kernels -------------------------------------------
+
+
+def _apply_stage(block: Block, stage: AbstractMap, fn) -> Block:
+    acc = BlockAccessor.for_block(block)
+    if stage.mode == "rows":
+        out_rows = [fn(r, *stage.fn_args, **stage.fn_kwargs)
+                    for r in acc.iter_rows()]
+        from ray_tpu.data.block import block_from_rows
+        return block_from_rows(out_rows)
+    if stage.mode == "flat":
+        out_rows = []
+        for r in acc.iter_rows():
+            out_rows.extend(fn(r, *stage.fn_args, **stage.fn_kwargs))
+        from ray_tpu.data.block import block_from_rows
+        return block_from_rows(out_rows)
+    if stage.mode == "filter":
+        return acc.filter_rows(lambda r: fn(r, *stage.fn_args, **stage.fn_kwargs))
+    # batches
+    out_blocks = []
+    n = acc.num_rows()
+    bs = stage.batch_size or n or 1
+    for start in range(0, max(n, 1), bs):
+        if n == 0:
+            break
+        batch = format_batch(acc.slice(start, min(start + bs, n)),
+                             stage.batch_format)
+        res = fn(batch, *stage.fn_args, **stage.fn_kwargs)
+        out_blocks.append(BlockAccessor.batch_to_block(res))
+    return BlockAccessor.concat(out_blocks)
+
+
+def _resolve_fn(stage: AbstractMap, instance_cache: dict):
+    fn = stage.fn
+    if isinstance(fn, type):  # callable class → construct once per worker
+        key = (id(stage), fn)
+        if key not in instance_cache:
+            instance_cache[key] = fn(*stage.fn_constructor_args)
+        return instance_cache[key]
+    return fn
+
+
+@ray_tpu.remote(num_returns=2)
+def _map_task(block: Block, stages: list):
+    cache: dict = {}
+    for stage in stages:
+        block = _apply_stage(block, stage, _resolve_fn(stage, cache))
+    return block, BlockAccessor.for_block(block).metadata()
+
+
+@ray_tpu.remote(num_returns=2)
+def _read_task(task):
+    blocks = list(task())
+    block = BlockAccessor.concat(blocks)
+    return block, BlockAccessor.for_block(block).metadata(
+        input_files=task.input_files)
+
+
+@ray_tpu.remote(num_returns=2)
+def _slice_task(block: Block, start: int, end: int):
+    out = BlockAccessor.for_block(block).slice(start, end)
+    return out, BlockAccessor.for_block(out).metadata()
+
+
+@ray_tpu.remote(num_returns=2)
+def _concat_task(*blocks):
+    out = BlockAccessor.concat(list(blocks))
+    return out, BlockAccessor.for_block(out).metadata()
+
+
+@ray_tpu.remote
+class _MapWorker:
+    """Actor for compute='actors' stages (reference ActorPoolMapOperator)."""
+
+    def __init__(self, stages):
+        self._stages = stages
+        self._cache: dict = {}
+
+    def map(self, block: Block):
+        for stage in self._stages:
+            block = _apply_stage(block, stage, _resolve_fn(stage, self._cache))
+        return block, BlockAccessor.for_block(block).metadata()
+
+
+# ---- physical operators --------------------------------------------------
+
+
+class PhysicalOp:
+    def __init__(self, name: str, inputs: list["PhysicalOp"]):
+        self.name = name
+        self.inputs = inputs
+        self.out: list[Bundle] = []          # ready output bundles
+        self._inputs_done = False
+        self.done = False
+
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        raise NotImplementedError
+
+    def inputs_done(self):
+        self._inputs_done = True
+
+    def poll(self):
+        """Advance async work; move finished results to self.out."""
+
+    def can_accept(self) -> bool:
+        return True
+
+    def shutdown(self):
+        pass
+
+
+class InputOp(PhysicalOp):
+    def __init__(self, bundles: list[Bundle]):
+        super().__init__("Input", [])
+        self.out = list(bundles)
+        self._inputs_done = True
+        self.done = True
+
+
+class TaskMapOp(PhysicalOp):
+    """Fused task-based map (reference TaskPoolMapOperator)."""
+
+    MAX_IN_FLIGHT = 8
+    MAX_OUT_BUFFER = 16
+
+    def __init__(self, name, inputs, stages: list[AbstractMap],
+                 resources: Optional[dict] = None):
+        super().__init__(name, inputs)
+        self._stages = stages
+        self._resources = dict(resources or {})
+        self._in_flight: list[tuple] = []  # (block_ref, meta_ref)
+
+    def can_accept(self) -> bool:
+        return (len(self._in_flight) < self.MAX_IN_FLIGHT
+                and len(self.out) < self.MAX_OUT_BUFFER)
+
+    def _submit(self, block_ref):
+        opts = {}
+        if self._resources:
+            opts["resources"] = self._resources
+        b, m = _map_task.options(**opts).remote(block_ref, self._stages)
+        self._in_flight.append((b, m))
+
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        self._submit(bundle[0])
+
+    def poll(self):
+        # Emit strictly in submission order (head-of-line) so downstream
+        # consumers see a deterministic block order (reference preserve_order).
+        while self._in_flight:
+            b, m = self._in_flight[0]
+            ready, _ = ray_tpu.wait([m], num_returns=1, timeout=0)
+            if not ready:
+                break
+            self._in_flight.pop(0)
+            meta = ray_tpu.get(m)
+            if meta.num_rows > 0:
+                self.out.append((b, meta))
+        if self._inputs_done and not self._in_flight:
+            self.done = True
+
+
+class ActorMapOp(PhysicalOp):
+    """Actor-pool map for stateful transforms (reference
+    ActorPoolMapOperator). Round-robins blocks over a fixed pool."""
+
+    MAX_IN_FLIGHT_PER_ACTOR = 2
+
+    def __init__(self, name, inputs, stages, num_actors: int,
+                 resources: Optional[dict] = None):
+        super().__init__(name, inputs)
+        self._stages = stages
+        opts = {"resources": dict(resources)} if resources else {}
+        self._actors = [_MapWorker.options(**opts).remote(stages)
+                        for _ in range(num_actors)]
+        self._in_flight: list = []
+        self._next = 0
+
+    def can_accept(self) -> bool:
+        return len(self._in_flight) < len(self._actors) * self.MAX_IN_FLIGHT_PER_ACTOR
+
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        actor = self._actors[self._next % len(self._actors)]
+        self._next += 1
+        self._in_flight.append(actor.map.remote(bundle[0]))
+
+    def poll(self):
+        while self._in_flight:
+            ref = self._in_flight[0]
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if not ready:
+                break
+            self._in_flight.pop(0)
+            block, meta = ray_tpu.get(ref)
+            if meta.num_rows > 0:
+                self.out.append((ray_tpu.put(block), meta))
+        if self._inputs_done and not self._in_flight:
+            self.done = True
+            self.shutdown()
+
+    def shutdown(self):
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        self._actors = []
+
+
+class ReadOp(TaskMapOp):
+    def __init__(self, name, read_tasks):
+        PhysicalOp.__init__(self, name, [])
+        self._stages = []
+        self._resources = {}
+        self._in_flight = []
+        self._pending = list(read_tasks)
+        self._inputs_done = True
+
+    def can_accept(self):
+        return False
+
+    def poll(self):
+        while self._pending and len(self._in_flight) < self.MAX_IN_FLIGHT \
+                and len(self.out) < self.MAX_OUT_BUFFER:
+            task = self._pending.pop(0)
+            self._in_flight.append(_read_task.remote(task))
+        while self._in_flight:
+            b, m = self._in_flight[0]
+            ready, _ = ray_tpu.wait([m], num_returns=1, timeout=0)
+            if not ready:
+                break
+            self._in_flight.pop(0)
+            meta = ray_tpu.get(m)
+            if meta.num_rows > 0:
+                self.out.append((b, meta))
+        if not self._pending and not self._in_flight:
+            self.done = True
+
+
+class LimitOp(PhysicalOp):
+    """Truncate the stream after N rows (reference limit_operator.py)."""
+
+    def __init__(self, name, inputs, limit: int):
+        super().__init__(name, inputs)
+        self._remaining = limit
+        self._pending_slice = None
+
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        if self._remaining <= 0:
+            return
+        ref, meta = bundle
+        if meta.num_rows <= self._remaining:
+            self._remaining -= meta.num_rows
+            self.out.append(bundle)
+        else:
+            b, m = _slice_task.remote(ref, 0, self._remaining)
+            self._remaining = 0
+            self._pending_slice = (b, m)
+
+    def truncated(self) -> bool:
+        return self._remaining <= 0
+
+    def poll(self):
+        if self._pending_slice is not None:
+            b, m = self._pending_slice
+            ready, _ = ray_tpu.wait([m], num_returns=1, timeout=0)
+            if ready:
+                self.out.append((b, ray_tpu.get(m)))
+                self._pending_slice = None
+        if (self._inputs_done or self.truncated()) and self._pending_slice is None:
+            self.done = True
+
+
+class UnionOp(PhysicalOp):
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        self.out.append(bundle)
+
+    def poll(self):
+        if self._inputs_done:
+            self.done = True
+
+
+class ZipOp(PhysicalOp):
+    """Align two streams row-for-row (reference zip_operator.py). Barrier on
+    both sides, then zip block-by-block with realignment."""
+
+    def __init__(self, name, inputs):
+        super().__init__(name, inputs)
+        self._buffers: dict[int, list[Bundle]] = {0: [], 1: []}
+        self._done_flags = [False, False]
+
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        self._buffers[input_index].append(bundle)
+
+    def inputs_done(self):
+        self._inputs_done = True
+
+    def poll(self):
+        if not self._inputs_done or self.done:
+            return
+        left = [b for b, _ in self._buffers[0]]
+        right = [b for b, _ in self._buffers[1]]
+        if not left and not right:
+            self.done = True
+            return
+        lt = BlockAccessor.concat([ray_tpu.get(b) for b in left])
+        rt = BlockAccessor.concat([ray_tpu.get(b) for b in right])
+        n = min(lt.num_rows, rt.num_rows)
+        lt, rt = lt.slice(0, n), rt.slice(0, n)
+        cols = {name: lt.column(name) for name in lt.column_names}
+        for name in rt.column_names:
+            out_name = name if name not in cols else name + "_1"
+            cols[out_name] = rt.column(name)
+        import pyarrow as pa
+        out = pa.table(cols)
+        self.out.append((ray_tpu.put(out),
+                         BlockAccessor.for_block(out).metadata()))
+        self.done = True
+
+
+class AllToAllOp(PhysicalOp):
+    """Barrier op base: buffers all input bundles, then runs a shuffle plan."""
+
+    def __init__(self, name, inputs):
+        super().__init__(name, inputs)
+        self._bundles: list[Bundle] = []
+        self._started = False
+        self._phase2: list[tuple] = []
+
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        self._bundles.append(bundle)
+
+    def _run(self, bundles: list[Bundle]):
+        raise NotImplementedError
+
+    def poll(self):
+        if self.done:
+            return
+        if self._inputs_done and not self._started:
+            self._started = True
+            self._run(self._bundles)
+        if self._started:
+            while self._phase2:
+                b, m = self._phase2[0]
+                ready, _ = ray_tpu.wait([m], num_returns=1, timeout=0)
+                if not ready:
+                    break
+                self._phase2.pop(0)
+                meta = ray_tpu.get(m)
+                if meta.num_rows > 0:
+                    self.out.append((b, meta))
+            if not self._phase2:
+                self.done = True
+
+
+@ray_tpu.remote
+def _partition_task(block: Block, n: int, how: str, key=None, seed=None,
+                    bounds=None):
+    """Split one block into n parts (round-robin / random / hash / range)."""
+    acc = BlockAccessor.for_block(block)
+    rows = acc.num_rows()
+    if how == "round":
+        idx = np.arange(rows)
+        assign = idx % n
+    elif how == "random":
+        rng = np.random.default_rng(seed)
+        assign = rng.integers(0, n, size=rows)
+    elif how == "hash":
+        col = acc.column_to_numpy(key)
+        assign = np.array([hash(x) % n for x in col.tolist()])
+    elif how == "range":
+        col = acc.column_to_numpy(key)
+        assign = np.searchsorted(np.asarray(bounds), col, side="right")
+    else:
+        raise ValueError(how)
+    return [acc.take_indices(np.nonzero(assign == i)[0]) for i in range(n)]
+
+
+class RepartitionOp(AllToAllOp):
+    def __init__(self, name, inputs, num_blocks: int, how: str = "round",
+                 key=None, seed=None, local_shuffle: bool = False):
+        super().__init__(name, inputs)
+        self._n = num_blocks
+        self._how = how
+        self._key = key
+        self._seed = seed
+
+    def _run(self, bundles):
+        n = self._n
+        if not bundles:
+            return
+        part_refs = [_partition_task.remote(b, n, self._how, self._key,
+                                            self._seed) for b, _ in bundles]
+        parts = ray_tpu.get(part_refs)  # list (per input block) of n blocks
+        for i in range(n):
+            shard = [p[i] for p in parts]
+            refs = [ray_tpu.put(s) for s in shard]
+            self._phase2.append(_concat_task.remote(*refs))
+
+
+class SortOp(AllToAllOp):
+    """Distributed sample sort (reference sort.py): sample → boundaries →
+    range partition → per-partition sort-merge."""
+
+    def __init__(self, name, inputs, key: str, descending: bool = False):
+        super().__init__(name, inputs)
+        self._key = key
+        self._desc = descending
+
+    def _run(self, bundles):
+        if not bundles:
+            return
+        n = max(1, len(bundles))
+        blocks = [ray_tpu.get(b) for b, _ in bundles]
+        samples = []
+        for blk in blocks:
+            acc = BlockAccessor.for_block(blk)
+            if acc.num_rows():
+                samples.append(acc.sample(min(20, acc.num_rows()))
+                               .column(self._key).to_numpy(zero_copy_only=False))
+        if not samples:
+            return
+        allsamp = np.sort(np.concatenate(samples))
+        bounds = [allsamp[int(len(allsamp) * (i + 1) / n)]
+                  for i in range(n - 1)] if n > 1 else []
+        part_refs = [_partition_task.remote(b, n, "range", self._key, None,
+                                            bounds) for b, _ in bundles]
+        parts = ray_tpu.get(part_refs)
+        order = range(n - 1, -1, -1) if self._desc else range(n)
+        for i in order:
+            shard = [p[i] for p in parts]
+            refs = [ray_tpu.put(s) for s in shard]
+            self._phase2.append(_sort_merge_task.remote(
+                self._key, self._desc, *refs))
+
+
+@ray_tpu.remote(num_returns=2)
+def _sort_merge_task(key: str, descending: bool, *blocks):
+    out = BlockAccessor.concat(list(blocks))
+    out = BlockAccessor.for_block(out).sort(key, descending)
+    return out, BlockAccessor.for_block(out).metadata()
+
+
+class AggregateOp(AllToAllOp):
+    """Hash-partition groupby + per-partition combine (reference
+    hash_aggregate.py)."""
+
+    def __init__(self, name, inputs, key: Optional[str], aggs: list):
+        super().__init__(name, inputs)
+        self._key = key
+        self._aggs = aggs
+
+    def _run(self, bundles):
+        if not bundles:
+            return
+        if self._key is None:
+            refs = [b for b, _ in bundles]
+            self._phase2.append(_aggregate_task.remote(
+                None, self._aggs, *refs))
+            return
+        n = min(4, len(bundles))
+        part_refs = [_partition_task.remote(b, n, "hash", self._key)
+                     for b, _ in bundles]
+        parts = ray_tpu.get(part_refs)
+        for i in range(n):
+            shard = [p[i] for p in parts]
+            refs = [ray_tpu.put(s) for s in shard]
+            self._phase2.append(_aggregate_task.remote(
+                self._key, self._aggs, *refs))
+
+
+@ray_tpu.remote(num_returns=2)
+def _aggregate_task(key, aggs, *blocks):
+    from ray_tpu.data.aggregate import apply_aggs
+    table = BlockAccessor.concat(list(blocks))
+    out = apply_aggs(table, key, aggs)
+    return out, BlockAccessor.for_block(out).metadata()
+
+
+class WriteOp(TaskMapOp):
+    def __init__(self, name, inputs, path: str, file_format: str):
+        PhysicalOp.__init__(self, name, inputs)
+        self._stages = []
+        self._resources = {}
+        self._in_flight = []
+        self._path = path
+        self._fmt = file_format
+        self._index = 0
+
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        b, m = _write_task.remote(bundle[0], self._path, self._fmt, self._index)
+        self._index += 1
+        self._in_flight.append((b, m))
+
+
+@ray_tpu.remote(num_returns=2)
+def _write_task(block: Block, path: str, fmt: str, index: int):
+    from ray_tpu.data.datasource import write_block
+    out_path = write_block(block, path, fmt, index)
+    from ray_tpu.data.block import block_from_dict
+    out = block_from_dict({"path": [out_path]})
+    return out, BlockAccessor.for_block(out).metadata()
+
+
+# ---- plan → physical ------------------------------------------------------
+
+
+def build_physical(plan: LogicalPlan, parallelism: int) -> list[PhysicalOp]:
+    plan = optimize(plan)
+    mapping: dict[int, PhysicalOp] = {}
+    ops: list[PhysicalOp] = []
+
+    for lop in plan.ops():
+        phys_inputs = [mapping[id(i)] for i in lop.inputs]
+        if isinstance(lop, Read):
+            tasks = lop.datasource.get_read_tasks(
+                lop.parallelism if lop.parallelism > 0 else parallelism)
+            op = ReadOp(lop.name, tasks)
+        elif isinstance(lop, InputData):
+            op = InputOp(lop.bundles)
+        elif isinstance(lop, FusedMap):
+            op = _map_physical(lop, phys_inputs, lop.stages)
+        elif isinstance(lop, AbstractMap):
+            op = _map_physical(lop, phys_inputs, [lop])
+        elif isinstance(lop, Limit):
+            op = LimitOp(lop.name or "Limit", phys_inputs, lop.limit)
+        elif isinstance(lop, Repartition):
+            op = RepartitionOp("Repartition", phys_inputs, lop.num_blocks)
+        elif isinstance(lop, RandomShuffle):
+            op = RepartitionOp("RandomShuffle", phys_inputs,
+                               max(1, parallelism), how="random",
+                               seed=lop.seed)
+        elif isinstance(lop, Sort):
+            op = SortOp("Sort", phys_inputs, lop.key, lop.descending)
+        elif isinstance(lop, Aggregate):
+            op = AggregateOp("Aggregate", phys_inputs, lop.key, lop.aggs)
+        elif isinstance(lop, Union):
+            op = UnionOp("Union", phys_inputs)
+        elif isinstance(lop, Zip):
+            op = ZipOp("Zip", phys_inputs)
+        elif isinstance(lop, Write):
+            op = WriteOp("Write", phys_inputs, lop.path, lop.file_format)
+        else:
+            raise TypeError(f"no physical op for {lop}")
+        mapping[id(lop)] = op
+        ops.append(op)
+    return ops
+
+
+def _map_physical(lop, phys_inputs, stages):
+    name = getattr(lop, "name", "Map")
+    if stages and stages[-1].compute == "actors" or \
+            (stages and stages[0].compute == "actors"):
+        st = stages[0]
+        return ActorMapOp(name, phys_inputs, stages, st.num_actors,
+                          st.resources)
+    res = stages[0].resources if stages else None
+    return TaskMapOp(name, phys_inputs, stages, res)
+
+
+# ---- the streaming loop ---------------------------------------------------
+
+
+class StreamingExecutor:
+    """Runs the physical op pipeline on a scheduler thread; the consumer
+    pulls bundles from a bounded queue (reference StreamingExecutor)."""
+
+    MAX_OUTPUT_QUEUE = 16
+
+    def __init__(self, plan: LogicalPlan, parallelism: int = 8):
+        self._ops = build_physical(plan, parallelism)
+        self._terminal = self._ops[-1]
+        self._outq: queue.Queue = queue.Queue(maxsize=self.MAX_OUTPUT_QUEUE)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._stopped = threading.Event()
+
+    def run(self) -> Iterator[Bundle]:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="data_executor")
+        self._thread.start()
+        while True:
+            item = self._outq.get()
+            if item is _DONE:
+                break
+            if isinstance(item, _ExecutorError):
+                raise item.error
+            yield item
+        if self._error is not None:
+            raise self._error
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        try:
+            consumers: dict[int, list[tuple[PhysicalOp, int]]] = {}
+            for op in self._ops:
+                for idx, inp in enumerate(op.inputs):
+                    consumers.setdefault(id(inp), []).append((op, idx))
+            while not self._stopped.is_set():
+                progressed = False
+                all_done = True
+                # early-exit: terminal LimitOp already satisfied
+                if isinstance(self._terminal, LimitOp) and \
+                        self._terminal.truncated():
+                    for op in self._ops[:-1]:
+                        op.shutdown()
+                for op in self._ops:
+                    op.poll()
+                    # move outputs downstream (or to the consumer queue)
+                    downstream = consumers.get(id(op), [])
+                    if not downstream:
+                        while op.out:
+                            bundle = op.out.pop(0)
+                            while not self._stopped.is_set():
+                                try:
+                                    self._outq.put(bundle, timeout=0.1)
+                                    break
+                                except queue.Full:
+                                    continue
+                            progressed = True
+                    else:
+                        while op.out:
+                            targets_ready = all(t.can_accept()
+                                                for t, _ in downstream)
+                            if not targets_ready:
+                                break
+                            bundle = op.out.pop(0)
+                            for t, idx in downstream:
+                                t.add_input(bundle, idx)
+                            progressed = True
+                        if op.done and not op.out:
+                            for t, _ in downstream:
+                                if not t._inputs_done and all(
+                                        i.done and not i.out for i in t.inputs):
+                                    t.inputs_done()
+                    if not (op.done and not op.out):
+                        all_done = False
+                if all_done:
+                    break
+                if not progressed:
+                    time.sleep(0.002)
+        except BaseException as e:  # noqa: BLE001 - surface to consumer
+            self._error = e
+            self._outq.put(_ExecutorError(e))
+            return
+        finally:
+            for op in self._ops:
+                op.shutdown()
+        self._outq.put(_DONE)
+
+
+class _ExecutorError:
+    def __init__(self, error):
+        self.error = error
+
+
+_DONE = object()

@@ -1,0 +1,68 @@
+"""GroupedData: groupby().agg/count/sum/... (reference:
+/root/reference/python/ray/data/grouped_data.py)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ray_tpu.data import aggregate as agg_mod
+from ray_tpu.data.logical import Aggregate, MapBatches
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._ds = dataset
+        self._key = key
+
+    def aggregate(self, *aggs) -> "Dataset":
+        return self._ds._with(Aggregate(
+            name=f"Aggregate({self._key})", inputs=[self._ds._terminal],
+            key=self._key, aggs=list(aggs)))
+
+    agg = aggregate
+
+    def count(self):
+        return self.aggregate(agg_mod.Count())
+
+    def sum(self, on: str):
+        return self.aggregate(agg_mod.Sum(on))
+
+    def min(self, on: str):
+        return self.aggregate(agg_mod.Min(on))
+
+    def max(self, on: str):
+        return self.aggregate(agg_mod.Max(on))
+
+    def mean(self, on: str):
+        return self.aggregate(agg_mod.Mean(on))
+
+    def std(self, on: str, ddof: int = 1):
+        return self.aggregate(agg_mod.Std(on, ddof))
+
+    def map_groups(self, fn: Callable) -> "Dataset":
+        """Apply fn to each group (runs after a sort-by-key repartition)."""
+        key = self._key
+
+        def apply(batch: dict):
+            import numpy as np
+
+            from ray_tpu.data.block import BlockAccessor, block_from_rows
+            keys = batch[key]
+            order = np.argsort(keys, kind="stable")
+            sorted_keys = keys[order]
+            uniq, starts = np.unique(sorted_keys, return_index=True)
+            outs = []
+            for i in range(len(uniq)):
+                lo = starts[i]
+                hi = starts[i + 1] if i + 1 < len(starts) else len(sorted_keys)
+                idx = order[lo:hi]
+                group = {k: v[idx] for k, v in batch.items()}
+                res = fn(group)
+                outs.append(BlockAccessor.batch_to_block(res))
+            return BlockAccessor.concat(outs)
+
+        # repartition by key hash so each group lands wholly in one block
+        from ray_tpu.data.dataset import Dataset
+        from ray_tpu.data.logical import Repartition
+        ds = self._ds.sort(self._key)
+        return ds.map_batches(apply, batch_format="numpy")

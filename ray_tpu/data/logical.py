@@ -1,0 +1,183 @@
+"""Logical plan + rule-based optimizer.
+
+TPU-native analog of the reference's logical layer
+(/root/reference/python/ray/data/_internal/logical/ — logical operators,
+optimizers.py, rules/operator_fusion). The plan is a linear-ish DAG of
+logical ops; optimization fuses adjacent row/batch transforms into a single
+physical map stage (so one object-store round trip per block per fused
+chain, the dominant cost in the reference too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from ray_tpu.data.datasource import Datasource
+
+
+@dataclasses.dataclass
+class LogicalOp:
+    name: str
+    inputs: list["LogicalOp"] = dataclasses.field(default_factory=list)
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass
+class Read(LogicalOp):
+    datasource: Optional[Datasource] = None
+    parallelism: int = -1
+
+    def __post_init__(self):
+        self.name = f"Read{self.datasource.name if self.datasource else ''}"
+
+
+@dataclasses.dataclass
+class InputData(LogicalOp):
+    """Pre-materialized block refs (from_blocks / materialized datasets)."""
+    bundles: list = dataclasses.field(default_factory=list)  # [(ref, meta)]
+
+
+@dataclasses.dataclass
+class AbstractMap(LogicalOp):
+    fn: Optional[Callable] = None
+    fn_args: tuple = ()
+    fn_kwargs: dict = dataclasses.field(default_factory=dict)
+    # "rows" | "batches" | "flat" | "filter"
+    mode: str = "batches"
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    compute: str = "tasks"            # "tasks" | "actors"
+    num_actors: int = 2
+    resources: dict = dataclasses.field(default_factory=dict)
+    fn_constructor_args: tuple = ()
+
+
+@dataclasses.dataclass
+class MapBatches(AbstractMap):
+    mode: str = "batches"
+
+
+@dataclasses.dataclass
+class MapRows(AbstractMap):
+    mode: str = "rows"
+
+
+@dataclasses.dataclass
+class FlatMap(AbstractMap):
+    mode: str = "flat"
+
+
+@dataclasses.dataclass
+class Filter(AbstractMap):
+    mode: str = "filter"
+
+
+@dataclasses.dataclass
+class Limit(LogicalOp):
+    limit: int = 0
+
+
+@dataclasses.dataclass
+class Repartition(LogicalOp):
+    num_blocks: int = 1
+
+
+@dataclasses.dataclass
+class RandomShuffle(LogicalOp):
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Sort(LogicalOp):
+    key: str = ""
+    descending: bool = False
+
+
+@dataclasses.dataclass
+class Aggregate(LogicalOp):
+    key: Optional[str] = None
+    aggs: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Union(LogicalOp):
+    pass
+
+
+@dataclasses.dataclass
+class Zip(LogicalOp):
+    pass
+
+
+@dataclasses.dataclass
+class Write(LogicalOp):
+    path: str = ""
+    file_format: str = "parquet"
+
+
+class LogicalPlan:
+    def __init__(self, terminal: LogicalOp):
+        self.terminal = terminal
+
+    def ops(self) -> list[LogicalOp]:
+        """Post-order (inputs before consumers)."""
+        seen: list[LogicalOp] = []
+
+        def visit(op):
+            for i in op.inputs:
+                visit(i)
+            if op not in seen:
+                seen.append(op)
+
+        visit(self.terminal)
+        return seen
+
+    def __str__(self):
+        return " -> ".join(str(o) for o in self.ops())
+
+
+# ---- optimizer -----------------------------------------------------------
+
+
+def _fusable(a: LogicalOp, b: LogicalOp) -> bool:
+    """Can b be fused onto a? (reference: rules/operator_fusion.py)"""
+    if not isinstance(a, AbstractMap) or not isinstance(b, AbstractMap):
+        return False
+    if a.compute != b.compute or a.resources != b.resources:
+        return False
+    if a.compute == "actors":
+        return False  # keep actor stages separate (stateful fns)
+    return True
+
+
+@dataclasses.dataclass
+class FusedMap(AbstractMap):
+    stages: list[AbstractMap] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.name = "Fused(" + "+".join(s.name for s in self.stages) + ")"
+
+
+def optimize(plan: LogicalPlan) -> LogicalPlan:
+    """Fuse adjacent map-ish ops along single-input chains."""
+
+    def rewrite(op: LogicalOp) -> LogicalOp:
+        op.inputs = [rewrite(i) for i in op.inputs]
+        if isinstance(op, AbstractMap) and len(op.inputs) == 1:
+            child = op.inputs[0]
+            if isinstance(child, FusedMap) and _fusable(child, op):
+                child.stages.append(op)
+                child.__post_init__()
+                return child
+            if isinstance(child, AbstractMap) and not isinstance(child, FusedMap) \
+                    and _fusable(child, op):
+                fused = FusedMap(name="", inputs=child.inputs,
+                                 compute=op.compute, resources=op.resources,
+                                 stages=[child, op])
+                return fused
+        return op
+
+    return LogicalPlan(rewrite(plan.terminal))

@@ -1,0 +1,102 @@
+"""Read API: the ray_tpu.data entry points (reference:
+/root/reference/python/ray/data/read_api.py — read_parquet:796,
+read_images:973, read_json:1268, read_csv:1441, range, from_items,
+from_numpy, from_pandas, from_arrow)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset, MaterializedDataset
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    ImageDatasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    TextDatasource,
+    TFRecordsDatasource,
+)
+from ray_tpu.data.logical import InputData, Read
+
+
+def _read(ds: Datasource, parallelism: int = -1) -> Dataset:
+    return Dataset(Read(name="", datasource=ds, parallelism=parallelism))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return _read(RangeDatasource(n), parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    arr = np.arange(n, dtype=np.int64).reshape((n,) + (1,) * len(shape))
+    arr = np.broadcast_to(arr, (n, *shape)).copy()
+    return from_numpy(arr, column="data")
+
+
+def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
+    return _read(ds, parallelism)
+
+
+def read_parquet(paths, *, columns: Optional[list] = None,
+                 parallelism: int = -1) -> Dataset:
+    return _read(ParquetDatasource(paths, columns=columns), parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(CSVDatasource(paths), parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(JSONDatasource(paths), parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(TextDatasource(paths), parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(BinaryDatasource(paths), parallelism)
+
+
+def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
+                parallelism: int = -1) -> Dataset:
+    return _read(ImageDatasource(paths, size=size, mode=mode), parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    return _read(TFRecordsDatasource(paths), parallelism)
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    return _read(ItemsDatasource(items), parallelism)
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data",
+               parallelism: int = -1) -> Dataset:
+    return _read(NumpyDatasource(arr, column), parallelism)
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+    return from_arrow(pa.Table.from_pandas(df, preserve_index=False))
+
+
+def from_arrow(table) -> Dataset:
+    import ray_tpu
+    from ray_tpu.data.block import BlockAccessor
+    ref = ray_tpu.put(table)
+    meta = BlockAccessor.for_block(table).metadata()
+    return MaterializedDataset(InputData(name="Input", bundles=[(ref, meta)]))
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
+    """Wrap a `datasets.Dataset` (reference read_api.py:3285)."""
+    table = hf_dataset.data.table  # HF datasets are arrow-backed
+    return from_arrow(table)

@@ -1,0 +1,33 @@
+"""ray_tpu.serve — model serving (reference: python/ray/serve/).
+
+Controller reconciliation + pow-2 router + replicas + dynamic batching +
+HTTP ingress; the LLM path (continuous batching on TPU) lives in
+ray_tpu.serve.llm.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
+    "batch", "delete", "deployment", "get_app_handle",
+    "get_deployment_handle", "run", "shutdown", "start_http_proxy", "status",
+]

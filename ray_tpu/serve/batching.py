@@ -1,0 +1,122 @@
+"""@serve.batch: dynamic request batching.
+
+TPU-native analog of the reference's batching
+(/root/reference/python/ray/serve/batching.py — @serve.batch:535,
+_BatchQueue:105): calls buffer until max_batch_size or batch_wait_timeout_s,
+then the underlying fn runs once on the list of requests and each caller gets
+its element back. On TPU replicas this is the host-side half of batching;
+the device-side half (padding to bucketed static shapes for XLA) is the
+engine's job (ray_tpu.serve.llm).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = batch_wait_timeout_s
+        self._queue: Optional[asyncio.Queue] = None
+        self._task = None
+
+    def _ensure(self):
+        if self._queue is None:
+            self._queue = asyncio.Queue()
+            self._task = asyncio.ensure_future(self._loop())
+
+    async def submit(self, item) -> Any:
+        self._ensure()
+        fut = asyncio.get_event_loop().create_future()
+        await self._queue.put((item, fut))
+        return await fut
+
+    async def _loop(self):
+        while True:
+            item, fut = await self._queue.get()
+            batch = [(item, fut)]
+            deadline = asyncio.get_event_loop().time() + self._timeout
+            while len(batch) < self._max:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(
+                        self._queue.get(), remaining))
+                except asyncio.TimeoutError:
+                    break
+            items = [b[0] for b in batch]
+            try:
+                results = self._fn(*_split_self(items))
+                if asyncio.iscoroutine(results):
+                    results = await results
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"batched fn returned {len(results)} results for "
+                        f"{len(items)} inputs")
+                for (_, f), r in zip(batch, results):
+                    if not f.done():
+                        f.set_result(r)
+            except Exception as e:  # noqa: BLE001 - propagate to callers
+                for _, f in batch:
+                    if not f.done():
+                        f.set_exception(e)
+
+
+def _split_self(items: list):
+    """items are (maybe (marker, self, arg)) tuples from the wrapper."""
+    if items and isinstance(items[0], tuple) and len(items[0]) == 3 \
+            and items[0][0] == _METHOD:
+        self_obj = items[0][1]
+        return (self_obj, [it[2] for it in items])
+    return ([it for it in items],)
+
+
+# String marker, not `object()`: the wrapper closure travels through
+# cloudpickle into replica workers, and a pickled object() loses identity.
+_METHOD = "__serve_batch_method_marker__"
+
+
+def batch(_fn=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator for batched endpoints (reference @serve.batch:535).
+
+    The wrapped fn must accept a list and return a list of equal length.
+    Works on free functions and methods.
+    """
+
+    def decorate(fn):
+        queues: dict[int, _BatchQueue] = {}
+
+        def get_queue(key: int) -> _BatchQueue:
+            q = queues.get(key)
+            if q is None:
+                q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                queues[key] = q
+            return q
+
+        import inspect
+        params = list(inspect.signature(fn).parameters)
+        is_method = params and params[0] == "self"
+
+        if is_method:
+            @functools.wraps(fn)
+            async def method_wrapper(self, item):
+                return await get_queue(id(self)).submit((_METHOD, self, item))
+            method_wrapper._is_serve_batch = True
+            return method_wrapper
+
+        @functools.wraps(fn)
+        async def fn_wrapper(item):
+            return await get_queue(0).submit(item)
+        fn_wrapper._is_serve_batch = True
+        return fn_wrapper
+
+    if _fn is not None:
+        return decorate(_fn)
+    return decorate

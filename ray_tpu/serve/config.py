@@ -1,0 +1,45 @@
+"""Serve configuration types (reference:
+/root/reference/python/ray/serve/config.py — AutoscalingConfig,
+DeploymentConfig fields on @serve.deployment api.py:333)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Queue-length driven replica autoscaling (reference
+    autoscaling_policy.py:86 replica_queue_length_autoscaling_policy)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+
+    def decide(self, current: int, total_ongoing: float) -> int:
+        if current == 0:
+            return self.min_replicas
+        desired = total_ongoing / max(self.target_ongoing_requests, 1e-9)
+        import math
+        target = int(math.ceil(desired))
+        return max(self.min_replicas, min(self.max_replicas, target))
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    user_config: Any = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 20.0
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+
+    def target_replicas(self) -> int:
+        if self.autoscaling_config:
+            return self.autoscaling_config.min_replicas
+        return self.num_replicas

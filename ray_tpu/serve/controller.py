@@ -1,0 +1,250 @@
+"""Serve controller: target-state reconciliation for deployments.
+
+TPU-native analog of the reference's ServeController
+(/root/reference/python/ray/serve/_private/controller.py:95 —
+run_control_loop:387; deployment_state.py replica lifecycle;
+autoscaling_state.py; deployment_scheduler.py). A detached actor owns the
+target state {app -> deployments -> config}, reconciles replica actors
+toward it, health-checks them, applies queue-length autoscaling, and serves
+versioned routing tables to routers/proxies (the long-poll analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.replica import ServeReplica
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "_serve_controller"
+
+
+class _DeploymentState:
+    def __init__(self, app: str, name: str, serialized_cls, init_args,
+                 init_kwargs, config: DeploymentConfig, route_prefix):
+        self.app = app
+        self.name = name
+        self.serialized_cls = serialized_cls
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.route_prefix = route_prefix
+        self.replicas: list = []
+        self.version = 0
+        self.target = config.target_replicas()
+        self._last_scale_ts = 0.0
+        self._scale_pending_since: Optional[float] = None
+        self._pending_target: Optional[int] = None
+
+    def full_name(self) -> str:
+        return f"{self.app}#{self.name}"
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self):
+        self._deployments: dict[str, _DeploymentState] = {}
+        self._routes: dict[str, tuple[str, str]] = {}  # prefix -> (app, deployment)
+        self._stopped = False
+        # __init__ runs off the actor event loop; the control loop is started
+        # lazily from the first async method invocation.
+        self._loop_task = None
+
+    def _ensure_started(self):
+        if self._loop_task is None:
+            self._loop_task = asyncio.ensure_future(self._control_loop())
+
+    # ---- deploy API ----------------------------------------------------
+    async def deploy_application(self, app_name: str,
+                                 deployments: list[dict]) -> bool:
+        """deployments: [{name, serialized_cls, init_args, init_kwargs,
+        config(DeploymentConfig), route_prefix, is_ingress}]"""
+        self._ensure_started()
+        new_names = set()
+        for d in deployments:
+            key = f"{app_name}#{d['name']}"
+            new_names.add(key)
+            existing = self._deployments.get(key)
+            state = _DeploymentState(
+                app_name, d["name"], d["serialized_cls"],
+                d.get("init_args"), d.get("init_kwargs"),
+                d["config"], d.get("route_prefix"))
+            if existing is not None:
+                state.replicas = existing.replicas
+                state.version = existing.version + 1
+                # config change with same code → reconfigure in place
+                if d["config"].user_config is not None:
+                    for r in state.replicas:
+                        try:
+                            await asyncio.wait_for(_as_future(
+                                r.reconfigure.remote(
+                                    d["config"].user_config)), 10.0)
+                        except Exception:  # noqa: BLE001
+                            pass
+            self._deployments[key] = state
+            if d.get("is_ingress") and d.get("route_prefix") is not None:
+                self._routes[d["route_prefix"]] = (app_name, d["name"])
+        # remove deployments of this app not in the new spec
+        for key in [k for k in self._deployments
+                    if k.startswith(app_name + "#") and k not in new_names]:
+            await self._drain_deployment(self._deployments.pop(key))
+        # wait until all deployments have their target replicas up
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if all(len(s.replicas) >= s.target
+                   for s in self._deployments.values()
+                   if s.app == app_name):
+                return True
+            await asyncio.sleep(0.05)
+        return False
+
+    async def delete_application(self, app_name: str) -> bool:
+        self._ensure_started()
+        for key in [k for k in self._deployments
+                    if self._deployments[k].app == app_name]:
+            await self._drain_deployment(self._deployments.pop(key))
+        self._routes = {p: t for p, t in self._routes.items()
+                        if t[0] != app_name}
+        return True
+
+    async def _drain_deployment(self, state: _DeploymentState):
+        for r in state.replicas:
+            try:
+                await asyncio.wait_for(
+                    _as_future(r.prepare_for_shutdown.remote(
+                        state.config.graceful_shutdown_timeout_s)),
+                    state.config.graceful_shutdown_timeout_s + 5.0)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        state.replicas = []
+
+    # ---- introspection -------------------------------------------------
+    async def get_routing_table(self, app_name: str) -> dict:
+        self._ensure_started()
+        out = {}
+        for state in self._deployments.values():
+            if state.app == app_name:
+                out[state.name] = (list(state.replicas), state.version)
+        return out
+
+    async def get_http_routes(self) -> dict:
+        self._ensure_started()
+        return dict(self._routes)
+
+    async def status(self) -> dict:
+        self._ensure_started()
+        return {
+            state.full_name(): {
+                "replicas": len(state.replicas),
+                "target": state.target,
+                "version": state.version,
+                "app": state.app,
+            }
+            for state in self._deployments.values()
+        }
+
+    async def shutdown(self) -> bool:
+        self._stopped = True
+        for state in self._deployments.values():
+            for r in state.replicas:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        self._deployments = {}
+        return True
+
+    # ---- reconciliation loop -------------------------------------------
+    async def _control_loop(self):
+        while not self._stopped:
+            try:
+                await self._reconcile_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("serve control loop error")
+            await asyncio.sleep(0.2)
+
+    async def _reconcile_once(self):
+        for state in list(self._deployments.values()):
+            # health: drop dead replicas
+            alive = []
+            for r in state.replicas:
+                try:
+                    await asyncio.wait_for(_as_future(
+                        r.check_health.remote()),
+                        state.config.health_check_timeout_s)
+                    alive.append(r)
+                except Exception:  # noqa: BLE001
+                    logger.warning("replica of %s failed health check",
+                                   state.full_name())
+            if len(alive) != len(state.replicas):
+                state.replicas = alive
+                state.version += 1
+
+            # autoscaling
+            asc = state.config.autoscaling_config
+            if asc is not None and state.replicas:
+                total = 0
+                for r in state.replicas:
+                    try:
+                        total += await asyncio.wait_for(
+                            _as_future(r.get_queue_len.remote()), 2.0)
+                    except Exception:  # noqa: BLE001
+                        pass
+                desired = asc.decide(len(state.replicas), total)
+                now = time.monotonic()
+                if desired != state.target:
+                    delay = (asc.upscale_delay_s if desired > state.target
+                             else asc.downscale_delay_s)
+                    if state._pending_target != desired:
+                        state._pending_target = desired
+                        state._scale_pending_since = now
+                    elif now - state._scale_pending_since >= delay:
+                        logger.info("autoscaling %s: %d -> %d",
+                                    state.full_name(), state.target, desired)
+                        state.target = desired
+                        state._pending_target = None
+                else:
+                    state._pending_target = None
+
+            # scale toward target
+            while len(state.replicas) < state.target:
+                replica = ServeReplica.options(
+                    max_concurrency=max(100, state.config.max_ongoing_requests),
+                    **state.config.ray_actor_options).remote(
+                    state.name, state.serialized_cls, state.init_args,
+                    state.init_kwargs, state.config.user_config,
+                    state.config.max_ongoing_requests)
+                state.replicas.append(replica)
+                state.version += 1
+            while len(state.replicas) > state.target:
+                victim = state.replicas.pop()
+                state.version += 1
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+async def _as_future(ref):
+    """Adapt a ray_tpu ObjectRef get to asyncio without blocking the loop."""
+    loop = asyncio.get_event_loop()
+    return await loop.run_in_executor(None, lambda: ray_tpu.get(ref))
+
+
+def get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, timeout=0.2)
+    except Exception:  # noqa: BLE001 - create it
+        return ServeController.options(
+            name=CONTROLLER_NAME, lifetime="detached",
+            max_concurrency=1000).remote()

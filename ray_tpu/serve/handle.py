@@ -1,0 +1,118 @@
+"""DeploymentHandle / DeploymentResponse.
+
+TPU-native analog of the reference's handle API
+(/root/reference/python/ray/serve/handle.py — DeploymentHandle:692,
+DeploymentResponse:375): `handle.remote(...)` routes through the pow-2
+router and returns a response future; responses can be passed as args to
+other handles (composition) and awaited/`.result()`ed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve.router import Router
+
+_routers: dict[str, Router] = {}
+_routers_lock = threading.Lock()
+
+
+def _router_for(app_name: str) -> Router:
+    with _routers_lock:
+        r = _routers.get(app_name)
+        if r is None:
+            from ray_tpu.serve.controller import get_or_create_controller
+            r = Router(get_or_create_controller(), app_name)
+            _routers[app_name] = r
+        return r
+
+
+def _reset_routers():
+    with _routers_lock:
+        _routers.clear()
+
+
+class DeploymentResponse:
+    """Future for one request (reference DeploymentResponse)."""
+
+    def __init__(self, ref, streaming: bool = False):
+        self._ref = ref
+        self._streaming = streaming
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        out = ray_tpu.get(self._ref, timeout=timeout_s)
+        return out
+
+    def __await__(self):
+        return self._ref.__await__()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentResponseGenerator:
+    def __init__(self, ref):
+        self._ref = ref
+
+    def __iter__(self):
+        chunks = ray_tpu.get(self._ref)
+        yield from chunks
+
+
+class DeploymentHandle:
+    """Callable handle to a deployment (reference DeploymentHandle:692)."""
+
+    def __init__(self, deployment_name: str, app_name: str,
+                 method_name: str = "__call__", *, stream: bool = False,
+                 _timeout_s: float = 30.0):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method = method_name
+        self._stream = stream
+        self._timeout_s = _timeout_s
+
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
+                timeout_s: Optional[float] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name if method_name is not None else self._method,
+            stream=self._stream if stream is None else stream,
+            _timeout_s=self._timeout_s if timeout_s is None else timeout_s)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def _resolve_args(self, args, kwargs):
+        """Allow DeploymentResponse composition: pass the underlying ref so
+        the arg resolves to the upstream result without blocking here."""
+        def conv(v):
+            if isinstance(v, DeploymentResponse):
+                return v.ref
+            return v
+        return tuple(conv(a) for a in args), {k: conv(v)
+                                              for k, v in kwargs.items()}
+
+    def remote(self, *args, **kwargs):
+        args, kwargs = self._resolve_args(args, kwargs)
+        router = _router_for(self.app_name)
+        ref = router.assign(self.deployment_name, self._method, args, kwargs,
+                            streaming=self._stream,
+                            timeout_s=self._timeout_s)
+        if self._stream:
+            return DeploymentResponseGenerator(ref)
+        return DeploymentResponse(ref)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method),
+                {"_stream": self._stream, "_timeout_s": self._timeout_s})
+
+    def __setstate__(self, state):
+        self._stream = state["_stream"]
+        self._timeout_s = state["_timeout_s"]

@@ -1,0 +1,134 @@
+"""HTTP ingress proxy.
+
+TPU-native analog of the reference's proxy
+(/root/reference/python/ray/serve/_private/proxy.py — HTTPProxy:706,
+proxy_request:414, send_request_to_replica:886): an aiohttp server that
+resolves the route prefix to an application's ingress deployment, routes via
+the pow-2 router, and returns the replica's response. JSON in/out; the
+reference's full ASGI passthrough is out of scope for the HTTP layer v1 —
+deployments see a dict request body.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.serve.router import Router
+
+
+class HTTPProxy:
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+        self._controller = controller
+        self.host = host
+        self.port = port
+        self._routers: dict[str, Router] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._runner = None
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._serve_thread,
+                                        daemon=True, name="http_proxy")
+        self._thread.start()
+        if not self._started.wait(10.0):
+            raise RuntimeError("http proxy failed to start")
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _serve_thread(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._runner = runner
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+    # ---- request path --------------------------------------------------
+    async def _resolve_route(self, path: str):
+        routes = await _aget(self._controller.get_http_routes.remote())
+        best = None
+        for prefix, target in routes.items():
+            if prefix is None:
+                continue
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") \
+                    or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, target)
+        return best
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info.get("tail", "")
+        if path == "/-/routes":
+            routes = await _aget(self._controller.get_http_routes.remote())
+            return web.json_response(
+                {p: f"{a}#{d}" for p, (a, d) in routes.items()})
+        if path == "/-/healthz":
+            return web.Response(text="ok")
+
+        resolved = await self._resolve_route(path)
+        if resolved is None:
+            return web.Response(status=404, text=f"no route for {path}")
+        prefix, (app_name, deployment) = resolved
+
+        router = self._routers.get(app_name)
+        if router is None:
+            router = Router(self._controller, app_name)
+            self._routers[app_name] = router
+
+        # build the request payload the user callable sees
+        body = await request.read()
+        payload: object
+        if body:
+            try:
+                payload = json.loads(body)
+            except json.JSONDecodeError:
+                payload = body
+        else:
+            payload = dict(request.query)
+
+        try:
+            ref = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: router.assign(
+                    deployment, "__call__", (payload,), {}))
+            result = await _aget(ref)
+        except TimeoutError as e:
+            return web.Response(status=503, text=str(e))
+        except Exception as e:  # noqa: BLE001 - surface replica errors as 500
+            return web.Response(status=500, text=repr(e))
+
+        if isinstance(result, (bytes, bytearray)):
+            return web.Response(body=bytes(result))
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result)
+
+
+async def _aget(ref):
+    loop = asyncio.get_event_loop()
+    return await loop.run_in_executor(None, lambda: ray_tpu.get(ref))

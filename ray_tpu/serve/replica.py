@@ -1,0 +1,116 @@
+"""Replica actor: runs the user's deployment callable.
+
+TPU-native analog of the reference's replica
+(/root/reference/python/ray/serve/_private/replica.py —
+UserCallableWrapper, health checks, graceful draining, ongoing-request
+tracking for the router's pow-2 choice and for autoscaling telemetry).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ServeReplica:
+    """One replica of one deployment. Async actor: requests run concurrently
+    on the actor's event loop up to max_ongoing_requests."""
+
+    def __init__(self, deployment_name: str, serialized_cls, init_args,
+                 init_kwargs, user_config, max_ongoing: int):
+        import cloudpickle
+        cls_or_fn = cloudpickle.loads(serialized_cls)
+        self._deployment_name = deployment_name
+        self._max_ongoing = max_ongoing
+        self._ongoing = 0
+        self._total = 0
+        self._is_fn = not isinstance(cls_or_fn, type)
+        if self._is_fn:
+            self._callable = cls_or_fn
+        else:
+            self._callable = cls_or_fn(*(init_args or ()),
+                                       **(init_kwargs or {}))
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    def _apply_user_config(self, user_config):
+        reconfigure = getattr(self._callable, "reconfigure", None)
+        if reconfigure is None:
+            raise ValueError(
+                f"deployment {self._deployment_name} got user_config but "
+                f"defines no reconfigure method")
+        reconfigure(user_config)
+
+    async def reconfigure(self, user_config) -> bool:
+        self._apply_user_config(user_config)
+        return True
+
+    async def handle_request(self, method_name: str, args: tuple,
+                             kwargs: dict) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_fn:
+                target = self._callable
+            elif method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._ongoing -= 1
+
+    async def handle_request_streaming(self, method_name: str, args: tuple,
+                                       kwargs: dict) -> list:
+        """Generator endpoints: collect and return chunks (the handle
+        re-streams them; reference streams over gRPC/ASGI incrementally)."""
+        self._ongoing += 1
+        self._total += 1
+        try:
+            target = (self._callable if self._is_fn or method_name == "__call__"
+                      else getattr(self._callable, method_name))
+            result = target(*args, **kwargs)
+            chunks = []
+            if inspect.isasyncgen(result):
+                async for chunk in result:
+                    chunks.append(chunk)
+            elif inspect.isgenerator(result):
+                chunks.extend(result)
+            else:
+                if inspect.iscoroutine(result):
+                    result = await result
+                chunks.append(result)
+            return chunks
+        finally:
+            self._ongoing -= 1
+
+    async def get_queue_len(self) -> int:
+        return self._ongoing
+
+    async def stats(self) -> dict:
+        return {"ongoing": self._ongoing, "total": self._total,
+                "deployment": self._deployment_name}
+
+    async def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None:
+            result = user_check()
+            if inspect.iscoroutine(result):
+                await result
+        return True
+
+    async def prepare_for_shutdown(self, timeout_s: float = 20.0) -> bool:
+        """Graceful drain: wait for ongoing requests to finish."""
+        deadline = time.monotonic() + timeout_s
+        while self._ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        shutdown = getattr(self._callable, "__del__", None)
+        return self._ongoing == 0

@@ -1,0 +1,104 @@
+"""Power-of-two-choices request router.
+
+TPU-native analog of the reference's router
+(/root/reference/python/ray/serve/_private/router.py — AsyncioRouter:457,
+assign_request:838; request_router/pow_2_router.py): pick two random
+replicas, probe cached queue lengths, route to the shorter queue. Queue
+lengths are refreshed in the background; routing table updates come from the
+controller via versioned polls (the reference uses long-poll, long_poll.py).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+import ray_tpu
+
+
+class ReplicaSet:
+    """Cached view of one deployment's replicas + queue lengths."""
+
+    def __init__(self):
+        self.replicas: list = []           # actor handles
+        self.version: int = -1
+        self._qlen: dict[int, tuple[float, int]] = {}  # idx -> (ts, len)
+        self._rr = 0
+
+    def update(self, replicas: list, version: int):
+        self.replicas = replicas
+        self.version = version
+        self._qlen = {}
+
+    def _probe(self, idx: int, staleness_s: float = 0.5) -> int:
+        now = time.monotonic()
+        cached = self._qlen.get(idx)
+        if cached and now - cached[0] < staleness_s:
+            return cached[1]
+        try:
+            qlen = ray_tpu.get(self.replicas[idx].get_queue_len.remote(),
+                               timeout=2.0)
+        except Exception:  # noqa: BLE001 - dead replica looks busy
+            qlen = 1 << 30
+        self._qlen[idx] = (now, qlen)
+        return qlen
+
+    def choose(self) -> Optional[object]:
+        n = len(self.replicas)
+        if n == 0:
+            return None
+        if n == 1:
+            return self.replicas[0]
+        i, j = random.sample(range(n), 2)
+        return self.replicas[i if self._probe(i) <= self._probe(j) else j]
+
+
+class Router:
+    """Routes requests for any deployment in one application."""
+
+    def __init__(self, controller, app_name: str, poll_period_s: float = 0.5):
+        self._controller = controller
+        self._app = app_name
+        self._sets: dict[str, ReplicaSet] = {}
+        self._lock = threading.Lock()
+        self._poll_period = poll_period_s
+        self._last_poll = 0.0
+
+    def _maybe_refresh(self, deployment: str, force: bool = False):
+        now = time.monotonic()
+        with self._lock:
+            rs = self._sets.setdefault(deployment, ReplicaSet())
+            if not force and rs.replicas and \
+                    now - self._last_poll < self._poll_period:
+                return rs
+        table = ray_tpu.get(self._controller.get_routing_table.remote(
+            self._app), timeout=10.0)
+        with self._lock:
+            self._last_poll = now
+            for dep, (replicas, version) in table.items():
+                cur = self._sets.setdefault(dep, ReplicaSet())
+                if version != cur.version:
+                    cur.update(replicas, version)
+            return self._sets.setdefault(deployment, ReplicaSet())
+
+    def assign(self, deployment: str, method: str, args: tuple,
+               kwargs: dict, *, streaming: bool = False,
+               timeout_s: float = 30.0):
+        """Pick a replica and submit; returns the reply ObjectRef."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            rs = self._maybe_refresh(deployment)
+            replica = rs.choose()
+            if replica is not None:
+                if streaming:
+                    return replica.handle_request_streaming.remote(
+                        method, args, kwargs)
+                return replica.handle_request.remote(method, args, kwargs)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no replicas available for deployment "
+                    f"{deployment!r} after {timeout_s}s")
+            self._maybe_refresh(deployment, force=True)
+            time.sleep(0.1)

@@ -1,0 +1,40 @@
+"""ray_tpu.train — distributed training orchestration, JAX/SPMD-first.
+
+Public surface mirrors the reference's `ray.train` v2
+(/root/reference/python/ray/train/v2/api/): trainers, config types,
+report/get_context/get_checkpoint/get_dataset_shard, Checkpoint, Result.
+The in-framework parallelism library (DP/FSDP/TP/PP/EP/CP) lives in
+ray_tpu.parallel + ray_tpu.train.spmd.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, StorageContext
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.controller import (
+    RunState,
+    TrainController,
+    TrainingFailedError,
+)
+from ray_tpu.train.sync import SynchronizationActor
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "CheckpointManager", "DataParallelTrainer",
+    "FailureConfig", "JaxTrainer", "RayTrainWorker", "Result", "RunConfig",
+    "RunState", "ScalingConfig", "StorageContext", "SynchronizationActor",
+    "TrainContext", "TrainController", "TrainingFailedError", "WorkerGroup",
+    "get_checkpoint", "get_context", "get_dataset_shard", "report",
+]

@@ -1,0 +1,202 @@
+"""Checkpoint handle + storage + top-K manager.
+
+TPU-native analog of the reference's checkpoint stack
+(/root/reference/python/ray/train/_checkpoint.py:56 Checkpoint-as-directory,
+train/v2/_internal/execution/storage.py StorageContext +
+_pyarrow_fs_copy_files:99, checkpoint/checkpoint_manager.py:78 top-K
+retention). Payload writing on TPU is expected to go through Orbax inside the
+user train fn; this layer only moves directories and tracks lineage — the
+same division of labor as the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+import threading
+import uuid
+from typing import Optional
+
+
+class Checkpoint:
+    """A directory full of checkpoint payload, addressed by path.
+
+    Like the reference's Checkpoint (train/_checkpoint.py:56) this is a thin
+    handle: `path` + helpers, no format opinion. Local filesystem paths only
+    in-tree (cloud fs can be layered via the same API).
+    """
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Copy checkpoint payload into `path` (or a temp dir) and return it."""
+        dest = path or tempfile.mkdtemp(prefix="ckpt_")
+        if os.path.abspath(dest) != os.path.abspath(self.path):
+            os.makedirs(dest, exist_ok=True)
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def update_metadata(self, metadata: dict) -> None:
+        meta = self.get_metadata()
+        meta.update(metadata)
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(meta, f)
+
+    def get_metadata(self) -> dict:
+        p = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and \
+            os.path.abspath(self.path) == os.path.abspath(other.path)
+
+    def __hash__(self):
+        return hash(os.path.abspath(self.path))
+
+
+class StorageContext:
+    """Resolves the run's persistent directory layout.
+
+    Layout (mirrors the reference storage.py):
+        {storage_path}/{run_name}/checkpoint_{index:06d}/...
+        {storage_path}/{run_name}/result.json
+    """
+
+    def __init__(self, storage_path: str, run_name: str):
+        self.storage_path = os.fspath(storage_path)
+        self.run_name = run_name
+        self.run_path = os.path.join(self.storage_path, run_name)
+        os.makedirs(self.run_path, exist_ok=True)
+
+    def checkpoint_dir(self, index: int) -> str:
+        return os.path.join(self.run_path, f"checkpoint_{index:06d}")
+
+    def persist(self, checkpoint: Checkpoint, index: int) -> Checkpoint:
+        """Copy a worker-local checkpoint dir into persistent storage."""
+        dest = self.checkpoint_dir(index)
+        if os.path.abspath(checkpoint.path) == os.path.abspath(dest):
+            return checkpoint
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        shutil.copytree(checkpoint.path, dest)
+        return Checkpoint(dest)
+
+
+@dataclasses.dataclass
+class _TrackedCheckpoint:
+    checkpoint: Checkpoint
+    metrics: dict
+    index: int
+
+
+class CheckpointManager:
+    """Top-K checkpoint retention ordered by a score metric.
+
+    Reference: train/v2/_internal/execution/checkpoint/checkpoint_manager.py:78.
+    """
+
+    def __init__(self, storage: StorageContext, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self._storage = storage
+        self._num_to_keep = num_to_keep
+        self._score_attr = score_attribute
+        self._score_order = score_order
+        self._lock = threading.Lock()
+        self._index = 0
+        self._checkpoints: list[_TrackedCheckpoint] = []
+        self.latest: Optional[_TrackedCheckpoint] = None
+
+    def register(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
+        """Persist a reported checkpoint; evict beyond top-K. Returns the
+        persisted handle."""
+        with self._lock:
+            idx = self._index
+            self._index += 1
+            persisted = self._storage.persist(checkpoint, idx)
+            tracked = _TrackedCheckpoint(persisted, dict(metrics), idx)
+            self._checkpoints.append(tracked)
+            self.latest = tracked
+            self._evict()
+            return persisted
+
+    def _score(self, t: _TrackedCheckpoint):
+        if self._score_attr is None:
+            return t.index  # recency
+        val = t.metrics.get(self._score_attr)
+        if val is None:
+            return float("-inf") if self._score_order == "max" else float("inf")
+        return val
+
+    def _evict(self):
+        if self._num_to_keep is None or len(self._checkpoints) <= self._num_to_keep:
+            return
+        reverse = self._score_order == "max"
+        ranked = sorted(self._checkpoints, key=self._score, reverse=reverse)
+        keep = set(id(t) for t in ranked[: self._num_to_keep])
+        # Never evict the latest (needed for resume).
+        keep.add(id(self.latest))
+        survivors = []
+        for t in self._checkpoints:
+            if id(t) in keep:
+                survivors.append(t)
+            else:
+                shutil.rmtree(t.checkpoint.path, ignore_errors=True)
+        self._checkpoints = survivors
+
+    def best_checkpoints(self) -> list[tuple[Checkpoint, dict]]:
+        with self._lock:
+            reverse = self._score_order == "max"
+            ranked = sorted(self._checkpoints, key=self._score, reverse=reverse)
+            return [(t.checkpoint, t.metrics) for t in ranked]
+
+    def write_state(self):
+        """Persist manager state for resume-after-driver-crash."""
+        state = {
+            "index": self._index,
+            "checkpoints": [
+                {"path": t.checkpoint.path, "metrics": t.metrics, "index": t.index}
+                for t in self._checkpoints
+            ],
+            "latest": self.latest.index if self.latest else None,
+        }
+        with open(os.path.join(self._storage.run_path, "manager_state.json"),
+                  "w") as f:
+            json.dump(state, f)
+
+    @classmethod
+    def restore_state(cls, storage: StorageContext, **kwargs) -> "CheckpointManager":
+        mgr = cls(storage, **kwargs)
+        p = os.path.join(storage.run_path, "manager_state.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                state = json.load(f)
+            mgr._index = state["index"]
+            for rec in state["checkpoints"]:
+                if os.path.exists(rec["path"]):
+                    t = _TrackedCheckpoint(Checkpoint(rec["path"]),
+                                           rec["metrics"], rec["index"])
+                    mgr._checkpoints.append(t)
+                    if state["latest"] == rec["index"]:
+                        mgr.latest = t
+        return mgr
+
+
+def new_run_name() -> str:
+    return "run_" + uuid.uuid4().hex[:10]

@@ -1,0 +1,113 @@
+"""Train configuration types.
+
+TPU-native analog of the reference's Train v2 config surface
+(/root/reference/python/ray/train/v2/api/config.py — ScalingConfig with
+use_tpu:89 / topology:90, validation :96-138; RunConfig; FailureConfig) and
+the checkpoint config (python/ray/train/_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one needs.
+
+    TPU-first: `use_tpu` + `topology` select a slice (gang-scheduled via an
+    atomic slice placement group); `num_workers` is hosts in the slice.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: Optional[str] = None          # e.g. "4x4" / "2x2x2"
+    accelerator_type: Optional[str] = None  # e.g. "v5p", "v6e"
+    resources_per_worker: Optional[dict] = None
+    placement_strategy: str = "PACK"        # SPREAD for one-worker-per-host TPU
+
+    def __post_init__(self):
+        if self.num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        if self.topology and not self.use_tpu:
+            raise ValueError("topology requires use_tpu=True")
+        if self.use_tpu and self.placement_strategy == "PACK":
+            # One worker process per TPU host is the only supported layout
+            # (SURVEY.md §7 hard part 7: single process per chipset).
+            self.placement_strategy = "SPREAD"
+
+    @property
+    def _resources_per_worker(self) -> dict:
+        if self.resources_per_worker:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            return {"TPU": 4}
+        return {"CPU": 1}
+
+    def total_resources(self) -> dict:
+        per = self._resources_per_worker
+        return {k: v * self.num_workers for k, v in per.items()}
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Retry budget for worker-group failures.
+
+    Mirrors reference FailureConfig semantics
+    (train/v2/_internal/execution/failure_handling/failure_policy.py):
+    max_failures=-1 retries forever; 0 fails fast.
+    """
+
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Top-K checkpoint retention (reference: train/v2 checkpoint manager,
+    checkpoint_manager.py:78)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Where run outputs (checkpoints, results) land."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    callbacks: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.environ.get(
+                "RAY_TPU_STORAGE_PATH",
+                os.path.join(os.path.expanduser("~"), "ray_tpu_results"))
+
+
+@dataclasses.dataclass
+class Result:
+    """Terminal state of a training run (reference: train/v2/api/result.py)."""
+
+    metrics: Optional[dict] = None
+    checkpoint: Optional[Any] = None
+    error: Optional[BaseException] = None
+    path: Optional[str] = None
+    best_checkpoints: list = dataclasses.field(default_factory=list)
+
+    @property
+    def metrics_dataframe(self):
+        raise NotImplementedError(
+            "metrics_dataframe requires pandas history tracking; "
+            "use Result.metrics")

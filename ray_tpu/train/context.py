@@ -1,0 +1,156 @@
+"""Per-worker train context + report().
+
+TPU-native analog of the reference's train context / train_fn_utils
+(/root/reference/python/ray/train/v2/api/train_fn_utils.py,
+.../api/context.py): the user train fn calls
+`ray_tpu.train.report(metrics, checkpoint=...)` and
+`ray_tpu.train.get_context()` for rank/world topology. The context lives in a
+module global inside the worker actor process; the train fn runs on a
+dedicated thread (reference: thread_runner.py), so report() communicates with
+the polling actor through a thread-safe queue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class TrainingReport:
+    metrics: dict
+    checkpoint: Optional[Checkpoint]
+    seq: int
+
+
+class TrainContext:
+    """What a rank knows about itself and the gang."""
+
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 local_world_size: int, node_rank: int,
+                 experiment_name: str = "", trial_name: str = "",
+                 trial_id: str = "", trial_dir: str = "",
+                 dataset_shards: Optional[dict] = None,
+                 hparams: Optional[dict] = None):
+        self._world_rank = world_rank
+        self._world_size = world_size
+        self._local_rank = local_rank
+        self._local_world_size = local_world_size
+        self._node_rank = node_rank
+        self._experiment_name = experiment_name
+        self._trial_name = trial_name
+        self._trial_id = trial_id
+        self._trial_dir = trial_dir
+        self._dataset_shards = dataset_shards or {}
+        self._hparams = hparams or {}
+        self._report_queue: queue.Queue = queue.Queue()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._latest_checkpoint: Optional[Checkpoint] = None
+
+    # -- topology ---------------------------------------------------------
+    def get_world_rank(self) -> int:
+        return self._world_rank
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_local_rank(self) -> int:
+        return self._local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._local_world_size
+
+    def get_node_rank(self) -> int:
+        return self._node_rank
+
+    def get_experiment_name(self) -> str:
+        return self._experiment_name
+
+    def get_trial_name(self) -> str:
+        return self._trial_name
+
+    def get_trial_id(self) -> str:
+        return self._trial_id
+
+    def get_trial_dir(self) -> str:
+        return self._trial_dir
+
+    # -- data -------------------------------------------------------------
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self._dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(
+                f"no dataset shard named {name!r}; pass datasets={{...}} to "
+                f"the trainer")
+        return shard
+
+    def get_hparams(self) -> dict:
+        return self._hparams
+
+    # -- reporting --------------------------------------------------------
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        if self._stop_event.is_set():
+            raise SystemExit("training stopped by controller")
+        with self._seq_lock:
+            seq = self._seq
+            self._seq += 1
+        if checkpoint is not None:
+            self._latest_checkpoint = checkpoint
+        self._report_queue.put(TrainingReport(dict(metrics), checkpoint, seq))
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        """Checkpoint to resume from (set by the controller on restart)."""
+        return self._latest_checkpoint
+
+    def should_stop(self) -> bool:
+        return self._stop_event.is_set()
+
+    # -- internal (worker actor side) -------------------------------------
+    def _drain_reports(self) -> list[TrainingReport]:
+        out = []
+        while True:
+            try:
+                out.append(self._report_queue.get_nowait())
+            except queue.Empty:
+                return out
+
+
+_context: Optional[TrainContext] = None
+_context_lock = threading.Lock()
+
+
+def get_context() -> TrainContext:
+    if _context is None:
+        raise RuntimeError(
+            "ray_tpu.train.get_context() called outside a train worker")
+    return _context
+
+
+def _set_context(ctx: Optional[TrainContext]):
+    global _context
+    with _context_lock:
+        _context = ctx
+
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None):
+    """Report metrics (+ optional checkpoint) from a train worker.
+
+    Reference semantics: ray.train.report
+    (train/v2/_internal/execution/train_fn_utils
+    → report_handler → checkpoint manager).
+    """
+    get_context().report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_context().get_dataset_shard(name)

@@ -1,0 +1,223 @@
+"""Train controller: the run state machine.
+
+TPU-native analog of the reference's TrainController
+(/root/reference/python/ray/train/v2/_internal/execution/controller/
+controller.py:96 — states Initializing/Scheduling/Running/Restarting/
+Resizing/Finished/Errored in state.py, loop run:480/_step:386), with the
+failure policy (failure_handling/failure_policy.py) and scaling policy
+(scaling_policy/fixed.py) folded in. Elasticity on TPU is restart-the-world:
+JAX's distributed runtime can't resize in place, so every recovery goes
+through Restarting with Orbax/dir checkpoint resume (SURVEY.md §7 hard
+part 4).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import time
+from typing import Callable, Optional
+
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    StorageContext,
+    new_run_name,
+)
+from ray_tpu.train.config import FailureConfig, Result, RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class RunState(enum.Enum):
+    INITIALIZING = "INITIALIZING"
+    SCHEDULING = "SCHEDULING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    ERRORED = "ERRORED"
+    FINISHED = "FINISHED"
+
+
+class FailureDecision(enum.Enum):
+    RETRY = "RETRY"
+    RAISE = "RAISE"
+
+
+class FailurePolicy:
+    """max_failures budget → retry or raise (reference default.py)."""
+
+    def __init__(self, failure_config: FailureConfig):
+        self._cfg = failure_config
+        self._failures = 0
+
+    def make_decision(self, error: str) -> FailureDecision:
+        self._failures += 1
+        if self._cfg.fail_fast:
+            return FailureDecision.RAISE
+        if self._cfg.max_failures < 0:
+            return FailureDecision.RETRY
+        if self._failures <= self._cfg.max_failures:
+            return FailureDecision.RETRY
+        return FailureDecision.RAISE
+
+
+class TrainController:
+    """Drives one training run to completion.
+
+    Runs in the driver process (the reference runs it as a detached actor;
+    here the Tuner/driver owns it directly — the worker gang is still fully
+    remote, so controller placement is an orchestration detail).
+    """
+
+    def __init__(self, train_fn: Callable, *, train_fn_config: Optional[dict],
+                 scaling_config: ScalingConfig, run_config: RunConfig,
+                 datasets: Optional[dict] = None,
+                 backend_fn: Optional[Callable] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 poll_interval_s: float = 0.05):
+        self._train_fn = train_fn
+        self._train_fn_config = train_fn_config
+        self._scaling = scaling_config
+        self._run_config = run_config
+        self._datasets = datasets or {}
+        self._backend_fn = backend_fn
+        self._poll_interval_s = poll_interval_s
+
+        self._run_name = run_config.name or new_run_name()
+        self._storage = StorageContext(run_config.storage_path, self._run_name)
+        ckpt_cfg = run_config.checkpoint_config
+        self._ckpt_manager = CheckpointManager(
+            self._storage, num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order)
+        self._failure_policy = FailurePolicy(run_config.failure_config)
+
+        self.state = RunState.INITIALIZING
+        self._worker_group: Optional[WorkerGroup] = None
+        self._latest_metrics: Optional[dict] = None
+        self._resume_checkpoint = resume_from_checkpoint
+        self._error: Optional[str] = None
+
+    # -- state transitions -------------------------------------------------
+    def _start_worker_group(self):
+        self.state = RunState.SCHEDULING
+        wg = WorkerGroup(self._scaling, experiment_name=self._run_name,
+                         trial_dir=self._storage.run_path)
+        shards = self._split_datasets(self._scaling.num_workers)
+        resume = self._resume_checkpoint
+        if self._ckpt_manager.latest is not None:
+            resume = self._ckpt_manager.latest.checkpoint
+        wg.start(hparams=self._train_fn_config,
+                 dataset_shards_per_rank=shards,
+                 resume_checkpoint=resume,
+                 backend_fn=self._backend_fn)
+        wg.run_train_fn(self._train_fn, self._train_fn_config)
+        self._worker_group = wg
+        self.state = RunState.RUNNING
+
+    def _split_datasets(self, n: int) -> Optional[list[dict]]:
+        if not self._datasets:
+            return None
+        per_rank: list[dict] = [dict() for _ in range(n)]
+        for name, ds in self._datasets.items():
+            splits = _maybe_streaming_split(ds, n)
+            for rank in range(n):
+                per_rank[rank][name] = splits[rank]
+        return per_rank
+
+    def _handle_reports(self, statuses) -> None:
+        """Collect per-rank reports; persist checkpoints (any rank may attach
+        one — rank 0 wins ties within a step, matching reference
+        report_handler)."""
+        by_seq: dict[int, list] = {}
+        for rank, st in enumerate(statuses):
+            if st is None:
+                continue
+            for rep in st.reports:
+                by_seq.setdefault(rep.seq, []).append((rank, rep))
+        for seq in sorted(by_seq):
+            ranked = sorted(by_seq[seq])
+            metrics = ranked[0][1].metrics
+            self._latest_metrics = metrics
+            ckpt = None
+            for rank, rep in ranked:
+                if rep.checkpoint is not None:
+                    ckpt = rep.checkpoint
+                    break
+            if ckpt is not None:
+                self._ckpt_manager.register(ckpt, metrics)
+                self._ckpt_manager.write_state()
+
+    def _teardown_workers(self):
+        if self._worker_group is not None:
+            self._worker_group.shutdown()
+            self._worker_group = None
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> Result:
+        while self.state not in (RunState.FINISHED, RunState.ERRORED):
+            self._step()
+        latest = self._ckpt_manager.latest
+        best = self._ckpt_manager.best_checkpoints()
+        err = None
+        if self.state == RunState.ERRORED:
+            err = TrainingFailedError(self._error or "training failed")
+        return Result(
+            metrics=self._latest_metrics,
+            checkpoint=latest.checkpoint if latest else None,
+            error=err, path=self._storage.run_path,
+            best_checkpoints=best)
+
+    def _step(self):
+        if self.state in (RunState.INITIALIZING, RunState.RESTARTING):
+            try:
+                self._start_worker_group()
+            except Exception as e:  # noqa: BLE001 - scheduling failure
+                self._on_failure(f"worker group start failed: {e!r}")
+            return
+
+        if self.state == RunState.RUNNING:
+            statuses = self._worker_group.poll()
+            self._handle_reports(statuses)
+            dead = [i for i, s in enumerate(statuses) if s is None]
+            errs = [(i, s.error) for i, s in enumerate(statuses)
+                    if s is not None and s.error]
+            if dead or errs:
+                msg = "; ".join(
+                    [f"rank {i} died" for i in dead] +
+                    [f"rank {i}: {e.splitlines()[-1]}" for i, e in errs])
+                full = "\n".join(e for _, e in errs) or msg
+                self._on_failure(msg, full)
+                return
+            if all(s.finished for s in statuses):
+                self._teardown_workers()
+                self.state = RunState.FINISHED
+                return
+            time.sleep(self._poll_interval_s)
+
+    def _on_failure(self, msg: str, full: str = ""):
+        logger.warning("training failure: %s", msg)
+        self._teardown_workers()
+        decision = self._failure_policy.make_decision(msg)
+        if decision == FailureDecision.RETRY:
+            logger.info("restarting worker group (resume from latest ckpt)")
+            self.state = RunState.RESTARTING
+        else:
+            self._error = full or msg
+            self.state = RunState.ERRORED
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+def _maybe_streaming_split(ds, n: int) -> list:
+    """Split a Dataset into n per-rank iterators; pass lists/arrays through
+    sliced."""
+    split = getattr(ds, "streaming_split", None)
+    if callable(split):
+        return split(n, equal=True)
+    if isinstance(ds, (list, tuple)):
+        return [list(ds[i::n]) for i in range(n)]
+    return [ds for _ in range(n)]

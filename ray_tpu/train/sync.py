@@ -1,0 +1,65 @@
+"""Control-plane collectives: barrier / broadcast between train workers.
+
+TPU-native analog of the reference's SynchronizationActor
+(/root/reference/python/ray/train/v2/_internal/execution/checkpoint/sync_actor.py:27
+and train/collective/collectives.py): a named actor all ranks rendezvous on.
+Device-plane collectives are XLA's business (psum over ICI); this is only for
+host-side control flow (checkpoint barriers, config broadcast).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote(max_concurrency=64)
+class SynchronizationActor:
+    """Reusable barrier + value broadcast for a fixed world size.
+
+    Generation counter makes the barrier reusable (ranks can hit it
+    repeatedly); broadcast follows last-writer-from-rank-0 semantics like the
+    reference's `broadcast_from_rank_zero`.
+    """
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._gen = 0
+        self._arrived = 0
+        self._values: dict = {}
+        self._cv = threading.Condition()
+
+    def barrier(self, rank: int, value=None, timeout: float = 600.0):
+        """Block until all ranks arrive; returns the dict {rank: value}."""
+        with self._cv:
+            gen = self._gen
+            self._values[rank] = value
+            self._arrived += 1
+            if self._arrived == self._world:
+                self._gen += 1
+                self._arrived = 0
+                result = dict(self._values)
+                self._values = {}
+                self._last_result = result
+                self._cv.notify_all()
+                return result
+            deadline = time.monotonic() + timeout
+            while self._gen == gen:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"barrier timeout: {self._arrived}/{self._world} "
+                        f"ranks arrived")
+                self._cv.wait(remaining)
+            return self._last_result
+
+    def broadcast_from_rank_zero(self, rank: int, value=None,
+                                 timeout: float = 600.0):
+        result = self.barrier(rank, value, timeout)
+        return result.get(0)
+
+
+def create_sync_actor(world_size: int, name: str):
+    return SynchronizationActor.options(name=name).remote(world_size)

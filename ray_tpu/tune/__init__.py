@@ -1,0 +1,35 @@
+"""ray_tpu.tune — hyperparameter tuning (reference: python/ray/tune/)."""
+
+from ray_tpu.train.context import get_context, report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    Trial,
+    TrialResult,
+    TuneConfig,
+    TuneController,
+    Tuner,
+)
+
+__all__ = [
+    "ASHAScheduler", "AsyncHyperBandScheduler", "FIFOScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining", "ResultGrid", "Trial",
+    "TrialResult", "TrialScheduler", "TuneConfig", "TuneController", "Tuner",
+    "choice", "get_context", "grid_search", "loguniform", "randint", "report",
+    "sample_from", "uniform",
+]

@@ -1,0 +1,182 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+TPU-native analog of the reference's schedulers
+(/root/reference/python/ray/tune/schedulers/ — async_hyperband.py
+AsyncHyperBandScheduler/ASHA, median_stopping_rule.py, pbt.py). The
+controller calls `on_result` on every report and honors the returned
+decision.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial, metrics: dict) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial, metrics: Optional[dict]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference async_hyperband.py): successive-halving brackets with
+    asynchronous promotion — a trial stops at a rung if its result is not in
+    the top 1/reduction_factor of completed results at that rung."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3.0):
+        assert mode in ("max", "min")
+        self._metric = metric
+        self._mode = mode
+        self._time_attr = time_attr
+        self._max_t = max_t
+        self._grace = grace_period
+        self._rf = reduction_factor
+        # rung milestones: grace * rf^k up to max_t
+        self._rungs: list[float] = []
+        t = grace_period
+        while t < max_t:
+            self._rungs.append(t)
+            t *= reduction_factor
+        self._rungs.append(max_t)
+        self._recorded: dict[float, list[float]] = {r: [] for r in self._rungs}
+        self._trial_rung: dict[str, int] = {}
+
+    def _value(self, metrics) -> float:
+        v = metrics[self._metric]
+        return v if self._mode == "max" else -v
+
+    def on_result(self, trial, metrics: dict) -> str:
+        t = metrics.get(self._time_attr)
+        if t is None or self._metric not in metrics:
+            return CONTINUE
+        if t >= self._max_t:
+            return STOP
+        rung_idx = self._trial_rung.get(trial.trial_id, 0)
+        if rung_idx >= len(self._rungs):
+            return STOP
+        milestone = self._rungs[rung_idx]
+        if t < milestone:
+            return CONTINUE
+        value = self._value(metrics)
+        recorded = self._recorded[milestone]
+        recorded.append(value)
+        self._trial_rung[trial.trial_id] = rung_idx + 1
+        if len(recorded) >= self._rf:
+            cutoff_idx = max(0, int(len(recorded) / self._rf) - 1)
+            cutoff = sorted(recorded, reverse=True)[cutoff_idx]
+            if value < cutoff:
+                return STOP
+        return CONTINUE
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of running
+    averages (reference median_stopping_rule.py)."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self._metric = metric
+        self._mode = mode
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        self._history: dict[str, list[float]] = {}
+
+    def _value(self, metrics) -> float:
+        v = metrics[self._metric]
+        return v if self._mode == "max" else -v
+
+    def on_result(self, trial, metrics: dict) -> str:
+        if self._metric not in metrics:
+            return CONTINUE
+        hist = self._history.setdefault(trial.trial_id, [])
+        hist.append(self._value(metrics))
+        t = metrics.get(self._time_attr, len(hist))
+        if t < self._grace or len(self._history) < self._min_samples:
+            return CONTINUE
+        means = [sum(h) / len(h) for h in self._history.values() if h]
+        means_sorted = sorted(means)
+        median = means_sorted[len(means_sorted) // 2]
+        if max(hist) < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference pbt.py): at each perturbation interval, bottom-quantile
+    trials exploit (copy hyperparams + checkpoint of) a top-quantile trial
+    and explore (perturb) the copied hyperparams. The controller applies the
+    returned mutation via trial restart."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        self._metric = metric
+        self._mode = mode
+        self._time_attr = time_attr
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._scores: dict[str, float] = {}
+        self._last_perturb: dict[str, float] = {}
+        self._rng = random.Random(seed)
+        self.exploit_requests: dict[str, dict] = {}  # trial_id -> new config
+
+    def _value(self, metrics) -> float:
+        v = metrics[self._metric]
+        return v if self._mode == "max" else -v
+
+    def on_result(self, trial, metrics: dict) -> str:
+        if self._metric not in metrics:
+            return CONTINUE
+        self._scores[trial.trial_id] = self._value(metrics)
+        t = metrics.get(self._time_attr, 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self._interval or len(self._scores) < 2:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        ranked = sorted(self._scores.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(n * self._quantile))
+        bottom = [tid for tid, _ in ranked[:k]]
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom and top:
+            donor_id = self._rng.choice(top)
+            self.exploit_requests[trial.trial_id] = {"donor": donor_id,
+                                                     "explore": True}
+        return CONTINUE
+
+    def mutate_config(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self._mutations.items():
+            if key not in out:
+                continue
+            if isinstance(spec, list):
+                out[key] = self._rng.choice(spec)
+            elif callable(spec):
+                out[key] = spec()
+            else:  # perturb numerically
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = out[key] * factor
+        return out

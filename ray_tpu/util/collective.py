@@ -1,0 +1,205 @@
+"""Host-level collectives between actors/tasks.
+
+TPU-native analog of the reference's ray.util.collective
+(/root/reference/python/ray/util/collective/collective.py —
+init_collective_group:166, allreduce:311, broadcast:426, allgather:476,
+reducescatter:525, send:584, recv:647). The reference's backends are
+NCCL/gloo/NIXL; here the DEVICE data plane is XLA collectives over ICI
+(psum/all_gather emitted by pjit — no framework code needed), so this module
+only provides the HOST control/data plane: numpy arrays over the
+control-plane rendezvous actor, used for cross-process coordination
+(checkpointing barriers, eval aggregation, parameter broadcast at startup).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCE_OPS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "prod": lambda arrs: np.prod(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "mean": lambda arrs: np.mean(arrs, axis=0),
+}
+
+
+@ray_tpu.remote
+class _CollectiveGroupActor:
+    """Rendezvous + reduce for one group. Each collective is a generation-
+    numbered barrier keyed by op sequence, so the group is reusable."""
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._cv = threading.Condition()
+        self._rounds: dict = {}  # seq -> {"values": {rank: v}, "result": ...}
+        self._p2p: dict = {}     # (src, dst, tag) -> value
+
+    def collect(self, seq: int, rank: int, value, op: str,
+                timeout: float = 300.0):
+        with self._cv:
+            rd = self._rounds.setdefault(seq, {"values": {}, "result": None,
+                                               "done": False})
+            rd["values"][rank] = value
+            if len(rd["values"]) == self._world:
+                vals = [rd["values"][r] for r in sorted(rd["values"])]
+                if op == "gather":
+                    rd["result"] = vals
+                elif op == "bcast":
+                    rd["result"] = next(v for v in vals if v is not None)
+                else:
+                    rd["result"] = _REDUCE_OPS[op](
+                        [np.asarray(v) for v in vals])
+                rd["done"] = True
+                self._cv.notify_all()
+            else:
+                deadline = time.monotonic() + timeout
+                while not rd["done"]:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"collective seq={seq}: "
+                            f"{len(rd['values'])}/{self._world} ranks arrived")
+                    self._cv.wait(remaining)
+            result = rd["result"]
+            rd.setdefault("retrieved", 0)
+            rd["retrieved"] += 1
+            if rd["retrieved"] == self._world:
+                del self._rounds[seq]
+            return result
+
+    def send(self, src: int, dst: int, tag: int, value):
+        with self._cv:
+            self._p2p[(src, dst, tag)] = value
+            self._cv.notify_all()
+
+    def recv(self, src: int, dst: int, tag: int, timeout: float = 300.0):
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (src, dst, tag) not in self._p2p:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"recv src={src} tag={tag} timed out")
+                self._cv.wait(remaining)
+            return self._p2p.pop((src, dst, tag))
+
+
+class _GroupState:
+    def __init__(self, actor, world_size: int, rank: int):
+        self.actor = actor
+        self.world_size = world_size
+        self.rank = rank
+        self.seq = 0
+
+    def next_seq(self) -> int:
+        s = self.seq
+        self.seq += 1
+        return s
+
+
+_groups: dict[str, _GroupState] = {}
+_lock = threading.Lock()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Join (rank 0: create) a named collective group."""
+    name = f"_collective_{group_name}"
+    if rank == 0:
+        try:
+            actor = ray_tpu.get_actor(name, timeout=0.2)
+        except Exception:  # noqa: BLE001 - not created yet
+            actor = _CollectiveGroupActor.options(
+                name=name, max_concurrency=max(8, world_size * 2),
+                lifetime="detached").remote(world_size)
+    else:
+        actor = ray_tpu.get_actor(name, timeout=60.0)
+    with _lock:
+        _groups[group_name] = _GroupState(actor, world_size, rank)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        st = _groups.pop(group_name, None)
+    if st is not None and st.rank == 0:
+        try:
+            ray_tpu.kill(st.actor)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _groups[group_name].world_size
+
+
+def _state(group_name: str) -> _GroupState:
+    st = _groups.get(group_name)
+    if st is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized; call "
+            f"init_collective_group first")
+    return st
+
+
+def allreduce(tensor: np.ndarray, op: str = "sum",
+              group_name: str = "default") -> np.ndarray:
+    st = _state(group_name)
+    out = ray_tpu.get(st.actor.collect.remote(
+        st.next_seq(), st.rank, np.asarray(tensor), op))
+    return np.asarray(out)
+
+
+def allgather(tensor: np.ndarray, group_name: str = "default") -> list:
+    st = _state(group_name)
+    return ray_tpu.get(st.actor.collect.remote(
+        st.next_seq(), st.rank, np.asarray(tensor), "gather"))
+
+
+def broadcast(tensor: Optional[np.ndarray], src_rank: int = 0,
+              group_name: str = "default") -> np.ndarray:
+    st = _state(group_name)
+    value = np.asarray(tensor) if st.rank == src_rank else None
+    out = ray_tpu.get(st.actor.collect.remote(
+        st.next_seq(), st.rank, value, "bcast"))
+    return np.asarray(out)
+
+
+def reducescatter(tensor: np.ndarray, op: str = "sum",
+                  group_name: str = "default") -> np.ndarray:
+    st = _state(group_name)
+    reduced = allreduce(tensor, op, group_name)
+    shards = np.array_split(reduced, st.world_size)
+    return shards[st.rank]
+
+
+def barrier(group_name: str = "default") -> None:
+    st = _state(group_name)
+    ray_tpu.get(st.actor.collect.remote(st.next_seq(), st.rank, 0, "sum"))
+
+
+def send(tensor: np.ndarray, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    st = _state(group_name)
+    ray_tpu.get(st.actor.send.remote(st.rank, dst_rank, tag,
+                                     np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0) -> np.ndarray:
+    st = _state(group_name)
+    return np.asarray(ray_tpu.get(st.actor.recv.remote(
+        src_rank, st.rank, tag)))

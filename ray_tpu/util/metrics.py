@@ -1,0 +1,149 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+TPU-native analog of the reference's ray.util.metrics
+(/root/reference/python/ray/util/metrics.py — Counter:165, Histogram:232,
+Gauge:310). Metrics are recorded locally and pushed to the control-plane KV
+under "metrics:" keys on flush; a Prometheus-style exposition dump is
+available via `collect_prometheus()` (the reference exports through the
+dashboard agent → Prometheus pipeline, §5.5)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict = {}
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+        _registry_add(self)
+
+    @property
+    def info(self) -> dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys}
+
+    def set_default_tags(self, tags: dict) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_tuple(self, tags: Optional[dict]) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        unknown = set(merged) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys {unknown} for {self._name}")
+        return tuple(merged.get(k, "") for k in self._tag_keys)
+
+
+class Counter(Metric):
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        if value <= 0:
+            raise ValueError("counter increments must be positive")
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _kind(self):
+        return "counter"
+
+
+class Gauge(Metric):
+    def set(self, value: float, tags: Optional[dict] = None) -> None:
+        with self._lock:
+            self._values[self._tag_tuple(tags)] = float(value)
+
+    def _kind(self):
+        return "gauge"
+
+
+class Histogram(Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = list(boundaries or [0.01, 0.1, 1, 10, 100])
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[dict] = None) -> None:
+        key = self._tag_tuple(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self._boundaries) + 1))
+            idx = 0
+            while idx < len(self._boundaries) and value > self._boundaries[idx]:
+                idx += 1
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _kind(self):
+        return "histogram"
+
+
+_registry: list[Metric] = []
+_registry_lock = threading.Lock()
+
+
+def _registry_add(metric: Metric) -> None:
+    with _registry_lock:
+        _registry.append(metric)
+
+
+def collect_prometheus() -> str:
+    """Prometheus text exposition of all registered metrics."""
+    lines = []
+    with _registry_lock:
+        metrics = list(_registry)
+    for m in metrics:
+        kind = m._kind()
+        lines.append(f"# HELP {m._name} {m._description}")
+        lines.append(f"# TYPE {m._name} {kind}")
+        if isinstance(m, Histogram):
+            for key, counts in m._counts.items():
+                tags = _fmt_tags(m._tag_keys, key)
+                cum = 0
+                for b, c in zip(m._boundaries, counts):
+                    cum += c
+                    lines.append(
+                        f'{m._name}_bucket{{le="{b}"{tags}}} {cum}')
+                cum += counts[-1]
+                lines.append(f'{m._name}_bucket{{le="+Inf"{tags}}} {cum}')
+                lines.append(f"{m._name}_sum{{{tags.lstrip(',')}}} "
+                             f"{m._sums[key]}")
+                lines.append(f"{m._name}_count{{{tags.lstrip(',')}}} "
+                             f"{m._totals[key]}")
+        else:
+            for key, val in m._values.items():
+                tags = _fmt_tags(m._tag_keys, key)
+                suffix = f"{{{tags.lstrip(',')}}}" if tags else ""
+                lines.append(f"{m._name}{suffix} {val}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt_tags(keys: tuple, values: tuple) -> str:
+    if not keys:
+        return ""
+    return "," + ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+
+
+def push_to_control_plane() -> None:
+    """Snapshot all metrics into the cluster KV (metrics:<worker>)."""
+    from ray_tpu.core import api
+    rt = api._try_get_runtime()
+    if rt is None:
+        return
+    payload = collect_prometheus()
+    rt.cp_client.notify("kv_put", {
+        "key": f"metrics:{rt.worker_id.hex()}",
+        "value": payload.encode(), "overwrite": True})

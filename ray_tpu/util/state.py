@@ -1,0 +1,122 @@
+"""State API: cluster introspection.
+
+TPU-native analog of the reference's state API
+(/root/reference/python/ray/util/state/api.py — list_actors:783,
+list_tasks:1010, list_objects:1055; backed by
+dashboard/state_aggregator.py + GCS task events gcs_task_manager.cc). Here
+the control plane is the single source of truth, so the listing calls go
+straight to it; `timeline()` renders task events as a chrome trace like
+ray.timeline (python/ray/_private/state.py:438).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+
+def _cp():
+    from ray_tpu.core import api
+    return api._get_runtime().cp_client
+
+
+def list_nodes() -> list[dict]:
+    import ray_tpu
+    return ray_tpu.nodes()
+
+
+def list_actors(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
+    out = _cp().call("list_actors", {"limit": limit})
+    for a in out:
+        for key in ("actor_id", "node_id"):
+            if hasattr(a.get(key), "hex"):
+                a[key] = a[key].hex()
+    return _apply_filters(out[:limit], filters)
+
+
+def list_placement_groups(limit: int = 1000) -> list[dict]:
+    pgs = _cp().call("list_pgs", None)
+    for p in pgs:
+        p["pg_id"] = p["pg_id"].hex() if hasattr(p["pg_id"], "hex") else p["pg_id"]
+    return pgs[:limit]
+
+
+def list_jobs(limit: int = 1000) -> list[dict]:
+    return _cp().call("list_jobs", None)[:limit]
+
+
+def list_tasks(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
+    events = _cp().call("list_task_events", {"limit": limit * 4})
+    # fold events into per-task latest state
+    tasks: dict[str, dict] = {}
+    for ev in events:
+        tid = ev["task_id"]
+        rec = tasks.setdefault(tid, {"task_id": tid, "name": ev.get("name", ""),
+                                     "state": "", "events": []})
+        rec["state"] = ev["state"]
+        rec["events"].append({"state": ev["state"], "ts": ev["ts"]})
+        if ev.get("name"):
+            rec["name"] = ev["name"]
+    out = list(tasks.values())[:limit]
+    return _apply_filters(out, filters)
+
+
+def summarize_tasks() -> dict:
+    counts: dict[str, int] = {}
+    for t in list_tasks(limit=100000):
+        counts[t["state"]] = counts.get(t["state"], 0) + 1
+    return counts
+
+
+def summarize_actors() -> dict:
+    counts: dict[str, int] = {}
+    for a in list_actors(limit=100000):
+        counts[a["state"]] = counts.get(a["state"], 0) + 1
+    return counts
+
+
+def _apply_filters(rows: list[dict], filters) -> list[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op == "=" and str(have) != str(value):
+                ok = False
+            elif op == "!=" and str(have) == str(value):
+                ok = False
+        if ok:
+            out.append(row)
+    return out
+
+
+def timeline(filename: Optional[str] = None) -> Optional[str]:
+    """Chrome-trace dump of task events (reference
+    _private/state.py:438 chrome_tracing_dump)."""
+    events = _cp().call("list_task_events", {"limit": 100000})
+    # group begin/end per task attempt
+    begun: dict[str, dict] = {}
+    trace = []
+    for ev in events:
+        tid = ev["task_id"]
+        if ev["state"] == "RUNNING":
+            begun[tid] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and tid in begun:
+            b = begun.pop(tid)
+            trace.append({
+                "cat": "task", "ph": "X",
+                "name": ev.get("name") or b.get("name") or tid[:8],
+                "pid": ev.get("node_id", "node")[:8],
+                "tid": ev.get("worker_id", "worker")[:8],
+                "ts": b["ts"] * 1e6,
+                "dur": (ev["ts"] - b["ts"]) * 1e6,
+                "args": {"task_id": tid, "state": ev["state"]},
+            })
+    payload = json.dumps(trace)
+    if filename:
+        with open(filename, "w") as f:
+            f.write(payload)
+        return None
+    return payload

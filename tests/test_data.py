@@ -1,0 +1,224 @@
+"""ray_tpu.data tests (models the reference's data test strategy:
+python/ray/data/tests/ — transforms, shuffles, readers, iteration)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rtd
+
+
+@pytest.fixture
+def rt(ray_start_regular):
+    yield ray_start_regular
+
+
+def test_range_count_schema(rt):
+    ds = rtd.range(100)
+    assert ds.count() == 100
+    assert ds.columns() == ["id"]
+
+
+def test_take_and_rows(rt):
+    rows = rtd.range(10).take(3)
+    assert rows == [{"id": 0}, {"id": 1}, {"id": 2}]
+
+
+def test_map_batches_tasks(rt):
+    ds = rtd.range(100, parallelism=4).map_batches(
+        lambda b: {"x": b["id"] * 2})
+    out = ds.take_all()
+    assert sorted(r["x"] for r in out) == list(range(0, 200, 2))
+
+
+def test_map_batches_fusion(rt):
+    ds = rtd.range(10).map_batches(lambda b: {"x": b["id"] + 1}) \
+        .map_batches(lambda b: {"x": b["x"] * 10})
+    assert "Fused" in ds.stats()
+    assert sorted(r["x"] for r in ds.take_all()) == list(range(10, 110, 10))
+
+
+def test_map_and_filter_and_flat_map(rt):
+    ds = rtd.range(20).filter(lambda r: r["id"] % 2 == 0) \
+        .map(lambda r: {"v": r["id"] * 10})
+    vals = sorted(r["v"] for r in ds.take_all())
+    assert vals == [i * 10 for i in range(0, 20, 2)]
+
+    ds2 = rtd.from_items([1, 2]).flat_map(
+        lambda r: [{"v": r["item"]}, {"v": r["item"] * 100}])
+    assert sorted(x["v"] for x in ds2.take_all()) == [1, 2, 100, 200]
+
+
+def test_map_batches_actor_compute(rt):
+    class AddState:
+        def __init__(self):
+            self.offset = 1000
+
+        def __call__(self, batch):
+            return {"x": batch["id"] + self.offset}
+
+    ds = rtd.range(20, parallelism=2).map_batches(AddState, concurrency=2)
+    assert sorted(r["x"] for r in ds.take_all()) == list(range(1000, 1020))
+
+
+def test_limit_streaming(rt):
+    ds = rtd.range(1000, parallelism=10).limit(7)
+    assert [r["id"] for r in ds.take_all()] == list(range(7))
+
+
+def test_iter_batches_exact_sizes(rt):
+    sizes = [len(b["id"]) for b in rtd.range(100, parallelism=3)
+             .iter_batches(batch_size=32)]
+    assert sizes == [32, 32, 32, 4]
+    sizes = [len(b["id"]) for b in rtd.range(100, parallelism=3)
+             .iter_batches(batch_size=32, drop_last=True)]
+    assert sizes == [32, 32, 32]
+
+
+def test_iter_batches_formats(rt):
+    b = next(iter(rtd.range(10).iter_batches(batch_size=5,
+                                             batch_format="pandas")))
+    assert list(b["id"]) == [0, 1, 2, 3, 4]
+    b = next(iter(rtd.range(10).iter_batches(batch_size=5,
+                                             batch_format="pyarrow")))
+    assert b.num_rows == 5
+
+
+def test_repartition_and_shuffle(rt):
+    mat = rtd.range(100, parallelism=2).repartition(5).materialize()
+    assert mat.num_blocks() == 5
+    assert mat.count() == 100
+    shuffled = rtd.range(50).random_shuffle(seed=7).take_all()
+    ids = [r["id"] for r in shuffled]
+    assert sorted(ids) == list(range(50))
+    assert ids != list(range(50))
+
+
+def test_sort(rt):
+    ds = rtd.from_items([{"k": v} for v in [5, 3, 8, 1, 9, 2]]).sort("k")
+    assert [r["k"] for r in ds.take_all()] == [1, 2, 3, 5, 8, 9]
+    ds = rtd.from_items([{"k": v} for v in [5, 3, 8]]).sort("k", descending=True)
+    assert [r["k"] for r in ds.take_all()] == [8, 5, 3]
+
+
+def test_groupby_agg(rt):
+    items = [{"g": i % 3, "v": i} for i in range(12)]
+    ds = rtd.from_items(items, parallelism=3).groupby("g").sum("v")
+    rows = {r["g"]: r["sum(v)"] for r in ds.take_all()}
+    assert rows == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+
+
+def test_global_aggregates(rt):
+    ds = rtd.range(10)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == pytest.approx(4.5)
+
+
+def test_union_zip(rt):
+    a = rtd.from_items([{"x": 1}, {"x": 2}])
+    b = rtd.from_items([{"x": 3}])
+    assert sorted(r["x"] for r in a.union(b).take_all()) == [1, 2, 3]
+
+    c = rtd.from_items([{"y": 10}, {"y": 20}])
+    rows = a.zip(c).take_all()
+    assert sorted((r["x"], r["y"]) for r in rows) == [(1, 10), (2, 20)]
+
+
+def test_parquet_roundtrip(rt, tmp_path):
+    ds = rtd.range(50, parallelism=2).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    paths = ds.write_parquet(str(tmp_path / "out"))
+    assert len(paths) >= 1
+    back = rtd.read_parquet(str(tmp_path / "out"))
+    assert back.count() == 50
+    assert back.sum("sq") == sum(i * i for i in range(50))
+
+
+def test_csv_json_roundtrip(rt, tmp_path):
+    ds = rtd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+    ds.write_csv(str(tmp_path / "csv"))
+    assert rtd.read_csv(str(tmp_path / "csv")).count() == 2
+
+    ds.write_json(str(tmp_path / "json"))
+    back = rtd.read_json(str(tmp_path / "json")).take_all()
+    assert sorted(r["a"] for r in back) == [1, 2]
+
+
+def test_read_text_binary(rt, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    assert [r["text"] for r in rtd.read_text(str(p)).take_all()] == \
+        ["hello", "world"]
+    assert rtd.read_binary_files(str(p)).take_all()[0]["bytes"] == \
+        b"hello\nworld\n"
+
+
+def test_tensor_columns_numpy(rt):
+    arr = np.arange(24, dtype=np.float32).reshape(6, 2, 2)
+    ds = rtd.from_numpy(arr, column="img")
+    batch = next(iter(ds.iter_batches(batch_size=6)))
+    np.testing.assert_array_equal(batch["img"], arr)
+
+
+def test_from_pandas_arrow(rt):
+    import pandas as pd
+    df = pd.DataFrame({"a": [1, 2, 3]})
+    assert rtd.from_pandas(df).sum("a") == 6
+
+
+def test_iter_jax_batches(rt):
+    import jax.numpy as jnp
+    batches = list(rtd.range(16).iter_jax_batches(batch_size=8))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], jnp.ndarray)
+    total = sum(int(b["id"].sum()) for b in batches)
+    assert total == sum(range(16))
+
+
+def test_split(rt):
+    parts = rtd.range(100, parallelism=4).split(2)
+    counts = [p.count() for p in parts]
+    assert sum(counts) == 100
+    assert all(c > 0 for c in counts)
+
+
+def test_streaming_split_cross_process(rt):
+    splits = rtd.range(40, parallelism=4).streaming_split(2)
+
+    @ray_tpu.remote
+    def consume(it):
+        return sorted(r["id"] for r in it.iter_rows())
+
+    out = ray_tpu.get([consume.remote(s) for s in splits], timeout=120)
+    all_ids = sorted(out[0] + out[1])
+    assert all_ids == list(range(40))
+    assert out[0] and out[1]
+
+
+def test_local_shuffle_buffer(rt):
+    ids = [int(b["id"][0]) for b in rtd.range(32).iter_batches(
+        batch_size=1, local_shuffle_buffer_size=16, local_shuffle_seed=3)]
+    assert sorted(ids) == list(range(32))
+    assert ids != list(range(32))
+
+
+def test_select_drop_rename_add(rt):
+    ds = rtd.from_items([{"a": 1, "b": 2}])
+    assert ds.select_columns(["a"]).take_all() == [{"a": 1}]
+    assert ds.drop_columns(["a"]).take_all() == [{"b": 2}]
+    assert ds.rename_columns({"a": "z"}).take_all() == [{"z": 1, "b": 2}]
+    out = ds.add_column("c", lambda b: b["a"] + b["b"])
+    assert out.take_all() == [{"a": 1, "b": 2, "c": 3}]
+
+
+def test_executor_error_propagates(rt):
+    def boom(b):
+        raise ValueError("kaboom")
+
+    with pytest.raises(Exception, match="kaboom"):
+        rtd.range(10).map_batches(boom).take_all()

@@ -1,0 +1,187 @@
+"""Serve tests (models the reference's serve test strategy:
+python/ray/serve/tests/ — deployment lifecycle, handles, composition,
+batching, autoscaling decisions, HTTP ingress)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_shutdown(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def test_deployment_function(serve_shutdown):
+    @serve.deployment
+    def hello(name):
+        return f"hello {name}"
+
+    handle = serve.run(hello.bind(), name="app1", route_prefix=None)
+    assert handle.remote("world").result(timeout_s=30) == "hello world"
+    serve.delete("app1")
+
+
+def test_deployment_class_replicas(serve_shutdown):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.count = start
+
+        def __call__(self, inc):
+            self.count += inc
+            return self.count
+
+        def peek(self):
+            return self.count
+
+    handle = serve.run(Counter.bind(10), name="app2", route_prefix=None)
+    out = handle.remote(1).result(timeout_s=30)
+    assert out == 11
+    st = serve.status()
+    assert st["app2#Counter"]["replicas"] == 2
+    # method routing
+    peek = handle.peek.remote().result(timeout_s=30)
+    assert peek in (10, 11)
+    serve.delete("app2")
+
+
+def test_composition(serve_shutdown):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, doubler):
+            self.doubler = doubler
+
+        def __call__(self, x):
+            resp = self.doubler.remote(x)
+            return resp.result(timeout_s=20) + 1
+
+    app = Ingress.bind(Doubler.bind())
+    handle = serve.run(app, name="app3", route_prefix=None)
+    assert handle.remote(5).result(timeout_s=30) == 11
+    serve.delete("app3")
+
+
+def test_user_config_reconfigure(serve_shutdown):
+    @serve.deployment(user_config={"threshold": 5})
+    class Thresholder:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, x):
+            return x > self.threshold
+
+    handle = serve.run(Thresholder.bind(), name="app4", route_prefix=None)
+    assert handle.remote(7).result(timeout_s=30) is True
+    # redeploy with new user_config reconfigures in place
+    handle = serve.run(Thresholder.options(
+        user_config={"threshold": 10}).bind(), name="app4", route_prefix=None)
+    assert handle.remote(7).result(timeout_s=30) is False
+    serve.delete("app4")
+
+
+def test_batching(serve_shutdown):
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def handle_batch(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 10 for x in items]
+
+        async def __call__(self, x):
+            return await self.handle_batch(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), name="app5", route_prefix=None)
+    resps = [handle.remote(i) for i in range(8)]
+    out = sorted(r.result(timeout_s=30) for r in resps)
+    assert out == [i * 10 for i in range(8)]
+    sizes = handle.sizes.remote().result(timeout_s=30)
+    assert max(sizes) > 1  # batching actually happened
+    serve.delete("app5")
+
+
+def test_autoscaling_decision():
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    asc = AutoscalingConfig(min_replicas=1, max_replicas=5,
+                            target_ongoing_requests=2)
+    assert asc.decide(current=1, total_ongoing=10) == 5
+    assert asc.decide(current=5, total_ongoing=2) == 1
+    assert asc.decide(current=2, total_ongoing=4) == 2
+
+
+def test_replica_failure_recovery(serve_shutdown):
+    @serve.deployment(num_replicas=1, health_check_period_s=0.2)
+    class Fragile:
+        def __call__(self, x):
+            return x + 1
+
+        def die(self):
+            import os
+            os._exit(1)
+
+    handle = serve.run(Fragile.bind(), name="app6", route_prefix=None)
+    assert handle.remote(1).result(timeout_s=30) == 2
+    try:
+        handle.die.remote().result(timeout_s=5)
+    except Exception:
+        pass
+    # controller health loop should replace the dead replica
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline:
+        try:
+            if handle.remote(5).result(timeout_s=10) == 6:
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok, "replica was not replaced after death"
+    serve.delete("app6")
+
+
+def test_http_proxy(serve_shutdown):
+    import json
+    import urllib.request
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            if isinstance(payload, dict):
+                return {"got": payload}
+            return {"got": str(payload)}
+
+    serve.run(Echo.bind(), name="httpapp", route_prefix="/echo")
+    proxy = serve.start_http_proxy(port=18123)
+
+    req = urllib.request.Request(
+        "http://127.0.0.1:18123/echo", data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert body == {"got": {"a": 1}}
+
+    health = urllib.request.urlopen(
+        "http://127.0.0.1:18123/-/healthz", timeout=10).read()
+    assert health == b"ok"
+    routes = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:18123/-/routes", timeout=10).read())
+    assert "/echo" in routes
+    serve.delete("httpapp")

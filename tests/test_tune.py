@@ -1,0 +1,160 @@
+"""Tune tests (models reference python/ray/tune/tests/: variant generation,
+schedulers, end-to-end Tuner.fit, PBT mutation, Train integration)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+
+
+def _run_cfg(tmp_path):
+    return RunConfig(storage_path=str(tmp_path))
+
+
+def test_variant_generation_grid_and_random():
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    space = {"lr": tune.grid_search([0.1, 0.01]),
+             "wd": tune.choice([1, 2, 3]),
+             "fixed": 7}
+    variants = BasicVariantGenerator(space, num_samples=2, seed=0).variants()
+    assert len(variants) == 4  # 2 grid x 2 samples
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(v["fixed"] == 7 for v in variants)
+    assert all(v["wd"] in (1, 2, 3) for v in variants)
+
+
+def test_variant_nested_space():
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    space = {"opt": {"lr": tune.uniform(0.0, 1.0),
+                     "sched": tune.grid_search(["cos", "lin"])}}
+    variants = BasicVariantGenerator(space, seed=1).variants()
+    assert len(variants) == 2
+    assert all(0.0 <= v["opt"]["lr"] <= 1.0 for v in variants)
+
+
+def test_tuner_fit_basic(ray_start_regular, tmp_path):
+    def trainable(config):
+        score = -(config["x"] - 3) ** 2
+        tune.report({"score": score})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=3),
+        run_config=_run_cfg(tmp_path))
+    grid = tuner.fit()
+    assert len(grid) == 5
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 0
+
+
+def test_tuner_trial_error_isolated(ray_start_regular, tmp_path):
+    def trainable(config):
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        tune.report({"score": config["x"]})
+
+    grid = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=_run_cfg(tmp_path)).fit()
+    assert len(grid.errors) == 1
+    assert grid.get_best_result().config["x"] == 2
+
+
+def test_asha_stops_bad_trials(ray_start_regular, tmp_path):
+    def trainable(config):
+        for step in range(20):
+            tune.report({"acc": config["quality"] * (step + 1) / 20.0,
+                         "training_iteration": step + 1})
+
+    sched = tune.ASHAScheduler(metric="acc", mode="max", max_t=20,
+                               grace_period=2, reduction_factor=2)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([0.1, 0.2, 0.9, 1.0])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=4),
+        run_config=_run_cfg(tmp_path)).fit()
+    best = grid.get_best_result()
+    assert best.config["quality"] in (0.9, 1.0)
+    # at least one weak trial should have been stopped before max_t
+    iters = [len(r.history) for r in grid]
+    assert min(iters) < 20
+
+
+def test_scheduler_asha_unit():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, ASHAScheduler
+    from ray_tpu.tune.tuner import Trial
+
+    sched = ASHAScheduler(metric="m", mode="max", max_t=8, grace_period=2,
+                          reduction_factor=2)
+    good = Trial("good", {})
+    bad = Trial("bad", {})
+    out = []
+    for t in (1, 2):
+        out.append(sched.on_result(good, {"m": 1.0, "training_iteration": t}))
+    # bad trial hits rung 2 with much worse metric after good recorded
+    sched.on_result(bad, {"m": 1.0, "training_iteration": 1})
+    decision = sched.on_result(bad, {"m": 0.01, "training_iteration": 2})
+    assert decision == STOP
+    assert sched.on_result(good, {"m": 1.0, "training_iteration": 8}) == STOP
+
+
+def test_pbt_mutation_unit():
+    sched = tune.PopulationBasedTraining(
+        metric="m", mode="max", perturbation_interval=1,
+        hyperparam_mutations={"lr": [0.1, 0.2, 0.4]}, seed=0)
+    cfg = sched.mutate_config({"lr": 0.1, "other": 5})
+    assert cfg["lr"] in (0.1, 0.2, 0.4)
+    assert cfg["other"] == 5
+
+
+def test_tuner_with_checkpoints(ray_start_regular, tmp_path):
+    def trainable(config):
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "w.txt"), "w") as f:
+            f.write(str(config["x"]))
+        tune.report({"score": config["x"]},
+                    checkpoint=rt_train.Checkpoint(d))
+
+    grid = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=_run_cfg(tmp_path)).fit()
+    best = grid.get_best_result()
+    assert best.checkpoint is not None
+    assert open(os.path.join(best.checkpoint.path, "w.txt")).read() == "2"
+
+
+def test_tuner_over_trainer(ray_start_regular, tmp_path):
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    def train_fn(config):
+        rt_train.report({"loss": abs(config.get("lr", 1.0) - 0.1)})
+
+    trainer = DataParallelTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "inner")))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "lr": tune.grid_search([0.1, 0.5])}},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=1),
+        run_config=_run_cfg(tmp_path)).fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["loss"] == pytest.approx(0.0)

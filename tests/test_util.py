@@ -1,0 +1,151 @@
+"""Tests for ray_tpu.util: ActorPool, Queue, collective, state API, metrics."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util import collective as col
+from ray_tpu.util import metrics, state
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, v):
+        return v * 2
+
+
+def test_actor_pool_ordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    assert out == [2, 4, 6, 8]
+
+
+def test_actor_pool_unordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    [1, 2, 3]))
+    assert out == [2, 4, 6]
+
+
+def test_queue_basic(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.full()
+    with pytest.raises(Exception):
+        q.put("c", block=False)
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.empty()
+    with pytest.raises(Exception):
+        q.get(block=False)
+    q.shutdown()
+
+
+def test_queue_cross_process(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q):
+        for i in range(5):
+            q.put(i)
+        return True
+
+    ray_tpu.get(producer.remote(q))
+    assert [q.get(timeout=10) for _ in range(5)] == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+def test_collective_allreduce_broadcast(ray_start_regular):
+    @ray_tpu.remote
+    def worker(rank, world):
+        col.init_collective_group(world, rank, group_name="g1")
+        reduced = col.allreduce(np.full((4,), float(rank + 1)),
+                                group_name="g1")
+        gathered = col.allgather(np.array([rank]), group_name="g1")
+        bcast = col.broadcast(
+            np.array([42.0]) if rank == 0 else None, src_rank=0,
+            group_name="g1")
+        col.barrier(group_name="g1")
+        return reduced.tolist(), [g.tolist() for g in gathered], bcast.tolist()
+
+    out = ray_tpu.get([worker.remote(r, 2) for r in range(2)], timeout=60)
+    for reduced, gathered, bcast in out:
+        assert reduced == [3.0, 3.0, 3.0, 3.0]
+        assert gathered == [[0], [1]]
+        assert bcast == [42.0]
+
+
+def test_collective_send_recv(ray_start_regular):
+    @ray_tpu.remote
+    def worker(rank, world):
+        col.init_collective_group(world, rank, group_name="g2")
+        if rank == 0:
+            col.send(np.array([7.0]), dst_rank=1, group_name="g2")
+            return None
+        return col.recv(src_rank=0, group_name="g2").tolist()
+
+    out = ray_tpu.get([worker.remote(r, 2) for r in range(2)], timeout=60)
+    assert out[1] == [7.0]
+
+
+def test_state_api(ray_start_regular):
+    @ray_tpu.remote
+    class Named:
+        def ping(self):
+            return "pong"
+
+    a = Named.options(name="state-test-actor").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    actors = state.list_actors()
+    names = [x["name"] for x in actors]
+    assert "state-test-actor" in names
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(3)])
+    import ray_tpu.core.api as core_api
+    core_api._get_runtime().flush_task_events()
+    tasks = state.list_tasks()
+    assert any("noop" in t["name"] for t in tasks)
+
+
+def test_timeline(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    ray_tpu.get(traced.remote())
+    import ray_tpu.core.api as core_api
+    core_api._get_runtime().flush_task_events()
+    p = tmp_path / "trace.json"
+    state.timeline(str(p))
+    import json
+    trace = json.loads(p.read_text())
+    assert isinstance(trace, list)
+
+
+def test_metrics():
+    c = metrics.Counter("reqs_total", "requests", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = metrics.Gauge("inflight", "in flight")
+    g.set(5)
+    h = metrics.Histogram("latency_s", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = metrics.collect_prometheus()
+    assert "reqs_total" in text and 'route="/a"' in text and "3.0" in text
+    assert "inflight 5.0" in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+    with pytest.raises(ValueError):
+        c.inc(0)
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"bad": "x"})
