@@ -84,7 +84,9 @@ class ShmArenaStore {
   // Allocate an extent for `id`. Evicts LRU unpinned sealed objects as
   // needed. Returns 0 on success (offset in *offset_out), -1 if the object
   // exists already (offset returned too), -2 if out of memory even after
-  // eviction. Evicted ids are appended newline-separated into evicted_buf.
+  // eviction. Evicted ids are appended newline-separated into evicted_buf
+  // (on BOTH the success and -2 paths — victims are deleted either way, so
+  // owners must always be notified). Truncation keeps whole lines only.
   int Put(const std::string& id, uint64_t size, uint64_t* offset_out,
           char* evicted_buf, uint64_t evicted_cap) {
     std::lock_guard<std::mutex> g(mu_);
@@ -95,6 +97,7 @@ class ShmArenaStore {
     }
     uint64_t need = align_up(size == 0 ? kAlign : size);
     std::string evicted;
+    int rc;
     while (true) {
       int64_t off = AllocLocked(need);
       if (off >= 0) {
@@ -105,13 +108,8 @@ class ShmArenaStore {
         objects_[id] = obj;
         used_ += need;
         *offset_out = obj.offset;
-        if (!evicted.empty() && evicted_buf != nullptr && evicted_cap > 0) {
-          size_t n = evicted.size() < evicted_cap - 1 ? evicted.size()
-                                                      : evicted_cap - 1;
-          memcpy(evicted_buf, evicted.data(), n);
-          evicted_buf[n] = '\0';
-        }
-        return 0;
+        rc = 0;
+        break;
       }
       // evict one LRU victim (sealed + unpinned)
       std::string victim;
@@ -123,12 +121,24 @@ class ShmArenaStore {
           victim = kv.first;
         }
       }
-      if (victim.empty()) return -2;
+      if (victim.empty()) {
+        rc = -2;
+        break;
+      }
       evicted += victim;
       evicted += '\n';
       num_evicted_++;
       DeleteLocked(victim);
     }
+    if (!evicted.empty() && evicted_buf != nullptr && evicted_cap > 0) {
+      size_t n = evicted.size() < evicted_cap - 1 ? evicted.size()
+                                                  : evicted_cap - 1;
+      // never cut an id in half: drop back to the last complete line
+      while (n > 0 && evicted[n - 1] != '\n') --n;
+      memcpy(evicted_buf, evicted.data(), n);
+      evicted_buf[n] = '\0';
+    }
+    return rc;
   }
 
   int Seal(const std::string& id) {

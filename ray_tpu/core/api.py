@@ -110,9 +110,26 @@ def _atexit_shutdown():
         pass
 
 
+_shutdown_hooks: list = []
+
+
+def register_shutdown_hook(fn) -> None:
+    """Run `fn()` at the start of shutdown(), before the runtime is torn
+    down. Used by libraries (e.g. the data streaming executor) to stop
+    background threads that hold runtime handles, so a later init() in the
+    same process doesn't race leaked threads from the previous cluster."""
+    if fn not in _shutdown_hooks:
+        _shutdown_hooks.append(fn)
+
+
 def shutdown():
     """(ref: worker.py:2067)"""
     global _runtime, _head
+    for hook in list(_shutdown_hooks):
+        try:
+            hook()
+        except Exception:
+            pass
     with _lock:
         rt, _runtime = _runtime, None
         head, _head = _head, None

@@ -29,7 +29,7 @@ from dataclasses import dataclass, field
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, PlacementGroupID, WorkerID
-from ray_tpu.core.object_store import ShmStore
+from ray_tpu.core.object_store import make_store
 from ray_tpu.core.rpc import ClientPool, RpcServer
 from ray_tpu.core.scheduler import add, fits, subtract
 
@@ -47,6 +47,7 @@ class _WorkerInfo:
     is_tpu_worker: bool = False
     idle_since: float = field(default_factory=time.monotonic)
     ready = None  # threading.Event
+    log_paths: tuple[str, str] | None = None
 
 
 @dataclass
@@ -81,8 +82,8 @@ class NodeAgent:
         # pg_id -> bundle_index -> remaining reserved resources
         self._pg_reserved: dict[PlacementGroupID, dict[int, dict[str, float]]] = {}
         self._pg_prepared: dict[PlacementGroupID, dict[int, dict[str, float]]] = {}
-        self.store = ShmStore(object_store_memory or cfg.object_store_memory,
-                              prefix=f"rtpu{os.getpid() % 10000}_{self.node_id.hex()[:6]}")
+        self.store = make_store(object_store_memory or cfg.object_store_memory,
+                                prefix=f"rtpu{os.getpid() % 10000}_{self.node_id.hex()[:6]}")
         self.store.on_evict = self._on_store_evict
         self._object_owners: dict = {}  # ObjectID -> owner addr, for evict notices
         self._stopped = threading.Event()
@@ -146,14 +147,28 @@ class NodeAgent:
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         if not for_tpu:
             # CPU-pool workers must never grab the TPU chips as an import side
-            # effect (single-process-per-chipset constraint).
+            # effect (single-process-per-chipset constraint). Dropping the
+            # TPU plugin bootstrap env also skips the sitecustomize-time jax
+            # import (~2.5s), so CPU worker spawn is fast; jax is imported
+            # lazily (CPU backend) only if a task actually uses it.
             env.setdefault("JAX_PLATFORMS", "cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         info = _WorkerInfo(worker_id=worker_id, is_tpu_worker=for_tpu)
         info.ready = threading.Event()
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env, cwd=os.getcwd())
+        # Per-worker log files (ref: /tmp/ray/session_*/logs +
+        # _private/log_monitor.py); stderr/stdout land here, readable via
+        # `ray_tpu.util.state.worker_logs()`.
+        log_dir = get_config().log_dir or os.path.join(
+            "/tmp/ray_tpu/logs", f"agent-{os.getpid()}")
+        os.makedirs(log_dir, exist_ok=True)
+        out_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out")
+        err_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.err")
+        with open(out_path, "ab") as fout, open(err_path, "ab") as ferr:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env, cwd=os.getcwd(), stdout=fout, stderr=ferr)
         info.proc, info.pid = proc, proc.pid
+        info.log_paths = (out_path, err_path)
         with self._lock:
             self._workers[worker_id] = info
         return info
@@ -369,11 +384,11 @@ class NodeAgent:
 
     # ---- object store --------------------------------------------------
     def _h_store_create(self, body):
-        name = self.store.create(body["object_id"], body["size"],
-                                 body.get("device_hint", ""))
+        name, offset = self.store.create(body["object_id"], body["size"],
+                                         body.get("device_hint", ""))
         if body.get("owner_addr") is not None:
             self._object_owners[body["object_id"]] = tuple(body["owner_addr"])
-        return {"shm_name": name}
+        return {"shm_name": name, "offset": offset}
 
     def _h_store_seal(self, body):
         self.store.seal(body["object_id"])

@@ -54,11 +54,15 @@ class ShmStore:
         self.on_evict = None  # callback(ObjectID) — notify owner of lost copy
 
     # ---- lifecycle ----------------------------------------------------
-    def create(self, object_id: ObjectID, size: int, device_hint: str = "") -> str:
+    def create(self, object_id: ObjectID, size: int,
+               device_hint: str = "") -> tuple[str, int]:
+        """Returns (shm_name, offset). Offset is always 0 for this backend
+        (one segment per object); the native arena backend returns real
+        offsets into its single segment."""
         with self._lock:
             if object_id in self._objects:
                 meta = self._objects[object_id]
-                return meta.shm_name
+                return meta.shm_name, 0
             self._evict_until(size)
             if self._used + size > self.capacity:
                 raise ObjectStoreFullError(
@@ -68,7 +72,7 @@ class ShmStore:
             self._segments[name] = seg
             self._objects[object_id] = _ObjMeta(shm_name=name, size=size, device_hint=device_hint)
             self._used += size
-            return name
+            return name, 0
 
     def seal(self, object_id: ObjectID):
         with self._lock:
@@ -78,13 +82,14 @@ class ShmStore:
             meta.sealed = True
             self._objects.move_to_end(object_id)
 
-    def get_meta(self, object_id: ObjectID) -> tuple[str, int, str] | None:
+    def get_meta(self, object_id: ObjectID) -> tuple[str, int, int, str] | None:
+        """(shm_name, offset, size, device_hint) of a sealed object."""
         with self._lock:
             meta = self._objects.get(object_id)
             if meta is None or not meta.sealed:
                 return None
             self._objects.move_to_end(object_id)  # LRU touch
-            return (meta.shm_name, meta.size, meta.device_hint)
+            return (meta.shm_name, 0, meta.size, meta.device_hint)
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -143,13 +148,13 @@ class ShmStore:
         seg = self._segments.get(meta[0])
         if seg is None:
             return None
-        total = meta[1]
+        total = meta[2]
         end = total if size is None else min(total, offset + size)
         return total, bytes(seg.buf[offset:end])
 
     def write_bytes(self, object_id: ObjectID, data: bytes):
         """Write a received remote copy (ref: object_manager.cc chunked push)."""
-        name = self.create(object_id, len(data))
+        name, _off = self.create(object_id, len(data))
         seg = self._segments[name]
         seg.buf[: len(data)] = data
         self.seal(object_id)
@@ -203,16 +208,16 @@ class ShmClient:
         self._attached: dict[str, _MappedSegment] = {}
         self._lock = threading.Lock()
 
-    def map(self, shm_name: str, size: int) -> memoryview:
+    def map(self, shm_name: str, size: int, offset: int = 0) -> memoryview:
         with self._lock:
             seg = self._attached.get(shm_name)
             if seg is None:
                 seg = self._attached[shm_name] = _MappedSegment(shm_name)
-        return seg.buf()[:size]
+        return seg.buf()[offset:offset + size]
 
-    def write(self, shm_name: str, size: int, writer) -> None:
+    def write(self, shm_name: str, size: int, writer, offset: int = 0) -> None:
         """``writer(memoryview)`` fills the buffer."""
-        mv = self.map(shm_name, size)
+        mv = self.map(shm_name, size, offset)
         writer(mv)
 
     def release(self, shm_name: str):
@@ -226,3 +231,180 @@ class ShmClient:
             segs, self._attached = list(self._attached.values()), {}
         for seg in segs:
             seg.close()
+
+
+class NativeShmStore:
+    """Agent-side store backed by the C++ arena allocator
+    (ray_tpu/_native/shm_store.cc): ONE shm segment per node, objects are
+    [offset, size) extents handed out by a best-fit free list, LRU eviction in
+    native code. Clients mmap the arena once and read every object zero-copy
+    at its offset — same client model as plasma's single memory-mapped pool
+    (plasma/client.cc), with (arena_name, offset) standing in for fd-passing.
+
+    Same interface as ShmStore; selected by config.use_native_object_store
+    when the toolchain can build the library.
+    """
+
+    def __init__(self, capacity_bytes: int, prefix: str = "rtpu"):
+        import ctypes
+        import os
+
+        from ray_tpu import _native
+
+        lib = _native.load_library()
+        if lib is None:
+            raise RuntimeError(
+                f"native store unavailable: {_native.build_error()!r}")
+        self._ctypes = ctypes
+        self._lib = lib
+        self.capacity = capacity_bytes
+        self.arena_name = f"{prefix}_arena_{os.getpid()}"
+        self._handle = lib.rtpu_store_create(
+            self.arena_name.encode(), ctypes.c_uint64(capacity_bytes))
+        if not self._handle:
+            raise RuntimeError("native store arena creation failed")
+        self._base = lib.rtpu_store_base(ctypes.c_void_p(self._handle))
+        self._lock = threading.Lock()
+        self._hints: dict[ObjectID, str] = {}
+        # reused under self._lock: avoids a 64KB alloc+memset per put
+        self._evicted_buf = ctypes.create_string_buffer(1 << 16)
+        self.num_evicted = 0
+        self.on_evict = None
+
+    def _drain_evictions(self) -> list[ObjectID]:
+        """Parse newline-separated hex ids out of the (truncation-safe)
+        eviction buffer; must hold self._lock."""
+        raw = self._evicted_buf.value
+        if not raw:
+            return []
+        out = []
+        for hexid in raw.decode().split("\n"):
+            if not hexid:
+                continue
+            try:
+                oid = ObjectID(bytes.fromhex(hexid))
+            except ValueError:
+                continue  # defensive: never fail a put on a bad notice
+            self._hints.pop(oid, None)
+            out.append(oid)
+        return out
+
+    def _notify_evicted(self, oids: list[ObjectID]) -> None:
+        for oid in oids:
+            self.num_evicted += 1
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(oid)
+                except Exception:
+                    pass
+
+    def create(self, object_id: ObjectID, size: int,
+               device_hint: str = "") -> tuple[str, int]:
+        ct = self._ctypes
+        offset = ct.c_uint64()
+        with self._lock:
+            self._evicted_buf[0] = b"\x00"
+            rc = self._lib.rtpu_store_put(
+                ct.c_void_p(self._handle), object_id.hex().encode(),
+                ct.c_uint64(size), ct.byref(offset), self._evicted_buf,
+                ct.c_uint64(len(self._evicted_buf)))
+            if rc == 0 and device_hint:
+                self._hints[object_id] = device_hint
+            evicted = self._drain_evictions()
+        self._notify_evicted(evicted)
+        if rc == -2:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes does not fit in native arena "
+                f"({self.capacity} capacity)")
+        return self.arena_name, offset.value
+
+    def seal(self, object_id: ObjectID):
+        rc = self._lib.rtpu_store_seal(
+            self._ctypes.c_void_p(self._handle), object_id.hex().encode())
+        if rc != 0:
+            raise KeyError(f"seal of unknown object {object_id}")
+
+    def _get(self, object_id: ObjectID):
+        ct = self._ctypes
+        offset, size, sealed = ct.c_uint64(), ct.c_uint64(), ct.c_int()
+        rc = self._lib.rtpu_store_get(
+            ct.c_void_p(self._handle), object_id.hex().encode(),
+            ct.byref(offset), ct.byref(size), ct.byref(sealed))
+        if rc != 0:
+            return None
+        return offset.value, size.value, bool(sealed.value)
+
+    def get_meta(self, object_id: ObjectID) -> tuple[str, int, int, str] | None:
+        got = self._get(object_id)
+        if got is None or not got[2]:
+            return None
+        return (self.arena_name, got[0], got[1],
+                self._hints.get(object_id, ""))
+
+    def contains(self, object_id: ObjectID) -> bool:
+        got = self._get(object_id)
+        return got is not None and got[2]
+
+    def pin(self, object_id: ObjectID, pinned: bool = True):
+        self._lib.rtpu_store_pin(
+            self._ctypes.c_void_p(self._handle), object_id.hex().encode(),
+            1 if pinned else 0)
+
+    def delete(self, object_id: ObjectID):
+        self._hints.pop(object_id, None)
+        self._lib.rtpu_store_delete(
+            self._ctypes.c_void_p(self._handle), object_id.hex().encode())
+
+    def read_bytes(self, object_id: ObjectID, offset: int = 0,
+                   size: int | None = None) -> tuple[int, bytes] | None:
+        meta = self.get_meta(object_id)
+        if meta is None:
+            return None
+        _name, obj_off, total, _hint = meta
+        end = total if size is None else min(total, offset + size)
+        n = max(0, end - offset)
+        data = self._ctypes.string_at(self._base + obj_off + offset, n)
+        return total, data
+
+    def write_bytes(self, object_id: ObjectID, data: bytes):
+        _name, obj_off = self.create(object_id, len(data))
+        self._ctypes.memmove(self._base + obj_off, data, len(data))
+        self.seal(object_id)
+
+    def stats(self) -> dict:
+        ct = self._ctypes
+        used, num_obj, evicted, cap = (ct.c_uint64(), ct.c_uint64(),
+                                       ct.c_uint64(), ct.c_uint64())
+        self._lib.rtpu_store_stats(
+            ct.c_void_p(self._handle), ct.byref(used), ct.byref(num_obj),
+            ct.byref(evicted), ct.byref(cap))
+        return {
+            "num_objects": num_obj.value,
+            "used_bytes": used.value,
+            "capacity_bytes": cap.value,
+            "num_evicted": evicted.value,
+            "backend": "native",
+        }
+
+    def shutdown(self):
+        with self._lock:
+            if self._handle:
+                self._lib.rtpu_store_destroy(self._ctypes.c_void_p(self._handle))
+                self._handle = None
+
+
+def make_store(capacity_bytes: int, prefix: str = "rtpu"):
+    """Pick the store backend per config.use_native_object_store, falling
+    back to the pure-python per-object-segment store when the native library
+    cannot be built (no toolchain)."""
+    from ray_tpu.core.config import get_config
+
+    if get_config().use_native_object_store:
+        try:
+            return NativeShmStore(capacity_bytes, prefix)
+        except Exception as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "native object store unavailable (%s); falling back to the "
+                "pure-python store", e)
+    return ShmStore(capacity_bytes, prefix)

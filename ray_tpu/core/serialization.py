@@ -166,6 +166,13 @@ def _deserialize_custom(pickled_deserializer: bytes, payload):
 
 
 def _jax_array_types() -> tuple:
+    """jax.Array, but ONLY if jax is already imported: a value cannot be a
+    jax.Array otherwise, and importing jax here would add ~2s to the first
+    serialize in every CPU worker (and could grab TPU chips as a side
+    effect — SURVEY.md §7 hard-part 7)."""
+    import sys
+    if "jax" not in sys.modules:
+        return ()
     try:
         import jax
         return (jax.Array,)

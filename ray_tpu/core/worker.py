@@ -142,7 +142,7 @@ class WorkerRuntime:
             "store_create",
             {"object_id": oid, "size": size, "device_hint": device_hint,
              "owner_addr": self.addr}, timeout=30.0)
-        mv = self.shm_client.map(reply["shm_name"], size)
+        mv = self.shm_client.map(reply["shm_name"], size, reply.get("offset", 0))
         _write_serialized(mv, sobj)
         agent.call_with_retry("store_seal", {"object_id": oid}, timeout=30.0)
         self.memory_store.put_location(oid, self.node_id)
@@ -248,8 +248,8 @@ class WorkerRuntime:
                     break
             if meta is None:
                 return None, False
-        shm_name, size, _device = meta
-        mv = self.shm_client.map(shm_name, size)
+        shm_name, offset, size, _device = meta
+        mv = self.shm_client.map(shm_name, size, offset)
         sobj = SerializedObject.from_buffer(mv)
         return self.serialization.deserialize(sobj), True
 
@@ -620,8 +620,14 @@ class WorkerRuntime:
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
         try:
+            t0 = time.monotonic()
             fn = self.function_manager.get(spec.function_id)
+            t1 = time.monotonic()
             args, kwargs = self._resolve_args(spec)
+            t2 = time.monotonic()
+            if t2 - t0 > 0.05:
+                logger.info("task %s setup: fn_get=%.3fs args=%.3fs",
+                            spec.repr_name(), t1 - t0, t2 - t1)
             if spec.task_type == TaskType.ACTOR_TASK:
                 method = getattr(self._actor_state.instance, spec.method_name)
                 result = method(*args, **kwargs)
@@ -680,7 +686,7 @@ class WorkerRuntime:
         reply = agent.call_with_retry(
             "store_create", {"object_id": oid, "size": size,
                              "owner_addr": spec.owner_addr}, timeout=30.0)
-        mv = self.shm_client.map(reply["shm_name"], size)
+        mv = self.shm_client.map(reply["shm_name"], size, reply.get("offset", 0))
         _write_serialized(mv, sobj)
         agent.call_with_retry("store_seal", {"object_id": oid}, timeout=30.0)
 

@@ -256,16 +256,29 @@ class Dataset:
     def streaming_split(self, n: int, *, equal: bool = True,
                         locality_hints=None) -> list[DataIterator]:
         """N coordinated iterators, one per consumer (reference
-        dataset.py:1818). A coordinator actor runs the executor and
-        round-robins bundles; consumers (train workers, possibly in other
-        processes) pull blocks through actor calls."""
-        coord = _SplitCoordinator.options(max_concurrency=max(4, n + 1)).remote(
-            self._terminal, self._parallelism, n)
+        dataset.py:1818). A coordinator actor runs the executor and deals
+        bundles; consumers (train workers, possibly in other processes) pull
+        blocks through actor calls.
+
+        Re-iterating an iterator starts a new epoch: the coordinator re-runs
+        the executor, so multi-epoch training loops work. With ``equal=True``
+        every rank receives the same number of blocks AND the same number of
+        rows per epoch (row-level tail equalization like the reference's
+        output_splitter.py; up to n-1 remainder rows are dropped), which keeps
+        SPMD collectives deadlock-free.
+        """
+        coord = _SplitCoordinator.options(
+            max_concurrency=max(4, 2 * n + 1)).remote(
+            self._terminal, self._parallelism, n, equal)
 
         def make_factory(rank: int):
+            epoch = [0]
+
             def factory():
+                e = epoch[0]
+                epoch[0] += 1
                 while True:
-                    blk = ray_tpu.get(coord.next.remote(rank), timeout=120.0)
+                    blk = ray_tpu.get(coord.next.remote(rank, e), timeout=120.0)
                     if blk is None:
                         return
                     yield blk
@@ -325,34 +338,106 @@ class MaterializedDataset(Dataset):
 
 @ray_tpu.remote
 class _SplitCoordinator:
-    """Runs the executor and deals bundles to n consumers round-robin.
+    """Runs the executor once per epoch and deals bundles to n consumers.
 
-    equal=True semantics approximated at block granularity; the reference's
-    output_splitter.py does the same block-level dealing with optional
-    row-level equalization at the tail.
+    equal=True deals fixed-size rounds (one block to every rank per round,
+    equal rows per block) with row-level equalization at the tail, mirroring
+    the reference's output_splitter.py guarantee that ranks receive equal row
+    counts. Each epoch re-runs the executor, so iterators are re-iterable.
     """
 
-    def __init__(self, terminal, parallelism: int, n: int):
+    def __init__(self, terminal, parallelism: int, n: int, equal: bool = True):
+        import threading as th
+
+        self._terminal = terminal
+        self._parallelism = parallelism
+        self._n = n
+        self._equal = equal
+        self._lock = th.Lock()
+        self._epochs: dict[int, list] = {}
+        self._finished_ranks: dict[int, set] = {}  # epoch -> ranks done
+
+    def _queues_for(self, epoch: int) -> list:
         import queue as queuelib
         import threading as th
 
-        self._queues = [queuelib.Queue(maxsize=4) for _ in range(n)]
+        with self._lock:
+            if epoch not in self._epochs:
+                queues = [queuelib.Queue(maxsize=4) for _ in range(self._n)]
+                self._epochs[epoch] = queues
+                self._finished_ranks[epoch] = set()
+                th.Thread(target=self._pump, args=(queues,),
+                          daemon=True).start()
+            return self._epochs[epoch]
 
-        def pump():
-            try:
-                ex = StreamingExecutor(LogicalPlan(terminal), parallelism)
+    def _mark_done(self, epoch: int, rank: int) -> None:
+        # GC an epoch only once EVERY rank consumed its end-of-stream
+        # sentinel; dropping earlier would strand a lagging rank on orphaned
+        # queues (and re-running the executor would hand it duplicate rows).
+        with self._lock:
+            done = self._finished_ranks.get(epoch)
+            if done is None:
+                return
+            done.add(rank)
+            if len(done) >= self._n:
+                self._epochs.pop(epoch, None)
+                self._finished_ranks.pop(epoch, None)
+
+    def _pump(self, queues: list) -> None:
+        n = self._n
+        try:
+            ex = StreamingExecutor(LogicalPlan(self._terminal),
+                                   self._parallelism)
+            if not self._equal:
                 for i, (ref, meta) in enumerate(ex.run()):
-                    blk = ray_tpu.get(ref)
-                    self._queues[i % n].put(blk)
-            finally:
-                for q in self._queues:
-                    q.put(None)
+                    queues[i % n].put(ray_tpu.get(ref))
+                return
+            # equal=True: deal rounds of `chunk` rows to every rank.
+            pending: list = []
+            pending_rows = 0
+            chunk = 0
+            for ref, meta in ex.run():
+                blk = ray_tpu.get(ref)
+                if blk.num_rows == 0:
+                    continue
+                if chunk == 0:
+                    chunk = blk.num_rows
+                pending.append(blk)
+                pending_rows += blk.num_rows
+                while pending_rows >= n * chunk:
+                    for q in queues:
+                        q.put(_take_rows(pending, chunk))
+                    pending_rows -= n * chunk
+            tail = pending_rows // n
+            if tail:
+                for q in queues:
+                    q.put(_take_rows(pending, tail))
+        finally:
+            for q in queues:
+                q.put(None)
 
-        self._thread = th.Thread(target=pump, daemon=True)
-        self._thread.start()
+    def next(self, rank: int, epoch: int = 0):
+        item = self._queues_for(epoch)[rank].get(timeout=110.0)
+        if item is None:
+            self._mark_done(epoch, rank)
+        return item
 
-    def next(self, rank: int):
-        return self._queues[rank].get(timeout=110.0)
+
+def _take_rows(pending: list, k: int) -> Block:
+    """Remove exactly k rows from the front of `pending` (a list of blocks),
+    slicing the boundary block as needed, and return them as one block."""
+    out = []
+    need = k
+    while need > 0:
+        blk = pending[0]
+        if blk.num_rows <= need:
+            out.append(pending.pop(0))
+            need -= blk.num_rows
+        else:
+            out.append(blk.slice(0, need))
+            pending[0] = blk.slice(need, blk.num_rows - need)
+            need = 0
+    return out[0] if len(out) == 1 else BlockAccessor.concat(out)
 
 
 def _fn_name(fn) -> str:

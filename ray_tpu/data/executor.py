@@ -20,6 +20,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Callable, Iterator, Optional
 
 import numpy as np
@@ -224,6 +225,7 @@ class ActorMapOp(PhysicalOp):
                         for _ in range(num_actors)]
         self._in_flight: list = []
         self._next = 0
+        self._shutdown = False
 
     def can_accept(self) -> bool:
         return len(self._in_flight) < len(self._actors) * self.MAX_IN_FLIGHT_PER_ACTOR
@@ -234,6 +236,12 @@ class ActorMapOp(PhysicalOp):
         self._in_flight.append(actor.map.remote(bundle[0]))
 
     def poll(self):
+        if self._shutdown:
+            # actors were killed (early-exit / executor stop): drop in-flight
+            # refs instead of get()ing results from dead actors
+            self._in_flight = []
+            self.done = True
+            return
         while self._in_flight:
             ref = self._in_flight[0]
             ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
@@ -248,6 +256,7 @@ class ActorMapOp(PhysicalOp):
             self.shutdown()
 
     def shutdown(self):
+        self._shutdown = True
         for a in self._actors:
             try:
                 ray_tpu.kill(a)
@@ -612,7 +621,13 @@ def _map_physical(lop, phys_inputs, stages):
 
 class StreamingExecutor:
     """Runs the physical op pipeline on a scheduler thread; the consumer
-    pulls bundles from a bounded queue (reference StreamingExecutor)."""
+    pulls bundles from a bounded queue (reference StreamingExecutor).
+
+    Lifecycle: if the consumer abandons the generator (GeneratorExit — e.g.
+    `take()` stops early) or the runtime shuts down, `stop()` halts the
+    scheduler thread and kills pool actors, so no leaked thread keeps calling
+    into a dead (or worse, the NEXT) cluster. Mirrors the reference's
+    executor shutdown on iterator close (streaming_executor.py:141)."""
 
     MAX_OUTPUT_QUEUE = 16
 
@@ -623,23 +638,40 @@ class StreamingExecutor:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._stopped = threading.Event()
+        _live_executors.add(self)
+        _install_shutdown_hook()
 
     def run(self) -> Iterator[Bundle]:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="data_executor")
         self._thread.start()
-        while True:
-            item = self._outq.get()
-            if item is _DONE:
-                break
-            if isinstance(item, _ExecutorError):
-                raise item.error
-            yield item
-        if self._error is not None:
-            raise self._error
+        try:
+            while True:
+                item = self._outq.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, _ExecutorError):
+                    raise item.error
+                yield item
+            if self._error is not None:
+                raise self._error
+        finally:
+            self.stop()
 
     def stop(self):
+        """Idempotent: stop the scheduler thread and wait for it to exit so
+        no in-flight RPC outlives the consumer/runtime."""
         self._stopped.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            # unblock a producer stuck on a full output queue
+            while True:
+                try:
+                    self._outq.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=10.0)
+        _live_executors.discard(self)
 
     def _loop(self):
         try:
@@ -706,3 +738,24 @@ class _ExecutorError:
 
 
 _DONE = object()
+
+# Live executors, stopped at runtime shutdown so their scheduler threads
+# can't call into a torn-down (or restarted) cluster.
+_live_executors: weakref.WeakSet = weakref.WeakSet()
+_hook_installed = False
+
+
+def _stop_all_executors():
+    for ex in list(_live_executors):
+        try:
+            ex.stop()
+        except Exception:
+            pass
+
+
+def _install_shutdown_hook():
+    global _hook_installed
+    if not _hook_installed:
+        from ray_tpu.core import api
+        api.register_shutdown_hook(_stop_all_executors)
+        _hook_installed = True

@@ -162,22 +162,28 @@ class FusedMap(AbstractMap):
 
 
 def optimize(plan: LogicalPlan) -> LogicalPlan:
-    """Fuse adjacent map-ish ops along single-input chains."""
+    """Fuse adjacent map-ish ops along single-input chains.
+
+    Pure: never mutates the input plan's ops, so a Dataset can be executed
+    repeatedly (count() then iter_batches(), multi-epoch iteration) without
+    the fused rewrite leaking back into the shared logical graph.
+    """
 
     def rewrite(op: LogicalOp) -> LogicalOp:
-        op.inputs = [rewrite(i) for i in op.inputs]
-        if isinstance(op, AbstractMap) and len(op.inputs) == 1:
-            child = op.inputs[0]
+        new_inputs = [rewrite(i) for i in op.inputs]
+        if isinstance(op, AbstractMap) and len(new_inputs) == 1:
+            child = new_inputs[0]
             if isinstance(child, FusedMap) and _fusable(child, op):
-                child.stages.append(op)
-                child.__post_init__()
-                return child
+                return FusedMap(name="", inputs=list(child.inputs),
+                                compute=op.compute, resources=op.resources,
+                                stages=[*child.stages, op])
             if isinstance(child, AbstractMap) and not isinstance(child, FusedMap) \
                     and _fusable(child, op):
-                fused = FusedMap(name="", inputs=child.inputs,
-                                 compute=op.compute, resources=op.resources,
-                                 stages=[child, op])
-                return fused
+                return FusedMap(name="", inputs=list(child.inputs),
+                                compute=op.compute, resources=op.resources,
+                                stages=[child, op])
+        if any(n is not o for n, o in zip(new_inputs, op.inputs)):
+            op = dataclasses.replace(op, inputs=new_inputs)
         return op
 
     return LogicalPlan(rewrite(plan.terminal))

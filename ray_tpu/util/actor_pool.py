@@ -40,10 +40,18 @@ class ActorPool:
     def get_next(self, timeout: float | None = None) -> Any:
         if not self.has_next():
             raise StopIteration("no pending results")
-        future = self._index_to_future.pop(self._next_return_index)
+        # Wait with the timeout BEFORE mutating pool state so a TimeoutError
+        # leaves the pool intact (reference actor_pool.py does ray.wait first).
+        future = self._index_to_future[self._next_return_index]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
-        result = ray_tpu.get(future, timeout=timeout)
-        self._return_actor(self._future_to_actor.pop(future))
+        try:
+            result = ray_tpu.get(future)
+        finally:
+            self._return_actor(self._future_to_actor.pop(future))
         return result
 
     def get_next_unordered(self, timeout: float | None = None) -> Any:

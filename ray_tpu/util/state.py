@@ -120,3 +120,37 @@ def timeline(filename: Optional[str] = None) -> Optional[str]:
             f.write(payload)
         return None
     return payload
+
+
+def worker_logs(worker_id: Optional[str] = None,
+                tail: int = 200) -> dict[str, str]:
+    """Read per-worker stdout/stderr captured by the node agent
+    (reference: per-worker files under /tmp/ray/session_*/logs, tailed by
+    _private/log_monitor.py). Returns {log_file_name: last `tail` lines}.
+
+    `worker_id` (hex prefix ok) filters to one worker's files.
+    """
+    import glob
+    import os
+
+    from ray_tpu.core.config import get_config
+
+    roots = []
+    if get_config().log_dir:
+        roots.append(get_config().log_dir)
+    roots.extend(glob.glob("/tmp/ray_tpu/logs/agent-*"))
+    out: dict[str, str] = {}
+    for root in roots:
+        for path in sorted(glob.glob(os.path.join(root, "worker-*.out")) +
+                           glob.glob(os.path.join(root, "worker-*.err"))):
+            name = os.path.basename(path)
+            if worker_id and worker_id[:12] not in name:
+                continue
+            try:
+                with open(path, "r", errors="replace") as f:
+                    lines = f.readlines()
+            except OSError:
+                continue
+            if lines:
+                out[name] = "".join(lines[-tail:])
+    return out
