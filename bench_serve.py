@@ -1,0 +1,131 @@
+"""Serving benchmark: p50 TTFT + req/s, continuous batching over HTTP.
+
+North-star metric harness (BASELINE.json: "Ray Serve p50 TTFT + req/s,
+Llama-3-8B continuous batching"; reference harness:
+release/serve_tests/workloads/ + release/llm_tests/serve/). Drives the FULL
+stack: HTTP proxy → router → replica actor → continuous-batching engine on
+the chip.
+
+The driver process must not initialize the TPU backend (one process per
+chip): the engine replica runs in a TPU worker when a TPU resource exists,
+else in-driver on CPU (test mode).
+
+Prints ONE JSON line:
+  {"metric": "serve_p50_ttft_ms", "value": ..., "unit": "ms",
+   "extra": {"req_per_s": ..., "p90_ttft_ms": ..., "tokens_per_s": ...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import statistics
+import time
+import urllib.request
+
+
+def _post(url: str, payload: dict, timeout: float = 600.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--prompt-tokens", type=int, default=128)
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny model on CPU (smoke mode)")
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig, build_openai_app
+
+    # Logical CPUs: serving actors (controller + replicas) are IO-bound hosts
+    # around the chip-bound engine; don't let a small host starve scheduling.
+    ray_tpu.init(num_cpus=max(8, (__import__("os").cpu_count() or 1)))
+    has_tpu = any(n.get("resources", {}).get("TPU", 0) > 0
+                  for n in ray_tpu.nodes())
+
+    if args.tiny or not has_tpu:
+        model_cfg = llama.llama_tiny(vocab_size=2048)
+        llm_cfg = LLMConfig(
+            model_id="llama-tiny", model_config=model_cfg,
+            max_batch_size=8, page_size=32, num_pages=256,
+            max_prompt_len=256, max_seq_len=512,
+            max_tokens=args.max_tokens)
+    else:
+        # ~1.2B on one v5e chip, bf16 weights + paged bf16 KV
+        model_cfg = llama.llama3_1b(max_seq_len=2048)
+        llm_cfg = LLMConfig(
+            model_id="llama3-1b", model_config=model_cfg,
+            max_batch_size=16, page_size=128, num_pages=288,
+            max_prompt_len=1024, max_seq_len=2048,
+            max_tokens=args.max_tokens,
+            ray_actor_options={"resources": {"TPU": 1}})
+
+    app = build_openai_app(llm_cfg, route_prefix="/v1")
+    serve.run(app, name="llm-bench", route_prefix="/v1")
+    proxy = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{proxy.port}/v1/completions"
+
+    prompt = "the quick brown fox jumps over the lazy dog " * (
+        max(1, args.prompt_tokens // 9))
+
+    # warmup: compile prefill buckets + decode program
+    _post(base, {"prompt": prompt, "max_tokens": 4})
+    _post(base, {"prompt": prompt, "max_tokens": 4})
+
+    ttfts: list[float] = []
+    latencies: list[float] = []
+    tokens_out = 0
+
+    def one(_i: int):
+        out = _post(base, {"prompt": prompt, "max_tokens": args.max_tokens})
+        meta = out.get("ray_tpu") or {}
+        return (meta.get("ttft_s"), meta.get("latency_s"),
+                out["usage"]["completion_tokens"])
+
+    t0 = time.monotonic()
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
+        for ttft, lat, ntok in pool.map(one, range(args.requests)):
+            if ttft is not None:
+                ttfts.append(ttft)
+            if lat is not None:
+                latencies.append(lat)
+            tokens_out += ntok
+    wall = time.monotonic() - t0
+
+    serve.shutdown()
+
+    p50 = statistics.median(ttfts) * 1e3 if ttfts else float("nan")
+    p90 = (statistics.quantiles(ttfts, n=10)[-1] * 1e3
+           if len(ttfts) >= 10 else p50)
+    print(json.dumps({
+        "metric": "serve_p50_ttft_ms",
+        "value": round(p50, 2),
+        "unit": "ms",
+        "vs_baseline": None,  # reference publishes no number (BASELINE.md)
+        "extra": {
+            "req_per_s": round(args.requests / wall, 3),
+            "p90_ttft_ms": round(p90, 2),
+            "p50_latency_ms": round(
+                statistics.median(latencies) * 1e3, 2) if latencies else None,
+            "gen_tokens_per_s": round(tokens_out / wall, 1),
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "max_tokens": args.max_tokens,
+            "model": llm_cfg.model_id,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,230 @@
+"""Core-runtime microbenchmarks (`ray microbenchmark` analog).
+
+Mirrors the workloads of the reference's perf suite
+(/root/reference/python/ray/_private/ray_perf.py:95; published numbers in
+BASELINE.md "Microbenchmarks") so the runtime's task/actor/object planes are
+measured, not guessed. Writes MICROBENCH.json and prints a table with the
+reference numbers alongside.
+
+Usage: python microbench.py [--quick] [--out MICROBENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+# BASELINE.md microbenchmark rows (m4.16xlarge-class, reference 2.49.1)
+_REFERENCE = {
+    "single_client_get": 9176.7,
+    "single_client_put": 4795.1,
+    "single_client_put_gbps": 20.35,
+    "single_client_tasks_sync": 901.0,
+    "single_client_tasks_async": 7418.7,
+    "multi_client_tasks_async": 19294.7,
+    "actor_calls_1_1_sync": 1826.4,
+    "actor_calls_1_1_async": 7925.7,
+    "actor_calls_1_n_async": 7563.5,
+    "actor_calls_n_n_async": 24808.7,
+    "async_actor_calls_1_1_sync": 1374.0,
+    "async_actor_calls_1_1_async": 3645.3,
+    "async_actor_calls_n_n_async": 21602.2,
+    "pg_create_remove_per_s": 751.1,
+}
+
+
+def _rate(n: int, t: float) -> float:
+    return n / t if t > 0 else float("inf")
+
+
+def _timeit(fn, n: int) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return _rate(n, time.perf_counter() - t0)
+
+
+def run(quick: bool = False) -> dict:
+    import numpy as np
+
+    import ray_tpu
+
+    scale = 0.2 if quick else 1.0
+
+    def N(n: int) -> int:
+        return max(10, int(n * scale))
+
+    # logical CPUs: every live actor reserves one; sections clean up after
+    # themselves but the peak (4 targets + 4 callers + driver tasks) needs
+    # headroom. Workload is RPC-bound, not CPU-bound.
+    ray_tpu.init(num_cpus=16)
+    results: dict[str, float] = {}
+
+    # ---- object plane --------------------------------------------------
+    small = b"x" * 1024
+    n = N(2000)
+    ref = ray_tpu.put(small)
+    results["single_client_get"] = _timeit(
+        lambda: [ray_tpu.get(ref) for _ in range(n)], n)
+    results["single_client_put"] = _timeit(
+        lambda: [ray_tpu.put(small) for _ in range(n)], n)
+
+    big = np.zeros(1 << 25, np.uint8)  # 32 MiB > inline threshold → shm
+    n_big = N(40)
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(big) for _ in range(n_big)]
+    dt = time.perf_counter() - t0
+    results["single_client_put_gbps"] = (n_big * big.nbytes / dt) / 1e9
+    del refs
+    # let refcount-driven deletions/evictions drain so the freed-object
+    # cleanup storm doesn't contaminate the latency sections that follow
+    time.sleep(1.0)
+
+    # ---- task plane ----------------------------------------------------
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote())  # warm the worker pool
+    n = N(500)
+    results["single_client_tasks_sync"] = _timeit(
+        lambda: [ray_tpu.get(nop.remote()) for _ in range(n)], n)
+    n = N(3000)
+    results["single_client_tasks_async"] = _timeit(
+        lambda: ray_tpu.get([nop.remote() for _ in range(n)]), n)
+
+    # multi client: M submitter actors each firing tasks
+    @ray_tpu.remote
+    class Client:
+        def fire(self, k):
+            return ray_tpu.get([nop.remote() for _ in range(k)]) and None
+
+    m = 4
+    clients = [Client.remote() for _ in range(m)]
+    k = N(500)
+    ray_tpu.get([c.fire.remote(10) for c in clients])  # warm
+    t0 = time.perf_counter()
+    ray_tpu.get([c.fire.remote(k) for c in clients], timeout=300)
+    results["multi_client_tasks_async"] = _rate(
+        m * k, time.perf_counter() - t0)
+    for c in clients:
+        ray_tpu.kill(c)
+
+    # ---- actor plane ---------------------------------------------------
+    @ray_tpu.remote
+    class Sync:
+        def m(self):
+            return None
+
+    a = Sync.remote()
+    ray_tpu.get(a.m.remote())
+    n = N(500)
+    results["actor_calls_1_1_sync"] = _timeit(
+        lambda: [ray_tpu.get(a.m.remote()) for _ in range(n)], n)
+    n = N(3000)
+    results["actor_calls_1_1_async"] = _timeit(
+        lambda: ray_tpu.get([a.m.remote() for _ in range(n)]), n)
+
+    actors = [Sync.remote() for _ in range(4)]
+    ray_tpu.get([b.m.remote() for b in actors])
+    n = N(3000)
+    t0 = time.perf_counter()
+    ray_tpu.get([actors[i % 4].m.remote() for i in range(n)])
+    results["actor_calls_1_n_async"] = _rate(n, time.perf_counter() - t0)
+
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self, target):
+            self.t = target
+
+        def drive(self, k):
+            return ray_tpu.get([self.t.m.remote() for _ in range(k)]) and None
+
+    callers = [Caller.remote(actors[i]) for i in range(4)]
+    k = N(800)
+    ray_tpu.get([c.drive.remote(10) for c in callers])
+    t0 = time.perf_counter()
+    ray_tpu.get([c.drive.remote(k) for c in callers], timeout=300)
+    results["actor_calls_n_n_async"] = _rate(4 * k, time.perf_counter() - t0)
+    for c in callers:
+        ray_tpu.kill(c)
+    for b in actors:
+        ray_tpu.kill(b)
+    ray_tpu.kill(a)
+
+    @ray_tpu.remote
+    class Async:
+        async def m(self):
+            return None
+
+    aa = Async.remote()
+    ray_tpu.get(aa.m.remote())
+    n = N(500)
+    results["async_actor_calls_1_1_sync"] = _timeit(
+        lambda: [ray_tpu.get(aa.m.remote()) for _ in range(n)], n)
+    n = N(3000)
+    results["async_actor_calls_1_1_async"] = _timeit(
+        lambda: ray_tpu.get([aa.m.remote() for _ in range(n)]), n)
+
+    async_actors = [Async.remote() for _ in range(4)]
+    ray_tpu.get([b.m.remote() for b in async_actors])
+    acallers = [Caller.remote(async_actors[i]) for i in range(4)]
+    k = N(800)
+    ray_tpu.get([c.drive.remote(10) for c in acallers])
+    t0 = time.perf_counter()
+    ray_tpu.get([c.drive.remote(k) for c in acallers], timeout=300)
+    results["async_actor_calls_n_n_async"] = _rate(
+        4 * k, time.perf_counter() - t0)
+    for c in acallers:
+        ray_tpu.kill(c)
+    for b in async_actors:
+        ray_tpu.kill(b)
+    ray_tpu.kill(aa)
+
+    # ---- placement groups ----------------------------------------------
+    n = N(60)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pg = ray_tpu.placement_group([{"CPU": 1}])
+        assert pg.ready(timeout=30)
+        ray_tpu.remove_placement_group(pg)
+    results["pg_create_remove_per_s"] = _rate(n, time.perf_counter() - t0)
+
+    ray_tpu.shutdown()
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default="MICROBENCH.json")
+    args = ap.parse_args()
+
+    results = run(quick=args.quick)
+
+    rows = []
+    for key, val in results.items():
+        ref = _REFERENCE.get(key)
+        ratio = (val / ref) if ref else None
+        rows.append({"metric": key, "value": round(val, 1),
+                     "reference": ref,
+                     "ratio_vs_reference": round(ratio, 3) if ratio else None})
+    payload = {"results": rows, "ts": time.time(),
+               "note": "reference numbers from BASELINE.md (m4.16xlarge, "
+                       "2.49.1); this host is much smaller — ratios are "
+                       "directional, not apples-to-apples"}
+    with open(args.out, "w") as f:
+        json.dump(payload, f, indent=1)
+
+    w = max(len(r["metric"]) for r in rows)
+    print(f"{'metric'.ljust(w)}  {'ours':>10}  {'reference':>10}  ratio")
+    for r in rows:
+        ref = f"{r['reference']:>10.1f}" if r["reference"] else " " * 10
+        ratio = f"{r['ratio_vs_reference']:.2f}x" \
+            if r["ratio_vs_reference"] else ""
+        print(f"{r['metric'].ljust(w)}  {r['value']:>10.1f}  {ref}  {ratio}")
+
+
+if __name__ == "__main__":
+    main()

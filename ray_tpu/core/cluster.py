@@ -17,9 +17,33 @@ from ray_tpu.core.node_agent import NodeAgent
 
 
 class Cluster:
-    def __init__(self):
-        self.control_plane = ControlPlane()
+    def __init__(self, store_path: str | None = None):
+        self._store_path = store_path
+        self.control_plane = ControlPlane(store_path=store_path)
         self.nodes: list[NodeAgent] = []
+
+    def kill_control_plane(self) -> tuple[str, int]:
+        """Simulate CP crash (no graceful teardown of cluster state);
+        returns the address to restart on."""
+        addr = self.control_plane.addr
+        self.control_plane.stop()
+        return addr
+
+    def restart_control_plane(self, addr: tuple[str, int]) -> ControlPlane:
+        """Restart the CP on the SAME address with the SAME durable store —
+        agents re-register via heartbeat, clients reconnect via RPC retry
+        (ref: gcs FT restart + NotifyGCSRestart)."""
+        import time
+        last: Exception | None = None
+        for _ in range(50):  # the old listener may take a moment to release
+            try:
+                self.control_plane = ControlPlane(
+                    host=addr[0], port=addr[1], store_path=self._store_path)
+                return self.control_plane
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        raise last
 
     @property
     def address(self) -> str:

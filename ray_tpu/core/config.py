@@ -42,6 +42,13 @@ class Config:
     object_store_eviction_headroom: float = 0.1
     # Use the native C++ shared-memory store if built; fall back to pure python.
     use_native_object_store: bool = True
+    # Spill sealed+unpinned objects to disk instead of evicting them
+    # (ref: local_object_manager.h:44 SpillObjects).
+    enable_object_spilling: bool = True
+    spill_dir: str = ""
+    # Pull admission control: max bytes of concurrent inbound object pulls
+    # (ref: pull_manager.h:49 bundle admission).
+    max_inflight_pull_bytes: int = 256 * 1024 * 1024
 
     # --- scheduling ---
     # Max worker processes per node agent (0 = num_cpus).
@@ -56,6 +63,11 @@ class Config:
     hybrid_threshold: float = 0.5
     # Weight of ICI distance in node scoring (TPU-native addition).
     ici_distance_weight: float = 0.2
+
+    # --- control-plane persistence ---
+    # Path for the control plane's durable metadata store (sqlite). Empty =
+    # in-memory only (CP restart loses the cluster; ref: redis_store_client).
+    cp_store_path: str = ""
 
     # --- fault tolerance ---
     task_max_retries: int = 3

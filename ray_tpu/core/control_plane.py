@@ -79,7 +79,10 @@ class _Node:
 
 
 class ControlPlane:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 store_path: str | None = None):
+        from ray_tpu.core.meta_store import make_meta_store
+
         self._lock = threading.RLock()
         self._nodes: dict[NodeID, _Node] = {}
         self._actors: dict[ActorID, ActorInfo] = {}
@@ -94,6 +97,10 @@ class ControlPlane:
         self._wake = threading.Condition()
         self._stopped = threading.Event()
         self._task_events: list[dict] = []  # GcsTaskManager-style sink (bounded)
+        self._store = make_meta_store(
+            store_path if store_path is not None
+            else (get_config().cp_store_path or None))
+        self._restore()
         self._server = RpcServer(
             self._handle, host=host, port=port, name="controlplane",
             blocking_methods={"resolve_actor", "pg_ready", "get_actor_by_name"},
@@ -107,6 +114,38 @@ class ControlPlane:
         self._health_thread.start()
 
     # ------------------------------------------------------------------
+    def _restore(self):
+        """Replay persisted state after a restart (ref: gcs_init_data.cc).
+        Nodes are NOT persisted — live agents re-register via the heartbeat
+        (the NotifyGCSRestart analog, node_manager.proto:406)."""
+        for key, val in self._store.load_all("kv"):
+            self._kv[key.decode()] = val
+        for key, val in self._store.load_all("job"):
+            self._jobs[JobID(key)] = val
+        restored_actors = 0
+        for key, info in self._store.load_all("actor"):
+            self._actors[info.actor_id] = info
+            if info.name and info.state != ActorState.DEAD:
+                self._named_actors[info.name] = info.actor_id
+            if info.state in (ActorState.PENDING, ActorState.RESTARTING):
+                self._pending_actors.append(info.actor_id)
+            restored_actors += 1
+        for key, pg in self._store.load_all("pg"):
+            self._pgs[pg.pg_id] = pg
+            if pg.state == PGState.PENDING:
+                self._pending_pgs.append(pg.pg_id)
+        if restored_actors or self._kv or self._pgs:
+            logger.info(
+                "control plane restored: %d actors, %d kv keys, %d pgs, "
+                "%d jobs", restored_actors, len(self._kv), len(self._pgs),
+                len(self._jobs))
+
+    def _persist_actor(self, info: ActorInfo) -> None:
+        self._store.save("actor", info.actor_id.binary(), info)
+
+    def _persist_pg(self, pg: PGInfo) -> None:
+        self._store.save("pg", pg.pg_id.binary(), pg)
+
     def _handle(self, method: str, body, peer):
         fn = getattr(self, "_h_" + method, None)
         if fn is None:
@@ -139,6 +178,19 @@ class ControlPlane:
                 node.view.available = dict(body["available"])
         self._wake_scheduler()
 
+    def _h_heartbeat(self, body):
+        """Agent heartbeat. Returns known=False after a CP restart so the
+        agent re-registers (the NotifyGCSRestart→reconnect analog,
+        node_manager.proto:406)."""
+        with self._lock:
+            node = self._nodes.get(body["node_id"])
+            if node is None or not node.view.alive:
+                return {"known": False}
+            node.view.available = dict(body["available"])
+            node.missed_health_checks = 0
+        self._wake_scheduler()
+        return {"known": True}
+
     def _h_get_nodes(self, body):
         with self._lock:
             return [
@@ -157,12 +209,16 @@ class ControlPlane:
         with self._lock:
             self._jobs[body["job_id"]] = {"driver_addr": tuple(body["addr"]),
                                           "start_time": time.time(), "alive": True}
+            self._store.save("job", body["job_id"].binary(),
+                             self._jobs[body["job_id"]])
         return {"ok": True}
 
     def _h_finish_job(self, body):
         with self._lock:
             if body["job_id"] in self._jobs:
                 self._jobs[body["job_id"]]["alive"] = False
+                self._store.save("job", body["job_id"].binary(),
+                                 self._jobs[body["job_id"]])
         # non-detached actors of the job die with it (ref: GcsActorManager
         # OnJobFinished)
         doomed = []
@@ -185,6 +241,7 @@ class ControlPlane:
             exists = body["key"] in self._kv
             if body.get("overwrite", True) or not exists:
                 self._kv[body["key"]] = body["value"]
+                self._store.save("kv", body["key"].encode(), body["value"])
                 return True
             return False
 
@@ -194,6 +251,7 @@ class ControlPlane:
 
     def _h_kv_del(self, body):
         with self._lock:
+            self._store.delete("kv", body["key"].encode())
             return self._kv.pop(body["key"], None) is not None
 
     def _h_kv_exists(self, body):
@@ -257,6 +315,7 @@ class ControlPlane:
                 self._named_actors[info.name] = info.actor_id
             self._actors[info.actor_id] = info
             self._pending_actors.append(info.actor_id)
+            self._persist_actor(info)
         self._wake_scheduler()
         return {"actor_id": info.actor_id}
 
@@ -355,6 +414,7 @@ class ControlPlane:
                 state_msg = "DEAD"
                 if info.name and not restartable:
                     self._named_actors.pop(info.name, None)
+            self._persist_actor(info)
         self._publish(f"actor:{actor_id.hex()}",
                       {"state": state_msg, "reason": reason})
         self._wake_scheduler()
@@ -372,6 +432,7 @@ class ControlPlane:
         with self._lock:
             self._pgs[pg.pg_id] = pg
             self._pending_pgs.append(pg.pg_id)
+            self._persist_pg(pg)
         self._wake_scheduler()
         return {"pg_id": pg.pg_id}
 
@@ -398,6 +459,7 @@ class ControlPlane:
             if pg is None or pg.state == PGState.REMOVED:
                 return {"ok": True}
             pg.state = PGState.REMOVED
+            self._persist_pg(pg)
             allocations = list(zip(pg.node_ids, pg.bundles))
         by_node: dict[NodeID, list] = {}
         for nid, b in allocations:
@@ -504,7 +566,13 @@ class ControlPlane:
             return False
         worker_addr = tuple(reply["worker_addr"])
         with self._lock:
-            subtract(cp_node.view.available, resources)
+            if reply.get("available") is not None:
+                # agent's authoritative post-grant snapshot; subtracting here
+                # instead would double-count when the agent's async resource
+                # report raced ahead of this reply
+                cp_node.view.available = dict(reply["available"])
+            else:
+                subtract(cp_node.view.available, resources)
             info.node_id = node.node_id
             info.worker_id = reply["worker_id"]
         spec.attempt_number = info.num_restarts
@@ -514,6 +582,7 @@ class ControlPlane:
                 with self._lock:
                     info.state = ActorState.ALIVE
                     info.addr = worker_addr
+                    self._persist_actor(info)
                 self._publish(f"actor:{info.actor_id.hex()}",
                               {"state": "ALIVE", "addr": worker_addr})
             else:
@@ -596,6 +665,7 @@ class ControlPlane:
         with self._lock:
             pg.node_ids = placement
             pg.state = PGState.CREATED
+            self._persist_pg(pg)
             for nid, items in by_node.items():
                 node = self._nodes.get(nid)
                 for _, b in items:
@@ -650,3 +720,7 @@ class ControlPlane:
         self._wake_scheduler()
         self._server.stop()
         self._pool.close_all()
+        try:
+            self._store.close()
+        except Exception:
+            pass

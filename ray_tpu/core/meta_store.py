@@ -1,0 +1,90 @@
+"""Pluggable control-plane metadata storage.
+
+TPU-native analog of the reference's GCS storage backends
+(/root/reference/src/ray/gcs/store_client/ — InMemoryStoreClient,
+RedisStoreClient for fault tolerance; replay via gcs_init_data.cc): the
+control plane writes every durable mutation (KV, jobs, actor records, PGs)
+through this interface, and on restart replays `load_all` per section.
+
+Backends:
+- MemoryMetaStore: default; no durability (CP death = cluster loss).
+- SqliteMetaStore: single-file WAL-mode sqlite — the single-node analog of
+  Redis-backed GCS FT. Safe for one writer (the CP) + crash recovery.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Iterator
+
+
+class MemoryMetaStore:
+    def __init__(self):
+        self._data: dict[tuple[str, bytes], bytes] = {}
+        self._lock = threading.Lock()
+
+    def save(self, section: str, key: bytes, obj: Any) -> None:
+        with self._lock:
+            self._data[(section, bytes(key))] = pickle.dumps(obj)
+
+    def delete(self, section: str, key: bytes) -> None:
+        with self._lock:
+            self._data.pop((section, bytes(key)), None)
+
+    def load_all(self, section: str) -> Iterator[tuple[bytes, Any]]:
+        with self._lock:
+            items = [(k[1], v) for k, v in self._data.items()
+                     if k[0] == section]
+        for key, blob in items:
+            yield key, pickle.loads(blob)
+
+    def close(self) -> None:
+        pass
+
+
+class SqliteMetaStore:
+    def __init__(self, path: str):
+        import sqlite3
+
+        self.path = path
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS meta ("
+            " section TEXT NOT NULL, key BLOB NOT NULL, value BLOB NOT NULL,"
+            " PRIMARY KEY (section, key))")
+        self._db.commit()
+
+    def save(self, section: str, key: bytes, obj: Any) -> None:
+        blob = pickle.dumps(obj)
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO meta (section, key, value) "
+                "VALUES (?, ?, ?)", (section, bytes(key), blob))
+            self._db.commit()
+
+    def delete(self, section: str, key: bytes) -> None:
+        with self._lock:
+            self._db.execute(
+                "DELETE FROM meta WHERE section = ? AND key = ?",
+                (section, bytes(key)))
+            self._db.commit()
+
+    def load_all(self, section: str) -> Iterator[tuple[bytes, Any]]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT key, value FROM meta WHERE section = ?",
+                (section,)).fetchall()
+        for key, blob in rows:
+            yield key, pickle.loads(blob)
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
+def make_meta_store(path: str | None):
+    return SqliteMetaStore(path) if path else MemoryMetaStore()

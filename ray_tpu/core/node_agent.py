@@ -86,6 +86,9 @@ class NodeAgent:
                                 prefix=f"rtpu{os.getpid() % 10000}_{self.node_id.hex()[:6]}")
         self.store.on_evict = self._on_store_evict
         self._object_owners: dict = {}  # ObjectID -> owner addr, for evict notices
+        self._pull_cv = threading.Condition()
+        self._pull_inflight_bytes = 0
+        self._pulls_in_progress: dict = {}  # ObjectID -> Event (single-flight)
         self._stopped = threading.Event()
         self._server = RpcServer(
             self._handle, host=host, port=port, name="nodeagent",
@@ -229,9 +232,14 @@ class NodeAgent:
                             self._leases[lease.lease_id] = lease
                             reserved = False  # consumed by the lease
                             self._report_resources()
+                            # snapshot rides the reply so the caller can SET
+                            # its view instead of subtracting (a subtract
+                            # after our async report double-counts the lease
+                            # and can wedge the view at 0)
                             return {"granted": True, "lease_id": lease.lease_id,
                                     "worker_id": worker.worker_id,
-                                    "worker_addr": worker.addr}
+                                    "worker_addr": worker.addr,
+                                    "available": dict(self.available)}
                         if not spawned and self._can_spawn(for_tpu):
                             spawned = need_spawn = True
                     elif pg_id is None:
@@ -245,6 +253,13 @@ class NodeAgent:
                 with self._lock:
                     self._lease_cv.wait(timeout=0.05)
                 if time.monotonic() > deadline:
+                    logger.warning(
+                        "lease timeout: res=%s reserved=%s spawned=%s "
+                        "available=%s workers=%s", resources, reserved,
+                        spawned, self.available,
+                        [(w.hex()[:6], i.busy, i.actor_id is not None,
+                          i.addr is not None)
+                         for w, i in self._workers.items()])
                     return {"granted": False, "timeout": True}
             return {"granted": False, "timeout": True}
         finally:
@@ -254,13 +269,28 @@ class NodeAgent:
                     self._lease_cv.notify_all()
 
     def _can_spawn(self, for_tpu: bool) -> bool:
+        """Concurrent leases are bounded by the CPU resource, so the pool
+        never needs more workers than logical CPUs (+ headroom for
+        zero-CPU leases); spawn-ahead is also bounded so a burst of lease
+        requests can't fork dozens of interpreters at once and thrash the
+        host (ref: worker_pool.h maximum_startup_concurrency)."""
         cfg = get_config()
-        limit = cfg.max_workers_per_node or max(4, int(self.resources_total.get("CPU", 4)) * 4)
-        n_mine = sum(1 for w in self._workers.values() if w.is_tpu_worker == for_tpu)
+        mine = [w for w in self._workers.values()
+                if w.is_tpu_worker == for_tpu]
         if for_tpu:
             # one TPU worker process per chip group (hard-part 7)
-            return n_mine < 1
-        return n_mine < limit
+            return len(mine) < 1
+        cpus = int(self.resources_total.get("CPU", 4))
+        # Actors each occupy a dedicated worker for life and are gated by
+        # the resource scheduler, so only POOL (non-actor) workers count
+        # against the cap — otherwise N zero-CPU actors would starve task
+        # leases (and vice versa).
+        pool = [w for w in mine if w.actor_id is None]
+        limit = cfg.max_workers_per_node or (cpus + 4)
+        if len(pool) >= limit:
+            return False
+        starting = sum(1 for w in pool if w.addr is None)
+        return starting < max(2, cpus // 2)
 
     def _try_reserve(self, resources, pg_id, bundle_index) -> bool:
         if pg_id is not None:
@@ -370,14 +400,16 @@ class NodeAgent:
             if pools:
                 for pool in pools.values():
                     add(self.available, pool)
-            # kill workers leased under this pg? leases keep running; their
-            # resources return on lease return (tracked against removed pg =>
-            # returned to node pool)
+            # Live leases under this pg become plain node leases; their
+            # resources return to `available` when the lease returns. No
+            # adjustment here: prepare subtracted the FULL bundle from
+            # `available`, and the pools we just added back held only the
+            # unleased remainder — the leased share stays owed until lease
+            # return (subtracting again would double-count it).
             for lease in self._leases.values():
                 if lease.pg_id == pg_id:
                     lease.pg_id = None
                     lease.bundle_index = -1
-                    subtract(self.available, lease.resources)
             self._lease_cv.notify_all()
         self._report_resources()
         return {"ok": True}
@@ -421,32 +453,78 @@ class NodeAgent:
         total, chunk = out
         return {"total": total, "data": chunk}
 
+    def _admit_pull(self, nbytes: int) -> bool:
+        """Admission control: bound total in-flight pull bytes so N
+        concurrent large pulls can't blow host memory / flood the network
+        (ref: pull_manager.h:49 PullManager quota). Blocking-methods
+        handlers run on dedicated threads, so waiting here is safe.
+        Returns False (nothing reserved) if the agent is shutting down."""
+        limit = get_config().max_inflight_pull_bytes
+        with self._pull_cv:
+            while self._pull_inflight_bytes + nbytes > limit \
+                    and self._pull_inflight_bytes > 0:
+                self._pull_cv.wait(timeout=1.0)
+                if self._stopped.is_set():
+                    return False
+            self._pull_inflight_bytes += nbytes
+        return True
+
+    def _release_pull(self, nbytes: int) -> None:
+        with self._pull_cv:
+            self._pull_inflight_bytes -= nbytes
+            self._pull_cv.notify_all()
+
     def _h_pull_object(self, body):
         """Fetch an object from a remote node's store into the local store
-        (ref: pull_manager.h:49). Chunked to bound memory."""
+        (ref: pull_manager.h:49). Chunks stream straight into the local
+        store allocation — peak host memory is one chunk, not the object.
+        Concurrent pulls of the same object are deduplicated: followers
+        wait for the leader instead of racing the chunk writes."""
         object_id = body["object_id"]
         if self.store.contains(object_id):
             return {"ok": True}
+        # single-flight per object (ref: PullManager object-level dedup)
+        with self._pull_cv:
+            leader = object_id not in self._pulls_in_progress
+            if leader:
+                self._pulls_in_progress[object_id] = threading.Event()
+            event = self._pulls_in_progress[object_id]
+        if not leader:
+            event.wait(timeout=300.0)
+            return {"ok": self.store.contains(object_id)}
+        try:
+            return self._pull_as_leader(body, object_id)
+        finally:
+            with self._pull_cv:
+                self._pulls_in_progress.pop(object_id, None)
+            event.set()
+
+    def _pull_as_leader(self, body, object_id):
         remote = self._pool.get(tuple(body["from_addr"]))
-        chunk = 4 * 1024 * 1024
+        chunk = 8 * 1024 * 1024
         first = remote.call_with_retry(
             "read_object", {"object_id": object_id, "offset": 0, "size": chunk},
             timeout=60.0)
         if first is None:
             return {"ok": False}
         total = first["total"]
-        buf = bytearray(total)
-        buf[: len(first["data"])] = first["data"]
-        off = len(first["data"])
-        while off < total:
-            part = remote.call_with_retry(
-                "read_object", {"object_id": object_id, "offset": off, "size": chunk},
-                timeout=60.0)
-            if part is None:
-                return {"ok": False}
-            buf[off:off + len(part["data"])] = part["data"]
-            off += len(part["data"])
-        self.store.write_bytes(object_id, bytes(buf))
+        if not self._admit_pull(total):
+            return {"ok": False}
+        try:
+            self.store.write_chunk(object_id, 0, first["data"], total)
+            off = len(first["data"])
+            while off < total:
+                part = remote.call_with_retry(
+                    "read_object",
+                    {"object_id": object_id, "offset": off, "size": chunk},
+                    timeout=60.0)
+                if part is None:
+                    self.store.delete(object_id)
+                    return {"ok": False}
+                self.store.write_chunk(object_id, off, part["data"], total)
+                off += len(part["data"])
+        finally:
+            self._release_pull(total)
         if body.get("owner_addr") is not None:
             self._object_owners[object_id] = tuple(body["owner_addr"])
         return {"ok": True}
@@ -465,8 +543,27 @@ class NodeAgent:
     # ---- worker monitoring ----------------------------------------------
     def _monitor_workers(self):
         cfg = get_config()
+        last_report = 0.0
         while not self._stopped.is_set():
             time.sleep(0.1)
+            # periodic resource heartbeat (ref: RaySyncer resource view
+            # gossip, ray_syncer.h:87): self-heals any CP-view drift from
+            # report/subtract races, and re-registers after a CP restart
+            # (NotifyGCSRestart analog)
+            now = time.monotonic()
+            if now - last_report >= 1.0:
+                last_report = now
+                try:
+                    r = self._pool.get(self.cp_addr).call(
+                        "heartbeat",
+                        {"node_id": self.node_id,
+                         "available": dict(self.available)}, timeout=5.0)
+                    if r is not None and not r.get("known", True):
+                        logger.info("control plane lost this node "
+                                    "(restart?); re-registering")
+                        self._register_with_cp()
+                except Exception:
+                    pass
             dead: list[_WorkerInfo] = []
             with self._lock:
                 for info in list(self._workers.values()):
@@ -516,7 +613,8 @@ class NodeAgent:
         for info in workers:
             if info.addr is not None:
                 try:
-                    self._pool.get(info.addr).notify("exit_worker", None)
+                    self._pool.get(info.addr).notify(
+                        "exit_worker", {"worker_id": info.worker_id})
                 except Exception:
                     pass
         deadline = time.monotonic() + 2.0

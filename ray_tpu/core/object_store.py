@@ -82,14 +82,16 @@ class ShmStore:
             meta.sealed = True
             self._objects.move_to_end(object_id)
 
-    def get_meta(self, object_id: ObjectID) -> tuple[str, int, int, str] | None:
-        """(shm_name, offset, size, device_hint) of a sealed object."""
+    def get_meta(self, object_id: ObjectID) -> tuple | None:
+        """(shm_name, offset, size, device_hint, copy_on_read) of a sealed
+        object. copy_on_read=False: per-object segments stay valid while
+        mapped even after unlink, so zero-copy reads are safe."""
         with self._lock:
             meta = self._objects.get(object_id)
             if meta is None or not meta.sealed:
                 return None
             self._objects.move_to_end(object_id)  # LRU touch
-            return (meta.shm_name, 0, meta.size, meta.device_hint)
+            return (meta.shm_name, 0, meta.size, meta.device_hint, False)
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -158,6 +160,17 @@ class ShmStore:
         seg = self._segments[name]
         seg.buf[: len(data)] = data
         self.seal(object_id)
+
+    def write_chunk(self, object_id: ObjectID, offset: int, data: bytes,
+                    total: int):
+        """Streamed chunk write: create on first chunk, seal when the last
+        byte lands (ref: ObjectBufferPool chunked writes). The caller is the
+        single writer for the object."""
+        name, _off = self.create(object_id, total)
+        seg = self._segments[name]
+        seg.buf[offset:offset + len(data)] = data
+        if offset + len(data) >= total:
+            self.seal(object_id)
 
     def stats(self) -> dict:
         with self._lock:
@@ -334,12 +347,16 @@ class NativeShmStore:
             return None
         return offset.value, size.value, bool(sealed.value)
 
-    def get_meta(self, object_id: ObjectID) -> tuple[str, int, int, str] | None:
+    def get_meta(self, object_id: ObjectID) -> tuple | None:
+        """copy_on_read=True: arena extents are REUSED after LRU eviction,
+        so readers must not keep aliases into the mapping (plasma solves
+        this with client-side pinning, plasma/client.cc; until that
+        protocol exists here, readers copy out)."""
         got = self._get(object_id)
         if got is None or not got[2]:
             return None
         return (self.arena_name, got[0], got[1],
-                self._hints.get(object_id, ""))
+                self._hints.get(object_id, ""), True)
 
     def contains(self, object_id: ObjectID) -> bool:
         got = self._get(object_id)
@@ -360,7 +377,7 @@ class NativeShmStore:
         meta = self.get_meta(object_id)
         if meta is None:
             return None
-        _name, obj_off, total, _hint = meta
+        _name, obj_off, total = meta[0], meta[1], meta[2]
         end = total if size is None else min(total, offset + size)
         n = max(0, end - offset)
         data = self._ctypes.string_at(self._base + obj_off + offset, n)
@@ -370,6 +387,14 @@ class NativeShmStore:
         _name, obj_off = self.create(object_id, len(data))
         self._ctypes.memmove(self._base + obj_off, data, len(data))
         self.seal(object_id)
+
+    def write_chunk(self, object_id: ObjectID, offset: int, data: bytes,
+                    total: int):
+        """Streamed chunk write into the arena (single writer per object)."""
+        _name, obj_off = self.create(object_id, total)
+        self._ctypes.memmove(self._base + obj_off + offset, data, len(data))
+        if offset + len(data) >= total:
+            self.seal(object_id)
 
     def stats(self) -> dict:
         ct = self._ctypes
@@ -393,18 +418,223 @@ class NativeShmStore:
                 self._handle = None
 
 
+class SpillingStore:
+    """Disk-spilling wrapper over either shm backend.
+
+    TPU-native analog of the reference's LocalObjectManager spilling
+    (/root/reference/src/ray/raylet/local_object_manager.h:44,
+    SpillObjects:114 + SpilledObjectReader): when a create would exceed the
+    high-water mark, sealed+unpinned objects are spilled to local disk in
+    LRU order instead of evicted (deleted); a get of a spilled object
+    restores it into shared memory transparently. Only objects the backend
+    would otherwise evict are spilled, so spilling never changes semantics —
+    it just turns "object lost, reconstruct" into "object restored from
+    disk".
+    """
+
+    def __init__(self, backend, spill_dir: str, capacity_bytes: int,
+                 headroom: float = 0.1):
+        import os
+
+        self._b = backend
+        self._dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        self._capacity = capacity_bytes
+        self._high_water = int(capacity_bytes * (1.0 - headroom))
+        self._lock = threading.Lock()
+        # our own LRU + seal view (backend internals differ); oid -> size
+        self._lru: OrderedDict[ObjectID, int] = OrderedDict()
+        self._pinned: dict[ObjectID, bool] = {}
+        self._sealed: set[ObjectID] = set()
+        self._spilled: dict[ObjectID, int] = {}  # oid -> size on disk
+        self._last_read: dict[ObjectID, float] = {}  # grace vs read races
+        self.num_spilled = 0
+        self.num_restored = 0
+
+    # passthrough surface ------------------------------------------------
+    @property
+    def capacity(self):
+        return self._capacity
+
+    @property
+    def on_evict(self):
+        return self._b.on_evict
+
+    @on_evict.setter
+    def on_evict(self, fn):
+        self._b.on_evict = fn
+
+    def _spill_path(self, oid: ObjectID) -> str:
+        import os
+        return os.path.join(self._dir, oid.hex())
+
+    def _maybe_spill(self, need: int) -> None:
+        """Spill LRU sealed objects until `need` fits under the high-water
+        mark. Lock held. Unlike eviction, spilling is safe for PINNED
+        (live-ref) objects — that is its purpose (the reference spills
+        primary copies under memory pressure, local_object_manager.h:44);
+        a later get transparently restores. Unsealed (mid-write) objects
+        are never touched."""
+        used = self._b.stats()["used_bytes"]
+        if used + need <= self._high_water:
+            return
+        now = time.monotonic()
+        for oid in list(self._lru):
+            if used + need <= self._high_water:
+                break
+            if oid not in self._sealed:
+                continue
+            # grace window: a reader that just fetched this object's meta
+            # may still be copying out of the mapping — don't pull the
+            # extent out from under it (full safety needs client read
+            # leases, plasma client.cc; this closes the practical window)
+            if now - self._last_read.get(oid, 0.0) < 5.0:
+                continue
+            out = self._b.read_bytes(oid)
+            if out is None:
+                self._lru.pop(oid, None)
+                continue
+            _total, data = out
+            with open(self._spill_path(oid), "wb") as f:
+                f.write(data)
+            self._b.delete(oid)
+            self._spilled[oid] = len(data)
+            self._lru.pop(oid, None)
+            self.num_spilled += 1
+            used = self._b.stats()["used_bytes"]
+
+    def _restore(self, oid: ObjectID) -> bool:
+        """Bring a spilled object back into shm. Lock held."""
+        import os
+        path = self._spill_path(oid)
+        size = self._spilled.get(oid)
+        if size is None or not os.path.exists(path):
+            return False
+        self._maybe_spill(size)
+        with open(path, "rb") as f:
+            data = f.read()
+        self._b.write_bytes(oid, data)
+        self._b.pin(oid, self._pinned.get(oid, False))
+        self._lru[oid] = size
+        self._sealed.add(oid)
+        self._spilled.pop(oid, None)
+        os.remove(path)
+        self.num_restored += 1
+        return True
+
+    # store interface ----------------------------------------------------
+    def create(self, object_id: ObjectID, size: int, device_hint: str = ""):
+        with self._lock:
+            self._maybe_spill(size)
+            name_off = self._b.create(object_id, size, device_hint)
+            self._lru[object_id] = size
+            self._pinned[object_id] = True
+            return name_off
+
+    def seal(self, object_id: ObjectID):
+        self._b.seal(object_id)
+        with self._lock:
+            self._sealed.add(object_id)
+
+    def get_meta(self, object_id: ObjectID):
+        with self._lock:
+            meta = self._b.get_meta(object_id)
+            if meta is None and object_id in self._spilled:
+                if self._restore(object_id):
+                    meta = self._b.get_meta(object_id)
+            if meta is not None:
+                self._lru.move_to_end(object_id, last=True)
+                self._last_read[object_id] = time.monotonic()
+            return meta
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._b.contains(object_id) or object_id in self._spilled
+
+    def pin(self, object_id: ObjectID, pinned: bool = True):
+        with self._lock:
+            self._pinned[object_id] = pinned
+        self._b.pin(object_id, pinned)
+
+    def delete(self, object_id: ObjectID):
+        import os
+        with self._lock:
+            self._lru.pop(object_id, None)
+            self._pinned.pop(object_id, None)
+            self._sealed.discard(object_id)
+            self._last_read.pop(object_id, None)
+            if self._spilled.pop(object_id, None) is not None:
+                try:
+                    os.remove(self._spill_path(object_id))
+                except OSError:
+                    pass
+        self._b.delete(object_id)
+
+    def read_bytes(self, object_id: ObjectID, offset: int = 0,
+                   size: int | None = None):
+        out = self._b.read_bytes(object_id, offset, size)
+        if out is not None:
+            return out
+        with self._lock:
+            if object_id in self._spilled and self._restore(object_id):
+                return self._b.read_bytes(object_id, offset, size)
+        return None
+
+    def write_bytes(self, object_id: ObjectID, data: bytes):
+        with self._lock:
+            self._maybe_spill(len(data))
+        self._b.write_bytes(object_id, data)
+        with self._lock:
+            self._lru[object_id] = len(data)
+            self._pinned[object_id] = True
+            self._sealed.add(object_id)
+
+    def write_chunk(self, object_id: ObjectID, offset: int, data: bytes,
+                    total: int):
+        if offset == 0:
+            with self._lock:
+                self._maybe_spill(total)
+        self._b.write_chunk(object_id, offset, data, total)
+        with self._lock:
+            self._lru[object_id] = total
+            self._pinned.setdefault(object_id, True)
+            if offset + len(data) >= total:
+                self._sealed.add(object_id)
+
+    def stats(self) -> dict:
+        out = self._b.stats()
+        out["num_spilled"] = self.num_spilled
+        out["num_restored"] = self.num_restored
+        out["spilled_bytes"] = sum(self._spilled.values())
+        return out
+
+    def shutdown(self):
+        import shutil as _sh
+        self._b.shutdown()
+        _sh.rmtree(self._dir, ignore_errors=True)
+
+
 def make_store(capacity_bytes: int, prefix: str = "rtpu"):
-    """Pick the store backend per config.use_native_object_store, falling
+    """Pick the store backend per config.use_native_object_store (falling
     back to the pure-python per-object-segment store when the native library
-    cannot be built (no toolchain)."""
+    cannot be built), wrapped with disk spilling when enabled."""
+    import os
+
     from ray_tpu.core.config import get_config
 
-    if get_config().use_native_object_store:
+    cfg = get_config()
+    backend = None
+    if cfg.use_native_object_store:
         try:
-            return NativeShmStore(capacity_bytes, prefix)
+            backend = NativeShmStore(capacity_bytes, prefix)
         except Exception as e:
             import logging
             logging.getLogger(__name__).warning(
                 "native object store unavailable (%s); falling back to the "
                 "pure-python store", e)
-    return ShmStore(capacity_bytes, prefix)
+    if backend is None:
+        backend = ShmStore(capacity_bytes, prefix)
+    if cfg.enable_object_spilling:
+        spill_dir = os.path.join(cfg.spill_dir or "/tmp/ray_tpu/spill",
+                                 prefix)
+        return SpillingStore(backend, spill_dir, capacity_bytes)
+    return backend

@@ -94,9 +94,49 @@ def _recv_frame(sock: socket.socket) -> tuple[int, bytes]:
     return kind, _recv_exact(sock, ln - 1)
 
 
+class DeferredReply:
+    """Returned by a handler to decouple the RPC reply from the handler
+    thread (ref: the reference's reply-later ServerCall — server_call.h —
+    where SendReply happens from any thread). The server binds a sender when
+    it sees this return value; `send(result)` / `fail(exc)` may be called
+    before or after binding, from any thread, exactly once."""
+
+    _UNSET = object()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sender = None
+        self._ok = None
+        self._result = self._UNSET
+
+    def send(self, result: Any) -> None:
+        self._finish(True, result)
+
+    def fail(self, exc: BaseException) -> None:
+        self._finish(False, exc)
+
+    def _finish(self, ok: bool, result: Any) -> None:
+        with self._lock:
+            if self._result is not self._UNSET:
+                return
+            self._ok, self._result = ok, result
+            sender = self._sender
+        if sender is not None:
+            sender(ok, result)
+
+    def _bind(self, sender) -> None:
+        with self._lock:
+            self._sender = sender
+            if self._result is self._UNSET:
+                return
+            ok, result = self._ok, self._result
+        sender(ok, result)
+
+
 class RpcServer:
     """Threaded RPC server. ``handler(method, body, peer)`` returns the response
-    body or raises; the exception is pickled back to the caller."""
+    body or raises; the exception is pickled back to the caller. A handler may
+    instead return a DeferredReply to free its thread and reply later."""
 
     def __init__(self, handler: Callable[[str, Any, tuple], Any], host: str = "127.0.0.1",
                  port: int = 0, name: str = "rpc", blocking_methods: set[str] | None = None,
@@ -151,6 +191,8 @@ class RpcServer:
                         self._dispatch, conn, wlock, kind, msg_id, method, body, peer)
         except (ConnectionLost, OSError):
             pass
+        except RuntimeError:
+            pass  # pool shut down mid-receive: server is stopping
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -164,8 +206,18 @@ class RpcServer:
             result, ok = self._handler(method, body, peer), True
         except BaseException as e:  # noqa: BLE001 — errors propagate to caller
             result, ok = e, False
+        if ok and isinstance(result, DeferredReply):
+            if kind == _ONEWAY:
+                result._bind(lambda *_: None)
+                return
+            result._bind(lambda ok2, res2: self._send_reply(
+                conn, wlock, msg_id, method, ok2, res2))
+            return
         if kind == _ONEWAY:
             return
+        self._send_reply(conn, wlock, msg_id, method, ok, result)
+
+    def _send_reply(self, conn, wlock, msg_id, method, ok, result):
         if _chaos().drop_response(method):
             return
         try:

@@ -29,7 +29,7 @@ from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.memory_store import MemoryStore
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import ShmClient
-from ray_tpu.core.rpc import ClientPool, RpcClient, RpcServer
+from ray_tpu.core.rpc import ClientPool, DeferredReply, RpcClient, RpcServer
 from ray_tpu.core.serialization import SerializationContext, SerializedObject
 from ray_tpu.core.submitter import ActorTaskSubmitter, NormalTaskSubmitter
 from ray_tpu.core.task_manager import TaskManager
@@ -51,10 +51,41 @@ from ray_tpu.exceptions import (
 logger = logging.getLogger(__name__)
 
 
-class _TaskContext(threading.local):
-    task_id: TaskID | None = None
-    put_counter: int = 0
-    child_counter: int = 0
+class _TaskContext:
+    """Per-execution task context. Backed by contextvars (not
+    threading.local) so concurrent coroutines of an async actor — which
+    interleave on ONE event-loop thread — each see their own task_id and
+    put counter (asyncio Tasks copy the context at creation)."""
+
+    def __init__(self):
+        import contextvars
+        self._task_id = contextvars.ContextVar("rtpu_task_id", default=None)
+        self._put = contextvars.ContextVar("rtpu_put_counter", default=0)
+        self._child = contextvars.ContextVar("rtpu_child_counter", default=0)
+
+    @property
+    def task_id(self) -> TaskID | None:
+        return self._task_id.get()
+
+    @task_id.setter
+    def task_id(self, v) -> None:
+        self._task_id.set(v)
+
+    @property
+    def put_counter(self) -> int:
+        return self._put.get()
+
+    @put_counter.setter
+    def put_counter(self, v: int) -> None:
+        self._put.set(v)
+
+    @property
+    def child_counter(self) -> int:
+        return self._child.get()
+
+    @child_counter.setter
+    def child_counter(self, v: int) -> None:
+        self._child.set(v)
 
 
 @dataclass
@@ -97,6 +128,7 @@ class WorkerRuntime:
         self._actor_state = _ActorExecState()
         self._subscribed_actors: set[ActorID] = set()
         self._cancelled_tasks: set[TaskID] = set()
+        self._device_objects: dict[ObjectID, Any] = {}  # HBM-resident values
         self._running_tasks: dict[TaskID, threading.Event] = {}
         self._blocked_notified = threading.local()
         self._shutdown = threading.Event()
@@ -127,6 +159,15 @@ class WorkerRuntime:
     def put(self, value: Any, *, device_hint: str = "") -> ObjectRef:
         self._ctx.put_counter += 1
         oid = ObjectID.for_put(self.current_task_id(), self._ctx.put_counter)
+        if _is_device_array(value):
+            # device-resident object (ref: experimental/gpu_object_manager):
+            # the array stays in THIS process's HBM; same-process gets return
+            # the live handle with no device↔host round-trip. The serialized
+            # host copy below is the durable/cross-process representation
+            # (chips admit one process, so crossing processes crosses the
+            # host anyway — SURVEY.md §7 hard-part 7).
+            self._device_objects[oid] = value
+            device_hint = device_hint or "jax"
         sobj = self.serialization.serialize(value)
         self.reference_counter.add_owned(oid, contained_refs=sobj.contained_refs)
         if sobj.serialized_size() <= get_config().max_inline_object_size or self.agent_addr is None:
@@ -161,6 +202,9 @@ class WorkerRuntime:
 
     def _get_one(self, ref: ObjectRef, deadline) -> Any:
         oid = ref.id()
+        dev = self._device_objects.get(oid)
+        if dev is not None:
+            return dev  # same-process device-resident handle, zero-copy
         reconstruction_attempts = 3
         while True:
             if self.reference_counter.is_owned(oid) or self.memory_store.contains(oid):
@@ -248,8 +292,13 @@ class WorkerRuntime:
                     break
             if meta is None:
                 return None, False
-        shm_name, offset, size, _device = meta
+        shm_name, offset, size, _device = meta[:4]
+        copy_on_read = bool(meta[4]) if len(meta) > 4 else False
         mv = self.shm_client.map(shm_name, size, offset)
+        if copy_on_read:
+            # arena-backed extents are reused after eviction; deserialized
+            # buffers must not alias the mapping (see NativeShmStore.get_meta)
+            mv = memoryview(bytes(mv))
         sobj = SerializedObject.from_buffer(mv)
         return self.serialization.deserialize(sobj), True
 
@@ -483,6 +532,7 @@ class WorkerRuntime:
     def _on_ref_zero(self, oid: ObjectID):
         """Owned count hit zero: drop the value everywhere
         (ref: reference_count.cc delete path)."""
+        self._device_objects.pop(oid, None)
         ent = self.memory_store.get(oid)
         self.memory_store.delete(oid)
         self.task_manager.release_lineage(oid)
@@ -588,12 +638,25 @@ class WorkerRuntime:
         return {"ok": True}
 
     def _h_kill_actor(self, body):
-        """(ref: core_worker.proto:536 KillActor)"""
+        """(ref: core_worker.proto:536 KillActor). Guarded by actor id: a
+        TCP port can be reused by a freshly spawned worker moments after an
+        actor's worker exits, and an unguarded kill would take out the
+        innocent new tenant mid-task."""
+        target = body.get("actor_id")
+        mine = self._actor_state.actor_id
+        if target is not None and mine is not None and target != mine:
+            return {"ok": False, "reason": "actor not hosted here"}
+        if target is not None and mine is None:
+            return {"ok": False, "reason": "no actor in this worker"}
         threading.Thread(target=lambda: (time.sleep(0.05), os._exit(1)),
                          daemon=True).start()
         return {"ok": True}
 
     def _h_exit_worker(self, body):
+        """Same port-reuse guard as kill_actor."""
+        target = body.get("worker_id")
+        if target is not None and target != self.worker_id:
+            return {"ok": False, "reason": "wrong worker"}
         threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)),
                          daemon=True).start()
         return {"ok": True}
@@ -698,6 +761,7 @@ class WorkerRuntime:
 
     # ---- actors --------------------------------------------------------
     def _execute_actor_creation(self, spec: TaskSpec) -> dict:
+        logger.debug("executing actor creation %s", spec.actor_id.hex()[:8])
         st = self._actor_state
         try:
             cls = self.function_manager.get(spec.function_id)
@@ -723,48 +787,101 @@ class WorkerRuntime:
             logger.exception("actor creation failed")
             return {"error": f"{type(e).__name__}: {e}"}
 
-    def _enqueue_actor_task(self, spec: TaskSpec) -> dict:
-        """In-order dispatch per caller (ref: actor_scheduling_queue.cc);
-        execution happens on the concurrency pool; this handler thread waits for
-        completion to carry the reply."""
+    def _enqueue_actor_task(self, spec: TaskSpec):
+        """In-order dispatch per caller (ref: actor_scheduling_queue.cc).
+
+        Reply-later: returns a DeferredReply immediately so the RPC thread is
+        never pinned for the duration of the call — per-worker concurrency is
+        bounded only by the actor's max_concurrency pool (sync methods) or
+        the event loop (async methods), matching the reference's fiber-based
+        executor semantics (task_execution/fiber.h)."""
         st = self._actor_state
         if st.instance is None:
             return {"results": [], "error": "actor not initialized"}
         caller = spec.caller_id.binary()
-        fut: Future = Future()
+        reply = DeferredReply()
         with st.lock:
             expected = st.expected_seq.get(caller, 0)
             if spec.seq_no == -1 or spec.allow_out_of_order:
-                self._dispatch_actor_task(spec, fut)
+                self._dispatch_actor_task(spec, reply)
             elif spec.seq_no == expected:
                 st.expected_seq[caller] = expected + 1
-                self._dispatch_actor_task(spec, fut)
+                self._dispatch_actor_task(spec, reply)
                 pend = st.pending.get(caller, {})
                 nxt = st.expected_seq[caller]
                 while nxt in pend:
-                    pspec, pfut = pend.pop(nxt)
-                    self._dispatch_actor_task(pspec, pfut)
+                    pspec, preply = pend.pop(nxt)
+                    self._dispatch_actor_task(pspec, preply)
                     nxt += 1
                     st.expected_seq[caller] = nxt
             elif spec.seq_no < expected:
                 # duplicate resubmission after reconnect: re-execute is unsafe;
                 # reply with error so the owner retries via status
-                self._dispatch_actor_task(spec, fut)
+                self._dispatch_actor_task(spec, reply)
                 st.expected_seq[caller] = spec.seq_no + 1
             else:
-                st.pending.setdefault(caller, {})[spec.seq_no] = (spec, fut)
-        return fut.result()
+                st.pending.setdefault(caller, {})[spec.seq_no] = (spec, reply)
+        return reply
 
-    def _dispatch_actor_task(self, spec: TaskSpec, fut: Future):
+    def _dispatch_actor_task(self, spec: TaskSpec, reply: DeferredReply):
         st = self._actor_state
+        method = getattr(st.instance, spec.method_name, None)
+        import inspect
+        if (st.loop is not None and method is not None
+                and inspect.iscoroutinefunction(method)):
+            # async method: resolve args on a pool thread (may ray.get), then
+            # run the coroutine on the actor's event loop — no thread held
+            # while the method awaits, so thousands of calls can be in flight
+            import asyncio
+
+            def schedule():
+                try:
+                    args, kwargs = self._resolve_args(spec)
+                except BaseException as e:  # noqa: BLE001
+                    reply.fail(e)
+                    return
+
+                async def arun():
+                    try:
+                        reply.send(await self._run_actor_task_async(
+                            spec, method, args, kwargs))
+                    except BaseException as e:  # noqa: BLE001
+                        reply.fail(e)
+
+                asyncio.run_coroutine_threadsafe(arun(), st.loop)
+
+            st.pool.submit(schedule)
+            return
 
         def run():
             try:
-                fut.set_result(self._run_actor_task(spec))
+                reply.send(self._run_actor_task(spec))
             except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
+                reply.fail(e)
 
         st.pool.submit(run)
+
+    async def _run_actor_task_async(self, spec: TaskSpec, method,
+                                    args, kwargs) -> dict:
+        st = self._actor_state
+        prev = self._ctx.task_id
+        self._ctx.task_id = spec.task_id
+        self._ctx.put_counter = 0
+        try:
+            result = await method(*args, **kwargs)
+            reply = self._success_reply(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            if isinstance(e, SystemExit):
+                reply = self._exit_actor_reply(spec)
+            else:
+                reply = self._error_reply(
+                    spec, e if isinstance(e, TaskError)
+                    else TaskError(e, task_repr=spec.repr_name()))
+        finally:
+            self._ctx.task_id = prev
+        if st.exiting:
+            self._do_exit_actor()
+        return reply
 
     def _run_actor_task(self, spec: TaskSpec) -> dict:
         st = self._actor_state
@@ -837,6 +954,14 @@ class WorkerRuntime:
         self.peer_pool.close_all()
         self.cp_client.close()
         self.shm_client.close()
+
+
+def _is_device_array(value) -> bool:
+    """True for a jax.Array (any backend) WITHOUT importing jax — a value
+    can't be one unless jax is already loaded in this process."""
+    import sys
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(value, jax.Array)
 
 
 def _write_serialized(mv: memoryview, sobj: SerializedObject):

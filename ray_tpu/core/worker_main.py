@@ -23,6 +23,10 @@ def main():
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"),
         format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s")
+    # debugging hook: `kill -USR1 <pid>` dumps all thread stacks to the
+    # worker's log file (reference: ray stack / py-spy dump equivalent)
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     from ray_tpu.core.ids import JobID, NodeID, WorkerID
     from ray_tpu.core.worker import WorkerRuntime
     from ray_tpu.core import api

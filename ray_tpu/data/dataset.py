@@ -357,37 +357,83 @@ class _SplitCoordinator:
         self._epochs: dict[int, list] = {}
         self._finished_ranks: dict[int, set] = {}  # epoch -> ranks done
 
-    def _queues_for(self, epoch: int) -> list:
+    def _queues_for(self, epoch: int, rank: int) -> list:
         import queue as queuelib
         import threading as th
 
+        to_gc = []
         with self._lock:
+            # a rank asking for epoch e has abandoned every earlier epoch
+            # (early-exit consumers): count it done there so abandoned
+            # epochs get collected instead of leaking pumps + executors
+            for e in list(self._finished_ranks):
+                if e < epoch and rank not in self._finished_ranks[e]:
+                    self._finished_ranks[e].add(rank)
+                    if len(self._finished_ranks[e]) >= self._n:
+                        to_gc.append(e)
             if epoch not in self._epochs:
                 queues = [queuelib.Queue(maxsize=4) for _ in range(self._n)]
-                self._epochs[epoch] = queues
+                ex_box: list = []
+                t = th.Thread(target=self._pump, args=(queues, ex_box),
+                              daemon=True)
+                self._epochs[epoch] = (queues, ex_box, t)
                 self._finished_ranks[epoch] = set()
-                th.Thread(target=self._pump, args=(queues,),
-                          daemon=True).start()
-            return self._epochs[epoch]
+                t.start()
+            queues = self._epochs[epoch][0]
+        for e in to_gc:
+            self._gc_epoch(e)
+        return queues
 
     def _mark_done(self, epoch: int, rank: int) -> None:
         # GC an epoch only once EVERY rank consumed its end-of-stream
-        # sentinel; dropping earlier would strand a lagging rank on orphaned
-        # queues (and re-running the executor would hand it duplicate rows).
+        # sentinel (or moved on); dropping earlier would strand a lagging
+        # rank on orphaned queues (and re-running the executor would hand it
+        # duplicate rows).
+        gc = False
         with self._lock:
             done = self._finished_ranks.get(epoch)
             if done is None:
                 return
             done.add(rank)
-            if len(done) >= self._n:
-                self._epochs.pop(epoch, None)
-                self._finished_ranks.pop(epoch, None)
+            gc = len(done) >= self._n
+        if gc:
+            self._gc_epoch(epoch)
 
-    def _pump(self, queues: list) -> None:
+    def _gc_epoch(self, epoch: int) -> None:
+        import queue as queuelib
+
+        with self._lock:
+            entry = self._epochs.pop(epoch, None)
+            self._finished_ranks.pop(epoch, None)
+        if entry is None:
+            return
+        queues, ex_box, pump_thread = entry
+        # stop the executor first (bounds what the pump can still emit),
+        # then keep draining until the pump thread actually exits — it can
+        # only be blocked on queue.put, and every drain frees capacity
+        for ex in ex_box:
+            try:
+                ex.stop()
+            except Exception:
+                pass
+        import time as _time
+        deadline = _time.monotonic() + 30.0
+        while pump_thread.is_alive() and _time.monotonic() < deadline:
+            for q in queues:
+                while True:
+                    try:
+                        q.get_nowait()
+                    except queuelib.Empty:
+                        break
+            _time.sleep(0.02)
+
+    def _pump(self, queues: list, ex_box: list | None = None) -> None:
         n = self._n
         try:
             ex = StreamingExecutor(LogicalPlan(self._terminal),
                                    self._parallelism)
+            if ex_box is not None:
+                ex_box.append(ex)
             if not self._equal:
                 for i, (ref, meta) in enumerate(ex.run()):
                     queues[i % n].put(ray_tpu.get(ref))
@@ -417,7 +463,7 @@ class _SplitCoordinator:
                 q.put(None)
 
     def next(self, rank: int, epoch: int = 0):
-        item = self._queues_for(epoch)[rank].get(timeout=110.0)
+        item = self._queues_for(epoch, rank)[rank].get(timeout=110.0)
         if item is None:
             self._mark_done(epoch, rank)
         return item

@@ -647,7 +647,14 @@ class StreamingExecutor:
         self._thread.start()
         try:
             while True:
-                item = self._outq.get()
+                try:
+                    item = self._outq.get(timeout=0.5)
+                except queue.Empty:
+                    # stop() may have drained the queue (including the _DONE
+                    # sentinel) from another thread; don't block forever
+                    if self._stopped.is_set():
+                        break
+                    continue
                 if item is _DONE:
                     break
                 if isinstance(item, _ExecutorError):

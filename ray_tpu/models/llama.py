@@ -40,6 +40,10 @@ class LlamaConfig:
     # attention implementation: "dense" | "ring" | "flash"
     attn_impl: str = "dense"
     remat: bool = True
+    # checkpoint policy: "full" recomputes everything; "dots" saves matmul
+    # outputs (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) —
+    # less recompute, more HBM
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -217,8 +221,23 @@ def _layer_fwd(x, layer, cos, sin, cfg: LlamaConfig, mesh):
     return x
 
 
+def _remat(body, cfg: LlamaConfig):
+    """Wrap a scan body in jax.checkpoint per cfg.remat_policy."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return jax.checkpoint(body)
+
+
 def forward(params, tokens, cfg: LlamaConfig, mesh=None):
     """tokens [B, T] → logits [B, T, vocab]."""
+    x = hidden_states(params, tokens, cfg, mesh)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def hidden_states(params, tokens, cfg: LlamaConfig, mesh=None):
+    """tokens [B, T] → final-norm hidden states [B, T, D] (no lm_head)."""
     b, t = tokens.shape
     x = params["embed"][tokens].astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(t), (b, t))
@@ -228,20 +247,47 @@ def forward(params, tokens, cfg: LlamaConfig, mesh=None):
         return _layer_fwd(x, layer, cos, sin, cfg, mesh), None
 
     if cfg.remat:
-        body = jax.checkpoint(body)  # trade FLOPs for HBM (SURVEY §brief)
+        body = _remat(body, cfg)
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def chunked_cross_entropy(lm_head, hidden, targets, chunk: int = 256):
+    """Next-token CE without ever materializing fp32 [B, T, vocab].
+
+    The naive log_softmax over the full sequence allocates B·T·V fp32 —
+    7.8 GiB at B=8, T=2048, V=128k, more than half a v5e's HBM. Scanning
+    sequence chunks keeps the live logits at B·chunk·V and lets XLA overlap
+    the lm_head matmul of one chunk with the reduction of the previous.
+    """
+    b, t, d = hidden.shape
+    chunk = min(chunk, t)
+    if t % chunk:
+        chunk = t  # fallback: uneven seq, single chunk
+    n = t // chunk
+    hid = hidden.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    tgt = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    # checkpoint: without it the scan's backward saves EVERY chunk's fp32
+    # logits as residuals — the full B·T·V tensor again
+    @jax.checkpoint
+    def body(acc, xs):
+        h, y = xs
+        logits = (h @ lm_head).astype(jnp.float32)       # [B, chunk, V]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, y[..., None], axis=-1)[..., 0] - lse
+        return acc + jnp.sum(ll), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (hid, tgt))
+    return -total / (b * t)
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
     """Next-token cross-entropy; batch: {"tokens": [B, T+1]} or tokens array."""
     tokens = batch["tokens"] if isinstance(batch, dict) else batch
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    hidden = hidden_states(params, inputs, cfg, mesh)
+    return chunked_cross_entropy(params["lm_head"], hidden, targets)
 
 
 # ---------------------------------------------------------------------------

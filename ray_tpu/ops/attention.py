@@ -106,20 +106,106 @@ def _flash_bh(q, k, v, *, causal: bool, sm_scale: float, block_q: int,
     )(q, k, v)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None,
-                    block_q: int = 256, block_k: int = 256,
-                    interpret: bool | None = None):
-    """q,k,v: [B, T, H, D] (same H — expand GQA before calling)."""
-    if sm_scale is None:
-        sm_scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_core(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret):
     b, t, h, d = q.shape
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), causal=causal,
                     sm_scale=sm_scale, block_q=block_q, block_k=block_k,
                     interpret=interpret)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_attention_core(q, k, v, causal, sm_scale, block_q, block_k,
+                                interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    """Blockwise-recompute backward (flash-attention-2 style), pure JAX:
+    scans over k/v blocks so peak memory is O(T·block) not O(T²); every op
+    is a batched matmul the MXU likes. Recomputes the softmax normalizer
+    from scratch (two passes) instead of saving per-row stats — trades a
+    forward-shaped matmul for not materializing [T,T] anywhere."""
+    q, k, v, out = res
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    bk = min(block_k, t_k)
+    n_blocks = t_k // bk if t_k % bk == 0 else 1
+    if t_k % bk:
+        bk = t_k
+
+    qf = q.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    # delta_i = sum_j P_ij * dP_ij = rowsum(dO * O)  (flash-attn-2 trick)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)  # [B,T,H]
+
+    # pass 1: softmax stats (m, l) per q row, streaming over k blocks
+    def stats_body(carry, kb):
+        m_prev, l_prev = carry
+        k_blk, start = kb
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) \
+            * sm_scale
+        if causal:
+            rows = jnp.arange(t_q)[:, None]
+            cols = start + jnp.arange(bk)[None, :]
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        l_new = l_prev * jnp.exp(m_prev - m_new) + \
+            jnp.sum(jnp.exp(s - m_new[..., None]), axis=-1)
+        return (m_new, l_new), None
+
+    starts = jnp.arange(n_blocks) * bk
+    k_blocks = k.reshape(b, n_blocks, bk, h, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, n_blocks, bk, h, d).transpose(1, 0, 2, 3, 4)
+    m0 = jnp.full((b, h, t_q), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_q), jnp.float32)
+    (m, l), _ = jax.lax.scan(stats_body, (m0, l0), (k_blocks, starts))
+    l = jnp.where(l > 0, l, 1.0)
+
+    # pass 2: accumulate dq; emit dk/dv per block
+    def grad_body(dq_acc, kb):
+        k_blk, v_blk, start = kb
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sm_scale
+        if causal:
+            rows = jnp.arange(t_q)[:, None]
+            cols = start + jnp.arange(bk)[None, :]
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - m[..., None]) / l[..., None]          # [B,H,Tq,bk]
+        dp = jnp.einsum("bqhd,bkhd->bhqk", g32, vf)
+        ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, g32)
+        return dq_acc, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((b, t_q, h, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        grad_body, dq0, (k_blocks, v_blocks, starts))
+    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: bool | None = None):
+    """q,k,v: [B, T, H, D] (same H — expand GQA before calling).
+    Differentiable: forward is the Pallas kernel, backward a blockwise
+    recompute (no [T,T] materialization)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_attention_core(q, k, v, causal, sm_scale, block_q, block_k,
+                                 interpret)
 
 
 def reference_attention(q, k, v, *, causal: bool = True,

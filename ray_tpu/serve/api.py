@@ -99,6 +99,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                autoscaling_config: Optional[dict | AutoscalingConfig] = None,
                ray_actor_options: Optional[dict] = None,
                health_check_period_s: float = 2.0,
+               health_check_timeout_s: float = 30.0,
                graceful_shutdown_timeout_s: float = 20.0):
     """@serve.deployment decorator (reference api.py:333)."""
 
@@ -107,6 +108,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             max_ongoing_requests=max_ongoing_requests,
             user_config=user_config,
             health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=ray_actor_options or {})
         if num_replicas == "auto":

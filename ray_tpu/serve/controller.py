@@ -141,6 +141,22 @@ class ServeController:
         self._ensure_started()
         return dict(self._routes)
 
+    async def ingress_has_http_dispatch(self, app_name: str,
+                                        deployment: str) -> bool:
+        """Does the ingress class define handle_http(path, method, payload)?
+        (Proxy sub-path dispatch for multi-route apps, e.g. the OpenAI
+        ingress — ray_tpu.serve.llm.openai_api.)"""
+        self._ensure_started()
+        state = self._deployments.get(f"{app_name}#{deployment}")
+        if state is None:
+            return False
+        import cloudpickle
+        try:
+            cls = cloudpickle.loads(state.serialized_cls)
+        except Exception:  # noqa: BLE001
+            return False
+        return callable(getattr(cls, "handle_http", None))
+
     async def status(self) -> dict:
         self._ensure_started()
         return {

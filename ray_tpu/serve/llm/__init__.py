@@ -1,0 +1,25 @@
+"""ray_tpu.serve.llm — TPU-native LLM serving.
+
+The reference delegates LLM serving to vLLM
+(/root/reference/python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py:101, llm_server.py, routers/router.py); on TPU the engine IS
+part of the framework: a continuous-batching engine (slot-based, static
+shapes for XLA) over a paged KV cache, wrapped in a serve deployment with an
+OpenAI-compatible ingress.
+
+Public surface:
+- LLMConfig            — model + engine sizing knobs
+- LLMServer            — serve deployment class (continuous batching replica)
+- build_openai_app     — Application serving /v1/completions + /v1/chat/...
+- LLMEngine            — the engine itself (usable standalone, e.g. bench)
+"""
+
+from ray_tpu.serve.llm.config import LLMConfig
+from ray_tpu.serve.llm.engine import LLMEngine
+from ray_tpu.serve.llm.llm_server import LLMServer, build_llm_deployment
+from ray_tpu.serve.llm.openai_api import build_openai_app
+
+__all__ = [
+    "LLMConfig", "LLMEngine", "LLMServer", "build_llm_deployment",
+    "build_openai_app",
+]

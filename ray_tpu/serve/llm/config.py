@@ -1,0 +1,49 @@
+"""LLM serving config (reference: python/ray/llm/_internal/serve/configs/
+server_models.py LLMConfig — model id + engine kwargs; here the engine knobs
+are first-class because the engine is in-framework)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    """Model + continuous-batching engine sizing.
+
+    TPU notes: `max_batch_size` fixes the decode slot count (static shapes —
+    one compiled decode program); prompt prefill pads to power-of-two buckets
+    bounded by `max_prompt_len` (bounded compile cache); the KV cache is
+    paged so long and short sequences share one HBM pool.
+    """
+
+    # model
+    model_id: str = "llama-tiny"
+    model_config: Any = None          # ray_tpu.models.llama.LlamaConfig
+    checkpoint_path: Optional[str] = None  # orbax/npz dir; None = random init
+    tokenizer: str = "byte"           # "byte" | HF tokenizer local path
+
+    # engine sizing
+    max_batch_size: int = 8           # decode slots
+    page_size: int = 128              # tokens per KV page
+    num_pages: int = 256              # total pages in the HBM pool
+    max_prompt_len: int = 512
+    max_seq_len: int = 1024           # prompt + generation cap per request
+    prefill_chunk: int = 512          # prefill compute chunk
+
+    # sampling defaults (overridable per request)
+    max_tokens: int = 128
+    temperature: float = 0.0          # 0 = greedy
+    top_k: int = 0                    # 0 = full softmax
+
+    # serving
+    num_replicas: int = 1
+    name: str = "llm"
+    ray_actor_options: Optional[dict] = None  # e.g. {"resources": {"TPU": 1}}
+
+    def llama(self):
+        from ray_tpu.models import llama
+        if self.model_config is not None:
+            return self.model_config
+        return llama.llama_tiny()

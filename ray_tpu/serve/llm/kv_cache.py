@@ -1,0 +1,229 @@
+"""Paged KV cache + paged attention steps for continuous batching.
+
+The TPU-native analog of vLLM's PagedAttention (the reference delegates to
+it — python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:101):
+KV lives in a fixed pool of fixed-size pages in HBM; each decode slot owns a
+page table mapping logical sequence positions to pool pages. All shapes are
+static (slot count, page count, pages-per-slot), so the decode step compiles
+ONCE and every iteration reuses the same XLA program — the crucial property
+on TPU, where recompilation would dwarf the step itself.
+
+Design choices for XLA (vs a CUDA kernel translation):
+- the per-slot KV view is materialized with a `jnp.take` gather over the
+  page axis — XLA fuses the gather into the attention matmul chain and never
+  round-trips HBM more than a dense cache would;
+- writes are scatters at (page, offset) index pairs; inactive slots write to
+  a reserved trash page (page 0), keeping the step free of dynamic shapes
+  and `lax.cond`s;
+- a Pallas kernel can later replace the gather+matmul for decode without
+  touching the engine (same function signature).
+
+Page 0 is RESERVED as the trash page; the allocator never hands it out.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    _gqa_expand,
+    apply_rope,
+    rms_norm,
+    rope_freqs,
+)
+
+
+def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int):
+    """KV pool: [n_layers, num_pages, page_size, n_kv_heads, head_dim]."""
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+class PageAllocator:
+    """Host-side free list over the page pool (page 0 reserved as trash).
+
+    Mirrors vLLM's BlockAllocator role; plain Python because allocation
+    happens between steps, never inside the compiled program.
+    """
+
+    def __init__(self, num_pages: int):
+        self._free = list(range(num_pages - 1, 0, -1))  # stack; never page 0
+        self._lock = threading.Lock()
+        self.num_pages = num_pages
+
+    def alloc(self, n: int) -> list[int] | None:
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            out = [self._free.pop() for _ in range(n)]
+            return out
+
+    def free(self, pages: list[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p != 0:
+                    self._free.append(p)
+
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+# ---------------------------------------------------------------------------
+# compiled steps
+# ---------------------------------------------------------------------------
+
+
+def _write_token_kv(k_cache, v_cache, k_new, v_new, page_idx, offset):
+    """Scatter one token's k/v per slot into the layer's page pool.
+
+    k_cache: [P, page, Hkv, D]; k_new: [B, Hkv, D]; page_idx/offset: [B].
+    Slots write distinct pages (or the shared trash page), so the scatter is
+    conflict-free for real slots.
+    """
+    k_cache = k_cache.at[page_idx, offset].set(k_new.astype(k_cache.dtype))
+    v_cache = v_cache.at[page_idx, offset].set(v_new.astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
+                      cfg: LlamaConfig, page_size: int):
+    """One fused decode step for all slots.
+
+    tokens: [B] current token ids; seq_lens: [B] tokens already in cache
+    (the new token lands at position seq_lens[b]); page_tables:
+    [B, max_pages] pool page ids (trash page 0 for unused entries).
+    Returns (logits [B, vocab], new_kv, new_seq_lens). Inactive slots should
+    carry seq_lens pointing at trash-page positions; their logits are junk
+    and the engine ignores them.
+    """
+    b = tokens.shape[0]
+    max_pages = page_tables.shape[1]
+    max_len = max_pages * page_size
+
+    x = params["embed"][tokens[:, None]].astype(cfg.dtype)       # [B,1,D]
+    cos, sin = rope_freqs(cfg, seq_lens[:, None])                # position = len
+    pos = seq_lens
+    page_idx = jnp.take_along_axis(
+        page_tables, (pos // page_size)[:, None], axis=1)[:, 0]  # [B]
+    offset = pos % page_size
+    # causal mask over the gathered view: positions 0..seq_len inclusive
+    valid = jnp.arange(max_len)[None, :] <= pos[:, None]          # [B, L]
+    sm = cfg.head_dim ** -0.5
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+
+    def body(carry, inputs):
+        (x,) = carry
+        layer, k_cache, v_cache = inputs
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache, v_cache = _write_token_kv(
+            k_cache, v_cache, k[:, 0], v[:, 0], page_idx, offset)
+        # gather each slot's pages → [B, max_pages, page, Hkv, D] → [B, L, ...]
+        k_seq = jnp.take(k_cache, page_tables, axis=0).reshape(
+            b, max_len, cfg.n_kv_heads, cfg.head_dim)
+        v_seq = jnp.take(v_cache, page_tables, axis=0).reshape(
+            b, max_len, cfg.n_kv_heads, cfg.head_dim)
+        k_full = _gqa_expand(k_seq, n_rep)
+        v_full = _gqa_expand(v_seq, n_rep)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
+            jnp.float32) * sm
+        logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
+        up = h2 @ layer["mlp"]["w_up"]
+        x = x + (gate * up) @ layer["mlp"]["w_down"]
+        return (x,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["layers"], kv["k"], kv["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}, seq_lens + 1
+
+
+def paged_prefill(params, kv, page_table, tokens, true_len,
+                  cfg: LlamaConfig, page_size: int):
+    """Prefill ONE slot's prompt into its pages.
+
+    tokens: [1, T] (bucket-padded); page_table: [max_pages] for this slot;
+    true_len: scalar actual prompt length. Returns (last-token logits
+    [vocab], new_kv). Padding positions (>= true_len) write to the trash
+    page via index clamping, so junk never lands in real pages.
+    """
+    t = tokens.shape[1]
+    x = params["embed"][tokens].astype(cfg.dtype)                 # [1,T,D]
+    positions = jnp.arange(t)[None, :]
+    cos, sin = rope_freqs(cfg, positions)
+    pos = jnp.arange(t)
+    in_range = pos < true_len
+    page_idx = jnp.where(in_range, jnp.take(page_table, pos // page_size), 0)
+    offset = pos % page_size
+    # causal mask for the in-prompt attention
+    causal = pos[:, None] >= pos[None, :]
+    sm = cfg.head_dim ** -0.5
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+
+    def body(carry, inputs):
+        (x,) = carry
+        layer, k_cache, v_cache = inputs
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # dense causal attention within the prompt (prefill is compute-bound
+        # and contiguous — no need to read back through pages)
+        k_full = _gqa_expand(k, n_rep)
+        v_full = _gqa_expand(v, n_rep)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
+            jnp.float32) * sm
+        logits = jnp.where(causal[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
+        up = h2 @ layer["mlp"]["w_up"]
+        x = x + (gate * up) @ layer["mlp"]["w_down"]
+        # scatter the prompt's k/v into this slot's pages
+        k_cache = k_cache.at[page_idx, offset].set(
+            k[0].astype(k_cache.dtype))
+        v_cache = v_cache.at[page_idx, offset].set(
+            v[0].astype(v_cache.dtype))
+        return (x,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["layers"], kv["k"], kv["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(true_len - 1, 0)[None, None, None], axis=1)[:, 0]
+    logits = (last @ params["lm_head"]).astype(jnp.float32)[0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def sample_tokens(logits, rng, temperature, top_k: int = 0):
+    """Greedy/temperature/top-k sampling on device. logits: [B, V];
+    temperature: [B] (0 → greedy)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    if top_k and top_k > 0:
+        vals, idx = jax.lax.top_k(logits, top_k)
+        scaled = vals / jnp.maximum(temperature[:, None], 1e-6)
+        choice = jax.random.categorical(rng, scaled, axis=-1)
+        sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+    else:
+        scaled = logits / jnp.maximum(temperature[:, None], 1e-6)
+        sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -1,0 +1,176 @@
+"""LLMServer: the serve deployment wrapping the continuous-batching engine.
+
+Matches the reference's LLMServer deployment
+(python/ray/llm/_internal/serve/deployments/llm/llm_server.py): one engine
+per replica, requests routed by serve's pow-2 router, OpenAI-shaped request
+and response dicts. Streaming uses generator endpoints (drained through the
+engine's per-request token queues).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Iterator, Optional
+
+from ray_tpu.serve.llm.config import LLMConfig
+from ray_tpu.serve.llm.engine import LLMEngine
+
+
+def _chat_prompt(messages: list[dict]) -> str:
+    """Minimal chat template (role-tagged concatenation)."""
+    parts = []
+    for m in messages:
+        parts.append(f"<|{m.get('role', 'user')}|>{m.get('content', '')}")
+    parts.append("<|assistant|>")
+    return "".join(parts)
+
+
+class LLMServer:
+    """Deployment callable. Each replica owns one engine (and therefore the
+    TPU chips of its placement bundle — one engine process per chip group,
+    SURVEY.md §7 hard-part 7)."""
+
+    def __init__(self, llm_config: LLMConfig | dict):
+        if isinstance(llm_config, dict):
+            llm_config = LLMConfig(**llm_config)
+        self.cfg = llm_config
+        self.engine = LLMEngine(llm_config)
+        self.engine.start()
+
+    # ---- OpenAI-shaped endpoints --------------------------------------
+    def completions(self, payload: dict) -> Any:
+        prompt = payload.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        params = self._sampling(payload)
+        if payload.get("stream"):
+            return self._stream_completion(prompt, params, chat=False)
+        out = self.engine.generate(prompt, **params)
+        return self._completion_response(out, chat=False)
+
+    def chat(self, payload: dict) -> Any:
+        prompt = _chat_prompt(payload.get("messages", []))
+        params = self._sampling(payload)
+        if payload.get("stream"):
+            return self._stream_completion(prompt, params, chat=True)
+        out = self.engine.generate(prompt, **params)
+        return self._completion_response(out, chat=True)
+
+    def models(self) -> dict:
+        return {"object": "list",
+                "data": [{"id": self.cfg.model_id, "object": "model",
+                          "owned_by": "ray_tpu"}]}
+
+    # ---- plumbing ------------------------------------------------------
+    def _sampling(self, payload: dict) -> dict:
+        out = {}
+        if payload.get("max_tokens") is not None:
+            out["max_tokens"] = int(payload["max_tokens"])
+        if payload.get("temperature") is not None:
+            out["temperature"] = float(payload["temperature"])
+        if payload.get("top_k") is not None:
+            out["top_k"] = int(payload["top_k"])
+        return out
+
+    def _completion_response(self, out: dict, chat: bool) -> dict:
+        oid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        if chat:
+            choice = {"index": 0, "finish_reason": "stop",
+                      "message": {"role": "assistant", "content": out["text"]}}
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "finish_reason": "stop",
+                      "text": out["text"]}
+            obj = "text_completion"
+        return {
+            "id": oid, "object": obj, "created": int(time.time()),
+            "model": self.cfg.model_id, "choices": [choice],
+            "usage": {
+                "prompt_tokens": out.get("num_prompt_tokens", 0),
+                "completion_tokens": out.get("num_generated_tokens", 0),
+                "total_tokens": out.get("num_prompt_tokens", 0)
+                + out.get("num_generated_tokens", 0),
+            },
+            # engine-side timing (bench harness reads these)
+            "ray_tpu": {"ttft_s": out.get("ttft_s"),
+                        "latency_s": out.get("latency_s")},
+        }
+
+    async def _stream_completion(self, prompt: str, params: dict, chat: bool):
+        """Async generator of OpenAI stream chunks (SSE payloads minus
+        framing). Async so the poll sleep yields the replica's event loop —
+        N streaming requests drain concurrently instead of serializing."""
+        import asyncio
+
+        rid = self.engine.submit(prompt, **params)
+        oid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        while True:
+            d = self.engine.drain(rid)
+            if d["text"]:
+                if chat:
+                    delta = {"delta": {"content": d["text"]}, "index": 0,
+                             "finish_reason": None}
+                else:
+                    delta = {"text": d["text"], "index": 0,
+                             "finish_reason": None}
+                yield {"id": oid, "object": obj,
+                       "model": self.cfg.model_id, "choices": [delta]}
+            if d["done"]:
+                fin = ({"delta": {}, "index": 0, "finish_reason": "stop"}
+                       if chat else
+                       {"text": "", "index": 0, "finish_reason": "stop"})
+                yield {"id": oid, "object": obj,
+                       "model": self.cfg.model_id, "choices": [fin]}
+                return
+            await asyncio.sleep(0.01)
+
+    # raw engine access (bench, composition)
+    def generate(self, prompt: str, **kw) -> dict:
+        return self.engine.generate(prompt, **kw)
+
+    def submit(self, prompt: str, **kw) -> str:
+        return self.engine.submit(prompt, **kw)
+
+    def drain(self, request_id: str) -> dict:
+        return self.engine.drain(request_id)
+
+    def engine_stats(self) -> dict:
+        return self.engine.engine_stats()
+
+    def check_health(self) -> bool:
+        return True
+
+    # ---- HTTP ingress dispatch (proxy calls handle_http when defined) --
+    def handle_http(self, path: str, method: str, payload: Any) -> Any:
+        path = "/" + path.strip("/")
+        if path.endswith("/chat/completions"):
+            return self.chat(payload if isinstance(payload, dict) else {})
+        if path.endswith("/completions"):
+            return self.completions(
+                payload if isinstance(payload, dict) else {})
+        if path.endswith("/models"):
+            return self.models()
+        if path.endswith("/stats"):
+            return self.engine_stats()
+        return {"error": {"message": f"no route for {path}", "code": 404}}
+
+
+def build_llm_deployment(llm_config: LLMConfig, *, name: Optional[str] = None):
+    """LLMServer as a serve Deployment (one engine per replica). TPU
+    placement comes from llm_config.ray_actor_options (e.g.
+    {"resources": {"TPU": 4}}) — each replica then lands on a TPU worker
+    process owning those chips."""
+    from ray_tpu import serve
+
+    return serve.deployment(
+        LLMServer,
+        name=name or llm_config.name,
+        num_replicas=llm_config.num_replicas,
+        max_ongoing_requests=4 * llm_config.max_batch_size,
+        ray_actor_options=dict(llm_config.ray_actor_options or {}),
+        # first requests compile XLA programs for minutes on TPU; don't let
+        # routine health checking kill the replica mid-compile
+        health_check_timeout_s=600.0,
+    )

@@ -1,0 +1,35 @@
+"""OpenAI-compatible application builder.
+
+Matches the reference's openai-compatible router
+(python/ray/llm/_internal/serve/deployments/routers/router.py +
+serve/llm/openai_api_models.py): `build_openai_app(config)` returns a serve
+Application whose ingress answers
+
+    POST /v1/completions
+    POST /v1/chat/completions
+    GET  /v1/models
+    GET  /v1/stats          (engine telemetry; ray_tpu addition)
+
+The HTTP proxy dispatches sub-paths through the ingress deployment's
+`handle_http(path, method, payload)` (ray_tpu.serve.proxy); `stream: true`
+requests return chunk lists that the proxy frames as SSE.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.serve.llm.config import LLMConfig
+from ray_tpu.serve.llm.llm_server import build_llm_deployment
+
+
+def build_openai_app(llm_config: LLMConfig | dict,
+                     route_prefix: str = "/v1",
+                     name: Optional[str] = None):
+    """Application: LLMServer ingress rooted at /v1 (reference
+    build_openai_app, llm/_internal/serve/builders/application_builders.py)."""
+    if isinstance(llm_config, dict):
+        llm_config = LLMConfig(**llm_config)
+    dep = build_llm_deployment(llm_config, name=name)
+    dep.route_prefix = route_prefix
+    return dep.bind(llm_config)

@@ -26,6 +26,7 @@ class HTTPProxy:
         self.host = host
         self.port = port
         self._routers: dict[str, Router] = {}
+        self._http_dispatch: dict[tuple, bool] = {}
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
@@ -59,6 +60,10 @@ class HTTPProxy:
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self.host, self.port)
         loop.run_until_complete(site.start())
+        if self.port == 0:  # OS-assigned: report the real port
+            for s in site._server.sockets:
+                self.port = s.getsockname()[1]
+                break
         self._runner = runner
         self._started.set()
         try:
@@ -112,21 +117,66 @@ class HTTPProxy:
         else:
             payload = dict(request.query)
 
+        # Ingresses that define handle_http(path, method, payload) get the
+        # sub-path dispatched to them (OpenAI-style multi-route apps,
+        # ray_tpu.serve.llm.openai_api); plain callables get __call__.
+        subpath = path[len(prefix.rstrip("/")):] or "/"
+        loop = asyncio.get_event_loop()
         try:
-            ref = await asyncio.get_event_loop().run_in_executor(
+            wants_dispatch = await loop.run_in_executor(
+                None, self._wants_http_dispatch, app_name, deployment)
+            # SSE only for multi-route (handle_http) ingresses that opt in
+            # via the OpenAI-style "stream" field — a plain deployment whose
+            # payload happens to contain stream=true keeps json responses
+            streaming = (wants_dispatch and isinstance(payload, dict)
+                         and bool(payload.get("stream")))
+            if wants_dispatch:
+                call = (deployment, "handle_http",
+                        (subpath, request.method, payload))
+            else:
+                call = (deployment, "__call__", (payload,))
+            ref = await loop.run_in_executor(
                 None, lambda: router.assign(
-                    deployment, "__call__", (payload,), {}))
+                    call[0], call[1], call[2], {}, streaming=streaming))
             result = await _aget(ref)
         except TimeoutError as e:
             return web.Response(status=503, text=str(e))
         except Exception as e:  # noqa: BLE001 - surface replica errors as 500
             return web.Response(status=500, text=repr(e))
 
+        if streaming and isinstance(result, list):
+            # server-sent events framing (reference: proxy ASGI streaming)
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream",
+                         "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+            for chunk in result:
+                data = json.dumps(chunk) if not isinstance(chunk, str) \
+                    else chunk
+                await resp.write(f"data: {data}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
         if isinstance(result, (bytes, bytearray)):
             return web.Response(body=bytes(result))
         if isinstance(result, str):
             return web.Response(text=result)
         return web.json_response(result)
+
+    def _wants_http_dispatch(self, app_name: str, deployment: str) -> bool:
+        """Does the ingress deployment define handle_http? (cached; the
+        controller records the flag at deploy time)."""
+        key = (app_name, deployment)
+        cached = self._http_dispatch.get(key)
+        if cached is None:
+            try:
+                cached = bool(ray_tpu.get(
+                    self._controller.ingress_has_http_dispatch.remote(
+                        app_name, deployment), timeout=5.0))
+            except Exception:  # noqa: BLE001 - older controller: plain calls
+                cached = False
+            self._http_dispatch[key] = cached
+        return cached
 
 
 async def _aget(ref):

@@ -61,7 +61,13 @@ class ServeReplica:
                 target = self._callable
             else:
                 target = getattr(self._callable, method_name)
-            result = target(*args, **kwargs)
+            if inspect.iscoroutinefunction(target):
+                return await target(*args, **kwargs)
+            # sync callables run on a thread so a long call (e.g. an LLM
+            # generation waiting on the chip) can't starve the event loop —
+            # health checks and concurrent requests keep flowing (reference:
+            # sync methods execute on the replica's thread pool)
+            result = await asyncio.to_thread(target, *args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result
             return result
@@ -83,7 +89,9 @@ class ServeReplica:
                 async for chunk in result:
                     chunks.append(chunk)
             elif inspect.isgenerator(result):
-                chunks.extend(result)
+                # drain sync generators on a thread (same loop-starvation
+                # concern as handle_request)
+                chunks.extend(await asyncio.to_thread(list, result))
             else:
                 if inspect.iscoroutine(result):
                     result = await result

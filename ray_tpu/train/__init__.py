@@ -7,7 +7,12 @@ The in-framework parallelism library (DP/FSDP/TP/PP/EP/CP) lives in
 ray_tpu.parallel + ray_tpu.train.spmd.
 """
 
-from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, StorageContext
+from ray_tpu.train.checkpoint import (
+    AsyncCheckpointWriter,
+    Checkpoint,
+    CheckpointManager,
+    StorageContext,
+)
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -27,12 +32,21 @@ from ray_tpu.train.controller import (
     TrainController,
     TrainingFailedError,
 )
+from ray_tpu.train.scaling import (
+    FixedScalingPolicy,
+    FunctionScalingPolicy,
+    ResizeDecision,
+    ScalingPolicy,
+)
 from ray_tpu.train.sync import SynchronizationActor
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup
 
 __all__ = [
+    "AsyncCheckpointWriter",
     "Checkpoint", "CheckpointConfig", "CheckpointManager", "DataParallelTrainer",
+    "FixedScalingPolicy", "FunctionScalingPolicy", "ResizeDecision",
+    "ScalingPolicy",
     "FailureConfig", "JaxTrainer", "RayTrainWorker", "Result", "RunConfig",
     "RunState", "ScalingConfig", "StorageContext", "SynchronizationActor",
     "TrainContext", "TrainController", "TrainingFailedError", "WorkerGroup",

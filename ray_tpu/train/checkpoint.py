@@ -89,13 +89,15 @@ class StorageContext:
         return os.path.join(self.run_path, f"checkpoint_{index:06d}")
 
     def persist(self, checkpoint: Checkpoint, index: int) -> Checkpoint:
-        """Copy a worker-local checkpoint dir into persistent storage."""
+        """Move (same filesystem) or copy a worker-local checkpoint dir into
+        persistent storage — moving avoids leaving dead payload dirs behind
+        in /tmp for the life of the run."""
         dest = self.checkpoint_dir(index)
         if os.path.abspath(checkpoint.path) == os.path.abspath(dest):
             return checkpoint
         if os.path.exists(dest):
             shutil.rmtree(dest)
-        shutil.copytree(checkpoint.path, dest)
+        _move_or_copy(checkpoint.path, dest)
         return Checkpoint(dest)
 
 
@@ -135,6 +137,31 @@ class CheckpointManager:
             self.latest = tracked
             self._evict()
             return persisted
+
+    def register_sharded(self, rank_checkpoints: list, metrics: dict,
+                         world_size: int) -> Checkpoint:
+        """Merge per-rank shard checkpoints into ONE sharded checkpoint
+        (layout: shard-{rank:05d}/ subdirs + metadata), the controller-side
+        half of Orbax-style distributed writes (SURVEY.md §5.4). Ranks wrote
+        their shards in parallel (each a local dir); here they only get
+        moved under a common index directory."""
+        with self._lock:
+            idx = self._index
+            self._index += 1
+            dest = self._storage.checkpoint_dir(idx)
+            os.makedirs(dest, exist_ok=True)
+            for rank, ckpt in rank_checkpoints:
+                _move_or_copy(ckpt.path,
+                              os.path.join(dest, f"shard-{rank:05d}"))
+            merged = Checkpoint(dest)
+            merged.update_metadata(
+                {"sharded": True, "world_size": world_size,
+                 "num_shards": len(rank_checkpoints)})
+            tracked = _TrackedCheckpoint(merged, dict(metrics), idx)
+            self._checkpoints.append(tracked)
+            self.latest = tracked
+            self._evict()
+            return merged
 
     def _score(self, t: _TrackedCheckpoint):
         if self._score_attr is None:
@@ -196,6 +223,64 @@ class CheckpointManager:
                     if state["latest"] == rec["index"]:
                         mgr.latest = t
         return mgr
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint writes: the train step keeps running while the
+    payload lands on disk (the async half of Orbax-style checkpointing,
+    SURVEY.md §5.4). One write in flight at a time; a new write waits for
+    the previous one, and report() fires only after the payload is durable
+    (so the controller never copies a half-written directory).
+
+    Usage inside a train fn:
+        writer = AsyncCheckpointWriter()
+        ...
+        writer.write_and_report(save_fn, metrics)   # save_fn(dir_path)
+        ...
+        writer.finish()   # before returning from the train fn
+    """
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(1, thread_name_prefix="ckpt-writer")
+        self._last = None
+
+    def write_and_report(self, save_fn, metrics: dict) -> None:
+        from ray_tpu.train import context as _ctx
+
+        self.wait()
+        ctx = _ctx.get_context()
+
+        def job():
+            path = tempfile.mkdtemp(prefix="ckpt_async_")
+            save_fn(path)
+            ctx.report(dict(metrics), Checkpoint(path))
+
+        self._last = self._pool.submit(job)
+
+    def wait(self) -> None:
+        if self._last is not None:
+            self._last.result()
+            self._last = None
+
+    def finish(self) -> None:
+        self.wait()
+        self._pool.shutdown(wait=True)
+
+
+def _move_or_copy(src: str, dest: str) -> None:
+    """Move single-use temp payloads (frees the source — no dead dirs
+    accumulating in /tmp for the life of the run); copy anything the caller
+    might still reference (non-temp paths)."""
+    tmp = os.path.abspath(tempfile.gettempdir())
+    src_abs = os.path.abspath(src)
+    if src_abs.startswith(tmp + os.sep):
+        try:
+            os.replace(src_abs, dest)
+            return
+        except OSError:
+            pass
+    shutil.copytree(src_abs, dest, dirs_exist_ok=True)
 
 
 def new_run_name() -> str:

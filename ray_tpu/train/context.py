@@ -52,6 +52,11 @@ class TrainContext:
         self._seq_lock = threading.Lock()
         self._stop_event = threading.Event()
         self._latest_checkpoint: Optional[Checkpoint] = None
+        # sync mode: report() blocks until the controller drains the queue
+        # (reference function-trainable semantics — the driver paces the
+        # trial, so scheduler STOP decisions land between iterations)
+        self._sync_report = False
+        self._drained = threading.Condition()
 
     # -- topology ---------------------------------------------------------
     def get_world_rank(self) -> int:
@@ -103,6 +108,13 @@ class TrainContext:
         if checkpoint is not None:
             self._latest_checkpoint = checkpoint
         self._report_queue.put(TrainingReport(dict(metrics), checkpoint, seq))
+        if self._sync_report:
+            with self._drained:
+                while not self._report_queue.empty() and \
+                        not self._stop_event.is_set():
+                    self._drained.wait(timeout=0.5)
+            if self._stop_event.is_set():
+                raise SystemExit("training stopped by controller")
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         """Checkpoint to resume from (set by the controller on restart)."""
@@ -118,7 +130,11 @@ class TrainContext:
             try:
                 out.append(self._report_queue.get_nowait())
             except queue.Empty:
-                return out
+                break
+        if out and self._sync_report:
+            with self._drained:
+                self._drained.notify_all()
+        return out
 
 
 _context: Optional[TrainContext] = None

@@ -35,6 +35,7 @@ class RunState(enum.Enum):
     SCHEDULING = "SCHEDULING"
     RUNNING = "RUNNING"
     RESTARTING = "RESTARTING"
+    RESIZING = "RESIZING"
     ERRORED = "ERRORED"
     FINISHED = "FINISHED"
 
@@ -75,13 +76,18 @@ class TrainController:
                  datasets: Optional[dict] = None,
                  backend_fn: Optional[Callable] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None,
+                 scaling_policy=None,
                  poll_interval_s: float = 0.05):
+        from ray_tpu.train.scaling import FixedScalingPolicy
+
         self._train_fn = train_fn
         self._train_fn_config = train_fn_config
         self._scaling = scaling_config
         self._run_config = run_config
         self._datasets = datasets or {}
         self._backend_fn = backend_fn
+        self._scaling_policy = scaling_policy or FixedScalingPolicy()
+        self._num_workers = scaling_config.num_workers
         self._poll_interval_s = poll_interval_s
 
         self._run_name = run_config.name or new_run_name()
@@ -98,13 +104,23 @@ class TrainController:
         self._latest_metrics: Optional[dict] = None
         self._resume_checkpoint = resume_from_checkpoint
         self._error: Optional[str] = None
+        # seq -> {rank: report}; a step's checkpoints may arrive across
+        # several polls — only register once the gang's reports are complete
+        self._pending_reports: dict[int, dict[int, object]] = {}
 
     # -- state transitions -------------------------------------------------
     def _start_worker_group(self):
         self.state = RunState.SCHEDULING
-        wg = WorkerGroup(self._scaling, experiment_name=self._run_name,
+        self._num_workers = \
+            self._scaling_policy.make_decision_for_non_running_worker_group(
+                self._num_workers)
+        import dataclasses as _dc
+        scaling = _dc.replace(self._scaling, num_workers=self._num_workers) \
+            if self._num_workers != self._scaling.num_workers \
+            else self._scaling
+        wg = WorkerGroup(scaling, experiment_name=self._run_name,
                          trial_dir=self._storage.run_path)
-        shards = self._split_datasets(self._scaling.num_workers)
+        shards = self._split_datasets(self._num_workers)
         resume = self._resume_checkpoint
         if self._ckpt_manager.latest is not None:
             resume = self._ckpt_manager.latest.checkpoint
@@ -127,27 +143,48 @@ class TrainController:
         return per_rank
 
     def _handle_reports(self, statuses) -> None:
-        """Collect per-rank reports; persist checkpoints (any rank may attach
-        one — rank 0 wins ties within a step, matching reference
-        report_handler)."""
-        by_seq: dict[int, list] = {}
+        """Collect per-rank reports; persist checkpoints. A step's reports
+        can straggle across polls, so they buffer in _pending_reports until
+        every rank has reported that seq (reference: the SynchronizationActor
+        barrier makes report a collective)."""
+        world = len(statuses)
         for rank, st in enumerate(statuses):
             if st is None:
                 continue
             for rep in st.reports:
-                by_seq.setdefault(rep.seq, []).append((rank, rep))
-        for seq in sorted(by_seq):
-            ranked = sorted(by_seq[seq])
-            metrics = ranked[0][1].metrics
-            self._latest_metrics = metrics
-            ckpt = None
-            for rank, rep in ranked:
-                if rep.checkpoint is not None:
-                    ckpt = rep.checkpoint
-                    break
-            if ckpt is not None:
-                self._ckpt_manager.register(ckpt, metrics)
-                self._ckpt_manager.write_state()
+                self._pending_reports.setdefault(rep.seq, {})[rank] = rep
+        for seq in sorted(self._pending_reports):
+            if len(self._pending_reports[seq]) < world:
+                continue
+            self._process_seq(seq, self._pending_reports.pop(seq), world)
+
+    def _flush_pending_reports(self, world: int) -> None:
+        """Register whatever arrived for incomplete steps (gang finished,
+        failed, or is being resized)."""
+        for seq in sorted(self._pending_reports):
+            self._process_seq(seq, self._pending_reports.pop(seq), world)
+
+    def _process_seq(self, seq: int, group: dict, world: int) -> None:
+        ranked = sorted(group.items())
+        metrics = ranked[0][1].metrics
+        self._latest_metrics = metrics
+        with_ckpt = [(rank, rep.checkpoint) for rank, rep in ranked
+                     if rep.checkpoint is not None]
+        sharded = [rc for rc in with_ckpt
+                   if rc[1].get_metadata().get("shard")]
+        if len(sharded) > 1:
+            # distributed checkpoint (EXPLICIT opt-in: each rank marked its
+            # payload with metadata {"shard": True}): merge the per-rank
+            # shards (Orbax-style per-host writes, SURVEY.md §5.4) into one
+            # dir: shard-{rank:05d}/...
+            self._ckpt_manager.register_sharded(
+                sharded, metrics, world_size=world)
+            self._ckpt_manager.write_state()
+        elif with_ckpt:
+            # default: rank 0's (full) checkpoint wins — reference
+            # report_handler semantics
+            self._ckpt_manager.register(with_ckpt[0][1], metrics)
+            self._ckpt_manager.write_state()
 
     def _teardown_workers(self):
         if self._worker_group is not None:
@@ -170,7 +207,8 @@ class TrainController:
             best_checkpoints=best)
 
     def _step(self):
-        if self.state in (RunState.INITIALIZING, RunState.RESTARTING):
+        if self.state in (RunState.INITIALIZING, RunState.RESTARTING,
+                          RunState.RESIZING):
             try:
                 self._start_worker_group()
             except Exception as e:  # noqa: BLE001 - scheduling failure
@@ -191,13 +229,31 @@ class TrainController:
                 self._on_failure(msg, full)
                 return
             if all(s.finished for s in statuses):
+                self._flush_pending_reports(len(statuses))
                 self._teardown_workers()
                 self.state = RunState.FINISHED
+                return
+            # elastic resize (restart-the-world; reference controller
+            # Resizing state, scaling_policy.py ResizeDecision)
+            from ray_tpu.train.scaling import ResizeDecision
+            decision = \
+                self._scaling_policy.make_decision_for_running_worker_group(
+                    statuses, self._num_workers)
+            if isinstance(decision, ResizeDecision) and \
+                    decision.num_workers != self._num_workers:
+                logger.info("resizing worker group %d -> %d (restart + "
+                            "resume from latest checkpoint)",
+                            self._num_workers, decision.num_workers)
+                self._flush_pending_reports(len(statuses))
+                self._teardown_workers()
+                self._num_workers = decision.num_workers
+                self.state = RunState.RESIZING
                 return
             time.sleep(self._poll_interval_s)
 
     def _on_failure(self, msg: str, full: str = ""):
         logger.warning("training failure: %s", msg)
+        self._flush_pending_reports(self._num_workers)
         self._teardown_workers()
         decision = self._failure_policy.make_decision(msg)
         if decision == FailureDecision.RETRY:

@@ -1,0 +1,62 @@
+"""Scaling policies: elastic resize decisions for the Train controller.
+
+TPU-native analog of the reference's scaling policy layer
+(/root/reference/python/ray/train/v2/_internal/execution/scaling_policy/
+scaling_policy.py — ResizeDecision/NoopDecision, consumed by the controller
+at controller.py:421-433; fixed.py is the default). On TPU a resize is
+restart-the-world (SURVEY.md §7 hard part 4): JAX's distributed runtime
+cannot resize in place, so every ResizeDecision tears the gang down and
+restarts it at the new size with resume-from-latest-checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ResizeDecision:
+    """Restart the worker group at `num_workers` ranks."""
+
+    num_workers: int
+
+
+class NoopDecision:
+    """Keep running as-is."""
+
+
+NOOP = NoopDecision()
+
+
+class ScalingPolicy:
+    """Decides gang sizing; subclass to make training elastic."""
+
+    def make_decision_for_non_running_worker_group(
+            self, requested_num_workers: int) -> int:
+        """Size to start (or restart) the gang at."""
+        return requested_num_workers
+
+    def make_decision_for_running_worker_group(
+            self, statuses, num_workers: int):
+        """Called every poll while RUNNING; return NOOP or ResizeDecision."""
+        return NOOP
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    """Never resizes (reference fixed.py)."""
+
+
+class FunctionScalingPolicy(ScalingPolicy):
+    """Adapter: `fn(statuses, num_workers) -> Optional[int]` (new size or
+    None). Convenient for tests and simple autoscaling hooks."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def make_decision_for_running_worker_group(self, statuses,
+                                               num_workers: int):
+        target: Optional[int] = self._fn(statuses, num_workers)
+        if target is None or target == num_workers:
+            return NOOP
+        return ResizeDecision(num_workers=target)

@@ -26,7 +26,8 @@ class DataParallelTrainer:
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[dict] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None,
-                 backend_fn: Optional[Callable] = None):
+                 backend_fn: Optional[Callable] = None,
+                 scaling_policy=None):
         self._train_loop = train_loop_per_worker
         self._train_loop_config = train_loop_config
         self._scaling_config = scaling_config or ScalingConfig()
@@ -34,6 +35,7 @@ class DataParallelTrainer:
         self._datasets = datasets
         self._resume_from_checkpoint = resume_from_checkpoint
         self._backend_fn = backend_fn
+        self._scaling_policy = scaling_policy
 
     def fit(self) -> Result:
         controller = TrainController(
@@ -43,7 +45,8 @@ class DataParallelTrainer:
             run_config=self._run_config,
             datasets=self._datasets,
             backend_fn=self._backend_fn,
-            resume_from_checkpoint=self._resume_from_checkpoint)
+            resume_from_checkpoint=self._resume_from_checkpoint,
+            scaling_policy=self._scaling_policy)
         return controller.run()
 
 

@@ -56,13 +56,14 @@ class RayTrainWorker:
                      trial_id: str = "", trial_dir: str = "",
                      hparams: Optional[dict] = None,
                      dataset_shards: Optional[dict] = None,
-                     resume_checkpoint=None) -> dict:
+                     resume_checkpoint=None, sync_report: bool = False) -> dict:
         self._ctx = TrainContext(
             world_rank=world_rank, world_size=world_size,
             local_rank=local_rank, local_world_size=local_world_size,
             node_rank=node_rank, experiment_name=experiment_name,
             trial_name=trial_name, trial_id=trial_id, trial_dir=trial_dir,
             dataset_shards=dataset_shards, hparams=hparams)
+        self._ctx._sync_report = sync_report
         if resume_checkpoint is not None:
             self._ctx._latest_checkpoint = resume_checkpoint
         _set_context(self._ctx)

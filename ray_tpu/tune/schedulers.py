@@ -54,10 +54,18 @@ class AsyncHyperBandScheduler(TrialScheduler):
         self._rungs.append(max_t)
         self._recorded: dict[float, list[float]] = {r: [] for r in self._rungs}
         self._trial_rung: dict[str, int] = {}
+        self._last_recorded: dict[str, tuple[float, float]] = {}
 
     def _value(self, metrics) -> float:
         v = metrics[self._metric]
         return v if self._mode == "max" else -v
+
+    def _cutoff(self, milestone: float) -> float | None:
+        recorded = self._recorded[milestone]
+        if len(recorded) < self._rf:
+            return None
+        cutoff_idx = max(0, int(len(recorded) / self._rf) - 1)
+        return sorted(recorded, reverse=True)[cutoff_idx]
 
     def on_result(self, trial, metrics: dict) -> str:
         t = metrics.get(self._time_attr)
@@ -69,16 +77,25 @@ class AsyncHyperBandScheduler(TrialScheduler):
         if rung_idx >= len(self._rungs):
             return STOP
         milestone = self._rungs[rung_idx]
-        if t < milestone:
+        if t >= milestone:
+            value = self._value(metrics)
+            self._recorded[milestone].append(value)
+            self._trial_rung[trial.trial_id] = rung_idx + 1
+            self._last_recorded[trial.trial_id] = (milestone, value)
+            cutoff = self._cutoff(milestone)
+            if cutoff is not None and value < cutoff:
+                return STOP
             return CONTINUE
-        value = self._value(metrics)
-        recorded = self._recorded[milestone]
-        recorded.append(value)
-        self._trial_rung[trial.trial_id] = rung_idx + 1
-        if len(recorded) >= self._rf:
-            cutoff_idx = max(0, int(len(recorded) / self._rf) - 1)
-            cutoff = sorted(recorded, reverse=True)[cutoff_idx]
-            if value < cutoff:
+        # Retroactive cut (determinism under concurrency): a trial that
+        # recorded at its last rung BEFORE its peers may only later fall
+        # below the rung's top-1/rf cutoff — re-check against the rung's
+        # CURRENT population every report so the decision doesn't depend on
+        # which trial happened to report first.
+        last = self._last_recorded.get(trial.trial_id)
+        if last is not None:
+            last_milestone, last_value = last
+            cutoff = self._cutoff(last_milestone)
+            if cutoff is not None and last_value < cutoff:
                 return STOP
         return CONTINUE
 

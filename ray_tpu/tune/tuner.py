@@ -136,7 +136,7 @@ class TuneController:
             node_rank=0, experiment_name=self._run_name,
             trial_name=trial.trial_id, trial_id=trial.trial_id,
             trial_dir=trial_dir, hparams=trial.config,
-            resume_checkpoint=resume_from))
+            resume_checkpoint=resume_from, sync_report=True))
         ray_tpu.get(trial.actor.run_train_fn.remote(
             self._trainable, trial.config))
         trial.state = "RUNNING"

@@ -1,0 +1,91 @@
+"""Control-plane fault-tolerance tests (reference: GCS FT —
+redis_store_client.cc storage, gcs_init_data.cc replay,
+NotifyGCSRestart node_manager.proto:406 reconnect)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_meta_store_roundtrip(tmp_path):
+    from ray_tpu.core.meta_store import SqliteMetaStore
+
+    path = str(tmp_path / "meta.db")
+    s = SqliteMetaStore(path)
+    s.save("kv", b"a", {"x": 1})
+    s.save("kv", b"b", [1, 2, 3])
+    s.save("actor", b"a", "actor-a")
+    s.delete("kv", b"b")
+    s.close()
+
+    s2 = SqliteMetaStore(path)
+    assert dict(s2.load_all("kv")) == {b"a": {"x": 1}}
+    assert dict(s2.load_all("actor")) == {b"a": "actor-a"}
+    s2.close()
+
+
+def test_cp_restart_preserves_state(tmp_path):
+    """Kill-and-restart the control plane: named actors, the KV store, and
+    placement groups survive; live agents re-register; the named actor is
+    still callable (its worker process never died)."""
+    from ray_tpu.core.cluster import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(store_path=str(tmp_path / "cp.db"))
+    cluster.add_node(num_cpus=4)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="survivor", lifetime="detached").remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=30) == 1
+
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        rt.cp_client.call("kv_put", {"key": "ft_key", "value": b"ft_value"},
+                          timeout=10.0)
+
+        pg = ray_tpu.placement_group([{"CPU": 1}])
+        assert pg.ready(timeout=30)
+
+        # ---- crash + restart on the same address ----
+        addr = cluster.kill_control_plane()
+        time.sleep(0.2)
+        cluster.restart_control_plane(addr)
+
+        # agents re-register within ~1s heartbeat; actor state replayed
+        deadline = time.monotonic() + 15.0
+        nodes = []
+        while time.monotonic() < deadline:
+            try:
+                nodes = ray_tpu.nodes()
+                if any(n["alive"] for n in nodes):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert any(n["alive"] for n in nodes), "agent never re-registered"
+
+        # KV survived
+        assert rt.cp_client.call_with_retry(
+            "kv_get", {"key": "ft_key"}, timeout=10.0) == b"ft_value"
+
+        # named actor survived AND kept its memory (same worker process)
+        c2 = ray_tpu.get_actor("survivor", timeout=15.0)
+        assert ray_tpu.get(c2.incr.remote(), timeout=30) == 2
+
+        # PG record survived
+        pgs = rt.cp_client.call_with_retry("list_pgs", None, timeout=10.0)
+        assert any(p["state"] == "CREATED" for p in pgs)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -224,3 +224,81 @@ def test_worker_group_execute(ray_start_regular):
         assert out[0] != out[1]  # distinct worker processes
     finally:
         wg.shutdown()
+
+
+def test_elastic_resize_resumes_from_checkpoint(ray_start_regular, tmp_path):
+    """ScalingPolicy resizes 4 -> 2 mid-run (restart-the-world); the resumed
+    2-rank gang continues from the checkpoint instead of step 0, and every
+    rank's shard lands in a merged sharded checkpoint."""
+    import tempfile
+
+    from ray_tpu.train import FunctionScalingPolicy
+
+    def train_fn(config):
+        ctx = rt_train.get_context()
+        start = 0
+        ckpt = rt_train.get_checkpoint()
+        if ckpt is not None:
+            meta = ckpt.get_metadata()
+            assert meta.get("sharded"), "expected merged sharded checkpoint"
+            shard0 = os.path.join(ckpt.path, "shard-00000")
+            start = int(open(os.path.join(shard0, "step.txt")).read()) + 1
+        import time as _time
+        for step in range(start, 6):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step))
+            with open(os.path.join(d, "rank.txt"), "w") as f:
+                f.write(str(ctx.get_world_rank()))
+            ckpt = Checkpoint(d)
+            # opt into the merged sharded layout (every rank's payload is a
+            # shard, not a full checkpoint)
+            ckpt.update_metadata({"shard": True})
+            rt_train.report(
+                {"step": step, "world": ctx.get_world_size()},
+                checkpoint=ckpt)
+            # slow enough that the controller polls mid-run (the resize
+            # decision must land before the run finishes)
+            _time.sleep(0.3)
+
+    def decide(statuses, num_workers):
+        # once any rank reported step >= 2 at world 4, shrink to 2
+        if num_workers == 4:
+            for st in statuses:
+                if st is not None and st.reports:
+                    if any(r.metrics.get("step", 0) >= 2 for r in st.reports):
+                        return 2
+        return None
+
+    trainer = DataParallelTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=4),
+        run_config=_run_cfg(tmp_path),
+        scaling_policy=FunctionScalingPolicy(decide))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 5
+    assert result.metrics["world"] == 2  # finished at the resized world size
+    # the final checkpoint is sharded with 2 shards
+    meta = result.checkpoint.get_metadata()
+    assert meta.get("sharded") and meta["num_shards"] == 2
+
+
+def test_async_checkpoint_writer(ray_start_regular, tmp_path):
+    from ray_tpu.train import AsyncCheckpointWriter
+
+    def train_fn(config):
+        writer = AsyncCheckpointWriter()
+        for step in range(3):
+            def save(path, step=step):
+                with open(os.path.join(path, "step.txt"), "w") as f:
+                    f.write(str(step))
+            writer.write_and_report(save, {"step": step})
+        writer.finish()
+
+    trainer = DataParallelTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=1),
+        run_config=_run_cfg(tmp_path))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert open(os.path.join(result.checkpoint.path, "step.txt")).read() == "2"
