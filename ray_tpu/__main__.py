@@ -1,0 +1,6 @@
+"""`python -m ray_tpu <cmd>` — the ray-tpu CLI entry point
+(reference: `ray` console script, python/ray/scripts/scripts.py)."""
+
+from ray_tpu.scripts.cli import main
+
+main()

@@ -1,0 +1,20 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+TPU-native analog of the reference's autoscaler v2
+(/root/reference/python/ray/autoscaler/v2/autoscaler.py:169
+update_autoscaling_state — resource demand from the GCS drives NodeProvider
+launches; per-cloud providers under autoscaler/aws|gcp|kuberay). Here the
+demand source is the control plane's pending actors + placement-group
+bundles, and providers launch whole TPU slices (the scaling unit on TPU,
+not single VMs).
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    GCETPUNodeProvider,
+    NodeProvider,
+)
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "FakeNodeProvider",
+           "GCETPUNodeProvider", "NodeProvider"]

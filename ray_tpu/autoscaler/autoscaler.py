@@ -1,0 +1,137 @@
+"""The autoscaling loop.
+
+Reference: python/ray/autoscaler/v2/autoscaler.py:169
+(update_autoscaling_state: read demand → compute target → instance manager
+launches/terminates) + monitor.py's periodic drive. Demand = the control
+plane's pending actors and placement-group bundles (get_pending_demand);
+supply = registered alive nodes. One node type per autoscaler for now (a
+TPU slice is the natural unit); layered node types can stack autoscalers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+
+from ray_tpu.core.rpc import RpcClient
+from ray_tpu.core.scheduler import fits
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    min_workers: int = 0
+    max_workers: int = 4
+    # what one launched node provides (must match the provider's nodes)
+    node_resources: dict = dataclasses.field(default_factory=dict)
+    node_labels: dict = dataclasses.field(default_factory=dict)
+    idle_timeout_s: float = 60.0
+    poll_interval_s: float = 1.0
+
+
+class Autoscaler:
+    def __init__(self, cp_addr: tuple[str, int], provider,
+                 config: AutoscalerConfig):
+        self._cp = RpcClient(tuple(cp_addr), name="autoscaler")
+        self._provider = provider
+        self._cfg = config
+        self._stopped = threading.Event()
+        self._idle_since: dict[str, float] = {}
+        self._node_names: list[str] = []
+        self._thread: threading.Thread | None = None
+        self.num_launched = 0
+        self.num_terminated = 0
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="autoscaler", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # ---- one reconciliation pass (public for tests) --------------------
+    def update(self) -> None:
+        demand = self._cp.call_with_retry("get_pending_demand", None,
+                                          timeout=10.0)
+        nodes = self._cp.call_with_retry("get_nodes", None, timeout=10.0)
+        alive = [n for n in nodes if n["alive"]]
+        shapes = list(demand["actor_shapes"]) + list(demand["bundle_shapes"])
+
+        # how many pending shapes fit NOWHERE in the current cluster?
+        unplaceable = 0
+        avail = [dict(n["available"]) for n in alive]
+        for shape in shapes:
+            placed = False
+            for a in avail:
+                if fits(a, shape):
+                    for k, v in shape.items():
+                        a[k] = a.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unplaceable += 1
+
+        cur = self._provider.non_terminated_nodes()
+        want_new = 0
+        if unplaceable > 0 and self._cfg.node_resources:
+            import math
+            per_node_cap = max(
+                1, int(min(self._cfg.node_resources.get(k, 0) / v
+                           for s in shapes[:1] for k, v in s.items()
+                           if v > 0) or 1))
+            want_new = min(math.ceil(unplaceable / per_node_cap),
+                           self._cfg.max_workers - len(cur))
+        want_new = max(want_new, self._cfg.min_workers - len(cur))
+        for _ in range(max(0, want_new)):
+            name = self._provider.create_node(
+                {"resources": dict(self._cfg.node_resources),
+                 "labels": dict(self._cfg.node_labels)})
+            self.num_launched += 1
+            logger.info("autoscaler launched node %s (unplaceable=%d)",
+                        name, unplaceable)
+
+        # scale down: provider nodes idle (full availability) past timeout
+        now = time.monotonic()
+        by_addr = {}
+        for n in alive:
+            by_addr[tuple(n["addr"])] = n
+        for name in list(self._provider.non_terminated_nodes()):
+            agent = getattr(self._provider, "agent", lambda _n: None)(name)
+            if agent is None:
+                continue  # cloud provider: idle detection via CP only
+            node = by_addr.get(tuple(agent.addr))
+            idle = (node is not None
+                    and node["available"] == node["resources"])
+            if not idle:
+                self._idle_since.pop(name, None)
+                continue
+            first = self._idle_since.setdefault(name, now)
+            over_min = len(self._provider.non_terminated_nodes()) \
+                > self._cfg.min_workers
+            if over_min and now - first >= self._cfg.idle_timeout_s:
+                logger.info("autoscaler terminating idle node %s", name)
+                try:
+                    self._cp.call("drain_node",
+                                  {"node_id": agent.node_id}, timeout=10.0)
+                except Exception:  # noqa: BLE001
+                    pass
+                self._provider.terminate_node(name)
+                self._idle_since.pop(name, None)
+                self.num_terminated += 1
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self.update()
+            except Exception:  # noqa: BLE001
+                logger.exception("autoscaler update failed")
+            self._stopped.wait(self._cfg.poll_interval_s)

@@ -1,0 +1,108 @@
+"""Node providers: how the autoscaler actually gets machines.
+
+Reference: python/ray/autoscaler/node_provider.py (ABC) + per-cloud
+implementations; the fake provider mirrors
+autoscaler/_private/fake_multi_node/node_provider.py — "launching" a node
+starts a real in-process NodeAgent, so autoscaler end-to-end tests run
+without a cloud (SURVEY.md §4 keystone).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class NodeProvider:
+    """Launch/terminate worker nodes for one node type."""
+
+    def create_node(self, node_config: dict) -> str:
+        """Start a node; returns a provider-scoped node name."""
+        raise NotImplementedError
+
+    def terminate_node(self, name: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list[str]:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Launches real in-process NodeAgents against a control plane."""
+
+    def __init__(self, cp_addr: tuple[str, int]):
+        self._cp_addr = tuple(cp_addr)
+        self._agents: dict[str, object] = {}
+        self._counter = 0
+
+    def create_node(self, node_config: dict) -> str:
+        from ray_tpu.core.node_agent import NodeAgent
+
+        self._counter += 1
+        name = f"fake-{self._counter}"
+        agent = NodeAgent(self._cp_addr,
+                          resources=dict(node_config.get("resources") or {}),
+                          labels=dict(node_config.get("labels") or {}))
+        self._agents[name] = agent
+        return name
+
+    def terminate_node(self, name: str) -> None:
+        agent = self._agents.pop(name, None)
+        if agent is not None:
+            agent.stop()
+
+    def non_terminated_nodes(self) -> list[str]:
+        return list(self._agents)
+
+    def agent(self, name: str):
+        return self._agents.get(name)
+
+
+class GCETPUNodeProvider(NodeProvider):
+    """GCE/GKE TPU slice provider (the cloud target for this framework —
+    reference: autoscaler/gcp/ + TPU pod scheduling). Shells out to
+    `gcloud compute tpus tpu-vm` so no SDK dependency is needed; requires
+    credentials + network, so everything is lazy and failures are explicit.
+    """
+
+    def __init__(self, project: str, zone: str, cluster_address: str,
+                 accelerator_type: str = "v5litepod-8",
+                 runtime_version: str = "tpu-ubuntu2204-base"):
+        self.project = project
+        self.zone = zone
+        self.cluster_address = cluster_address
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self._nodes: set[str] = set()
+        self._counter = 0
+
+    def _gcloud(self, *args: str) -> str:
+        import subprocess
+        out = subprocess.run(
+            ["gcloud", "compute", "tpus", "tpu-vm", *args,
+             f"--project={self.project}", f"--zone={self.zone}",
+             "--format=json"],
+            capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(f"gcloud failed: {out.stderr[-500:]}")
+        return out.stdout
+
+    def create_node(self, node_config: dict) -> str:
+        self._counter += 1
+        name = node_config.get("name") or f"ray-tpu-node-{self._counter}"
+        accel = node_config.get("accelerator_type", self.accelerator_type)
+        self._gcloud(
+            "create", name, f"--accelerator-type={accel}",
+            f"--version={node_config.get('runtime_version', self.runtime_version)}")
+        # bootstrap: every TPU VM host joins as a worker node
+        self._gcloud(
+            "ssh", name, "--worker=all", "--command",
+            f"python -m ray_tpu start --address {self.cluster_address}")
+        self._nodes.add(name)
+        return name
+
+    def terminate_node(self, name: str) -> None:
+        self._gcloud("delete", name, "--quiet")
+        self._nodes.discard(name)
+
+    def non_terminated_nodes(self) -> list[str]:
+        return sorted(self._nodes)

@@ -112,7 +112,8 @@ class ActorClass:
             max_task_retries=int(options.get("max_task_retries", 0)),
             max_concurrency=int(options.get("max_concurrency", 1000 if is_async else 1)),
             is_async=is_async,
-            strategy=_build_strategy(options))
+            strategy=_build_strategy(options),
+            runtime_env=options.get("runtime_env"))
         handle = ActorHandle(actor_id, self._cls.__name__,
                              max_task_retries=int(options.get("max_task_retries", 0)))
         return handle

@@ -55,6 +55,11 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
          job_name: str = "") -> "RuntimeContext":
     """Start (head mode) or connect to (address=...) a cluster."""
     global _runtime, _head
+    if address is None:
+        # job drivers launched by `ray-tpu submit` / the job supervisor get
+        # the cluster address through the environment (reference:
+        # RAY_ADDRESS)
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
     with _lock:
         if _runtime is not None:
             return RuntimeContext(_runtime)

@@ -69,6 +69,12 @@ class Config:
     # in-memory only (CP restart loses the cluster; ref: redis_store_client).
     cp_store_path: str = ""
 
+    # --- memory / OOM protection (ref: memory_monitor.h:52) ---
+    # Kill the newest killable worker when host memory use crosses this
+    # fraction; 0 disables the monitor.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
+
     # --- fault tolerance ---
     task_max_retries: int = 3
     actor_max_restarts: int = 0

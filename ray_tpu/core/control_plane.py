@@ -94,6 +94,11 @@ class ControlPlane:
         self._pool = ClientPool("cp")
         self._pending_actors: list[ActorID] = []
         self._pending_pgs: list[PlacementGroupID] = []
+        # snapshot of work the scheduling loop has taken out of the pending
+        # lists for an in-flight placement pass — without it, an autoscaler
+        # demand poll during the pass reads zero demand and scales down
+        self._placing_actors: list[ActorID] = []
+        self._placing_pgs: list[PlacementGroupID] = []
         self._wake = threading.Condition()
         self._stopped = threading.Event()
         self._task_events: list[dict] = []  # GcsTaskManager-style sink (bounded)
@@ -198,6 +203,23 @@ class ControlPlane:
                  "resources": dict(n.view.total), "available": dict(n.view.available),
                  "labels": dict(n.view.labels)}
                 for n in self._nodes.values()]
+
+    def _h_get_pending_demand(self, body):
+        """Unplaceable work for the autoscaler (ref: autoscaler.proto:376
+        AutoscalerStateService resource demand): resource shapes of pending
+        actors and pending placement-group bundles."""
+        with self._lock:
+            actor_ids = dict.fromkeys(
+                list(self._pending_actors) + list(self._placing_actors))
+            actor_shapes = [dict(self._actors[a].spec.resources)
+                            for a in actor_ids if a in self._actors]
+            bundle_shapes = []
+            for pg_id in dict.fromkeys(
+                    list(self._pending_pgs) + list(self._placing_pgs)):
+                pg = self._pgs.get(pg_id)
+                if pg is not None:
+                    bundle_shapes.extend(dict(b) for b in pg.bundles)
+        return {"actor_shapes": actor_shapes, "bundle_shapes": bundle_shapes}
 
     def _h_drain_node(self, body):
         """(ref: node_manager.proto:448 DrainRaylet)"""
@@ -512,17 +534,22 @@ class ControlPlane:
             if not self._pending_actors:
                 return False
             pending, self._pending_actors = self._pending_actors, []
+            self._placing_actors = list(pending)
         progressed = False
-        for aid in pending:
-            with self._lock:
-                info = self._actors.get(aid)
-                if info is None or info.state not in (ActorState.PENDING, ActorState.RESTARTING):
-                    continue
-            if self._try_schedule_actor(info):
-                progressed = True
-            else:
+        try:
+            for aid in pending:
                 with self._lock:
-                    self._pending_actors.append(aid)
+                    info = self._actors.get(aid)
+                    if info is None or info.state not in (ActorState.PENDING, ActorState.RESTARTING):
+                        continue
+                if self._try_schedule_actor(info):
+                    progressed = True
+                else:
+                    with self._lock:
+                        self._pending_actors.append(aid)
+        finally:
+            with self._lock:
+                self._placing_actors = []
         return progressed
 
     def _try_schedule_actor(self, info: ActorInfo) -> bool:
@@ -555,6 +582,8 @@ class ControlPlane:
             return False
         cp_node = self._nodes.get(node.node_id)
         try:
+            if spec.runtime_env:
+                lease_body["runtime_env"] = spec.runtime_env
             reply = self._pool.get(node.addr).call_with_retry(
                 "lease_worker", {**lease_body, "for_actor": info.actor_id},
                 timeout=get_config().lease_timeout_s)
@@ -606,17 +635,22 @@ class ControlPlane:
             if not self._pending_pgs:
                 return False
             pending, self._pending_pgs = self._pending_pgs, []
+            self._placing_pgs = list(pending)
         progressed = False
-        for pg_id in pending:
-            with self._lock:
-                pg = self._pgs.get(pg_id)
-                if pg is None or pg.state != PGState.PENDING:
-                    continue
-            if self._try_schedule_pg(pg):
-                progressed = True
-            else:
+        try:
+            for pg_id in pending:
                 with self._lock:
-                    self._pending_pgs.append(pg_id)
+                    pg = self._pgs.get(pg_id)
+                    if pg is None or pg.state != PGState.PENDING:
+                        continue
+                if self._try_schedule_pg(pg):
+                    progressed = True
+                else:
+                    with self._lock:
+                        self._pending_pgs.append(pg_id)
+        finally:
+            with self._lock:
+                self._placing_pgs = []
         return progressed
 
     def _try_schedule_pg(self, pg: PGInfo) -> bool:

@@ -1,0 +1,92 @@
+"""Host-memory monitor + worker-killing policy.
+
+TPU-native analog of the reference's OOM protection
+(/root/reference/src/ray/common/memory_monitor.h:52 — kernel memory usage
+polling; worker_killing_policy.h:39 — retriable-FIFO / group-by-owner
+victim selection; python/_private/memory_monitor.py:97): when host memory
+crosses the threshold, the node agent kills the newest killable worker so
+the kernel OOM killer doesn't take down the agent (or the TPU runtime)
+instead. The killed task surfaces as a retriable worker crash to its owner.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def read_memory_usage_fraction() -> float:
+    """Used fraction of host memory, cgroup-aware where possible."""
+    try:
+        # cgroup v2 (containerized nodes)
+        with open("/sys/fs/cgroup/memory.max") as f:
+            limit = f.read().strip()
+        if limit != "max":
+            with open("/sys/fs/cgroup/memory.current") as f:
+                cur = int(f.read().strip())
+            return cur / max(int(limit), 1)
+    except OSError:
+        pass
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, v = line.split(":", 1)
+                info[k] = int(v.strip().split()[0])
+        total = info.get("MemTotal", 1)
+        avail = info.get("MemAvailable", total)
+        return (total - avail) / total
+    except OSError:
+        return 0.0
+
+
+def pick_victim(workers: list) -> object | None:
+    """Newest killable worker first (the reference's retriable-FIFO policy:
+    prefer the task most recently started — cheapest progress lost, most
+    likely still retriable); tasks before actors; never TPU workers (the
+    chip process is the node's reason to exist)."""
+    candidates = [w for w in workers
+                  if w.addr is not None and not w.is_tpu_worker]
+    if not candidates:
+        return None
+    tasks = [w for w in candidates if w.actor_id is None and w.busy]
+    pool = tasks or [w for w in candidates if w.actor_id is not None]
+    if not pool:
+        return None
+    return max(pool, key=lambda w: w.idle_since)
+
+
+class MemoryMonitor:
+    """Driven from the node agent's monitor thread."""
+
+    def __init__(self, kill_fn, threshold: float, min_interval_s: float = 1.0,
+                 read_fn=read_memory_usage_fraction):
+        self._kill = kill_fn        # (worker_info, reason) -> None
+        self._threshold = threshold
+        self._interval = min_interval_s
+        self._read = read_fn
+        self._last_check = 0.0
+        self.num_killed = 0
+
+    def maybe_kill(self, workers: list) -> None:
+        now = time.monotonic()
+        if now - self._last_check < self._interval:
+            return
+        self._last_check = now
+        frac = self._read()
+        if frac < self._threshold:
+            return
+        victim = pick_victim(workers)
+        if victim is None:
+            logger.warning(
+                "host memory at %.0f%% (threshold %.0f%%) but no killable "
+                "worker", frac * 100, self._threshold * 100)
+            return
+        self.num_killed += 1
+        logger.warning(
+            "host memory at %.0f%% >= %.0f%%: killing worker %s to avoid "
+            "the kernel OOM killer (task will retry per its policy)",
+            frac * 100, self._threshold * 100, victim.worker_id.hex()[:8])
+        self._kill(victim, f"memory pressure ({frac:.0%} used)")

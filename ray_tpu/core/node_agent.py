@@ -45,6 +45,7 @@ class _WorkerInfo:
     busy: bool = False
     actor_id: ActorID | None = None
     is_tpu_worker: bool = False
+    env_key: str = ""  # runtime-env hash (worker pool keyed per env)
     idle_since: float = field(default_factory=time.monotonic)
     ready = None  # threading.Event
     log_paths: tuple[str, str] | None = None
@@ -96,6 +97,12 @@ class NodeAgent:
             pool_size=16)
         self.addr = self._server.addr
         self._register_with_cp()
+        self._memory_monitor = None
+        if cfg.memory_usage_threshold > 0:
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+            self._memory_monitor = MemoryMonitor(
+                self._oom_kill_worker, cfg.memory_usage_threshold,
+                cfg.memory_monitor_interval_s)
         self._monitor_thread = threading.Thread(
             target=self._monitor_workers, name="agent-monitor", daemon=True)
         self._monitor_thread.start()
@@ -141,9 +148,29 @@ class NodeAgent:
         return {"ok": True}
 
     # ---- worker pool ---------------------------------------------------
-    def _spawn_worker(self, for_tpu: bool = False) -> _WorkerInfo:
+    def _spawn_worker(self, for_tpu: bool = False,
+                      runtime_env: dict | None = None) -> _WorkerInfo:
+        from ray_tpu.runtime_env import env_hash, materialize_runtime_env
+
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        cwd = os.getcwd()
+        # the framework must stay importable even when a runtime_env moves
+        # the worker's cwd (source-tree installs aren't on sys.path then)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        if runtime_env:
+            # materialize BEFORE spawn (reference: runtime_env agent creates
+            # the env, then the worker starts inside it)
+            env_vars, env_cwd, pypath = materialize_runtime_env(
+                self._pool.get(self.cp_addr), runtime_env)
+            env.update(env_vars)
+            if env_cwd:
+                cwd = env_cwd
+            if pypath:
+                env["PYTHONPATH"] = os.pathsep.join(
+                    pypath + [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
         env["RAY_TPU_CP_ADDR"] = f"{self.cp_addr[0]}:{self.cp_addr[1]}"
         env["RAY_TPU_AGENT_ADDR"] = f"{self.addr[0]}:{self.addr[1]}"
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
@@ -154,22 +181,26 @@ class NodeAgent:
             # TPU plugin bootstrap env also skips the sitecustomize-time jax
             # import (~2.5s), so CPU worker spawn is fast; jax is imported
             # lazily (CPU backend) only if a task actually uses it.
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            # Force (not setdefault): the ambient env may carry
+            # JAX_PLATFORMS=<tpu plugin> which would make the worker try to
+            # initialize the TPU backend with its bootstrap stripped below.
+            env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
-        info = _WorkerInfo(worker_id=worker_id, is_tpu_worker=for_tpu)
+        info = _WorkerInfo(worker_id=worker_id, is_tpu_worker=for_tpu,
+                           env_key=env_hash(runtime_env))
         info.ready = threading.Event()
         # Per-worker log files (ref: /tmp/ray/session_*/logs +
         # _private/log_monitor.py); stderr/stdout land here, readable via
         # `ray_tpu.util.state.worker_logs()`.
         log_dir = get_config().log_dir or os.path.join(
-            "/tmp/ray_tpu/logs", f"agent-{os.getpid()}")
+            "/tmp/ray_tpu_logs", f"agent-{os.getpid()}")
         os.makedirs(log_dir, exist_ok=True)
         out_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out")
         err_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.err")
         with open(out_path, "ab") as fout, open(err_path, "ab") as ferr:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.core.worker_main"],
-                env=env, cwd=os.getcwd(), stdout=fout, stderr=ferr)
+                env=env, cwd=cwd, stdout=fout, stderr=ferr)
         info.proc, info.pid = proc, proc.pid
         info.log_paths = (out_path, err_path)
         with self._lock:
@@ -190,10 +221,12 @@ class NodeAgent:
             self._lease_cv.notify_all()
         return {"ok": True, "node_id": self.node_id}
 
-    def _pop_idle_worker(self, for_tpu: bool) -> _WorkerInfo | None:
+    def _pop_idle_worker(self, for_tpu: bool,
+                         env_key: str = "") -> _WorkerInfo | None:
         for info in self._workers.values():
             if (info.addr is not None and not info.busy and info.actor_id is None
-                    and info.is_tpu_worker == for_tpu):
+                    and info.is_tpu_worker == for_tpu
+                    and info.env_key == env_key):
                 return info
         return None
 
@@ -210,6 +243,9 @@ class NodeAgent:
         pg_id = body.get("pg_id")
         bundle_index = body.get("bundle_index", -1)
         for_actor = body.get("for_actor")
+        runtime_env = body.get("runtime_env")
+        from ray_tpu.runtime_env import env_hash
+        env_key = env_hash(runtime_env)
         for_tpu = resources.get("TPU", 0) > 0
         deadline = time.monotonic() + body.get("timeout", cfg.lease_timeout_s)
         reserved = False
@@ -218,11 +254,12 @@ class NodeAgent:
             while not self._stopped.is_set():
                 need_spawn = False
                 try_redirect = False
+                evict_proc = None
                 with self._lock:
                     if not reserved:
                         reserved = self._try_reserve(resources, pg_id, bundle_index)
                     if reserved:
-                        worker = self._pop_idle_worker(for_tpu)
+                        worker = self._pop_idle_worker(for_tpu, env_key)
                         if worker is not None and worker.ready.is_set():
                             worker.busy = True
                             if for_actor is not None:
@@ -242,10 +279,31 @@ class NodeAgent:
                                     "available": dict(self.available)}
                         if not spawned and self._can_spawn(for_tpu):
                             spawned = need_spawn = True
+                        elif not spawned:
+                            # pool is at its cap but holds idle workers for
+                            # OTHER runtime envs: evict one to make room, or
+                            # an env-mismatched burst starves this lease
+                            # until its timeout
+                            victim = next(
+                                (i for i in self._workers.values()
+                                 if i.addr is not None and not i.busy
+                                 and i.actor_id is None
+                                 and i.is_tpu_worker == for_tpu
+                                 and i.env_key != env_key), None)
+                            if victim is not None:
+                                victim.busy = True  # unleaseable while dying
+                                del self._workers[victim.worker_id]
+                                evict_proc = victim.proc
+                                spawned = need_spawn = True
                     elif pg_id is None:
                         try_redirect = True
+                if evict_proc is not None:
+                    try:
+                        evict_proc.terminate()
+                    except Exception:  # noqa: BLE001 - already gone
+                        pass
                 if need_spawn:
-                    self._spawn_worker(for_tpu)
+                    self._spawn_worker(for_tpu, runtime_env)
                 if try_redirect:
                     target = self._find_remote_node(resources)
                     if target is not None:
@@ -255,10 +313,10 @@ class NodeAgent:
                 if time.monotonic() > deadline:
                     logger.warning(
                         "lease timeout: res=%s reserved=%s spawned=%s "
-                        "available=%s workers=%s", resources, reserved,
-                        spawned, self.available,
+                        "env_key=%r available=%s workers=%s", resources,
+                        reserved, spawned, env_key, self.available,
                         [(w.hex()[:6], i.busy, i.actor_id is not None,
-                          i.addr is not None)
+                          i.addr is not None, i.env_key)
                          for w, i in self._workers.items()])
                     return {"granted": False, "timeout": True}
             return {"granted": False, "timeout": True}
@@ -564,6 +622,10 @@ class NodeAgent:
                         self._register_with_cp()
                 except Exception:
                     pass
+            if self._memory_monitor is not None:
+                with self._lock:
+                    snapshot = list(self._workers.values())
+                self._memory_monitor.maybe_kill(snapshot)
             dead: list[_WorkerInfo] = []
             with self._lock:
                 for info in list(self._workers.values()):
@@ -582,6 +644,15 @@ class NodeAgent:
                             pass
             for info in dead:
                 self._on_worker_dead(info)
+
+    def _oom_kill_worker(self, info: _WorkerInfo, reason: str) -> None:
+        """Hard-kill a worker under memory pressure; the normal dead-worker
+        path (monitor loop) reaps it and notifies owners."""
+        try:
+            if info.proc is not None:
+                info.proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
 
     def _on_worker_dead(self, info: _WorkerInfo):
         code = info.proc.returncode if info.proc else None

@@ -634,7 +634,7 @@ def make_store(capacity_bytes: int, prefix: str = "rtpu"):
     if backend is None:
         backend = ShmStore(capacity_bytes, prefix)
     if cfg.enable_object_spilling:
-        spill_dir = os.path.join(cfg.spill_dir or "/tmp/ray_tpu/spill",
+        spill_dir = os.path.join(cfg.spill_dir or "/tmp/ray_tpu_spill",
                                  prefix)
         return SpillingStore(backend, spill_dir, capacity_bytes)
     return backend

@@ -79,7 +79,8 @@ class RemoteFunction:
             strategy=_build_strategy(options),
             max_retries=options.get("max_retries"),
             retry_exceptions=bool(options.get("retry_exceptions", False)),
-            name=options.get("name", "") or self._fn.__name__)
+            name=options.get("name", "") or self._fn.__name__,
+            runtime_env=options.get("runtime_env"))
         if num_returns == 1:
             return refs[0]
         return refs

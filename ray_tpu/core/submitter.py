@@ -32,12 +32,14 @@ logger = logging.getLogger(__name__)
 
 
 def _shape_key(spec: TaskSpec):
-    """Tasks are queued per (resources, strategy) shape so a cached lease only
-    serves tasks with identical placement constraints."""
+    """Tasks are queued per (resources, strategy, runtime_env) shape so a
+    cached lease only serves tasks with identical placement constraints AND
+    worker environment (reference worker_pool env-hash keying)."""
+    from ray_tpu.runtime_env import env_hash
     pg = getattr(spec.strategy, "pg_id", None)
     idx = getattr(spec.strategy, "bundle_index", -1)
     s = spec.strategy
-    strat_key: tuple = (type(s).__name__,)
+    strat_key: tuple = (type(s).__name__, env_hash(spec.runtime_env))
     if hasattr(s, "node_id_hex"):
         strat_key += (s.node_id_hex, s.soft)
     if hasattr(s, "hard"):
@@ -60,6 +62,7 @@ class _ShapeState:
     busy: dict = field(default_factory=dict)       # worker_addr -> _Lease
     requests_in_flight: int = 0
     strategy: object = None
+    runtime_env: dict | None = None
 
 
 class NormalTaskSubmitter:
@@ -76,6 +79,7 @@ class NormalTaskSubmitter:
         with self._lock:
             st = self._shapes.setdefault(key, _ShapeState())
             st.strategy = spec.strategy
+            st.runtime_env = spec.runtime_env
             st.queue.append(spec)
         self._pump(key)
 
@@ -111,6 +115,7 @@ class NormalTaskSubmitter:
         with self._lock:
             st0 = self._shapes.get(key)
             strategy = st0.strategy if st0 else None
+            runtime_env = st0.runtime_env if st0 else None
         max_hops = 4
         try:
             if pg_id is not None:
@@ -132,6 +137,8 @@ class NormalTaskSubmitter:
                     max_hops = 1  # do not follow spillback off a constrained node
             for _ in range(max_hops):
                 body = {"resources": resources, "timeout": cfg.lease_timeout_s}
+                if runtime_env:
+                    body["runtime_env"] = runtime_env
                 if pg_id is not None:
                     body["pg_id"] = pg_id
                     body["bundle_index"] = bundle_index

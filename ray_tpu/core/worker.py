@@ -379,8 +379,11 @@ class WorkerRuntime:
                     num_returns: int = 1, resources: dict | None = None,
                     strategy: SchedulingStrategy | None = None,
                     max_retries: int | None = None, retry_exceptions: bool = False,
-                    name: str = "") -> list[ObjectRef]:
+                    name: str = "", runtime_env: dict | None = None) -> list[ObjectRef]:
         cfg = get_config()
+        if runtime_env:
+            from ray_tpu.runtime_env import prepare_runtime_env
+            runtime_env = prepare_runtime_env(self, runtime_env)
         spec = TaskSpec(
             task_id=self._next_task_id(), job_id=self.job_id,
             task_type=TaskType.NORMAL, name=name or getattr(fn, "__name__", "task"),
@@ -389,7 +392,7 @@ class WorkerRuntime:
             num_returns=num_returns, resources=resources or {"CPU": 1.0},
             strategy=strategy or DefaultStrategy(),
             max_retries=cfg.task_max_retries if max_retries is None else max_retries,
-            retry_exceptions=retry_exceptions,
+            retry_exceptions=retry_exceptions, runtime_env=runtime_env,
             owner_id=self.worker_id, owner_addr=self.addr,
             caller_id=self.worker_id, depth=self._depth() + 1)
         refs = self._register_returns(spec)
@@ -403,7 +406,11 @@ class WorkerRuntime:
                               detached: bool = False, max_restarts: int = 0,
                               max_task_retries: int = 0, max_concurrency: int = 1,
                               is_async: bool = False,
-                              strategy: SchedulingStrategy | None = None) -> None:
+                              strategy: SchedulingStrategy | None = None,
+                              runtime_env: dict | None = None) -> None:
+        if runtime_env:
+            from ray_tpu.runtime_env import prepare_runtime_env
+            runtime_env = prepare_runtime_env(self, runtime_env)
         spec = TaskSpec(
             task_id=self._next_task_id(), job_id=self.job_id,
             task_type=TaskType.ACTOR_CREATION, name=cls.__name__,
@@ -414,7 +421,8 @@ class WorkerRuntime:
             owner_id=self.worker_id, owner_addr=self.addr,
             actor_id=actor_id, max_restarts=max_restarts,
             max_task_retries=max_task_retries, max_concurrency=max_concurrency,
-            is_async_actor=is_async, caller_id=self.worker_id)
+            is_async_actor=is_async, caller_id=self.worker_id,
+            runtime_env=runtime_env)
         self.cp_client.call_with_retry(
             "create_actor", {"spec": spec, "name": name, "detached": detached},
             timeout=60.0)

@@ -1,0 +1,21 @@
+"""ray_tpu.dashboard — minimal cluster dashboard + log access.
+
+TPU-native analog of the reference's dashboard head
+(/root/reference/python/ray/dashboard/head.py + state_aggregator.py): an
+aiohttp server exposing the state API as JSON plus a single-page HTML view.
+No per-node agents — the control plane already aggregates everything, and
+worker logs are read through `ray_tpu.util.state.worker_logs()`.
+
+Endpoints:
+    GET /              — HTML overview (auto-refreshing tables)
+    GET /api/nodes     — node table
+    GET /api/actors    — actor table
+    GET /api/tasks     — recent task events
+    GET /api/pgs       — placement groups
+    GET /api/jobs      — submitted jobs
+    GET /api/logs      — worker log files (?worker_id=&tail=)
+"""
+
+from ray_tpu.dashboard.app import Dashboard, start_dashboard
+
+__all__ = ["Dashboard", "start_dashboard"]

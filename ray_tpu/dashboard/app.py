@@ -1,0 +1,145 @@
+"""Dashboard HTTP server (see package docstring)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+import ray_tpu
+
+_INDEX = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; }
+ table { border-collapse: collapse; margin-bottom: 2em; }
+ td, th { border: 1px solid #999; padding: 4px 8px; text-align: left; }
+ th { background: #eee; }
+ h2 { margin-bottom: 4px; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="content">loading…</div>
+<script>
+async function refresh() {
+  const sections = ["nodes", "actors", "pgs", "jobs", "tasks"];
+  let html = "";
+  for (const s of sections) {
+    const rows = await (await fetch("/api/" + s)).json();
+    html += "<h2>" + s + " (" + rows.length + ")</h2>";
+    if (rows.length) {
+      const cols = Object.keys(rows[0]);
+      html += "<table><tr>" + cols.map(c => "<th>" + c + "</th>").join("") + "</tr>";
+      for (const r of rows.slice(0, 200)) {
+        html += "<tr>" + cols.map(c => "<td>" + JSON.stringify(r[c]) + "</td>").join("") + "</tr>";
+      }
+      html += "</table>";
+    }
+  }
+  document.getElementById("content").innerHTML = html;
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+def _hexify(obj):
+    """IDs → hex strings for JSON."""
+    if isinstance(obj, dict):
+        return {k: _hexify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_hexify(v) for v in obj]
+    if isinstance(obj, (int, float, bool)) or obj is None:
+        return obj
+    if hasattr(obj, "hex") and not isinstance(obj, (str, bytes)):
+        try:
+            return obj.hex()[:16]
+        except Exception:  # noqa: BLE001
+            return str(obj)
+    if isinstance(obj, bytes):
+        return obj.hex()[:16]
+    return obj
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._thread: Optional[threading.Thread] = None
+        self._loop = None
+        self._started = threading.Event()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="dashboard")
+        self._thread.start()
+        if not self._started.wait(10.0):
+            raise RuntimeError("dashboard failed to start")
+        return self
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+    def _serve(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/{section}", self._api)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        if self.port == 0:
+            for s in site._server.sockets:
+                self.port = s.getsockname()[1]
+                break
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+    async def _index(self, request):
+        from aiohttp import web
+        return web.Response(text=_INDEX, content_type="text/html")
+
+    async def _api(self, request):
+        from aiohttp import web
+
+        section = request.match_info["section"]
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.util import state
+            if section == "nodes":
+                return ray_tpu.nodes()
+            if section == "actors":
+                return state.list_actors()
+            if section == "tasks":
+                return state.list_tasks(limit=200)
+            if section == "pgs":
+                return state.list_placement_groups()
+            if section == "jobs":
+                from ray_tpu.job import JobSubmissionClient
+                return JobSubmissionClient().list_jobs()
+            if section == "logs":
+                wid = request.query.get("worker_id")
+                tail = int(request.query.get("tail", "100"))
+                logs = state.worker_logs(worker_id=wid, tail=tail)
+                return [{"file": k, "content": v} for k, v in logs.items()]
+            return None
+
+        data = await loop.run_in_executor(None, fetch)
+        if data is None:
+            return web.Response(status=404, text=f"unknown section {section}")
+        return web.json_response(_hexify(data))
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    return Dashboard(host, port).start()

@@ -1,0 +1,11 @@
+"""ray_tpu.job — job submission (reference: dashboard/modules/job/).
+
+A job is a user script run as a supervised driver subprocess: a detached
+supervisor actor starts it with the cluster address in the environment,
+captures its output, and records status in the control-plane KV so any
+client can query it (job_manager.py + job_supervisor.py in the reference).
+"""
+
+from ray_tpu.job.manager import JobStatus, JobSubmissionClient
+
+__all__ = ["JobStatus", "JobSubmissionClient"]

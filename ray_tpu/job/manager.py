@@ -1,0 +1,200 @@
+"""Job manager: supervised driver subprocesses.
+
+TPU-native analog of the reference's job submission stack
+(/root/reference/python/ray/dashboard/modules/job/job_manager.py +
+job_supervisor.py — the driver runs as a subprocess under a supervisor
+actor; status and logs stream back through the cluster):
+
+- `JobSubmissionClient.submit(entrypoint)` spawns a DETACHED `_JobSupervisor`
+  actor; the supervisor execs the entrypoint with `RAY_TPU_ADDRESS` set so
+  `ray_tpu.init()` inside the script joins this cluster.
+- Status lives in the control-plane KV (`job:<id>` keys) — queryable from
+  any client, surviving the submitting process (and CP restarts when the CP
+  runs with a persistent store).
+- Logs are captured to a file and served back through the supervisor.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+import ray_tpu
+
+
+class JobStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+def _kv_key(job_id: str) -> str:
+    return f"job:{job_id}"
+
+
+def _kv_put(payload: dict) -> None:
+    from ray_tpu.core import api
+    rt = api._get_runtime()
+    rt.cp_client.call_with_retry(
+        "kv_put", {"key": _kv_key(payload["job_id"]),
+                   "value": json.dumps(payload).encode()}, timeout=10.0)
+
+
+def _kv_get(job_id: str) -> Optional[dict]:
+    from ray_tpu.core import api
+    rt = api._get_runtime()
+    raw = rt.cp_client.call_with_retry(
+        "kv_get", {"key": _kv_key(job_id)}, timeout=10.0)
+    return json.loads(raw) if raw else None
+
+
+@ray_tpu.remote
+class _JobSupervisor:
+    """Runs ONE job's entrypoint as a subprocess (reference
+    job_supervisor.py). Detached so it outlives the submitting client."""
+
+    def __init__(self, job_id: str, entrypoint: str, cluster_address: str,
+                 env_vars: Optional[dict] = None,
+                 working_dir: Optional[str] = None):
+        import subprocess
+        import threading
+
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        log_dir = os.path.join("/tmp/ray_tpu_jobs", job_id)
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_path = os.path.join(log_dir, "driver.log")
+
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = cluster_address
+        env["RAY_TPU_JOB_ID"] = job_id
+        # make the framework importable from anywhere (it may be running
+        # from a source tree rather than site-packages)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(env_vars or {})
+
+        self._record(JobStatus.RUNNING, start_time=time.time())
+        logf = open(self.log_path, "ab")
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, env=env,
+            cwd=working_dir or os.getcwd(),
+            stdout=logf, stderr=subprocess.STDOUT)
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _record(self, status: JobStatus, **extra) -> None:
+        cur = _kv_get(self.job_id) or {"job_id": self.job_id}
+        cur.update({"status": status.value, "entrypoint": self.entrypoint,
+                    "log_path": self.log_path, **extra})
+        _kv_put(cur)
+
+    def _wait(self) -> None:
+        rc = self._proc.wait()
+        self._record(
+            JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED,
+            end_time=time.time(), return_code=rc)
+
+    def status(self) -> str:
+        rec = _kv_get(self.job_id)
+        return rec["status"] if rec else JobStatus.PENDING.value
+
+    def logs(self, tail: int = 1000) -> str:
+        try:
+            with open(self.log_path, "r", errors="replace") as f:
+                return "".join(f.readlines()[-tail:])
+        except OSError:
+            return ""
+
+    def stop(self) -> bool:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                self._proc.kill()
+            self._record(JobStatus.STOPPED, end_time=time.time())
+            return True
+        return False
+
+
+class JobSubmissionClient:
+    """Submit + query jobs (reference: job SDK sdk.py). Requires a connected
+    runtime (`ray_tpu.init(address=...)` or in-process head)."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        self._cluster_address = f"{rt.cp_addr[0]}:{rt.cp_addr[1]}"
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   env_vars: Optional[dict] = None,
+                   working_dir: Optional[str] = None) -> str:
+        job_id = submission_id or f"job_{uuid.uuid4().hex[:10]}"
+        _kv_put({"job_id": job_id, "status": JobStatus.PENDING.value,
+                 "entrypoint": entrypoint, "submit_time": time.time()})
+        sup = _JobSupervisor.options(
+            name=f"_job_supervisor_{job_id}", lifetime="detached").remote(
+            job_id, entrypoint, self._cluster_address, env_vars, working_dir)
+        # touch the supervisor so scheduling errors surface here
+        ray_tpu.get(sup.status.remote(), timeout=60.0)
+        return job_id
+
+    def get_job_status(self, job_id: str) -> JobStatus:
+        rec = _kv_get(job_id)
+        if rec is None:
+            raise ValueError(f"unknown job {job_id}")
+        return JobStatus(rec["status"])
+
+    def get_job_info(self, job_id: str) -> dict:
+        rec = _kv_get(job_id)
+        if rec is None:
+            raise ValueError(f"unknown job {job_id}")
+        return rec
+
+    def get_job_logs(self, job_id: str, tail: int = 1000) -> str:
+        try:
+            sup = ray_tpu.get_actor(f"_job_supervisor_{job_id}", timeout=5.0)
+            return ray_tpu.get(sup.logs.remote(tail), timeout=30.0)
+        except Exception:  # noqa: BLE001 - supervisor gone: read the file
+            rec = _kv_get(job_id)
+            if rec and rec.get("log_path") and os.path.exists(rec["log_path"]):
+                with open(rec["log_path"], "r", errors="replace") as f:
+                    return "".join(f.readlines()[-tail:])
+            return ""
+
+    def stop_job(self, job_id: str) -> bool:
+        sup = ray_tpu.get_actor(f"_job_supervisor_{job_id}", timeout=5.0)
+        return ray_tpu.get(sup.stop.remote(), timeout=30.0)
+
+    def list_jobs(self) -> list[dict]:
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        keys = rt.cp_client.call_with_retry(
+            "kv_keys", {"prefix": "job:"}, timeout=10.0) or []
+        out = []
+        for k in keys:
+            raw = rt.cp_client.call_with_retry(
+                "kv_get", {"key": k}, timeout=10.0)
+            if raw:
+                out.append(json.loads(raw))
+        return out
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> JobStatus:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.get_job_status(job_id)
+            if st in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+                return st
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} still {st} after {timeout}s")

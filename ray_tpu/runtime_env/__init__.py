@@ -1,0 +1,30 @@
+"""Runtime environments: per-task/actor worker environments.
+
+TPU-native analog of the reference's runtime_env stack
+(/root/reference/python/ray/_private/runtime_env/ — plugins for env_vars,
+working_dir, py_modules, pip/uv/conda/container; packaging via the GCS KV,
+packaging.py; per-node agent materializes envs before worker start).
+
+Supported here:
+- ``env_vars``: dict of environment variables for the worker process.
+- ``working_dir``: local directory, zipped into the control-plane KV and
+  unpacked on the executing node; becomes the worker's cwd and joins
+  PYTHONPATH.
+- ``py_modules``: list of local package dirs, shipped the same way and
+  prepended to PYTHONPATH.
+- ``pip``: recorded but gated — installing packages at runtime requires
+  network access; enable explicitly via config allow_runtime_env_pip.
+
+Workers are POOLED PER ENVIRONMENT (reference worker_pool keying by env
+hash): a lease for runtime_env E only reuses workers started with E.
+"""
+
+from ray_tpu.runtime_env.packaging import (
+    RuntimeEnvError,
+    env_hash,
+    materialize_runtime_env,
+    prepare_runtime_env,
+)
+
+__all__ = ["RuntimeEnvError", "env_hash", "materialize_runtime_env",
+           "prepare_runtime_env"]

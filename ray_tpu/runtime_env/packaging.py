@@ -1,0 +1,144 @@
+"""Runtime-env packaging + materialization.
+
+Reference: python/ray/_private/runtime_env/packaging.py (zip working_dir /
+py_modules into the GCS KV under content-hash URIs; agents download + cache
+by URI) and runtime_env/agent (per-node materialization before worker
+start).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import tempfile
+import zipfile
+
+_PKG_PREFIX = "pkg:"
+_ENV_ROOT = "/tmp/ray_tpu_envs"
+_MAX_PKG_BYTES = 100 * 1024 * 1024
+
+
+class RuntimeEnvError(ValueError):
+    pass
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _dirs, files in os.walk(base):
+            if "__pycache__" in root:
+                continue
+            for f in files:
+                full = os.path.join(root, f)
+                zf.write(full, os.path.relpath(full, base))
+    data = buf.getvalue()
+    if len(data) > _MAX_PKG_BYTES:
+        raise RuntimeEnvError(
+            f"runtime_env package {path} is {len(data)} bytes "
+            f"(limit {_MAX_PKG_BYTES}); ship data through the object store "
+            f"instead")
+    return data
+
+
+def _upload_dir(rt, path: str) -> str:
+    """Zip a directory into the CP KV; returns its kv:// URI."""
+    if not os.path.isdir(path):
+        raise RuntimeEnvError(f"runtime_env dir not found: {path}")
+    data = _zip_dir(path)
+    digest = hashlib.sha1(data).hexdigest()[:20]
+    key = f"{_PKG_PREFIX}{digest}"
+    rt.cp_client.call_with_retry(
+        "kv_put", {"key": key, "value": data, "overwrite": False},
+        timeout=60.0)
+    return f"kv://{key}"
+
+
+def prepare_runtime_env(rt, runtime_env: dict | None) -> dict | None:
+    """Driver side: validate + upload local dirs, returning a normalized
+    runtime_env whose dirs are kv:// URIs (safe to ship in a TaskSpec)."""
+    if not runtime_env:
+        return None
+    out = dict(runtime_env)
+    unknown = set(out) - {"env_vars", "working_dir", "py_modules", "pip"}
+    if unknown:
+        raise RuntimeEnvError(f"unsupported runtime_env keys: {unknown}")
+    if out.get("env_vars"):
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in out["env_vars"].items()):
+            raise RuntimeEnvError("env_vars must be str->str")
+    wd = out.get("working_dir")
+    if wd and not wd.startswith("kv://"):
+        out["working_dir"] = _upload_dir(rt, wd)
+    mods = out.get("py_modules")
+    if mods:
+        out["py_modules"] = [
+            m if m.startswith("kv://") else _upload_dir(rt, m) for m in mods]
+    if out.get("pip"):
+        from ray_tpu.core.config import get_config
+        if not getattr(get_config(), "allow_runtime_env_pip", False):
+            raise RuntimeEnvError(
+                "runtime_env['pip'] needs network access; set "
+                "RAY_TPU_ALLOW_RUNTIME_ENV_PIP=1 to enable")
+    return out
+
+
+def env_hash(runtime_env: dict | None) -> str:
+    """Stable identity for worker pooling (reference worker_pool env hash)."""
+    if not runtime_env:
+        return ""
+    return hashlib.sha1(
+        json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _fetch_pkg(cp_client, uri: str) -> str:
+    """Download + unzip a kv:// package on this node; cached by digest."""
+    key = uri[len("kv://"):]
+    dest = os.path.join(_ENV_ROOT, key.replace(":", "_"))
+    marker = os.path.join(dest, ".ready")
+    if os.path.exists(marker):
+        return dest
+    data = cp_client.call_with_retry("kv_get", {"key": key}, timeout=60.0)
+    if data is None:
+        raise RuntimeEnvError(f"runtime_env package missing from KV: {uri}")
+    # extract to a private temp dir + atomic rename: concurrent lease
+    # threads materializing the same env must never interleave writes into
+    # a directory a worker is already importing from
+    tmp = tempfile.mkdtemp(prefix=os.path.basename(dest) + ".tmp.",
+                           dir=_ENV_ROOT)
+    try:
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(tmp)
+        open(os.path.join(tmp, ".ready"), "w").close()
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            # a racer beat us to the rename — their copy is identical
+            if not os.path.exists(marker):
+                raise
+            shutil.rmtree(tmp, ignore_errors=True)
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return dest
+
+
+def materialize_runtime_env(cp_client, runtime_env: dict | None
+                            ) -> tuple[dict, str | None, list[str]]:
+    """Agent side (before worker spawn): returns (env_vars, cwd,
+    pythonpath_entries) for the worker process."""
+    if not runtime_env:
+        return {}, None, []
+    env_vars = dict(runtime_env.get("env_vars") or {})
+    cwd = None
+    pypath: list[str] = []
+    wd = runtime_env.get("working_dir")
+    if wd:
+        cwd = _fetch_pkg(cp_client, wd)
+        pypath.append(cwd)
+    for uri in runtime_env.get("py_modules") or []:
+        pypath.append(_fetch_pkg(cp_client, uri))
+    return env_vars, cwd, pypath

@@ -1,0 +1,312 @@
+"""ray-tpu CLI: start / stop / status / submit / logs / jobs /
+microbenchmark / timeline.
+
+TPU-native analog of the reference's CLI surface
+(/root/reference/python/ray/scripts/scripts.py — `ray start/stop/status/
+microbenchmark/timeline`; dashboard/modules/job/cli.py — `ray job submit`).
+
+Usage:
+    python -m ray_tpu start --head [--port 6380] [--num-cpus 8] [--store-path p]
+    python -m ray_tpu start --address host:port      # join as a worker node
+    python -m ray_tpu status [--address host:port]
+    python -m ray_tpu submit [--address ...] -- python my_script.py
+    python -m ray_tpu jobs [--address ...]
+    python -m ray_tpu logs JOB_ID [--address ...]
+    python -m ray_tpu stop
+    python -m ray_tpu microbenchmark
+    python -m ray_tpu timeline --out trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_STATE_DIR = os.path.expanduser("~/.ray_tpu")
+_ADDR_FILE = os.path.join(_STATE_DIR, "address")
+_PID_FILE = os.path.join(_STATE_DIR, "head.pid")
+
+
+def _write_state(address: str, pid: int) -> None:
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    with open(_ADDR_FILE, "w") as f:
+        f.write(address)
+    with open(_PID_FILE, "w") as f:
+        f.write(str(pid))
+
+
+def _read_address(cli_value: str | None) -> str:
+    if cli_value:
+        return cli_value
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    if os.path.exists(_ADDR_FILE):
+        with open(_ADDR_FILE) as f:
+            return f.read().strip()
+    raise SystemExit("no cluster address: pass --address, set "
+                     "RAY_TPU_ADDRESS, or `ray-tpu start --head` first")
+
+
+# ---- head/worker node daemons ---------------------------------------------
+
+def _run_head_daemon(args) -> None:
+    """The long-lived head process (GCS+raylet analog in-proc)."""
+    from ray_tpu.core.control_plane import ControlPlane
+    from ray_tpu.core.node_agent import NodeAgent
+
+    cp = ControlPlane(port=args.port, store_path=args.store_path or None)
+    res = {"CPU": float(args.num_cpus or (os.cpu_count() or 1))}
+    agent = NodeAgent(cp.addr, resources=res)
+    addr = f"{cp.addr[0]}:{cp.addr[1]}"
+    dashboard = None
+    if getattr(args, "dashboard_port", -1) >= 0:
+        import ray_tpu
+        ray_tpu.init(address=addr)
+        from ray_tpu.dashboard import start_dashboard
+        dashboard = start_dashboard(port=args.dashboard_port)
+        print(f"dashboard at http://127.0.0.1:{dashboard.port}", flush=True)
+    print(f"ray_tpu head up at {addr}", flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    while not stop:
+        time.sleep(0.5)
+    if dashboard is not None:
+        dashboard.stop()
+    agent.stop()
+    cp.stop()
+
+
+def _run_node_daemon(args) -> None:
+    """A long-lived worker-node agent joining an existing cluster."""
+    from ray_tpu.core.node_agent import NodeAgent
+
+    host, port = _read_address(args.address).rsplit(":", 1)
+    res = {"CPU": float(args.num_cpus or (os.cpu_count() or 1))}
+    agent = NodeAgent((host, int(port)), resources=res)
+    print(f"ray_tpu node joined {host}:{port} as {agent.node_id.hex()[:8]}",
+          flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    while not stop:
+        time.sleep(0.5)
+    agent.stop()
+
+
+def cmd_start(args) -> None:
+    if args.block:
+        if args.head:
+            _run_head_daemon(args)
+        else:
+            _run_node_daemon(args)
+        return
+    # detach: re-exec ourselves with --block in a daemonized subprocess
+    cmd = [sys.executable, "-m", "ray_tpu", "start", "--block"]
+    if args.head:
+        cmd += ["--head", "--port", str(args.port),
+                "--dashboard-port", str(args.dashboard_port)]
+        if args.store_path:
+            cmd += ["--store-path", args.store_path]
+    else:
+        cmd += ["--address", _read_address(args.address)]
+    if args.num_cpus:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    log = open(os.path.join(_STATE_DIR, "head.log" if args.head
+                            else f"node-{os.getpid()}.log"), "ab")
+    proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                            start_new_session=True)
+    if args.head:
+        address = f"127.0.0.1:{args.port}"
+        _write_state(address, proc.pid)
+        # wait for the control plane to accept connections
+        from ray_tpu.core.rpc import RpcClient
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                RpcClient(("127.0.0.1", args.port), name="probe").call(
+                    "ping", None, timeout=2.0)
+                print(f"started head at {address} (pid {proc.pid})")
+                print(f"connect with: ray_tpu.init(address='{address}')")
+                return
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        raise SystemExit("head failed to start; see ~/.ray_tpu/head.log")
+    print(f"started worker node (pid {proc.pid})")
+
+
+def cmd_stop(args) -> None:
+    stopped = False
+    if os.path.exists(_PID_FILE):
+        with open(_PID_FILE) as f:
+            pid = int(f.read().strip())
+        try:
+            os.kill(pid, signal.SIGTERM)
+            stopped = True
+            # wait for exit so a follow-up `start` can rebind the ports
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    break
+                time.sleep(0.1)
+            else:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            print(f"stopped head (pid {pid})")
+        except ProcessLookupError:
+            pass
+        os.remove(_PID_FILE)
+    if os.path.exists(_ADDR_FILE):
+        os.remove(_ADDR_FILE)
+    # reap orphaned workers of dead clusters
+    subprocess.run(["pkill", "-f", "ray_tpu.core.worker_main"], check=False)
+    if not stopped:
+        print("no head pidfile; killed stray workers only")
+
+
+def cmd_status(args) -> None:
+    import ray_tpu
+    ray_tpu.init(address=_read_address(args.address))
+    from ray_tpu.util import state
+
+    nodes = ray_tpu.nodes()
+    print(f"nodes: {len(nodes)}")
+    for n in nodes:
+        live = "ALIVE" if n["alive"] else "DEAD"
+        print(f"  {n['node_id'].hex()[:8]} {live} at {n['addr']} "
+              f"resources={n['resources']} available={n['available']}")
+    actors = state.list_actors()
+    by_state: dict[str, int] = {}
+    for a in actors:
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    print(f"actors: {by_state or 0}")
+    pgs = state.list_placement_groups()
+    print(f"placement groups: {len(pgs)}")
+    ray_tpu.shutdown()
+
+
+def cmd_submit(args) -> None:
+    import ray_tpu
+    from ray_tpu.job import JobSubmissionClient
+
+    ray_tpu.init(address=_read_address(args.address))
+    client = JobSubmissionClient()
+    entrypoint = " ".join(args.entrypoint)
+    job_id = client.submit_job(entrypoint=entrypoint,
+                               working_dir=args.working_dir)
+    print(f"submitted {job_id}: {entrypoint}")
+    if args.no_wait:
+        return
+    status = client.wait_until_finished(job_id, timeout=args.timeout)
+    print(f"status: {status.value}")
+    print("---- logs ----")
+    print(client.get_job_logs(job_id))
+    if status.value != "SUCCEEDED":
+        raise SystemExit(1)
+
+
+def cmd_jobs(args) -> None:
+    import ray_tpu
+    from ray_tpu.job import JobSubmissionClient
+
+    ray_tpu.init(address=_read_address(args.address))
+    for rec in JobSubmissionClient().list_jobs():
+        print(json.dumps(rec))
+
+
+def cmd_logs(args) -> None:
+    import ray_tpu
+    from ray_tpu.job import JobSubmissionClient
+
+    ray_tpu.init(address=_read_address(args.address))
+    print(JobSubmissionClient().get_job_logs(args.job_id, tail=args.tail))
+
+
+def cmd_microbenchmark(args) -> None:
+    import runpy
+    sys.argv = ["microbench.py"] + (["--quick"] if args.quick else [])
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "microbench.py")
+    runpy.run_path(path, run_name="__main__")
+
+
+def cmd_timeline(args) -> None:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_read_address(args.address))
+    out = args.out or f"timeline-{int(time.time())}.json"
+    state.timeline(filename=out)
+    print(f"wrote chrome trace to {out} (open in chrome://tracing)")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--port", type=int, default=6380)
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--store-path", default=None,
+                    help="sqlite path for control-plane fault tolerance")
+    sp.add_argument("--dashboard-port", type=int, default=8265,
+                    help="-1 disables the dashboard")
+    sp.add_argument("--block", action="store_true",
+                    help="run in the foreground")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the local head + workers")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("submit", help="run an entrypoint as a managed job")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--working-dir", default=None)
+    sp.add_argument("--no-wait", action="store_true")
+    sp.add_argument("--timeout", type=float, default=3600.0)
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="-- python my_script.py ...")
+    sp.set_defaults(fn=cmd_submit)
+
+    sp = sub.add_parser("jobs", help="list jobs")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("logs", help="print a job's driver log")
+    sp.add_argument("job_id")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--tail", type=int, default=1000)
+    sp.set_defaults(fn=cmd_logs)
+
+    sp = sub.add_parser("microbenchmark", help="run core microbenchmarks")
+    sp.add_argument("--quick", action="store_true")
+    sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("timeline", help="dump a chrome trace of task events")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--out", default=None)
+    sp.set_defaults(fn=cmd_timeline)
+
+    args = p.parse_args(argv)
+    if args.cmd == "submit" and args.entrypoint \
+            and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import (
     DeploymentHandle,
@@ -29,5 +30,6 @@ __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "batch", "delete", "deployment", "get_app_handle",
-    "get_deployment_handle", "run", "shutdown", "start_http_proxy", "status",
+    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
+    "run", "shutdown", "start_http_proxy", "status",
 ]

@@ -59,6 +59,13 @@ class ServeController:
     def _ensure_started(self):
         if self._loop_task is None:
             self._loop_task = asyncio.ensure_future(self._control_loop())
+            self._change_event = asyncio.Event()
+
+    def _notify_change(self):
+        ev = getattr(self, "_change_event", None)
+        if ev is not None:
+            ev.set()
+            self._change_event = asyncio.Event()
 
     # ---- deploy API ----------------------------------------------------
     async def deploy_application(self, app_name: str,
@@ -137,6 +144,38 @@ class ServeController:
                 out[state.name] = (list(state.replicas), state.version)
         return out
 
+    async def poll_routing_table(self, app_name: str,
+                                 known_versions: dict,
+                                 timeout_s: float = 30.0) -> dict | None:
+        """LONG-POLL (reference long_poll.py LongPollHost:228): returns the
+        app's routing table as soon as any deployment's version differs from
+        `known_versions` ({name: version}), or None at timeout. Routers hang
+        on this instead of re-polling on a timer."""
+        self._ensure_started()
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        known = dict(known_versions or {})
+        while True:
+            current = {s.name: s.version for s in self._deployments.values()
+                       if s.app == app_name}
+            # Changed = a deployment the router hasn't seen (or at an older
+            # version), or a deployment the router saw a REAL version of that
+            # is now gone. A router-side placeholder (version -1 for a
+            # deployment that doesn't exist yet) must NOT count, or the
+            # long-poll degenerates into a hot spin.
+            changed = any(known.get(d) != ver for d, ver in current.items()) \
+                or any(ver >= 0 and d not in current
+                       for d, ver in known.items())
+            if changed:
+                return await self.get_routing_table(app_name)
+            ev = self._change_event
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                return None
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=min(remaining, 5.0))
+            except asyncio.TimeoutError:
+                pass
+
     async def get_http_routes(self) -> dict:
         self._ensure_started()
         return dict(self._routes)
@@ -205,6 +244,7 @@ class ServeController:
             if len(alive) != len(state.replicas):
                 state.replicas = alive
                 state.version += 1
+                self._notify_change()
 
             # autoscaling
             asc = state.config.autoscaling_config
@@ -233,7 +273,9 @@ class ServeController:
                     state._pending_target = None
 
             # scale toward target
+            changed_any = False
             while len(state.replicas) < state.target:
+                changed_any = True
                 replica = ServeReplica.options(
                     max_concurrency=max(100, state.config.max_ongoing_requests),
                     **state.config.ray_actor_options).remote(
@@ -243,12 +285,15 @@ class ServeController:
                 state.replicas.append(replica)
                 state.version += 1
             while len(state.replicas) > state.target:
+                changed_any = True
                 victim = state.replicas.pop()
                 state.version += 1
                 try:
                     ray_tpu.kill(victim)
                 except Exception:  # noqa: BLE001
                     pass
+            if changed_any:
+                self._notify_change()
 
 
 async def _as_future(ref):

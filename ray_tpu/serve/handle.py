@@ -31,6 +31,8 @@ def _router_for(app_name: str) -> Router:
 
 def _reset_routers():
     with _routers_lock:
+        for r in _routers.values():
+            r.stop()  # kills the long-poll thread; orphans would spin forever
         _routers.clear()
 
 
@@ -67,21 +69,26 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, app_name: str,
                  method_name: str = "__call__", *, stream: bool = False,
-                 _timeout_s: float = 30.0):
+                 _timeout_s: float = 30.0, _multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
         self._stream = stream
         self._timeout_s = _timeout_s
+        self._multiplexed_model_id = _multiplexed_model_id
 
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
-                timeout_s: Optional[float] = None) -> "DeploymentHandle":
+                timeout_s: Optional[float] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name if method_name is not None else self._method,
             stream=self._stream if stream is None else stream,
-            _timeout_s=self._timeout_s if timeout_s is None else timeout_s)
+            _timeout_s=self._timeout_s if timeout_s is None else timeout_s,
+            _multiplexed_model_id=(self._multiplexed_model_id
+                                   if multiplexed_model_id is None
+                                   else multiplexed_model_id))
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
@@ -101,9 +108,13 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         args, kwargs = self._resolve_args(args, kwargs)
         router = _router_for(self.app_name)
+        if self._multiplexed_model_id:
+            kwargs = {**kwargs,
+                      "_multiplexed_model_id": self._multiplexed_model_id}
         ref = router.assign(self.deployment_name, self._method, args, kwargs,
                             streaming=self._stream,
-                            timeout_s=self._timeout_s)
+                            timeout_s=self._timeout_s,
+                            multiplexed_model_id=self._multiplexed_model_id)
         if self._stream:
             return DeploymentResponseGenerator(ref)
         return DeploymentResponse(ref)
@@ -111,8 +122,10 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method),
-                {"_stream": self._stream, "_timeout_s": self._timeout_s})
+                {"_stream": self._stream, "_timeout_s": self._timeout_s,
+                 "_multiplexed_model_id": self._multiplexed_model_id})
 
     def __setstate__(self, state):
         self._stream = state["_stream"]
         self._timeout_s = state["_timeout_s"]
+        self._multiplexed_model_id = state.get("_multiplexed_model_id", "")

@@ -1,0 +1,85 @@
+"""Model multiplexing: many models per deployment, LRU-cached per replica.
+
+TPU-native analog of the reference's multiplexing
+(/root/reference/python/ray/serve/multiplex.py — @serve.multiplexed model
+loader + serve.get_multiplexed_model_id(); the router prefers replicas that
+already hold the requested model). Affinity here is rendezvous hashing on
+the model id — deterministic with zero telemetry: the same model id lands
+on the same replica while the replica set is stable, so its cache stays
+hot (LoRA adapters etc.), and reshuffles minimally when replicas change.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import functools
+from collections import OrderedDict
+from typing import Callable, Optional
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "rtpu_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request handler: the model id this request was routed for."""
+    return _current_model_id.get()
+
+
+def _set_multiplexed_model_id(model_id: str):
+    _current_model_id.set(model_id)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for an async model-loader method: results are LRU-cached
+    per replica, keyed by model id; the oldest model is evicted (and its
+    __del__ releases device memory) beyond the cap."""
+
+    def decorate(fn):
+        cache: OrderedDict[str, object] = OrderedDict()
+        lock = asyncio.Lock()
+
+        @functools.wraps(fn)
+        async def wrapper(self_or_id, *args):
+            # support both method (self, model_id) and free fn (model_id)
+            model_id = args[0] if args else self_or_id
+            async with lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+            out = fn(self_or_id, *args) if args else fn(self_or_id)
+            if asyncio.iscoroutine(out):
+                out = await out
+            async with lock:
+                cache[model_id] = out
+                cache.move_to_end(model_id)
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)
+            return out
+
+        wrapper._is_multiplexed = True
+        return wrapper
+
+    if func is not None:
+        return decorate(func)
+    return decorate
+
+
+def rendezvous_pick(replicas: list, model_id: str):
+    """Highest-random-weight hashing: stable replica choice per model id.
+
+    Weights hash the replica's stable identity (actor id), not its list
+    index — index-keyed weights would reshuffle nearly every model's
+    assignment whenever the replica set changes, mass-evicting warm
+    caches on each scale event."""
+    import hashlib
+
+    def weight(idx: int) -> int:
+        rep = replicas[idx]
+        rid = getattr(rep, "actor_id", None)
+        key = rid.hex() if rid is not None else str(idx)
+        return int.from_bytes(hashlib.sha1(
+            f"{model_id}:{key}".encode()).digest()[:8], "big")
+
+    return max(range(len(replicas)), key=weight)

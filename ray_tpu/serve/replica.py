@@ -54,6 +54,10 @@ class ServeReplica:
                              kwargs: dict) -> Any:
         self._ongoing += 1
         self._total += 1
+        model_id = (kwargs or {}).pop("_multiplexed_model_id", "")
+        if model_id:
+            from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+            _set_multiplexed_model_id(model_id)
         try:
             if self._is_fn:
                 target = self._callable
@@ -80,6 +84,10 @@ class ServeReplica:
         re-streams them; reference streams over gRPC/ASGI incrementally)."""
         self._ongoing += 1
         self._total += 1
+        model_id = (kwargs or {}).pop("_multiplexed_model_id", "")
+        if model_id:
+            from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+            _set_multiplexed_model_id(model_id)
         try:
             target = (self._callable if self._is_fn or method_name == "__call__"
                       else getattr(self._callable, method_name))

@@ -45,10 +45,15 @@ class ReplicaSet:
         self._qlen[idx] = (now, qlen)
         return qlen
 
-    def choose(self) -> Optional[object]:
+    def choose(self, model_id: str = "") -> Optional[object]:
         n = len(self.replicas)
         if n == 0:
             return None
+        if model_id:
+            # multiplexed request: rendezvous-hash affinity keeps the model's
+            # per-replica cache hot (serve/multiplex.py)
+            from ray_tpu.serve.multiplex import rendezvous_pick
+            return self.replicas[rendezvous_pick(self.replicas, model_id)]
         if n == 1:
             return self.replicas[0]
         i, j = random.sample(range(n), 2)
@@ -56,7 +61,12 @@ class ReplicaSet:
 
 
 class Router:
-    """Routes requests for any deployment in one application."""
+    """Routes requests for any deployment in one application.
+
+    Config updates arrive by LONG-POLL push from the controller (reference
+    long_poll.py): a background thread hangs on poll_routing_table and
+    applies changes the moment versions bump — the request path reads only
+    the local cache, no controller RPC per request."""
 
     def __init__(self, controller, app_name: str, poll_period_s: float = 0.5):
         self._controller = controller
@@ -65,32 +75,63 @@ class Router:
         self._lock = threading.Lock()
         self._poll_period = poll_period_s
         self._last_poll = 0.0
+        self._stopped = threading.Event()
+        self._poll_thread = threading.Thread(
+            target=self._long_poll_loop, name=f"router-poll-{app_name}",
+            daemon=True)
+        self._poll_thread.start()
 
-    def _maybe_refresh(self, deployment: str, force: bool = False):
-        now = time.monotonic()
+    def _apply_table(self, table: dict) -> None:
         with self._lock:
-            rs = self._sets.setdefault(deployment, ReplicaSet())
-            if not force and rs.replicas and \
-                    now - self._last_poll < self._poll_period:
-                return rs
-        table = ray_tpu.get(self._controller.get_routing_table.remote(
-            self._app), timeout=10.0)
-        with self._lock:
-            self._last_poll = now
+            self._last_poll = time.monotonic()
             for dep, (replicas, version) in table.items():
                 cur = self._sets.setdefault(dep, ReplicaSet())
                 if version != cur.version:
                     cur.update(replicas, version)
+            # the table is the app's FULL routing state: deployments that
+            # were deleted must drop out of the cache, or the long-poll
+            # version handshake never converges
+            for dep in [d for d, rs in self._sets.items()
+                        if d not in table and rs.version >= 0]:
+                del self._sets[dep]
+
+    def _long_poll_loop(self) -> None:
+        while not self._stopped.is_set():
+            with self._lock:
+                known = {d: rs.version for d, rs in self._sets.items()}
+            try:
+                table = ray_tpu.get(
+                    self._controller.poll_routing_table.remote(
+                        self._app, known, 30.0), timeout=40.0)
+            except Exception:  # noqa: BLE001 - controller briefly away
+                time.sleep(0.5)
+                continue
+            if table:
+                self._apply_table(table)
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _maybe_refresh(self, deployment: str, force: bool = False):
+        with self._lock:
+            rs = self._sets.setdefault(deployment, ReplicaSet())
+            if rs.replicas and not force:
+                return rs
+        # cold start / forced: one synchronous fetch
+        table = ray_tpu.get(self._controller.get_routing_table.remote(
+            self._app), timeout=10.0)
+        self._apply_table(table)
+        with self._lock:
             return self._sets.setdefault(deployment, ReplicaSet())
 
     def assign(self, deployment: str, method: str, args: tuple,
                kwargs: dict, *, streaming: bool = False,
-               timeout_s: float = 30.0):
+               timeout_s: float = 30.0, multiplexed_model_id: str = ""):
         """Pick a replica and submit; returns the reply ObjectRef."""
         deadline = time.monotonic() + timeout_s
         while True:
             rs = self._maybe_refresh(deployment)
-            replica = rs.choose()
+            replica = rs.choose(multiplexed_model_id)
             if replica is not None:
                 if streaming:
                     return replica.handle_request_streaming.remote(

@@ -10,6 +10,9 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    ConcurrencyLimiter,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -27,7 +30,8 @@ from ray_tpu.tune.tuner import (
 )
 
 __all__ = [
-    "ASHAScheduler", "AsyncHyperBandScheduler", "FIFOScheduler",
+    "ASHAScheduler", "AsyncHyperBandScheduler", "ConcurrencyLimiter",
+    "FIFOScheduler", "Searcher", "TPESearcher",
     "MedianStoppingRule", "PopulationBasedTraining", "ResultGrid", "Trial",
     "TrialResult", "TrialScheduler", "TuneConfig", "TuneController", "Tuner",
     "choice", "get_context", "grid_search", "loguniform", "randint", "report",

@@ -156,3 +156,156 @@ def _unflatten(d: dict) -> dict:
             cur = cur.setdefault(p, {})
         cur[parts[-1]] = v
     return out
+
+
+# ---- searchers (reference: tune/search/searcher.py ABC + optuna/hyperopt
+# plugins; here a native TPE so no external dependency is needed) ----------
+
+
+class Searcher:
+    """Suggest/observe protocol (reference Searcher ABC)."""
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        pass
+
+
+class TPESearcher(Searcher):
+    """Native tree-structured Parzen estimator (the algorithm behind
+    hyperopt — reference integrates it via tune/search/hyperopt). Models
+    each dimension independently: observed results split into good (top
+    ``gamma`` quantile) and bad; candidates are drawn from the good
+    distribution and ranked by the good/bad density ratio.
+    """
+
+    def __init__(self, space: dict, *, metric: str, mode: str = "max",
+                 n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        assert mode in ("max", "min")
+        self._flat_space = _flatten(space)
+        for k, v in self._flat_space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    f"{k}: grid_search is not a samplable domain; use "
+                    f"choice() with TPESearcher")
+        self._metric = metric
+        self._mode = mode
+        self._n_initial = n_initial
+        self._gamma = gamma
+        self._n_candidates = n_candidates
+        self._rng = _random.Random(seed)
+        self._observed: list[tuple[dict, float]] = []  # (flat_cfg, score)
+        self._pending: dict[str, dict] = {}
+
+    # -- scoring helpers --
+    def _score(self, result: dict) -> Optional[float]:
+        v = result.get(self._metric) if result else None
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def _split(self) -> tuple[list[dict], list[dict]]:
+        ranked = sorted(self._observed, key=lambda t: -t[1])
+        n_good = max(1, int(len(ranked) * self._gamma))
+        return ([c for c, _ in ranked[:n_good]],
+                [c for c, _ in ranked[n_good:]] or [c for c, _ in ranked])
+
+    def _density(self, value, key, domain, configs) -> float:
+        vals = [c[key] for c in configs if key in c]
+        if not vals:
+            return 1e-12
+        if isinstance(domain, Choice):
+            counts = sum(1 for v in vals if v == value)
+            return (counts + 1.0) / (len(vals) + len(domain.values))
+        import math
+        lo, hi = _domain_range(domain)
+        log = isinstance(domain, LogUniform)
+        x = math.log(value) if log else float(value)
+        pts = [math.log(v) if log else float(v) for v in vals]
+        bw = max((hi - lo) / max(len(pts) ** 0.5, 1.0), 1e-9)
+        return sum(math.exp(-0.5 * ((x - p) / bw) ** 2) for p in pts) \
+            / (len(pts) * bw) + 1e-12
+
+    def _sample_dim(self, key, domain, good, bad):
+        if not isinstance(domain, Domain):
+            return domain  # constant
+        best_v, best_ratio = None, -1.0
+        for _ in range(self._n_candidates):
+            # candidate from the good distribution (perturb a good point)
+            if good and self._rng.random() < 0.8:
+                base = self._rng.choice(good).get(key)
+                v = self._perturb(domain, base) if base is not None \
+                    else domain.sample(self._rng)
+            else:
+                v = domain.sample(self._rng)
+            ratio = (self._density(v, key, domain, good)
+                     / self._density(v, key, domain, bad))
+            if ratio > best_ratio:
+                best_v, best_ratio = v, ratio
+        return best_v
+
+    def _perturb(self, domain, base):
+        import math
+        if isinstance(domain, Choice):
+            return base if self._rng.random() < 0.7 \
+                else domain.sample(self._rng)
+        lo, hi = _domain_range(domain)
+        if isinstance(domain, LogUniform):
+            x = math.log(base) + self._rng.gauss(0, (hi - lo) * 0.2)
+            return math.exp(min(max(x, lo), hi))
+        v = base + self._rng.gauss(0, (hi - lo) * 0.2)
+        v = min(max(v, lo), hi)
+        return int(round(v)) if isinstance(domain, RandInt) else v
+
+    # -- Searcher API --
+    def suggest(self, trial_id: str) -> dict:
+        if len(self._observed) < self._n_initial:
+            flat = {k: (v.sample(self._rng) if isinstance(v, Domain) else v)
+                    for k, v in self._flat_space.items()}
+        else:
+            good, bad = self._split()
+            flat = {k: self._sample_dim(k, v, good, bad)
+                    for k, v in self._flat_space.items()}
+        self._pending[trial_id] = flat
+        return _unflatten(flat)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        flat = self._pending.pop(trial_id, None)
+        score = None if error else self._score(result)
+        if flat is not None and score is not None:
+            self._observed.append((flat, score))
+
+
+def _domain_range(domain) -> tuple[float, float]:
+    import math
+    if isinstance(domain, LogUniform):
+        return math.log(domain.low), math.log(domain.high)
+    if isinstance(domain, (Uniform, RandInt)):
+        return float(domain.low), float(domain.high)
+    return 0.0, 1.0
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (reference search/concurrency_limiter)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self._searcher = searcher
+        self._max = max_concurrent
+        self._live: set[str] = set()
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._live) >= self._max:
+            return None
+        cfg = self._searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        self._live.discard(trial_id)
+        self._searcher.on_trial_complete(trial_id, result, error)

@@ -33,6 +33,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
+    # a Searcher (e.g. TPESearcher) that proposes configs sequentially
+    # instead of upfront variant expansion (reference search_alg)
+    search_alg: object = None
     seed: Optional[int] = None
 
     def __post_init__(self):
@@ -120,12 +123,33 @@ class TuneController:
         self._poll_interval_s = poll_interval_s
         self._run_name = run_config.name or new_run_name()
         self._storage = StorageContext(run_config.storage_path, self._run_name)
-        variants = BasicVariantGenerator(
-            param_space, tune_config.num_samples, tune_config.seed).variants()
-        self.trials = [Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:6]}",
-                             config=cfg) for i, cfg in enumerate(variants)]
+        self._searcher = tune_config.search_alg
+        if self._searcher is None:
+            variants = BasicVariantGenerator(
+                param_space, tune_config.num_samples,
+                tune_config.seed).variants()
+            self.trials = [
+                Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:6]}",
+                      config=cfg) for i, cfg in enumerate(variants)]
+            self._suggest_budget = 0
+        else:
+            # searcher-driven: trials are created lazily from suggest()
+            self.trials = []
+            self._suggest_budget = tune_config.num_samples
         self._scheduler = tune_config.scheduler or FIFOScheduler()
         self._max_concurrent = tune_config.max_concurrent_trials or 4
+
+    def _next_suggested_trial(self) -> Optional[Trial]:
+        if self._searcher is None or self._suggest_budget <= 0:
+            return None
+        trial_id = f"trial_{len(self.trials):05d}_{uuid.uuid4().hex[:6]}"
+        cfg = self._searcher.suggest(trial_id)
+        if cfg is None:
+            return None  # concurrency-limited: retry next loop
+        self._suggest_budget -= 1
+        trial = Trial(trial_id=trial_id, config=cfg)
+        self.trials.append(trial)
+        return trial
 
     def _start_trial(self, trial: Trial, resume_from: Optional[Checkpoint] = None):
         trial.actor = RayTrainWorker.remote()
@@ -174,6 +198,14 @@ class TuneController:
                 decision = STOP
         return decision
 
+    def _notify_searcher(self, trial: Trial, error: bool = False) -> None:
+        if self._searcher is not None:
+            try:
+                self._searcher.on_trial_complete(
+                    trial.trial_id, trial.last_metrics, error=error)
+            except Exception:  # noqa: BLE001
+                pass
+
     def _apply_pbt(self):
         sched = self._scheduler
         if not isinstance(sched, PopulationBasedTraining):
@@ -194,7 +226,13 @@ class TuneController:
     def run(self) -> ResultGrid:
         pending = list(self.trials)
         running: list[Trial] = []
-        while pending or running:
+        while pending or running or self._suggest_budget > 0:
+            while self._suggest_budget > 0 and not pending \
+                    and len(running) < self._max_concurrent:
+                t = self._next_suggested_trial()
+                if t is None:
+                    break
+                pending.append(t)
             while pending and len(running) < self._max_concurrent:
                 trial = pending.pop(0)
                 try:
@@ -203,6 +241,7 @@ class TuneController:
                 except Exception as e:  # noqa: BLE001 - scheduling failure
                     trial.error = repr(e)
                     trial.state = "ERROR"
+                    self._notify_searcher(trial, error=True)
             for trial in list(running):
                 try:
                     status = ray_tpu.get(trial.actor.poll.remote(),
@@ -211,23 +250,27 @@ class TuneController:
                     trial.error = f"trial actor died: {e!r}"
                     self._stop_trial(trial, "ERROR")
                     running.remove(trial)
+                    self._notify_searcher(trial, error=True)
                     continue
                 decision = self._handle_reports(trial, status.reports)
                 if status.error:
                     trial.error = status.error
                     self._stop_trial(trial, "ERROR")
                     running.remove(trial)
+                    self._notify_searcher(trial, error=True)
                 elif decision == STOP:
                     self._scheduler.on_complete(trial, trial.last_metrics)
                     self._stop_trial(trial, "STOPPED")
                     running.remove(trial)
+                    self._notify_searcher(trial)
                 elif status.finished:
                     self._scheduler.on_complete(trial, trial.last_metrics)
                     self._stop_trial(trial, "TERMINATED")
                     running.remove(trial)
+                    self._notify_searcher(trial)
             self._apply_pbt()
             running = [t for t in self.trials if t.state == "RUNNING"]
-            if running or pending:
+            if running or pending or self._suggest_budget > 0:
                 time.sleep(self._poll_interval_s)
         results = [TrialResult(metrics=t.last_metrics, config=t.config,
                                error=t.error, checkpoint=t.checkpoint,

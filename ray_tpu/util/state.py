@@ -138,7 +138,7 @@ def worker_logs(worker_id: Optional[str] = None,
     roots = []
     if get_config().log_dir:
         roots.append(get_config().log_dir)
-    roots.extend(glob.glob("/tmp/ray_tpu/logs/agent-*"))
+    roots.extend(glob.glob("/tmp/ray_tpu_logs/agent-*"))
     out: dict[str, str] = {}
     for root in roots:
         for path in sorted(glob.glob(os.path.join(root, "worker-*.out")) +

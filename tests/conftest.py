@@ -10,7 +10,7 @@ multi-node Cluster fixture. JAX tests run on a virtual 8-device CPU mesh
 import os
 
 # Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: ambient env may say otherwise
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,115 @@
+"""Jobs, CLI, runtime envs, dashboard (reference: dashboard/modules/job/,
+scripts/scripts.py, _private/runtime_env/, dashboard/)."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def test_job_submission(ray_start_regular, tmp_path):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"
+        "@ray_tpu.remote\n"
+        "def f(x): return x + 1\n"
+        "print('total:', sum(ray_tpu.get([f.remote(i) for i in range(4)])))\n"
+        "ray_tpu.shutdown()\n")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    status = client.wait_until_finished(job_id, timeout=120.0)
+    assert status == JobStatus.SUCCEEDED
+    assert "total: 10" in client.get_job_logs(job_id)
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+
+def test_job_failure_recorded(ray_start_regular, tmp_path):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    status = client.wait_until_finished(job_id, timeout=60.0)
+    assert status == JobStatus.FAILED
+    assert client.get_job_info(job_id)["return_code"] == 3
+
+
+def test_runtime_env_env_vars_and_working_dir(ray_start_regular, tmp_path):
+    d = tmp_path / "wd"
+    d.mkdir()
+    (d / "mymod_rt.py").write_text("VALUE = 123")
+    (d / "data.txt").write_text("hello-env")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_FLAG": "yes"},
+                                 "working_dir": str(d)})
+    def probe():
+        import mymod_rt
+        return (os.environ["RT_FLAG"], mymod_rt.VALUE,
+                open("data.txt").read())
+
+    assert ray_tpu.get(probe.remote(), timeout=90) == (
+        "yes", 123, "hello-env")
+
+    # plain tasks keep the clean environment
+    @ray_tpu.remote
+    def clean():
+        return os.environ.get("RT_FLAG")
+
+    assert ray_tpu.get(clean.remote(), timeout=90) is None
+
+
+def test_runtime_env_validation(ray_start_regular):
+    from ray_tpu.runtime_env import RuntimeEnvError
+
+    @ray_tpu.remote(runtime_env={"bogus_key": 1})
+    def f():
+        return 1
+
+    with pytest.raises(RuntimeEnvError):
+        f.remote()
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu.dashboard import start_dashboard
+
+    db = start_dashboard(port=0)
+    try:
+        base = f"http://127.0.0.1:{db.port}"
+        nodes = json.loads(urllib.request.urlopen(
+            base + "/api/nodes", timeout=30).read())
+        assert nodes and nodes[0]["alive"]
+        html = urllib.request.urlopen(base + "/", timeout=30).read().decode()
+        assert "ray_tpu dashboard" in html
+    finally:
+        db.stop()
+
+
+def test_cli_status_and_head(tmp_path):
+    ray_tpu.shutdown()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    port = 6399
+    subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--port", str(port), "--num-cpus", "2", "--dashboard-port", "-1"],
+        check=True, env=env, timeout=120, cwd="/root/repo")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "status",
+             "--address", f"127.0.0.1:{port}"],
+            capture_output=True, text=True, env=env, timeout=120,
+            cwd="/root/repo")
+        assert out.returncode == 0, out.stderr[-500:]
+        assert "nodes: 1" in out.stdout
+    finally:
+        subprocess.run([sys.executable, "-m", "ray_tpu", "stop"],
+                       env=env, timeout=60, cwd="/root/repo")

@@ -185,3 +185,36 @@ def test_http_proxy(serve_shutdown):
         "http://127.0.0.1:18123/-/routes", timeout=10).read())
     assert "/echo" in routes
     serve.delete("httpapp")
+
+
+def test_multiplexed_models(ray_start_regular):
+    """@serve.multiplexed LRU-caches per-model state per replica; handle
+    .options(multiplexed_model_id=...) routes the same model to the same
+    replica (rendezvous affinity)."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model-{model_id}"
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return {"model": model, "loads": len(self.loads), "x": x}
+
+    handle = serve.run(MultiModel.bind(), name="mx", route_prefix="/mx")
+    h1 = handle.options(multiplexed_model_id="m1")
+    outs = [h1.remote(i).result(timeout_s=60) for i in range(4)]
+    assert all(o["model"] == "model-m1" for o in outs)
+    # the model loaded ONCE despite 4 requests (same replica + LRU cache)
+    assert outs[-1]["loads"] == 1
+    h2 = handle.options(multiplexed_model_id="m2")
+    out2 = h2.remote(0).result(timeout_s=60)
+    assert out2["model"] == "model-m2"
+    serve.shutdown()
