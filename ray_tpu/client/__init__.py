@@ -1,0 +1,11 @@
+"""Remote-driver client mode (`ray_tpu.init("ray_tpu://host:port")`).
+
+TPU-native analog of the reference's Ray Client (util/client/): a
+ClientServer beside the cluster head hosts one real driver per connected
+client; the client proxies the runtime API over the framework RPC layer.
+"""
+
+from ray_tpu.client.client import ClientRuntime
+from ray_tpu.client.server import ClientServer
+
+__all__ = ["ClientRuntime", "ClientServer"]

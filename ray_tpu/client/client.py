@@ -1,0 +1,203 @@
+"""Client-side runtime for remote drivers (`ray_tpu://host:port`).
+
+TPU-native analog of the reference's Ray Client worker
+(/root/reference/python/ray/util/client/worker.py): implements the same
+runtime interface the local WorkerRuntime exposes to the API layer
+(submit_task / submit_actor_creation / submit_actor_task / put / get / wait),
+but every operation is an RPC to a ClientServer, which runs a real driver
+inside the cluster. No shared memory with the cluster is needed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import cloudpickle
+
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.rpc import RpcClient
+
+
+class _ClientRefCounter:
+    """Local-ref bookkeeping: when the last client-side ObjectRef for an oid
+    dies, release the server-side pin (batched)."""
+
+    def __init__(self, runtime: "ClientRuntime"):
+        self._rt = runtime
+        self._counts: dict = {}
+        self._lock = threading.Lock()
+
+    def add_local_ref(self, oid):
+        with self._lock:
+            self._counts[oid] = self._counts.get(oid, 0) + 1
+
+    def remove_local_ref(self, oid):
+        release = False
+        with self._lock:
+            c = self._counts.get(oid, 0) - 1
+            if c <= 0:
+                self._counts.pop(oid, None)
+                release = True
+            else:
+                self._counts[oid] = c
+        if release:
+            self._rt._release(oid)
+
+    # api.cancel probes these; harmless defaults for client mode
+    def is_owned(self, oid) -> bool:
+        return False
+
+
+class _CpProxy:
+    """cp_client lookalike forwarding through the server's driver, so state
+    APIs / named actors / kill work unchanged in client mode."""
+
+    def __init__(self, runtime: "ClientRuntime"):
+        self._rt = runtime
+
+    def call(self, method: str, body=None, timeout: float | None = 30.0):
+        return self._rt._call("call_cp", {"method": method, "body": body,
+                                          "timeout": timeout},
+                              timeout=(timeout or 30.0) + 10.0)
+
+    def call_with_retry(self, method: str, body=None,
+                        timeout: float | None = 30.0, retries: int = 3):
+        last = None
+        for _ in range(retries + 1):
+            try:
+                return self.call(method, body, timeout)
+            except Exception as e:  # noqa: BLE001
+                last = e
+        raise last
+
+    def notify(self, method: str, body=None):
+        self.call(method, body, timeout=30.0)
+
+
+class _StubTaskManager:
+    def get_pending_spec(self, task_id):
+        return None
+
+
+class ClientRuntime:
+    mode = "client"
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._client = RpcClient((host, int(port)), name="ray-client")
+        reply = self._client.call("connect", {}, timeout=30.0)
+        self._session = reply["session_id"]
+        self.job_id = reply["job_id"]
+        self.node_id = None
+        self.worker_id = None
+        self.cp_addr = (host, int(port))
+        self.addr = ("client", 0)
+        self.reference_counter = _ClientRefCounter(self)
+        self.cp_client = _CpProxy(self)
+        self.task_manager = _StubTaskManager()
+        self._fn_ids: dict[int, str] = {}  # id(fn) -> server fn_id
+        self._fn_lock = threading.Lock()
+
+    # -- plumbing -------------------------------------------------------
+    def _call(self, method: str, body: dict, timeout: float = 60.0):
+        body["session"] = self._session
+        return self._client.call(method, body, timeout=timeout)
+
+    def _release(self, oid):
+        try:
+            self._call("release", {"oids": [oid.binary()]}, timeout=10.0)
+        except Exception:
+            pass
+
+    def _register(self, fn) -> str:
+        with self._fn_lock:
+            fn_id = self._fn_ids.get(id(fn))
+        if fn_id is not None:
+            return fn_id
+        blob = cloudpickle.dumps(fn)
+        fn_id = self._call("register_fn", {"blob": blob}, timeout=60.0)["fn_id"]
+        with self._fn_lock:
+            self._fn_ids[id(fn)] = fn_id
+        return fn_id
+
+    def _pack_args(self, args, kwargs) -> bytes:
+        from ray_tpu.client.server import _RefPlaceholder
+
+        def swap(x):
+            if isinstance(x, ObjectRef):
+                return _RefPlaceholder(x.id().binary())
+            return x
+        return cloudpickle.dumps(
+            (tuple(swap(a) for a in args),
+             {k: swap(v) for k, v in kwargs.items()}))
+
+    def _mk_refs(self, ref_infos) -> list[ObjectRef]:
+        return [ObjectRef(oid, owner, tuple(addr) if addr else None)
+                for oid, owner, addr in ref_infos]
+
+    # -- runtime interface ---------------------------------------------
+    def put(self, value, **_kw) -> ObjectRef:
+        reply = self._call("put", {"data": cloudpickle.dumps(value)})
+        return self._mk_refs(reply["refs"])[0]
+
+    def get(self, refs, timeout: float | None = None):
+        reply = self._call(
+            "get", {"oids": [r.id().binary() for r in refs],
+                    "timeout": timeout},
+            timeout=(timeout or 3600.0) + 30.0)
+        if "error" in reply:
+            raise cloudpickle.loads(reply["error"])
+        return cloudpickle.loads(reply["data"])
+
+    def wait(self, refs, num_returns: int = 1, timeout: float | None = None):
+        reply = self._call(
+            "wait", {"oids": [r.id().binary() for r in refs],
+                     "num_returns": num_returns, "timeout": timeout},
+            timeout=(timeout or 3600.0) + 30.0)
+        by_bin = {r.id().binary(): r for r in refs}
+        return ([by_bin[b] for b in reply["ready"]],
+                [by_bin[b] for b in reply["pending"]])
+
+    def submit_task(self, fn, args, kwargs, **opts) -> list[ObjectRef]:
+        reply = self._call("task", {
+            "fn_id": self._register(fn),
+            "args": self._pack_args(args, kwargs),
+            "opts": opts})
+        return self._mk_refs(reply["refs"])
+
+    def submit_actor_creation(self, cls, args, kwargs, *, actor_id, **opts):
+        self._call("actor_create", {
+            "fn_id": self._register(cls),
+            "actor_id": actor_id,
+            "args": self._pack_args(args, kwargs),
+            "opts": opts})
+        return actor_id
+
+    def submit_actor_task(self, actor_id, method: str, args, kwargs,
+                          **opts) -> list[ObjectRef]:
+        reply = self._call("actor_call", {
+            "actor_id": actor_id, "method": method,
+            "args": self._pack_args(args, kwargs), "opts": opts})
+        return self._mk_refs(reply["refs"])
+
+    def as_future(self, ref):
+        from concurrent.futures import Future
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get([ref], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def in_actor(self) -> bool:
+        return False
+
+    def shutdown(self):
+        try:
+            self._call("disconnect", {}, timeout=10.0)
+        except Exception:
+            pass
+        self._client.close()

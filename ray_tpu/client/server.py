@@ -1,0 +1,194 @@
+"""Client server: hosts remote drivers (`ray_tpu://` connections).
+
+TPU-native analog of the reference's Ray Client server
+(/root/reference/python/ray/util/client/server/ — proxier + per-client
+drivers, ARCHITECTURE.md): a process colocated with the cluster head accepts
+client connections over the framework RPC layer; each session runs a real
+driver WorkerRuntime inside the server, and the client proxies its API calls
+to it. Clients therefore need no shared memory with the cluster — they can
+be laptops across a WAN.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+import uuid
+
+import cloudpickle
+
+from ray_tpu.core.ids import JobID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.rpc import RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class _Session:
+    def __init__(self, server, session_id: str):
+        from ray_tpu.core.worker import WorkerRuntime
+
+        self.id = session_id
+        self.fn_cache: dict[str, object] = {}
+        self.pinned: dict[bytes, ObjectRef] = {}  # oid binary -> ref (pin)
+        self.lock = threading.Lock()
+        self.last_seen = time.monotonic()
+        self.rt = WorkerRuntime(
+            mode="driver", cp_addr=server.cp_addr,
+            agent_addr=server.agent_addr, job_id=JobID.from_random(),
+            node_id=server.node_id)
+        self.rt.cp_client.call_with_retry(
+            "register_job", {"job_id": self.rt.job_id, "addr": self.rt.addr},
+            timeout=30.0)
+
+    def pin(self, refs: list[ObjectRef]) -> list:
+        with self.lock:
+            for r in refs:
+                self.pinned[r.id().binary()] = r
+        return [(r.id(), r.owner, r.owner_addr) for r in refs]
+
+    def resolve(self, oid_bins: list[bytes]) -> list[ObjectRef]:
+        with self.lock:
+            return [self.pinned[b] for b in oid_bins]
+
+    def close(self):
+        try:
+            self.rt.cp_client.call(
+                "finish_job", {"job_id": self.rt.job_id}, timeout=5.0)
+        except Exception:
+            pass
+        with self.lock:
+            self.pinned.clear()
+        self.rt.shutdown()
+
+
+class ClientServer:
+    """(ref: util/client/server/server.py BasicRayServicer)"""
+
+    def __init__(self, cp_addr: tuple, *, host: str = "0.0.0.0", port: int = 0):
+        self.cp_addr = tuple(cp_addr)
+        probe = RpcClient(self.cp_addr, name="client-server-probe")
+        nodes = probe.call_with_retry("get_nodes", None, timeout=30.0)
+        probe.close()
+        alive = [n for n in nodes if n["alive"]]
+        if not alive:
+            raise RuntimeError("no alive nodes to host client drivers on")
+        self.agent_addr = tuple(alive[0]["addr"])
+        self.node_id = alive[0]["node_id"]
+        self._sessions: dict[str, _Session] = {}
+        self._lock = threading.Lock()
+        self._server = RpcServer(
+            self._handle, host=host, port=port, name="client-server",
+            blocking_methods={"get", "wait", "call_cp", "task", "actor_call"},
+            pool_size=16)
+        self.addr = self._server.addr
+
+    def _handle(self, method: str, body, peer):
+        if method == "connect":
+            s = _Session(self, uuid.uuid4().hex)
+            with self._lock:
+                self._sessions[s.id] = s
+            return {"session_id": s.id, "job_id": s.rt.job_id}
+        s = self._session(body["session"])
+        s.last_seen = time.monotonic()
+        return getattr(self, "_h_" + method)(s, body)
+
+    def _session(self, session_id: str) -> _Session:
+        with self._lock:
+            s = self._sessions.get(session_id)
+        if s is None:
+            raise RuntimeError(f"unknown client session {session_id}")
+        return s
+
+    # -- handlers -------------------------------------------------------
+    def _h_disconnect(self, s: _Session, body):
+        with self._lock:
+            self._sessions.pop(s.id, None)
+        s.close()
+        return {"ok": True}
+
+    def _h_put(self, s: _Session, body):
+        value = cloudpickle.loads(body["data"])
+        return {"refs": s.pin([s.rt.put(value)])}
+
+    def _h_get(self, s: _Session, body):
+        refs = s.resolve(body["oids"])
+        try:
+            values = s.rt.get(refs, timeout=body.get("timeout"))
+            return {"data": cloudpickle.dumps(values)}
+        except BaseException as e:  # noqa: BLE001 — app errors cross the wire
+            return {"error": cloudpickle.dumps(e)}
+
+    def _h_wait(self, s: _Session, body):
+        refs = s.resolve(body["oids"])
+        ready, pending = s.rt.wait(refs, num_returns=body["num_returns"],
+                                   timeout=body.get("timeout"))
+        return {"ready": [r.id().binary() for r in ready],
+                "pending": [r.id().binary() for r in pending]}
+
+    def _h_register_fn(self, s: _Session, body):
+        fn_id = hashlib.sha1(body["blob"]).hexdigest()
+        if fn_id not in s.fn_cache:
+            s.fn_cache[fn_id] = cloudpickle.loads(body["blob"])
+        return {"fn_id": fn_id}
+
+    def _load_args(self, s: _Session, body):
+        args, kwargs = cloudpickle.loads(body["args"])
+        # client-side ObjectRefs arrive as placeholders -> swap pinned refs
+        def swap(x):
+            if isinstance(x, _RefPlaceholder):
+                return s.pinned[x.oid_bin]
+            return x
+        return tuple(swap(a) for a in args), {k: swap(v) for k, v in kwargs.items()}
+
+    def _h_task(self, s: _Session, body):
+        fn = s.fn_cache.get(body["fn_id"])
+        if fn is None:
+            raise RuntimeError("function not registered (client must "
+                               "register_fn first)")
+        args, kwargs = self._load_args(s, body)
+        refs = s.rt.submit_task(fn, args, kwargs, **body["opts"])
+        return {"refs": s.pin(refs)}
+
+    def _h_actor_create(self, s: _Session, body):
+        cls = s.fn_cache.get(body["fn_id"])
+        if cls is None:
+            raise RuntimeError("class not registered")
+        args, kwargs = self._load_args(s, body)
+        s.rt.submit_actor_creation(
+            cls, args, kwargs, actor_id=body["actor_id"], **body["opts"])
+        return {"actor_id": body["actor_id"]}
+
+    def _h_actor_call(self, s: _Session, body):
+        args, kwargs = self._load_args(s, body)
+        refs = s.rt.submit_actor_task(
+            body["actor_id"], body["method"], args, kwargs, **body["opts"])
+        return {"refs": s.pin(refs)}
+
+    def _h_release(self, s: _Session, body):
+        with s.lock:
+            for b in body["oids"]:
+                s.pinned.pop(b, None)
+        return {"ok": True}
+
+    def _h_call_cp(self, s: _Session, body):
+        """Transparent control-plane passthrough: state APIs, named actors,
+        cluster_resources etc. work unchanged over the client."""
+        return s.rt.cp_client.call(body["method"], body["body"],
+                                   timeout=body.get("timeout", 30.0))
+
+    def stop(self):
+        with self._lock:
+            sessions, self._sessions = list(self._sessions.values()), {}
+        for s in sessions:
+            s.close()
+        self._server.stop()
+
+
+class _RefPlaceholder:
+    """Wire form of a client-held ObjectRef inside task args."""
+
+    def __init__(self, oid_bin: bytes):
+        self.oid_bin = oid_bin

@@ -66,9 +66,21 @@ def init(address: str | None = None, *, num_cpus: float | None = None,
         reset_config()
         cfg = get_config()
         cfg.apply(_system_config)
+        if not log_to_driver:
+            cfg.log_to_driver = False
         if _system_config:
             # propagate to spawned worker processes
             os.environ.update(cfg.to_env(_system_config))
+
+        if address and address.startswith(("ray_tpu://", "ray://")):
+            # remote-driver (client) mode: no shared memory with the cluster;
+            # everything proxies through a ClientServer-hosted driver
+            # (ref: util/client/ ray:// mode, client_mode_hook.py)
+            from ray_tpu.client.client import ClientRuntime
+            rt = ClientRuntime(address.split("://", 1)[1])
+            _runtime = rt
+            atexit.register(_atexit_shutdown)
+            return RuntimeContext(rt)
 
         from ray_tpu.core.worker import WorkerRuntime
 

@@ -1,0 +1,180 @@
+"""Mutable shared-memory channels for host-side pipelining.
+
+TPU-native analog of the reference's mutable-object channels
+(/root/reference/src/ray/core_worker/experimental_mutable_object_manager.cc,
+python/ray/experimental/channel/shared_memory_channel.py): a fixed-capacity
+shared buffer that a writer overwrites in place and one or more readers
+consume, with writer/reader rendezvous — no per-message allocation, no
+object-store churn.
+
+Design notes (vs the reference):
+- On TPU the accelerator data plane is XLA collectives over ICI, and a chip
+  admits exactly one process — so channels here are HOST-local (one machine,
+  many processes), used to pipeline batches between stage actors
+  (data loading -> preprocna -> device feed). Cross-host movement belongs to
+  the object plane (chunked pulls) or the SPMD program itself.
+- Synchronization is a seqlock over /dev/shm: the writer publishes by
+  bumping ``seq`` after the payload landing; readers ack by writing their
+  per-reader slot. Single-writer/N-reader needs no atomics — every word has
+  exactly one writer (TSO gives release/acquire on the seq publish).
+
+Layout: [magic u32][capacity u64][num_readers u32][seq u64][len u64]
+        [ack u64 x num_readers][payload capacity bytes]
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import time
+import uuid
+
+_MAGIC = 0x52435748  # "RCWH"
+_HDR = struct.Struct("<IQI")          # magic, capacity, num_readers
+_SEQ_OFF = _HDR.size                  # u64 seq
+_LEN_OFF = _SEQ_OFF + 8               # u64 len
+_ACK_OFF = _LEN_OFF + 8               # u64 * num_readers
+
+
+class ChannelTimeoutError(TimeoutError):
+    pass
+
+
+class ChannelClosedError(RuntimeError):
+    pass
+
+
+_CLOSED_SEQ = (1 << 64) - 1
+
+
+def _wait(pred, timeout: float | None, what: str):
+    """Adaptive spin→sleep wait: sub-ms latency when hot, cheap when idle."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    spins = 0
+    while not pred():
+        spins += 1
+        if spins < 200:
+            continue  # hot spin ~ tens of us
+        time.sleep(0.0001 if spins < 2200 else 0.002)
+        if deadline is not None and time.monotonic() > deadline:
+            raise ChannelTimeoutError(f"channel {what} timed out")
+
+
+class _Mapped:
+    def __init__(self, path: str, create_bytes: int | None = None):
+        self.path = path
+        if create_bytes is not None:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            os.ftruncate(fd, create_bytes)
+        else:
+            fd = os.open(path, os.O_RDWR)
+        try:
+            self.mm = mmap.mmap(fd, 0)
+        finally:
+            os.close(fd)
+
+    def u64(self, off: int) -> int:
+        return int.from_bytes(self.mm[off:off + 8], "little")
+
+    def put_u64(self, off: int, val: int) -> None:
+        self.mm[off:off + 8] = val.to_bytes(8, "little")
+
+
+class Channel:
+    """Writer endpoint. Pickling a Channel ships an attach-by-name handle;
+    use ``reader(i)`` to hand each consumer its reader index."""
+
+    def __init__(self, capacity: int = 8 * 1024 * 1024, num_readers: int = 1,
+                 _attach: str | None = None):
+        if _attach is None:
+            name = f"rtpu_chan_{uuid.uuid4().hex[:16]}"
+            self._path = "/dev/shm/" + name
+            total = _ACK_OFF + 8 * num_readers + capacity
+            self._map = _Mapped(self._path, create_bytes=total)
+            self._map.mm[:_HDR.size] = _HDR.pack(_MAGIC, capacity, num_readers)
+            self._owner = True
+        else:
+            self._path = _attach
+            self._map = _Mapped(self._path)
+            self._owner = False
+        magic, cap, n = _HDR.unpack(self._map.mm[:_HDR.size])
+        if magic != _MAGIC:
+            raise ValueError(f"not a channel segment: {self._path}")
+        self.capacity, self.num_readers = cap, n
+        self._payload_off = _ACK_OFF + 8 * n
+
+    # -- pickle: attach-by-name handle ---------------------------------
+    def __reduce__(self):
+        return (Channel, (0, 0, self._path))
+
+    def _seq(self) -> int:
+        return self._map.u64(_SEQ_OFF)
+
+    def _acks_current(self) -> bool:
+        seq = self._seq()
+        return all(self._map.u64(_ACK_OFF + 8 * i) == seq
+                   for i in range(self.num_readers))
+
+    def write(self, value, timeout: float | None = 10.0) -> None:
+        """Blocks until every reader consumed the previous value, then
+        publishes this one (ref: MutableObjectManager::WriteAcquire)."""
+        if self._seq() == _CLOSED_SEQ:
+            raise ChannelClosedError("channel closed")
+        data = value if isinstance(value, (bytes, bytearray, memoryview)) \
+            else pickle.dumps(value, protocol=5)
+        if len(data) > self.capacity:
+            raise ValueError(
+                f"value of {len(data)} bytes exceeds channel capacity "
+                f"{self.capacity}; size the channel for the largest batch")
+        _wait(self._acks_current, timeout, "write (readers lagging)")
+        self._map.mm[self._payload_off:self._payload_off + len(data)] = \
+            bytes(data)
+        self._map.put_u64(_LEN_OFF, len(data))
+        self._map.put_u64(_SEQ_OFF, self._seq() + 1)  # publish
+
+    def reader(self, index: int) -> "ChannelReader":
+        if not 0 <= index < self.num_readers:
+            raise ValueError(f"reader index {index} out of range")
+        return ChannelReader(self._path, index)
+
+    def close(self) -> None:
+        """Mark closed; readers observe ChannelClosedError on next read."""
+        self._map.put_u64(_SEQ_OFF, _CLOSED_SEQ)
+
+    def unlink(self) -> None:
+        if self._owner:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+class ChannelReader:
+    def __init__(self, path: str, index: int):
+        self._path, self._index = path, index
+        self._map = _Mapped(path)
+        magic, cap, n = _HDR.unpack(self._map.mm[:_HDR.size])
+        self._payload_off = _ACK_OFF + 8 * n
+        self._ack_off = _ACK_OFF + 8 * index
+        self._seen = self._map.u64(self._ack_off)
+
+    def __reduce__(self):
+        return (ChannelReader, (self._path, self._index))
+
+    def read(self, timeout: float | None = 10.0, raw: bool = False):
+        """Blocks for the next value (each reader sees every value exactly
+        once — ref: MutableObjectManager::ReadAcquire/ReadRelease)."""
+        def ready():
+            s = self._map.u64(_SEQ_OFF)
+            return s > self._seen
+        _wait(ready, timeout, "read")
+        seq = self._map.u64(_SEQ_OFF)
+        if seq == _CLOSED_SEQ:
+            raise ChannelClosedError("channel closed by writer")
+        n = self._map.u64(_LEN_OFF)
+        data = bytes(self._map.mm[self._payload_off:self._payload_off + n])
+        self._seen = seq
+        self._map.put_u64(self._ack_off, seq)  # release
+        return data if raw else pickle.loads(data)

@@ -100,6 +100,10 @@ class Config:
     # --- misc ---
     worker_register_timeout_s: float = 30.0
     log_dir: str = ""
+    # Stream worker stdout/stderr to the driver (ref: _private/log_monitor.py
+    # + worker.py log_to_driver).
+    log_to_driver: bool = True
+    log_monitor_interval_s: float = 0.3
 
     def __post_init__(self) -> None:
         # env overrides

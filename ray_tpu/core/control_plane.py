@@ -91,6 +91,7 @@ class ControlPlane:
         self._kv: dict[str, bytes] = {}
         self._jobs: dict[JobID, dict] = {}
         self._subs: dict[str, set[tuple[str, int]]] = {}
+        self._sub_strikes: dict[tuple, int] = {}  # (channel, addr) -> fails
         self._pool = ClientPool("cp")
         self._pending_actors: list[ActorID] = []
         self._pending_pgs: list[PlacementGroupID] = []
@@ -306,8 +307,18 @@ class ControlPlane:
         for addr in targets:
             try:
                 self._pool.get(addr).notify("pubsub", {"channel": channel, "msg": msg})
+                self._sub_strikes.pop((channel, addr), None)
             except Exception:
-                pass
+                # subscribers that exited without unsubscribing must not
+                # accumulate connect churn forever: drop after 3 consecutive
+                # failed deliveries (a live one re-establishes on success)
+                self._pool.invalidate(addr)
+                strikes = self._sub_strikes.get((channel, addr), 0) + 1
+                self._sub_strikes[(channel, addr)] = strikes
+                if strikes >= 3:
+                    with self._lock:
+                        self._subs.get(channel, set()).discard(addr)
+                    self._sub_strikes.pop((channel, addr), None)
 
     # ---- task events (observability sink; ref: gcs_task_manager.cc) ----
     def _h_report_task_events(self, body):
@@ -585,7 +596,8 @@ class ControlPlane:
             if spec.runtime_env:
                 lease_body["runtime_env"] = spec.runtime_env
             reply = self._pool.get(node.addr).call_with_retry(
-                "lease_worker", {**lease_body, "for_actor": info.actor_id},
+                "lease_worker", {**lease_body, "for_actor": info.actor_id,
+                                 "job_id": spec.job_id.hex()},
                 timeout=get_config().lease_timeout_s)
         except Exception as e:
             logger.warning("lease for actor %s on node %s failed: %s",

@@ -91,6 +91,16 @@ class MemoryStore:
                 return
         cb(ent)
 
+    def remove_callback(self, object_id: ObjectID, cb) -> None:
+        """Deregister an on_available callback (abandoned waits must not
+        accumulate closures on never-produced objects)."""
+        with self._lock:
+            lst = self._callbacks.get(object_id)
+            if lst and cb in lst:
+                lst.remove(cb)
+                if not lst:
+                    del self._callbacks[object_id]
+
     def remove_location(self, object_id: ObjectID, node_id: NodeID) -> None:
         """Drop a shm location record (object evicted/lost on that node)."""
         with self._lock:

@@ -49,6 +49,8 @@ class _WorkerInfo:
     idle_since: float = field(default_factory=time.monotonic)
     ready = None  # threading.Event
     log_paths: tuple[str, str] | None = None
+    log_offsets: list = field(default_factory=lambda: [0, 0])
+    job_id: str = ""  # hex of the job the current/last lease belongs to
 
 
 @dataclass
@@ -106,6 +108,9 @@ class NodeAgent:
         self._monitor_thread = threading.Thread(
             target=self._monitor_workers, name="agent-monitor", daemon=True)
         self._monitor_thread.start()
+        if cfg.log_to_driver:
+            threading.Thread(target=self._log_monitor_loop,
+                             name="agent-logmon", daemon=True).start()
 
     def _detect_tpu_topology(self):
         """Populate TPU resources/labels from the environment (generalizes the
@@ -207,6 +212,49 @@ class NodeAgent:
             self._workers[worker_id] = info
         return info
 
+    def _log_monitor_loop(self):
+        """Tail per-worker log files and publish new lines to the CP
+        "worker_logs" channel, where driver runtimes print them (TPU-native
+        analog of the reference's log monitor, _private/log_monitor.py: files
+        -> GCS pubsub -> driver stdout)."""
+        interval = get_config().log_monitor_interval_s
+        while not self._stopped.wait(interval):
+            with self._lock:
+                targets = [w for w in self._workers.values()
+                           if w.log_paths and w.job_id]
+            for info in targets:
+                for i, path in enumerate(info.log_paths):
+                    try:
+                        with open(path, "rb") as f:
+                            f.seek(info.log_offsets[i])
+                            data = f.read(256 * 1024)
+                    except OSError:
+                        continue
+                    if not data:
+                        continue
+                    # consume only whole lines; an unterminated tail stays in
+                    # the file for the next tick (a straddled write must not
+                    # surface as two broken lines / torn UTF-8). Pathological
+                    # newline-free output still flushes once it tops 64KB.
+                    nl = data.rfind(b"\n")
+                    if nl < 0 and len(data) < 64 * 1024:
+                        continue
+                    data = data if nl < 0 else data[:nl + 1]
+                    info.log_offsets[i] += len(data)
+                    lines = data.decode("utf-8", "replace").splitlines()
+                    for lo in range(0, len(lines), 200):
+                        try:
+                            self._pool.get(self.cp_addr).notify("publish", {
+                                "channel": f"worker_logs:{info.job_id}",
+                                "msg": {"node_id": self.node_id.hex()[:8],
+                                        "pid": info.pid,
+                                        "stream": ("out", "err")[i],
+                                        "actor": (info.actor_id.hex()[:8]
+                                                  if info.actor_id else None),
+                                        "lines": lines[lo:lo + 200]}})
+                        except Exception:
+                            break
+
     def _h_worker_ready(self, body):
         """Worker process calls home after starting its RPC server."""
         with self._lock:
@@ -262,6 +310,7 @@ class NodeAgent:
                         worker = self._pop_idle_worker(for_tpu, env_key)
                         if worker is not None and worker.ready.is_set():
                             worker.busy = True
+                            worker.job_id = body.get("job_id") or worker.job_id
                             if for_actor is not None:
                                 worker.actor_id = for_actor
                             lease = _Lease(uuid.uuid4().hex, worker.worker_id,

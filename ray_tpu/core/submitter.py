@@ -136,7 +136,8 @@ class NormalTaskSubmitter:
                     agent_addr = picked
                     max_hops = 1  # do not follow spillback off a constrained node
             for _ in range(max_hops):
-                body = {"resources": resources, "timeout": cfg.lease_timeout_s}
+                body = {"resources": resources, "timeout": cfg.lease_timeout_s,
+                        "job_id": self._rt.job_id.hex()}
                 if runtime_env:
                     body["runtime_env"] = runtime_env
                 if pg_id is not None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -139,6 +140,14 @@ class WorkerRuntime:
             blocking_methods={"push_task", "get_object_status", "wait_object"},
             pool_size=8)
         self.addr = self._server.addr
+        if mode == "driver" and get_config().log_to_driver:
+            try:
+                self.cp_client.notify(
+                    "subscribe",
+                    {"channel": f"worker_logs:{job_id.hex()}",
+                     "addr": self.addr})
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     # identity & context
@@ -349,29 +358,93 @@ class WorkerRuntime:
 
     def wait(self, refs: list[ObjectRef], num_returns: int = 1,
              timeout: float | None = None) -> tuple[list[ObjectRef], list[ObjectRef]]:
-        """(ref: CoreWorker::Wait core_worker.h:695)"""
+        """Event-driven wait (ref: CoreWorker::Wait core_worker.h:695 + the
+        raylet's WaitManager): owned refs wake on memory-store availability,
+        borrowed refs on owner long-poll replies — no per-ref poll loop."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready: list[ObjectRef] = []
-        pending = list(refs)
-        sleep = 0.001
-        while len(ready) < num_returns:
-            still = []
-            for ref in pending:
-                if self.is_ready(ref):
-                    ready.append(ref)
-                else:
-                    still.append(ref)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
+        cond = threading.Condition()
+        ready_ids: set = set()
+        finished = [False]
+        cleanups: list = []
+
+        def mark(oid):
+            with cond:
+                ready_ids.add(oid)
+                cond.notify_all()
+
+        need_block = False
+        for ref in refs:
+            oid = ref.id()
+            if self.memory_store.contains(oid):
+                ready_ids.add(oid)
+            elif self.reference_counter.is_owned(oid):
+                cb = (lambda ent, o=oid: mark(o))
+                self.memory_store.on_available(oid, cb)
+                cleanups.append((oid, cb))
+                need_block = True
+            else:
+                self._owner_wait_async(ref, mark, finished, deadline)
+                need_block = True
+
+        if need_block and len(ready_ids) < num_returns:
             self._notify_blocked()
-            time.sleep(sleep)
-            sleep = min(sleep * 1.5, 0.05)
-        order = {ref: i for i, ref in enumerate(refs)}
-        ready.sort(key=lambda r: order[r])
-        return ready, [r for r in refs if r not in set(ready)]
+        with cond:
+            cond.wait_for(
+                lambda: len(ready_ids) >= min(num_returns, len(refs)),
+                self._remaining(deadline))
+            finished[0] = True
+            ready_now = set(ready_ids)
+        for oid, cb in cleanups:
+            self.memory_store.remove_callback(oid, cb)
+        ready = [r for r in refs if r.id() in ready_now]
+        if len(ready) > num_returns:
+            ready = ready[:num_returns]
+        ready_set = {id(r) for r in ready}
+        return ready, [r for r in refs if id(r) not in ready_set]
+
+    def _owner_wait_async(self, ref: ObjectRef, mark, finished, deadline):
+        """Long-poll the owner for a borrowed ref's status; re-arms itself on
+        'pending' replies until the wait finishes (event-driven borrower side
+        of get_object_status, ref: core_worker.proto:492)."""
+        owner_addr = ref.owner_addr
+        oid = ref.id()
+        if owner_addr is None:
+            return
+
+        def on_reply(ok, status):
+            if finished[0]:
+                return
+            if ok and isinstance(status, dict):
+                kind = status.get("kind")
+                if kind == "shm":
+                    self.memory_store.put_location(oid, status["node_id"])
+                    mark(oid)
+                    return
+                if kind == "inline":
+                    self.memory_store.put_inline(
+                        oid, SerializedObject.from_buffer(status["data"]),
+                        status.get("is_error", False))
+                    mark(oid)
+                    return
+                if kind == "lost":
+                    return  # never becomes ready
+            elif not ok:
+                return  # owner unreachable: ref won't resolve here
+            if deadline is not None and time.monotonic() >= deadline:
+                return
+            issue()
+
+        def issue():
+            t = self._remaining(deadline)
+            body = {"object_id": oid, "wait": True,
+                    "timeout": min(t, 5.0) if t is not None else 5.0}
+            try:
+                self.peer_pool.get(owner_addr).call_async(
+                    "get_object_status", body, callback=on_reply)
+            except Exception:
+                pass
+
+        issue()
 
     # ------------------------------------------------------------------
     # task submission
@@ -621,6 +694,17 @@ class WorkerRuntime:
 
     def _h_pubsub(self, body):
         channel, msg = body["channel"], body["msg"]
+        if channel.startswith("worker_logs:"):
+            # log monitor fan-in: print worker output at the driver with a
+            # provenance prefix (ref: _private/log_monitor.py + worker.py
+            # print_to_stdstream)
+            who = f"pid={msg.get('pid')}, node={msg.get('node_id')}"
+            if msg.get("actor"):
+                who = f"actor={msg['actor']}, " + who
+            stream = sys.stderr if msg.get("stream") == "err" else sys.stdout
+            for line in msg.get("lines", ()):
+                print(f"({who}) {line}", file=stream)
+            return {"ok": True}
         if channel.startswith("actor:"):
             actor_id = ActorID(bytes.fromhex(channel.split(":", 1)[1]))
             if msg.get("state") == "DEAD":
@@ -955,6 +1039,14 @@ class WorkerRuntime:
 
     def shutdown(self):
         self._shutdown.set()
+        if self.mode == "driver":
+            try:  # the CP must not keep publishing logs to a dead driver
+                self.cp_client.notify(
+                    "unsubscribe",
+                    {"channel": f"worker_logs:{self.job_id.hex()}",
+                     "addr": self.addr})
+            except Exception:
+                pass
         self.flush_task_events()
         self.normal_submitter.shutdown()
         self.actor_submitter.shutdown()

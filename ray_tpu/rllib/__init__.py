@@ -1,0 +1,22 @@
+"""RL library: parallel rollout collection on actors + jitted learners.
+
+TPU-native rebuild of the reference's RLlib core
+(/root/reference/rllib/ — algorithms/, core/rl_module/, env/): EnvRunner
+actors sample on CPU, learning is a jitted JAX step, weights broadcast
+through the object store. Ships PPO and DQN on the new API stack surface
+(AlgorithmConfig fluent builder -> Algorithm.train()).
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.buffer import ReplayBuffer
+from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.env import CartPole, Env, RandomWalk, make_env, register_env
+from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rllib.models import RLModule
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "ReplayBuffer", "DQN", "DQNConfig",
+    "CartPole", "Env", "RandomWalk", "make_env", "register_env",
+    "EnvRunner", "EnvRunnerGroup", "RLModule", "PPO", "PPOConfig",
+]

@@ -1,0 +1,153 @@
+"""Algorithm/AlgorithmConfig — the RL library's public API.
+
+Mirrors the reference's new API stack surface (rllib/algorithms/algorithm.py,
+algorithm_config.py): config.environment(...).env_runners(...).training(...)
+.build() -> Algorithm; algo.train() returns a result dict per iteration.
+
+Architecture is the reference's split re-shaped for TPU: host-side EnvRunner
+actors collect experience (branchy, CPU-bound), a jitted Learner updates
+params (dense, MXU-bound). Weight sync is an object-store broadcast.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.models import RLModule
+
+
+@dataclass
+class AlgorithmConfig:
+    algo_cls: type | None = None
+    env_spec: Any = "CartPole"
+    num_env_runners: int = 2
+    rollout_steps: int = 256          # per runner per iteration
+    hidden: tuple = (64, 64)
+    lr: float = 3e-4
+    gamma: float = 0.99
+    seed: int = 0
+    train_kwargs: dict = field(default_factory=dict)
+
+    # builder-style setters (ref: algorithm_config.py fluent API)
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env_spec = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_steps: int | None = None) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_steps is not None:
+            self.rollout_steps = rollout_steps
+        return self
+
+    def training(self, **kw) -> "AlgorithmConfig":
+        for k in ("lr", "gamma", "seed"):
+            if k in kw:
+                setattr(self, k, kw.pop(k))
+        if "hidden" in kw:
+            self.hidden = tuple(kw.pop("hidden"))
+        self.train_kwargs.update(kw)
+        return self
+
+    def build(self) -> "Algorithm":
+        if self.algo_cls is None:
+            raise ValueError("config is not bound to an algorithm class")
+        return self.algo_cls(self)
+
+
+class Algorithm:
+    """Base: owns the module, the runner group, and the iteration loop."""
+
+    def __init__(self, config: AlgorithmConfig):
+        import jax
+
+        self.config = config
+        probe = make_env(config.env_spec)
+        self.module = RLModule(probe.observation_dim, probe.num_actions,
+                               hidden=config.hidden)
+        self.params = self.module.init(jax.random.PRNGKey(config.seed))
+        self.runners = EnvRunnerGroup(config.env_spec, self.module,
+                                      num_runners=config.num_env_runners,
+                                      seed=config.seed)
+        self._iter = 0
+        self._timesteps = 0
+        self.setup()
+
+    # subclass hooks ----------------------------------------------------
+    def setup(self) -> None:
+        pass
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    # public ------------------------------------------------------------
+    def train(self) -> dict:
+        t0 = time.monotonic()
+        metrics = self.training_step()
+        self._iter += 1
+        stats = self.runners.episode_stats()
+        rets = stats["episode_returns"]
+        return {
+            "training_iteration": self._iter,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "episode_return_mean": float(np.mean(rets)) if rets else None,
+            "episodes_this_iter": len(rets),
+            "time_this_iter_s": time.monotonic() - t0,
+            **metrics,
+        }
+
+    def compute_single_action(self, obs, explore: bool = False) -> int:
+        logits = np.asarray(
+            self.module.forward_inference(self.params, np.asarray(obs)[None]))[0]
+        if explore:
+            z = logits - logits.max()
+            p = np.exp(z) / np.exp(z).sum()
+            return int(np.random.default_rng().choice(len(p), p=p))
+        return int(logits.argmax())
+
+    def evaluate(self, num_episodes: int = 5, max_steps: int = 1000) -> dict:
+        env = make_env(self.config.env_spec)
+        rets = []
+        for ep in range(num_episodes):
+            obs = env.reset(seed=1000 + ep)
+            total = 0.0
+            for _ in range(max_steps):
+                obs, r, term, trunc = env.step(
+                    self.compute_single_action(obs))
+                total += r
+                if term or trunc:
+                    break
+            rets.append(total)
+        return {"episode_return_mean": float(np.mean(rets))}
+
+    def stop(self) -> None:
+        self.runners.stop()
+
+    # tune integration: Algorithm is a trainable ------------------------
+    @classmethod
+    def as_trainable(cls, config: AlgorithmConfig, stop_iters: int = 10):
+        """Returns fn(cfg_overrides, report) usable with ray_tpu.tune."""
+        def trainable(overrides: dict, report=None):
+            import dataclasses
+            cfg = dataclasses.replace(config, algo_cls=cls)
+            for k, v in (overrides or {}).items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+                else:
+                    cfg.train_kwargs[k] = v
+            algo = cfg.build()
+            try:
+                for _ in range(stop_iters):
+                    result = algo.train()
+                    if report is not None:
+                        report(result)
+                return result
+            finally:
+                algo.stop()
+        return trainable

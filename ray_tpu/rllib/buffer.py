@@ -1,0 +1,43 @@
+"""Replay buffer (ref: rllib/utils/replay_buffers/replay_buffer.py).
+
+Numpy ring storage on the host — replay is random-access and mutation-heavy,
+the wrong shape for device memory; sampled minibatches move to the device as
+one contiguous batch per train step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, observation_dim: int, seed: int = 0):
+        self._cap = capacity
+        self._obs = np.zeros((capacity, observation_dim), np.float32)
+        self._next_obs = np.zeros((capacity, observation_dim), np.float32)
+        self._actions = np.zeros((capacity,), np.int32)
+        self._rewards = np.zeros((capacity,), np.float32)
+        self._dones = np.zeros((capacity,), np.float32)
+        self._size = 0
+        self._head = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, batch: dict) -> None:
+        n = len(batch["actions"])
+        idx = (self._head + np.arange(n)) % self._cap
+        self._obs[idx] = batch["obs"]
+        self._next_obs[idx] = batch["next_obs"]
+        self._actions[idx] = batch["actions"]
+        self._rewards[idx] = batch["rewards"]
+        self._dones[idx] = batch["dones"]
+        self._head = (self._head + n) % self._cap
+        self._size = min(self._size + n, self._cap)
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self._rng.integers(0, self._size, batch_size)
+        return {"obs": self._obs[idx], "next_obs": self._next_obs[idx],
+                "actions": self._actions[idx], "rewards": self._rewards[idx],
+                "dones": self._dones[idx]}

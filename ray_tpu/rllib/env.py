@@ -1,0 +1,128 @@
+"""Environments for the RL library.
+
+The reference's RLlib runs arbitrary gym envs on CPU rollout workers
+(/root/reference/rllib/env/single_agent_env_runner.py). This build ships the
+same Env protocol plus built-in numpy envs so the library is testable with
+zero extra dependencies; any gymnasium env also satisfies the protocol.
+
+Envs are host-side (numpy) by design: rollouts are branchy and sequential —
+wrong shape for the MXU — so they stay on CPU actors while learning runs as a
+jitted SPMD step on the accelerator (see learner.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Env:
+    """Minimal single-agent env protocol (gymnasium-compatible subset)."""
+
+    observation_dim: int
+    num_actions: int
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> tuple[np.ndarray, float, bool, bool]:
+        """Returns (obs, reward, terminated, truncated)."""
+        raise NotImplementedError
+
+
+class CartPole(Env):
+    """Classic cart-pole balancing (dynamics per Barto-Sutton-Anderson).
+
+    Pure numpy so EnvRunner actors need no gym install; matches gymnasium's
+    CartPole-v1 termination (|x|>2.4, |theta|>12deg, 500-step truncation).
+    """
+
+    observation_dim = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 500):
+        self._max_steps = max_steps
+        self._rng = np.random.default_rng(0)
+        self._state = np.zeros(4, np.float32)
+        self._t = 0
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self._t = 0
+        return self._state.copy()
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self._state
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(th), np.sin(th)
+        # masscart 1.0, masspole 0.1, pole half-length 0.5, dt 0.02
+        temp = (force + 0.05 * th_dot**2 * sinth) / 1.1
+        th_acc = (9.8 * sinth - costh * temp) / (0.5 * (4.0 / 3.0 - 0.1 * costh**2 / 1.1))
+        x_acc = temp - 0.05 * th_acc * costh / 1.1
+        x, x_dot = x + 0.02 * x_dot, x_dot + 0.02 * x_acc
+        th, th_dot = th + 0.02 * th_dot, th_dot + 0.02 * th_acc
+        self._state = np.array([x, x_dot, th, th_dot], np.float32)
+        self._t += 1
+        terminated = bool(abs(x) > 2.4 or abs(th) > 0.2095)
+        truncated = self._t >= self._max_steps
+        return self._state.copy(), 1.0, terminated, truncated
+
+
+class RandomWalk(Env):
+    """1-D chain: start in the middle, +1 reward at the right end.
+
+    Deliberately trivial — DQN/PPO must solve it in seconds, which keeps CI
+    assertions about *learning* (not just running) cheap.
+    """
+
+    num_actions = 2
+
+    def __init__(self, n: int = 9):
+        self._n = n
+        self.observation_dim = n
+        self._pos = n // 2
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        self._pos = self._n // 2
+        return self._obs()
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros(self._n, np.float32)
+        o[self._pos] = 1.0
+        return o
+
+    def step(self, action: int):
+        self._pos += 1 if action == 1 else -1
+        if self._pos <= 0:
+            return self._obs(), 0.0, True, False
+        if self._pos >= self._n - 1:
+            return self._obs(), 1.0, True, False
+        return self._obs(), 0.0, False, False
+
+
+_REGISTRY = {"CartPole": CartPole, "RandomWalk": RandomWalk}
+
+
+def register_env(name: str, creator) -> None:
+    """(ref: rllib tune.register_env) — creator() -> Env."""
+    _REGISTRY[name] = creator
+
+
+def make_env(spec) -> Env:
+    if isinstance(spec, Env):
+        return spec
+    if callable(spec):
+        return spec()
+    if isinstance(spec, str) and spec in _REGISTRY:
+        return _REGISTRY[spec]()
+    raise ValueError(f"unknown env: {spec!r} (register_env or pass a creator)")
+
+
+def resolve_env_spec(spec):
+    """Resolve a registry name to its creator on the driver, so the spec
+    shipped to EnvRunner actors (other processes, which only have the
+    builtin registry) is self-contained."""
+    if isinstance(spec, str) and spec in _REGISTRY:
+        return _REGISTRY[spec]
+    return spec

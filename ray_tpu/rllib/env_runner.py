@@ -1,0 +1,117 @@
+"""EnvRunner actors: parallel rollout collection.
+
+TPU-native analog of the reference's EnvRunnerGroup
+(/root/reference/rllib/env/env_runner_group.py, single_agent_env_runner.py):
+one actor per runner steps its env with the current policy and returns
+fixed-size sample batches. Policy weights ship by ObjectRef broadcast (one
+put per iteration, every runner gets the same ref) instead of per-runner
+NCCL broadcast.
+
+Inference inside a runner is a jitted CPU apply on batch=1 — cheap for the
+small nets RL uses; learning happens in the Learner, not here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env, resolve_env_spec
+from ray_tpu.rllib.models import RLModule
+
+
+@ray_tpu.remote
+class EnvRunner:
+    def __init__(self, env_spec, module: RLModule, seed: int = 0):
+        import jax
+
+        self._env = make_env(env_spec)
+        self._module = module
+        self._rng = np.random.default_rng(seed)
+        self._obs = self._env.reset(seed=seed)
+        self._ep_return = 0.0
+        self._ep_len = 0
+        self._done_returns: list[float] = []
+        self._done_lens: list[int] = []
+        self._logits_fn = jax.jit(module.forward_inference)
+        self._value_fn = jax.jit(
+            lambda p, o: module.forward_train(p, o)[1])
+
+    def sample(self, params: dict, num_steps: int, *,
+               explore: bool = True, epsilon: float = 0.0) -> dict:
+        """Collect num_steps transitions with the given policy params.
+
+        Returns a column batch: obs, actions, rewards, dones, next_obs,
+        logp (behavior log-prob, for PPO), vf (bootstrap values).
+        """
+        obs = np.empty((num_steps, self._env.observation_dim), np.float32)
+        next_obs = np.empty_like(obs)
+        actions = np.empty((num_steps,), np.int32)
+        rewards = np.empty((num_steps,), np.float32)
+        dones = np.empty((num_steps,), np.float32)
+        logps = np.empty((num_steps,), np.float32)
+
+        for t in range(num_steps):
+            obs[t] = self._obs
+            logits = np.asarray(self._logits_fn(params, self._obs[None]))[0]
+            if epsilon > 0.0 and self._rng.random() < epsilon:
+                a = int(self._rng.integers(self._env.num_actions))
+            elif explore:
+                z = logits - logits.max()
+                p = np.exp(z) / np.exp(z).sum()
+                a = int(self._rng.choice(len(p), p=p))
+            else:
+                a = int(logits.argmax())
+            z = logits - logits.max()
+            logps[t] = z[a] - np.log(np.exp(z).sum())
+            o2, r, term, trunc = self._env.step(a)
+            actions[t], rewards[t] = a, r
+            dones[t] = float(term)  # truncation is not a terminal for GAE
+            next_obs[t] = o2
+            self._ep_return += r
+            self._ep_len += 1
+            if term or trunc:
+                self._done_returns.append(self._ep_return)
+                self._done_lens.append(self._ep_len)
+                self._ep_return, self._ep_len = 0.0, 0
+                o2 = self._env.reset()
+            self._obs = o2
+
+        return {"obs": obs, "actions": actions, "rewards": rewards,
+                "dones": dones, "next_obs": next_obs, "logp": logps,
+                "vf": np.asarray(self._value_fn(params, obs)),
+                "last_obs": self._obs.copy(),
+                "last_done": 0.0}
+
+    def episode_stats(self) -> dict:
+        """Drain completed-episode stats since the last call."""
+        rets, self._done_returns = self._done_returns, []
+        lens, self._done_lens = self._done_lens, []
+        return {"episode_returns": rets, "episode_lens": lens}
+
+
+class EnvRunnerGroup:
+    """Fan-out over n EnvRunner actors (ref: env_runner_group.py)."""
+
+    def __init__(self, env_spec, module: RLModule, num_runners: int = 2,
+                 seed: int = 0):
+        env_spec = resolve_env_spec(env_spec)
+        self._runners = [EnvRunner.remote(env_spec, module, seed=seed + i)
+                         for i in range(num_runners)]
+
+    def sample(self, params, steps_per_runner: int, **kw) -> list[dict]:
+        params_ref = ray_tpu.put(params)  # one broadcast, n consumers
+        return ray_tpu.get([r.sample.remote(params_ref, steps_per_runner, **kw)
+                            for r in self._runners], timeout=300.0)
+
+    def episode_stats(self) -> dict:
+        stats = ray_tpu.get(
+            [r.episode_stats.remote() for r in self._runners], timeout=60.0)
+        return {
+            "episode_returns": [x for s in stats for x in s["episode_returns"]],
+            "episode_lens": [x for s in stats for x in s["episode_lens"]],
+        }
+
+    def stop(self) -> None:
+        for r in self._runners:
+            ray_tpu.kill(r)

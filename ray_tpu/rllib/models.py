@@ -1,0 +1,64 @@
+"""RL policy/value networks as plain jax pytrees.
+
+The reference's RLModule abstraction (/root/reference/rllib/core/rl_module/
+rl_module.py) wraps a torch module with forward_inference / forward_train.
+Here a module is a (init, apply) pair over a param pytree — the same idiom as
+ray_tpu.models.llama — so the learner can jit/shard it like any other model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_init(rng, sizes: list[int]) -> dict:
+    """He-initialized MLP params: sizes = [in, hidden..., out]."""
+    params = {}
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, k = jax.random.split(rng)
+        params[f"w{i}"] = (jax.random.normal(k, (a, b), jnp.float32)
+                           * np.sqrt(2.0 / a))
+        params[f"b{i}"] = jnp.zeros((b,), jnp.float32)
+    return params
+
+
+def mlp_apply(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    n = len(params) // 2
+    for i in range(n):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class RLModule:
+    """Policy (+ optional value head) over an MLP torso.
+
+    forward_inference returns action logits; forward_train returns
+    (logits, value). Stateless — params travel separately so EnvRunner
+    actors receive plain pytrees through the object store.
+    """
+
+    def __init__(self, observation_dim: int, num_actions: int,
+                 hidden: tuple[int, ...] = (64, 64)):
+        self.observation_dim = observation_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng) -> dict:
+        k1, k2 = jax.random.split(rng)
+        sizes = [self.observation_dim, *self.hidden]
+        return {
+            "pi": mlp_init(k1, sizes + [self.num_actions]),
+            "vf": mlp_init(k2, sizes + [1]),
+        }
+
+    def forward_inference(self, params: dict, obs: jnp.ndarray) -> jnp.ndarray:
+        return mlp_apply(params["pi"], obs)
+
+    def forward_train(self, params: dict, obs: jnp.ndarray):
+        logits = mlp_apply(params["pi"], obs)
+        value = mlp_apply(params["vf"], obs)[..., 0]
+        return logits, value

@@ -1,0 +1,123 @@
+"""PPO (ref: rllib/algorithms/ppo/ppo.py, torch learner in
+ppo/torch/ppo_torch_learner.py — rebuilt as a single jitted update).
+
+GAE advantages are computed inside the jitted step with lax.scan (reverse
+accumulation), the clipped surrogate + value + entropy losses in one fused
+program; minibatch SGD epochs run as a lax-free Python loop over device
+arrays (shapes static, so one compile).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+def _gae(rewards, dones, values, last_value, gamma, lam):
+    """Reverse-scan GAE: adv_t = d_t + gamma*lam*(1-done_t)*adv_{t+1}."""
+    next_values = jnp.concatenate([values[1:], last_value[None]])
+    deltas = rewards + gamma * (1.0 - dones) * next_values - values
+
+    def step(carry, x):
+        delta, done = x
+        adv = delta + gamma * lam * (1.0 - done) * carry
+        return adv, adv
+
+    _, advs = jax.lax.scan(step, 0.0, (deltas, dones), reverse=True)
+    return advs, advs + values
+
+
+class PPO(Algorithm):
+    def setup(self) -> None:
+        kw = self.config.train_kwargs
+        self._clip = kw.get("clip_param", 0.2)
+        self._vf_coeff = kw.get("vf_loss_coeff", 0.5)
+        self._ent_coeff = kw.get("entropy_coeff", 0.01)
+        self._lam = kw.get("lambda_", 0.95)
+        self._epochs = kw.get("num_epochs", 4)
+        self._minibatch = kw.get("minibatch_size", 128)
+        self._opt = optax.adam(self.config.lr)
+        self._opt_state = self._opt.init(self.params)
+        self._rng = np.random.default_rng(self.config.seed)
+
+        module, gamma, lam = self.module, self.config.gamma, self._lam
+
+        @jax.jit
+        def advantages(params, batch):
+            _, last_v = module.forward_train(params, batch["last_obs"][None])
+            adv, targets = _gae(batch["rewards"], batch["dones"], batch["vf"],
+                                last_v[0], gamma, lam)
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            return adv, targets
+
+        clip, vf_c, ent_c = self._clip, self._vf_coeff, self._ent_coeff
+
+        def loss_fn(params, mb):
+            logits, values = module.forward_train(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None], axis=1)[:, 0]
+            ratio = jnp.exp(logp - mb["logp"])
+            surr = jnp.minimum(
+                ratio * mb["adv"],
+                jnp.clip(ratio, 1 - clip, 1 + clip) * mb["adv"])
+            pi_loss = -surr.mean()
+            vf_loss = ((values - mb["targets"]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pi_loss + vf_c * vf_loss - ent_c * entropy
+            return total, (pi_loss, vf_loss, entropy)
+
+        @jax.jit
+        def update(params, opt_state, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, aux
+
+        self._advantages = advantages
+        self._update = update
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        samples = self.runners.sample(self.params, cfg.rollout_steps)
+        self._timesteps += cfg.rollout_steps * cfg.num_env_runners
+
+        # per-runner GAE (trajectories must not cross runner boundaries)
+        cols: dict[str, list] = {k: [] for k in
+                                 ("obs", "actions", "logp", "adv", "targets")}
+        for s in samples:
+            adv, targets = self._advantages(self.params, s)
+            cols["obs"].append(s["obs"])
+            cols["actions"].append(s["actions"])
+            cols["logp"].append(s["logp"])
+            cols["adv"].append(np.asarray(adv))
+            cols["targets"].append(np.asarray(targets))
+        batch = {k: np.concatenate(v) for k, v in cols.items()}
+
+        n = len(batch["actions"])
+        last_loss, last_aux = 0.0, (0.0, 0.0, 0.0)
+        for _ in range(self._epochs):
+            perm = self._rng.permutation(n)
+            for lo in range(0, n - self._minibatch + 1, self._minibatch):
+                idx = perm[lo:lo + self._minibatch]
+                mb = {k: v[idx] for k, v in batch.items()}
+                self.params, self._opt_state, last_loss, last_aux = \
+                    self._update(self.params, self._opt_state, mb)
+        pi_l, vf_l, ent = last_aux
+        return {"loss": float(last_loss), "policy_loss": float(pi_l),
+                "vf_loss": float(vf_l), "entropy": float(ent)}
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return AlgorithmConfig(algo_cls=cls)
+
+
+def PPOConfig() -> AlgorithmConfig:
+    """(ref: PPOConfig class — here a bound AlgorithmConfig factory)"""
+    return PPO.get_default_config()

@@ -104,6 +104,7 @@ def _fetch_pkg(cp_client, uri: str) -> str:
     data = cp_client.call_with_retry("kv_get", {"key": key}, timeout=60.0)
     if data is None:
         raise RuntimeEnvError(f"runtime_env package missing from KV: {uri}")
+    os.makedirs(_ENV_ROOT, exist_ok=True)
     # extract to a private temp dir + atomic rename: concurrent lease
     # threads materializing the same env must never interleave writes into
     # a directory a worker is already importing from

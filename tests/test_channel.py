@@ -1,0 +1,124 @@
+"""Mutable-object channel tests (reference:
+python/ray/tests/experimental/test_mutable_objects.py model — writer/reader
+rendezvous, multi-reader, overwrite-in-place)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.channel import (Channel, ChannelClosedError,
+                                  ChannelTimeoutError)
+
+
+def test_channel_roundtrip_same_process():
+    ch = Channel(capacity=1 << 16, num_readers=1)
+    r = ch.reader(0)
+    ch.write({"x": 1})
+    assert r.read() == {"x": 1}
+    ch.write([1, 2, 3])
+    assert r.read() == [1, 2, 3]
+    ch.unlink()
+
+
+def test_channel_backpressure_and_order():
+    ch = Channel(capacity=1 << 16, num_readers=1)
+    r = ch.reader(0)
+    got = []
+
+    def consume():
+        for _ in range(20):
+            got.append(r.read(timeout=10.0))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(20):
+        ch.write(i, timeout=10.0)
+    t.join(10.0)
+    assert got == list(range(20))  # every value seen exactly once, in order
+    ch.unlink()
+
+
+def test_channel_writer_blocks_on_slow_reader():
+    ch = Channel(capacity=1 << 12, num_readers=1)
+    ch.write("first")
+    with pytest.raises(ChannelTimeoutError):
+        ch.write("second", timeout=0.2)  # reader never consumed "first"
+    assert ch.reader(0).read() == "first"
+    ch.write("second", timeout=5.0)  # now it fits
+    ch.unlink()
+
+
+def test_channel_multi_reader_broadcast():
+    ch = Channel(capacity=1 << 14, num_readers=3)
+    readers = [ch.reader(i) for i in range(3)]
+    ch.write("v0")
+    assert [r.read() for r in readers] == ["v0"] * 3
+    ch.write("v1")
+    assert [r.read() for r in readers] == ["v1"] * 3
+    ch.unlink()
+
+
+def test_channel_too_large_value():
+    ch = Channel(capacity=64, num_readers=1)
+    with pytest.raises(ValueError):
+        ch.write(np.zeros(1024))
+    ch.unlink()
+
+
+def test_channel_close_wakes_reader():
+    ch = Channel(capacity=1 << 12, num_readers=1)
+    r = ch.reader(0)
+    err = []
+
+    def consume():
+        try:
+            r.read(timeout=10.0)
+        except ChannelClosedError as e:
+            err.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.1)
+    ch.close()
+    t.join(5.0)
+    assert err
+    ch.unlink()
+
+
+def test_channel_cross_process_pipeline(ray_start_regular):
+    """Producer/consumer actor pipeline over one channel — the host-side
+    pipelining pattern compiled-graph channels exist for."""
+
+    @ray_tpu.remote
+    class Producer:
+        def __init__(self, ch):
+            self.ch = ch
+
+        def run(self, n):
+            for i in range(n):
+                self.ch.write(np.full(128, i, np.float32), timeout=30.0)
+            return n
+
+    @ray_tpu.remote
+    class Consumer:
+        def __init__(self, reader):
+            self.reader = reader
+
+        def run(self, n):
+            total = 0.0
+            for _ in range(n):
+                total += float(self.reader.read(timeout=30.0)[0])
+            return total
+
+    ch = Channel(capacity=1 << 16, num_readers=1)
+    prod = Producer.remote(ch)
+    cons = Consumer.remote(ch.reader(0))
+    n = 50
+    pf = prod.run.remote(n)
+    cf = cons.run.remote(n)
+    assert ray_tpu.get(pf, timeout=60.0) == n
+    assert ray_tpu.get(cf, timeout=60.0) == float(sum(range(n)))
+    ch.unlink()

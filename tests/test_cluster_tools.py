@@ -68,6 +68,27 @@ def test_runtime_env_env_vars_and_working_dir(ray_start_regular, tmp_path):
     assert ray_tpu.get(clean.remote(), timeout=90) is None
 
 
+def test_log_to_driver(ray_start_regular, capfd):
+    """Worker stdout streams to the driver with a provenance prefix
+    (ref: _private/log_monitor.py -> worker.py print_to_stdstream)."""
+
+    @ray_tpu.remote
+    def noisy():
+        print("log-stream-probe-xyzzy")
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    deadline = time.time() + 5.0
+    seen = ""
+    while time.time() < deadline:
+        seen += capfd.readouterr().out
+        if "log-stream-probe-xyzzy" in seen:
+            break
+        time.sleep(0.2)
+    assert "log-stream-probe-xyzzy" in seen
+    assert "(pid=" in seen
+
+
 def test_runtime_env_validation(ray_start_regular):
     from ray_tpu.runtime_env import RuntimeEnvError
 
