@@ -40,7 +40,11 @@ class Autoscaler:
         self._cfg = config
         self._stopped = threading.Event()
         self._idle_since: dict[str, float] = {}
-        self._node_names: list[str] = []
+        # nodes launched but not yet registered with the CP: name -> t0.
+        # Counted against new demand so a slow boot doesn't re-trigger a
+        # launch every poll (ref: instance_manager pending-instance set).
+        self._launching: dict[str, float] = {}
+        self.launch_grace_s = 600.0
         self._thread: threading.Thread | None = None
         self.num_launched = 0
         self.num_terminated = 0
@@ -80,7 +84,36 @@ class Autoscaler:
             if not placed:
                 unplaceable += 1
 
+        # provider-name -> CP node mapping (cloud nodes carry a
+        # provider_node_name label; the fake provider also exposes agent())
+        now = time.monotonic()
+        by_pname: dict[str, dict] = {}
+        for n in alive:
+            pname = (n.get("labels") or {}).get("provider_node_name")
+            if pname:
+                by_pname[pname] = n
+        get_agent = getattr(self._provider, "agent", lambda _n: None)
+
+        def cp_node_for(name: str):
+            node = by_pname.get(name)
+            if node is not None:
+                return node
+            agent = get_agent(name)
+            if agent is not None:
+                for n in alive:
+                    if tuple(n["addr"]) == tuple(agent.addr):
+                        return n
+            return None
+
         cur = self._provider.non_terminated_nodes()
+        # registration drains the launching set; boots past the grace period
+        # stop counting (the node may have failed — allow a replacement)
+        for name in list(self._launching):
+            if (cp_node_for(name) is not None
+                    or name not in cur
+                    or now - self._launching[name] > self.launch_grace_s):
+                self._launching.pop(name, None)
+
         want_new = 0
         if unplaceable > 0 and self._cfg.node_resources:
             import math
@@ -88,27 +121,22 @@ class Autoscaler:
                 1, int(min(self._cfg.node_resources.get(k, 0) / v
                            for s in shapes[:1] for k, v in s.items()
                            if v > 0) or 1))
-            want_new = min(math.ceil(unplaceable / per_node_cap),
-                           self._cfg.max_workers - len(cur))
+            want_new = min(
+                math.ceil(unplaceable / per_node_cap) - len(self._launching),
+                self._cfg.max_workers - len(cur))
         want_new = max(want_new, self._cfg.min_workers - len(cur))
         for _ in range(max(0, want_new)):
             name = self._provider.create_node(
                 {"resources": dict(self._cfg.node_resources),
                  "labels": dict(self._cfg.node_labels)})
+            self._launching[name] = now
             self.num_launched += 1
             logger.info("autoscaler launched node %s (unplaceable=%d)",
                         name, unplaceable)
 
         # scale down: provider nodes idle (full availability) past timeout
-        now = time.monotonic()
-        by_addr = {}
-        for n in alive:
-            by_addr[tuple(n["addr"])] = n
         for name in list(self._provider.non_terminated_nodes()):
-            agent = getattr(self._provider, "agent", lambda _n: None)(name)
-            if agent is None:
-                continue  # cloud provider: idle detection via CP only
-            node = by_addr.get(tuple(agent.addr))
+            node = cp_node_for(name)
             idle = (node is not None
                     and node["available"] == node["resources"])
             if not idle:
@@ -121,7 +149,7 @@ class Autoscaler:
                 logger.info("autoscaler terminating idle node %s", name)
                 try:
                     self._cp.call("drain_node",
-                                  {"node_id": agent.node_id}, timeout=10.0)
+                                  {"node_id": node["node_id"]}, timeout=10.0)
                 except Exception:  # noqa: BLE001
                     pass
                 self._provider.terminate_node(name)

@@ -39,9 +39,11 @@ class FakeNodeProvider(NodeProvider):
 
         self._counter += 1
         name = f"fake-{self._counter}"
+        labels = dict(node_config.get("labels") or {})
+        labels["provider_node_name"] = name
         agent = NodeAgent(self._cp_addr,
                           resources=dict(node_config.get("resources") or {}),
-                          labels=dict(node_config.get("labels") or {}))
+                          labels=labels)
         self._agents[name] = agent
         return name
 
@@ -93,10 +95,13 @@ class GCETPUNodeProvider(NodeProvider):
         self._gcloud(
             "create", name, f"--accelerator-type={accel}",
             f"--version={node_config.get('runtime_version', self.runtime_version)}")
-        # bootstrap: every TPU VM host joins as a worker node
+        # bootstrap: every TPU VM host joins as a worker node, labelled with
+        # the provider node name so the autoscaler can match CP nodes back
+        # to cloud instances for idle scale-down
         self._gcloud(
             "ssh", name, "--worker=all", "--command",
-            f"python -m ray_tpu start --address {self.cluster_address}")
+            f"python -m ray_tpu start --address {self.cluster_address} "
+            f"--labels provider_node_name={name}")
         self._nodes.add(name)
         return name
 

@@ -99,6 +99,9 @@ class Config:
 
     # --- misc ---
     worker_register_timeout_s: float = 30.0
+    # runtime_env["pip"] needs network access; opt in explicitly
+    # (RAY_TPU_ALLOW_RUNTIME_ENV_PIP=1).
+    allow_runtime_env_pip: bool = False
     log_dir: str = ""
     # Stream worker stdout/stderr to the driver (ref: _private/log_monitor.py
     # + worker.py log_to_driver).
@@ -128,6 +131,14 @@ class Config:
         """Serialize overrides for child process environments."""
         merged = dict(overrides or {})
         return {_SYSTEM_CONFIG_ENV: json.dumps(merged)} if merged else {}
+
+
+def package_parent_path() -> str:
+    """Directory containing the ray_tpu package — prepended to PYTHONPATH of
+    spawned processes (workers, job drivers) so the framework stays
+    importable when a runtime_env or entrypoint changes their cwd."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
 
 
 _config: Config | None = None

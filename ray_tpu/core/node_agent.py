@@ -162,9 +162,9 @@ class NodeAgent:
         cwd = os.getcwd()
         # the framework must stay importable even when a runtime_env moves
         # the worker's cwd (source-tree installs aren't on sys.path then)
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        from ray_tpu.core.config import package_parent_path
+        env["PYTHONPATH"] = (package_parent_path() + os.pathsep
+                             + env.get("PYTHONPATH", ""))
         if runtime_env:
             # materialize BEFORE spawn (reference: runtime_env agent creates
             # the env, then the worker starts inside it)

@@ -22,17 +22,23 @@ _INDEX = """<!doctype html>
 <h1>ray_tpu dashboard</h1>
 <div id="content">loading…</div>
 <script>
+function esc(s) {
+  // user-controlled strings (actor names, entrypoints) must never reach
+  // innerHTML unescaped
+  return s.replace(/&/g, "&amp;").replace(/</g, "&lt;").replace(/>/g, "&gt;")
+          .replace(/"/g, "&quot;");
+}
 async function refresh() {
   const sections = ["nodes", "actors", "pgs", "jobs", "tasks"];
   let html = "";
   for (const s of sections) {
     const rows = await (await fetch("/api/" + s)).json();
-    html += "<h2>" + s + " (" + rows.length + ")</h2>";
+    html += "<h2>" + esc(s) + " (" + rows.length + ")</h2>";
     if (rows.length) {
       const cols = Object.keys(rows[0]);
-      html += "<table><tr>" + cols.map(c => "<th>" + c + "</th>").join("") + "</tr>";
+      html += "<table><tr>" + cols.map(c => "<th>" + esc(c) + "</th>").join("") + "</tr>";
       for (const r of rows.slice(0, 200)) {
-        html += "<tr>" + cols.map(c => "<td>" + JSON.stringify(r[c]) + "</td>").join("") + "</tr>";
+        html += "<tr>" + cols.map(c => "<td>" + esc(JSON.stringify(r[c])) + "</td>").join("") + "</tr>";
       }
       html += "</table>";
     }

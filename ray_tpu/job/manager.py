@@ -76,9 +76,9 @@ class _JobSupervisor:
         env["RAY_TPU_JOB_ID"] = job_id
         # make the framework importable from anywhere (it may be running
         # from a source tree rather than site-packages)
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        from ray_tpu.core.config import package_parent_path
+        env["PYTHONPATH"] = (package_parent_path() + os.pathsep
+                             + env.get("PYTHONPATH", ""))
         env.update(env_vars or {})
 
         self._record(JobStatus.RUNNING, start_time=time.time())

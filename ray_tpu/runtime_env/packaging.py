@@ -79,7 +79,7 @@ def prepare_runtime_env(rt, runtime_env: dict | None) -> dict | None:
             m if m.startswith("kv://") else _upload_dir(rt, m) for m in mods]
     if out.get("pip"):
         from ray_tpu.core.config import get_config
-        if not getattr(get_config(), "allow_runtime_env_pip", False):
+        if not get_config().allow_runtime_env_pip:
             raise RuntimeEnvError(
                 "runtime_env['pip'] needs network access; set "
                 "RAY_TPU_ALLOW_RUNTIME_ENV_PIP=1 to enable")

@@ -82,13 +82,22 @@ def _run_head_daemon(args) -> None:
     cp.stop()
 
 
+def _parse_labels(spec: str | None) -> dict:
+    out = {}
+    for item in filter(None, (spec or "").split(",")):
+        k, _, v = item.partition("=")
+        out[k] = v
+    return out
+
+
 def _run_node_daemon(args) -> None:
     """A long-lived worker-node agent joining an existing cluster."""
     from ray_tpu.core.node_agent import NodeAgent
 
     host, port = _read_address(args.address).rsplit(":", 1)
     res = {"CPU": float(args.num_cpus or (os.cpu_count() or 1))}
-    agent = NodeAgent((host, int(port)), resources=res)
+    agent = NodeAgent((host, int(port)), resources=res,
+                      labels=_parse_labels(getattr(args, "labels", None)))
     print(f"ray_tpu node joined {host}:{port} as {agent.node_id.hex()[:8]}",
           flush=True)
     stop = []
@@ -114,6 +123,8 @@ def cmd_start(args) -> None:
             cmd += ["--store-path", args.store_path]
     else:
         cmd += ["--address", _read_address(args.address)]
+        if args.labels:
+            cmd += ["--labels", args.labels]
     if args.num_cpus:
         cmd += ["--num-cpus", str(args.num_cpus)]
     os.makedirs(_STATE_DIR, exist_ok=True)
@@ -145,8 +156,19 @@ def cmd_stop(args) -> None:
     if os.path.exists(_PID_FILE):
         with open(_PID_FILE) as f:
             pid = int(f.read().strip())
+        # the head was started with start_new_session=True, so its process
+        # group holds exactly this cluster (head + its spawned workers);
+        # killing the group never touches other clusters on the machine
+        def _signal(sig):
+            try:
+                os.killpg(pid, sig)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(pid, sig)
+                except ProcessLookupError:
+                    raise
         try:
-            os.kill(pid, signal.SIGTERM)
+            _signal(signal.SIGTERM)
             stopped = True
             # wait for exit so a follow-up `start` can rebind the ports
             deadline = time.time() + 10.0
@@ -158,7 +180,7 @@ def cmd_stop(args) -> None:
                 time.sleep(0.1)
             else:
                 try:
-                    os.kill(pid, signal.SIGKILL)
+                    _signal(signal.SIGKILL)
                 except ProcessLookupError:
                     pass
             print(f"stopped head (pid {pid})")
@@ -167,10 +189,15 @@ def cmd_stop(args) -> None:
         os.remove(_PID_FILE)
     if os.path.exists(_ADDR_FILE):
         os.remove(_ADDR_FILE)
-    # reap orphaned workers of dead clusters
-    subprocess.run(["pkill", "-f", "ray_tpu.core.worker_main"], check=False)
-    if not stopped:
-        print("no head pidfile; killed stray workers only")
+    if getattr(args, "force", False):
+        # explicit opt-in only: this reaps EVERY ray_tpu worker on the
+        # machine, including other live clusters'
+        subprocess.run(["pkill", "-f", "ray_tpu.core.worker_main"],
+                       check=False)
+        print("killed all ray_tpu workers on this machine (--force)")
+    elif not stopped:
+        print("no head pidfile; nothing stopped (use --force to reap "
+              "stray workers)")
 
 
 def cmd_status(args) -> None:
@@ -262,11 +289,15 @@ def main(argv=None) -> None:
                     help="sqlite path for control-plane fault tolerance")
     sp.add_argument("--dashboard-port", type=int, default=8265,
                     help="-1 disables the dashboard")
+    sp.add_argument("--labels", default=None,
+                    help="node labels, k=v[,k2=v2] (worker nodes)")
     sp.add_argument("--block", action="store_true",
                     help="run in the foreground")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the local head + workers")
+    sp.add_argument("--force", action="store_true",
+                    help="also pkill every ray_tpu worker on this machine")
     sp.set_defaults(fn=cmd_stop)
 
     sp = sub.add_parser("status", help="cluster summary")

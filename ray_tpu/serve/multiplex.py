@@ -38,24 +38,48 @@ def multiplexed(func: Optional[Callable] = None, *,
 
     def decorate(fn):
         cache: OrderedDict[str, object] = OrderedDict()
+        pending: dict[str, asyncio.Future] = {}  # in-flight load dedup
         lock = asyncio.Lock()
 
         @functools.wraps(fn)
         async def wrapper(self_or_id, *args):
             # support both method (self, model_id) and free fn (model_id)
             model_id = args[0] if args else self_or_id
-            async with lock:
-                if model_id in cache:
-                    cache.move_to_end(model_id)
-                    return cache[model_id]
-            out = fn(self_or_id, *args) if args else fn(self_or_id)
-            if asyncio.iscoroutine(out):
-                out = await out
+            while True:
+                async with lock:
+                    if model_id in cache:
+                        cache.move_to_end(model_id)
+                        return cache[model_id]
+                    fut = pending.get(model_id)
+                    if fut is None:
+                        # we are the loader; others await our future (a
+                        # duplicate load of an LLM is 2x device memory)
+                        fut = pending[model_id] = \
+                            asyncio.get_running_loop().create_future()
+                        break
+                try:
+                    return await asyncio.shield(fut)
+                except Exception:
+                    continue  # loader failed: retry (maybe become loader)
+            try:
+                out = fn(self_or_id, *args) if args else fn(self_or_id)
+                if asyncio.iscoroutine(out):
+                    out = await out
+            except BaseException as e:
+                async with lock:
+                    pending.pop(model_id, None)
+                if not fut.done():
+                    fut.set_exception(e)
+                    fut.exception()  # consumed; avoid un-retrieved warnings
+                raise
             async with lock:
                 cache[model_id] = out
                 cache.move_to_end(model_id)
+                pending.pop(model_id, None)
                 while len(cache) > max_num_models_per_replica:
                     cache.popitem(last=False)
+            if not fut.done():
+                fut.set_result(out)
             return out
 
         wrapper._is_multiplexed = True

@@ -68,13 +68,11 @@ class Router:
     applies changes the moment versions bump — the request path reads only
     the local cache, no controller RPC per request."""
 
-    def __init__(self, controller, app_name: str, poll_period_s: float = 0.5):
+    def __init__(self, controller, app_name: str):
         self._controller = controller
         self._app = app_name
         self._sets: dict[str, ReplicaSet] = {}
         self._lock = threading.Lock()
-        self._poll_period = poll_period_s
-        self._last_poll = 0.0
         self._stopped = threading.Event()
         self._poll_thread = threading.Thread(
             target=self._long_poll_loop, name=f"router-poll-{app_name}",
@@ -83,7 +81,6 @@ class Router:
 
     def _apply_table(self, table: dict) -> None:
         with self._lock:
-            self._last_poll = time.monotonic()
             for dep, (replicas, version) in table.items():
                 cur = self._sets.setdefault(dep, ReplicaSet())
                 if version != cur.version:
