@@ -76,6 +76,7 @@ class PGInfo:
 class _Node:
     view: NodeView
     missed_health_checks: int = 0
+    metrics: dict | None = None  # last heartbeat's system gauges
 
 
 class ControlPlane:
@@ -103,6 +104,7 @@ class ControlPlane:
         self._wake = threading.Condition()
         self._stopped = threading.Event()
         self._task_events: list[dict] = []  # GcsTaskManager-style sink (bounded)
+        self._task_event_counts: dict[str, int] = {}  # running totals
         self._store = make_meta_store(
             store_path if store_path is not None
             else (get_config().cp_store_path or None))
@@ -194,8 +196,50 @@ class ControlPlane:
                 return {"known": False}
             node.view.available = dict(body["available"])
             node.missed_health_checks = 0
+            if body.get("metrics"):
+                node.metrics = body["metrics"]
         self._wake_scheduler()
         return {"known": True}
+
+    def _h_get_metrics(self, body):
+        """Prometheus exposition of cluster system metrics: CP-derived
+        gauges + per-node agent gauges (TPU-native analog of the reference's
+        metrics export pipeline, stats/metric_defs.cc + dashboard/modules/
+        metrics/; scraped via the dashboard's /metrics endpoint)."""
+        out = []
+
+        def emit(name, value, tags=""):
+            out.append(f"ray_tpu_{name}{tags} {value}")
+
+        with self._lock:
+            nodes = list(self._nodes.values())
+            actors_by_state: dict[str, int] = {}
+            for a in self._actors.values():
+                s = getattr(a.state, "name", str(a.state))
+                actors_by_state[s] = actors_by_state.get(s, 0) + 1
+            pgs = len(self._pgs)
+            jobs = len(self._jobs)
+            events_by_state = dict(self._task_event_counts)
+        emit("nodes_alive", sum(1 for n in nodes if n.view.alive))
+        emit("nodes_total", len(nodes))
+        for s, c in sorted(actors_by_state.items()):
+            emit("actors", c, f'{{state="{s}"}}')
+        emit("placement_groups", pgs)
+        emit("jobs", jobs)
+        for s, c in sorted(events_by_state.items()):
+            emit("task_events_total", c, f'{{state="{s}"}}')
+        for n in nodes:
+            if not n.view.alive:
+                continue
+            nid = n.view.node_id.hex()[:12]
+            for k, v in (getattr(n, "metrics", None) or {}).items():
+                if ":" in k:
+                    base, res = k.split(":", 1)
+                    emit(f"node_{base}", v,
+                         f'{{node="{nid}",resource="{res}"}}')
+                else:
+                    emit(f"node_{k}", v, f'{{node="{nid}"}}')
+        return "\n".join(out) + "\n"
 
     def _h_get_nodes(self, body):
         with self._lock:
@@ -323,6 +367,10 @@ class ControlPlane:
     # ---- task events (observability sink; ref: gcs_task_manager.cc) ----
     def _h_report_task_events(self, body):
         with self._lock:
+            for ev in body["events"]:
+                s = ev.get("state", "UNKNOWN")
+                self._task_event_counts[s] = \
+                    self._task_event_counts.get(s, 0) + 1
             self._task_events.extend(body["events"])
             overflow = len(self._task_events) - get_config().task_events_buffer_size
             if overflow > 0:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
+import weakref
 
 import cloudpickle
 
@@ -21,12 +22,23 @@ class FunctionManager:
         self._cache: dict[str, object] = {}
         self._exported: set[str] = set()
         self._lock = threading.Lock()
+        # fn object -> exported id. Weak keys: identity-based so the
+        # per-submit cloudpickle.dumps (the hot path's biggest CPU cost)
+        # happens once per function object, not once per task.
+        self._by_obj: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
     def export(self, fn) -> str:
+        try:
+            cached = self._by_obj.get(fn)
+        except TypeError:  # unhashable/unweakrefable callables
+            cached = None
+        if cached is not None:
+            return cached
         blob = cloudpickle.dumps(fn)
         function_id = hashlib.sha1(blob).hexdigest()
         with self._lock:
             if function_id in self._exported:
+                self._remember(fn, function_id)
                 return function_id
         self._rt.cp_client.call_with_retry(
             "kv_put", {"key": f"fn:{function_id}", "value": blob, "overwrite": False},
@@ -34,7 +46,14 @@ class FunctionManager:
         with self._lock:
             self._exported.add(function_id)
             self._cache.setdefault(function_id, cloudpickle.loads(blob))
+        self._remember(fn, function_id)
         return function_id
+
+    def _remember(self, fn, function_id: str) -> None:
+        try:
+            self._by_obj[fn] = function_id
+        except TypeError:
+            pass
 
     def get(self, function_id: str, timeout: float = 30.0):
         with self._lock:

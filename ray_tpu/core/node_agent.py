@@ -212,6 +212,37 @@ class NodeAgent:
             self._workers[worker_id] = info
         return info
 
+    def _system_metrics(self) -> dict:
+        """Per-node system gauges shipped with the heartbeat and exported at
+        the control plane's prometheus endpoint (TPU-native analog of the
+        reference's per-node ReporterAgent -> MetricsAgent pipeline,
+        dashboard/modules/reporter/reporter_agent.py + stats/metric_defs.cc)."""
+        with self._lock:
+            workers = list(self._workers.values())
+            leases = len(self._leases)
+        m = {
+            "workers_total": len(workers),
+            "workers_busy": sum(1 for w in workers if w.busy),
+            "workers_actor": sum(1 for w in workers
+                                 if w.actor_id is not None),
+            "leases_active": leases,
+        }
+        try:
+            st = self.store.stats()
+            m["object_store_used_bytes"] = st.get("used_bytes", 0)
+            m["object_store_num_objects"] = st.get("num_objects", 0)
+            m["object_store_capacity_bytes"] = getattr(
+                self.store, "capacity", 0)
+        except Exception:  # noqa: BLE001 - store impl without counters
+            pass
+        m["object_store_num_spilled"] = getattr(self.store, "num_spilled", 0)
+        for k, v in self.resources_total.items():
+            m[f"resource_total:{k}"] = float(v)
+        with self._lock:
+            for k, v in self.available.items():
+                m[f"resource_available:{k}"] = float(v)
+        return m
+
     def _log_monitor_loop(self):
         """Tail per-worker log files and publish new lines to the CP
         "worker_logs" channel, where driver runtimes print them (TPU-native
@@ -296,6 +327,13 @@ class NodeAgent:
         env_key = env_hash(runtime_env)
         for_tpu = resources.get("TPU", 0) > 0
         deadline = time.monotonic() + body.get("timeout", cfg.lease_timeout_s)
+        # When nothing can be reserved and no spillback target exists, reply
+        # `busy` after a short grace instead of blocking out the full
+        # timeout: the caller then opens its per-worker pipelining depth
+        # (submitter MAX_INFLIGHT_PER_WORKER) rather than waiting on a lease
+        # that may be a minute away.
+        busy_deadline = time.monotonic() + min(
+            0.5, body.get("timeout", cfg.lease_timeout_s))
         reserved = False
         spawned = False
         try:
@@ -357,6 +395,8 @@ class NodeAgent:
                     target = self._find_remote_node(resources)
                     if target is not None:
                         return {"granted": False, "redirect": target}
+                    if time.monotonic() > busy_deadline:
+                        return {"granted": False, "busy": True}
                 with self._lock:
                     self._lease_cv.wait(timeout=0.05)
                 if time.monotonic() > deadline:
@@ -664,7 +704,8 @@ class NodeAgent:
                     r = self._pool.get(self.cp_addr).call(
                         "heartbeat",
                         {"node_id": self.node_id,
-                         "available": dict(self.available)}, timeout=5.0)
+                         "available": dict(self.available),
+                         "metrics": self._system_metrics()}, timeout=5.0)
                     if r is not None and not r.get("known", True):
                         logger.info("control plane lost this node "
                                     "(restart?); re-registering")
@@ -748,5 +789,9 @@ class NodeAgent:
                     except Exception:
                         pass
         self._server.stop()
+        # the monitor thread reads store stats for heartbeats; it must be
+        # gone before the native arena handle is destroyed (use-after-free
+        # segfault otherwise)
+        self._monitor_thread.join(timeout=5.0)
         self.store.shutdown()
         self._pool.close_all()

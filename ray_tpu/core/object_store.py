@@ -400,9 +400,14 @@ class NativeShmStore:
         ct = self._ctypes
         used, num_obj, evicted, cap = (ct.c_uint64(), ct.c_uint64(),
                                        ct.c_uint64(), ct.c_uint64())
-        self._lib.rtpu_store_stats(
-            ct.c_void_p(self._handle), ct.byref(used), ct.byref(num_obj),
-            ct.byref(evicted), ct.byref(cap))
+        with self._lock:
+            if not self._handle:  # shut down concurrently (agent stop)
+                return {"num_objects": 0, "used_bytes": 0,
+                        "capacity_bytes": 0, "num_evicted": 0,
+                        "backend": "native"}
+            self._lib.rtpu_store_stats(
+                ct.c_void_p(self._handle), ct.byref(used), ct.byref(num_obj),
+                ct.byref(evicted), ct.byref(cap))
         return {
             "num_objects": num_obj.value,
             "used_bytes": used.value,

@@ -241,6 +241,10 @@ class RpcServer:
                     c.close()
                 except OSError:
                     pass
+        # release the handler pool threads — a long-lived process that starts
+        # many servers (tests, serve controllers) must not accumulate 8-16
+        # idle threads per stopped server
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
 
 class RpcClient:

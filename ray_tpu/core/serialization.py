@@ -102,40 +102,52 @@ class SerializationContext:
         self._runtime = runtime
         self._local = threading.local()
         self._custom_serializers: dict[type, tuple[Callable, Callable]] = {}
+        self._static_dispatch: type | None = None  # pickler cls, lazily built
 
     def register_serializer(self, cls: type, *, serializer: Callable, deserializer: Callable):
         """Custom per-type serializer (ref: ray.util.register_serializer)."""
         self._custom_serializers[cls] = (serializer, deserializer)
+        self._static_dispatch = None
+
+    def _pickler_class(self) -> type:
+        """One pickler subclass per context, rebuilt only when a custom
+        serializer registers or jax first appears. The C pickler snapshots
+        `dispatch_table` at construction from the CLASS, so per-call state
+        (contained refs) flows through a thread-local instead of closures —
+        building a fresh class per serialize() was the old hot-path cost."""
+        jnp_array_types = _jax_array_types()
+        cached = self._static_dispatch
+        if cached is not None and (not jnp_array_types
+                                   or jnp_array_types[0] in cached.dispatch_table):
+            return cached
+        table = dict(getattr(cloudpickle.CloudPickler, "dispatch_table", {}))
+        table[ObjectRef] = _reduce_ref_tl
+        for t in jnp_array_types:
+            table[t] = _reduce_jax_array
+        for t, (ser, des) in self._custom_serializers.items():
+            table[t] = lambda obj, ser=ser, des=des: (
+                _deserialize_custom, (cloudpickle.dumps(des), ser(obj)))
+        cls = type("_CtxPickler", (cloudpickle.CloudPickler,),
+                   {"dispatch_table": table})
+        self._static_dispatch = cls
+        return cls
 
     # ------------------------------------------------------------------
     def serialize(self, value: Any) -> SerializedObject:
         buffers: list = []
         contained: list[ObjectRef] = []
-
-        class _Pickler(cloudpickle.CloudPickler):
-            dispatch_table = dict(getattr(cloudpickle.CloudPickler, "dispatch_table", {}))
-
-        ctx = self
-
-        def _reduce_ref(ref: ObjectRef):
-            contained.append(ref)
-            if ctx._runtime is not None:
-                ctx._runtime.reference_counter.add_borrow_on_serialize(ref)
-            return (_deserialize_ref_in_context, (ref.id(), ref.owner, ref.owner_addr))
-
-        _Pickler.dispatch_table[ObjectRef] = _reduce_ref
-
-        jnp_array_types = _jax_array_types()
-        for t in jnp_array_types:
-            _Pickler.dispatch_table[t] = _reduce_jax_array
-
-        for t, (ser, des) in self._custom_serializers.items():
-            _Pickler.dispatch_table[t] = lambda obj, ser=ser, des=des: (
-                _deserialize_custom, (cloudpickle.dumps(des), ser(obj)))
-
+        cls = self._pickler_class()
         sio = io.BytesIO()
-        p = _Pickler(sio, protocol=5, buffer_callback=lambda b: buffers.append(b.raw()))
-        p.dump(value)
+        p = cls(sio, protocol=5,
+                buffer_callback=lambda b: buffers.append(b.raw()))
+        stack = getattr(_ser_tl, "stack", None)
+        if stack is None:
+            stack = _ser_tl.stack = []
+        stack.append((contained, self._runtime))
+        try:
+            p.dump(value)
+        finally:
+            stack.pop()
         return SerializedObject(inband=sio.getvalue(), buffers=buffers, contained_refs=contained)
 
     def deserialize(self, sobj: SerializedObject) -> Any:
@@ -151,6 +163,15 @@ class _DeserCtx(threading.local):
 
 
 _deser_ctx = _DeserCtx()
+_ser_tl = threading.local()  # serialize() call state: [(contained, runtime)]
+
+
+def _reduce_ref_tl(ref: ObjectRef):
+    contained, runtime = _ser_tl.stack[-1]
+    contained.append(ref)
+    if runtime is not None:
+        runtime.reference_counter.add_borrow_on_serialize(ref)
+    return (_deserialize_ref_in_context, (ref.id(), ref.owner, ref.owner_addr))
 
 
 def _deserialize_ref_in_context(object_id: ObjectID, owner, owner_addr):

@@ -31,6 +31,16 @@ from ray_tpu.exceptions import ActorDiedError, TaskError, WorkerCrashedError
 logger = logging.getLogger(__name__)
 
 
+@dataclass
+class _ShapeState:
+    queue: deque = field(default_factory=deque)
+    leases: list = field(default_factory=list)     # list[_Lease]
+    requests_in_flight: int = 0
+    strategy: object = None
+    runtime_env: dict | None = None
+    last_busy: float = 0.0  # ts of last busy (saturated) lease reply
+
+
 def _shape_key(spec: TaskSpec):
     """Tasks are queued per (resources, strategy, runtime_env) shape so a
     cached lease only serves tasks with identical placement constraints AND
@@ -53,26 +63,32 @@ class _Lease:
     agent_addr: tuple
     worker_addr: tuple
     worker_id: object
-
-
-@dataclass
-class _ShapeState:
-    queue: deque = field(default_factory=deque)
-    idle: list = field(default_factory=list)      # list[_Lease]
-    busy: dict = field(default_factory=dict)       # worker_addr -> _Lease
-    requests_in_flight: int = 0
-    strategy: object = None
-    runtime_env: dict | None = None
+    inflight: int = 0  # pushed-not-replied tasks pipelined on this worker
+    idle_since: float = 0.0  # monotonic ts when inflight last hit 0
 
 
 class NormalTaskSubmitter:
     MAX_LEASES_PER_SHAPE = 16
+    # Tasks pushed to one worker without waiting for replies (the reference's
+    # max_tasks_in_flight_per_worker lease pipelining). Depth beyond 1 only
+    # opens once no lease requests are outstanding — otherwise a 2-task burst
+    # on a 2-node cluster would bind both tasks to the first granted worker
+    # instead of spreading (and breadth is what the scheduler promised).
+    MAX_INFLIGHT_PER_WORKER = 8
+    # Granted leases linger briefly after their queue drains so sync
+    # call-loops reuse a warm worker instead of re-leasing per task
+    # (ref: worker lease idle keep-alive).
+    IDLE_LEASE_TTL_S = 0.5
 
     def __init__(self, runtime):
         self._rt = runtime
         self._lock = threading.Lock()
         self._shapes: dict[object, _ShapeState] = {}
         self._lease_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="lease")
+        self._reaper = threading.Thread(
+            target=self._reap_idle_leases, name="lease-reaper", daemon=True)
+        self._stopped = threading.Event()
+        self._reaper.start()
 
     def submit(self, spec: TaskSpec):
         key = _shape_key(spec)
@@ -84,28 +100,52 @@ class NormalTaskSubmitter:
         self._pump(key)
 
     def _pump(self, key):
-        """Dispatch queued tasks onto idle leases; request more leases if the
-        queue is still non-empty."""
+        """Dispatch queued tasks onto lease capacity; request more leases if
+        the queue still has undispatchable work."""
         to_push = []
-        request_lease = False
+        new_requests = 0
         with self._lock:
             st = self._shapes.get(key)
             if st is None:
                 return
-            while st.queue and st.idle:
-                lease = st.idle.pop()
-                spec = st.queue.popleft()
-                st.busy[lease.worker_addr] = lease
-                to_push.append((lease, spec))
-            want = min(len(st.queue), self.MAX_LEASES_PER_SHAPE
-                       - len(st.busy) - len(st.idle) - st.requests_in_flight)
-            if want > 0:
-                st.requests_in_flight += 1
-                request_lease = True
+            depth = (self.MAX_INFLIGHT_PER_WORKER
+                     if st.requests_in_flight == 0 else 1)
+            while st.queue and st.leases:
+                lease = min(st.leases, key=lambda l: l.inflight)
+                if lease.inflight >= depth:
+                    break
+                lease.inflight += 1
+                to_push.append((lease, st.queue.popleft()))
+            new_requests = min(
+                max(0, len(st.queue) - st.requests_in_flight),
+                self.MAX_LEASES_PER_SHAPE
+                - len(st.leases) - st.requests_in_flight)
+            if time.monotonic() - st.last_busy < 0.5:
+                # the cluster just said it's saturated for this shape:
+                # don't storm it with more lease requests; pipelining onto
+                # held leases carries the queue meanwhile
+                new_requests = 0
+            if new_requests > 0:
+                st.requests_in_flight += new_requests
         for lease, spec in to_push:
             self._push(key, lease, spec)
-        if request_lease:
+        for _ in range(max(0, new_requests)):
             self._lease_pool.submit(self._request_lease, key)
+
+    def _reap_idle_leases(self):
+        while not self._stopped.wait(0.25):
+            now = time.monotonic()
+            to_return = []
+            with self._lock:
+                for st in self._shapes.values():
+                    for lease in list(st.leases):
+                        if (lease.inflight == 0 and not st.queue
+                                and now - lease.idle_since
+                                > self.IDLE_LEASE_TTL_S):
+                            st.leases.remove(lease)
+                            to_return.append(lease)
+            for lease in to_return:
+                self._return_lease(lease)
 
     def _request_lease(self, key):
         resources, pg_id, bundle_index = dict(key[0]), key[1], key[2]
@@ -152,6 +192,14 @@ class NormalTaskSubmitter:
                 if reply.get("redirect"):
                     agent_addr = tuple(reply["redirect"])
                     continue
+                if reply.get("busy"):
+                    # cluster saturated for this shape right now: back off so
+                    # the retry loop doesn't hot-spin, then let _pump decide
+                    with self._lock:
+                        st_b = self._shapes.get(key)
+                        if st_b is not None:
+                            st_b.last_busy = time.monotonic()
+                    time.sleep(0.1)
                 break
         except Exception as e:
             logger.debug("lease request failed: %s", e)
@@ -162,17 +210,21 @@ class NormalTaskSubmitter:
             st.requests_in_flight -= 1
             if granted is not None:
                 if st.queue:
-                    st.idle.append(granted)
+                    st.leases.append(granted)
                 else:
                     self._return_lease(granted)
                     return
         if granted is not None:
             self._pump(key)
         else:
+            # failed/busy grant: re-pump whenever work remains — with leases
+            # held, the depth gate has just loosened (requests_in_flight
+            # dropped), so queued tasks can now pipeline onto them; with no
+            # leases at all this retries the lease request (throttled by the
+            # busy backoff above)
             with self._lock:
                 st = self._shapes.get(key)
-                retry = st is not None and bool(st.queue) and not st.idle \
-                    and not st.busy and st.requests_in_flight == 0
+                retry = st is not None and bool(st.queue)
             if retry:
                 self._pump(key)
 
@@ -219,26 +271,37 @@ class NormalTaskSubmitter:
         client.call_async("push_task", {"spec": spec}, callback=on_reply)
 
     def _on_worker_idle(self, key, lease: _Lease):
-        """(ref: OnWorkerIdle normal_task_submitter.cc:139)"""
+        """(ref: OnWorkerIdle normal_task_submitter.cc:139). A fully idle
+        lease is NOT returned here — it lingers IDLE_LEASE_TTL_S (reaper
+        thread) so sync call-loops reuse the warm worker."""
         next_spec = None
+        repump = False
         with self._lock:
             st = self._shapes.get(key)
             if st is None:
                 self._return_lease(lease)
                 return
-            if st.queue:
+            lease.inflight -= 1
+            if lease not in st.leases:
+                # _on_push_failed declared this worker dead while other
+                # pipelined calls were still in flight: never dispatch onto
+                # it again (it would burn a retry on a known-dead address)
+                repump = bool(st.queue)
+            elif st.queue:
+                lease.inflight += 1
                 next_spec = st.queue.popleft()
-            else:
-                st.busy.pop(lease.worker_addr, None)
-                self._return_lease(lease)
+            elif lease.inflight == 0:
+                lease.idle_since = time.monotonic()
         if next_spec is not None:
             self._push(key, lease, next_spec)
+        elif repump:
+            self._pump(key)
 
     def _on_push_failed(self, key, lease: _Lease, spec: TaskSpec, err):
         with self._lock:
             st = self._shapes.get(key)
-            if st is not None:
-                st.busy.pop(lease.worker_addr, None)
+            if st is not None and lease in st.leases:
+                st.leases.remove(lease)
         self._rt.peer_pool.invalidate(lease.worker_addr)
         retry_spec = self._rt.task_manager.should_retry_system_failure(spec.task_id)
         if retry_spec is not None:
@@ -259,6 +322,14 @@ class NormalTaskSubmitter:
             pass
 
     def shutdown(self):
+        self._stopped.set()
+        # return still-held leases so agents free their workers promptly
+        with self._lock:
+            leases = [l for st in self._shapes.values() for l in st.leases]
+            for st in self._shapes.values():
+                st.leases.clear()
+        for lease in leases:
+            self._return_lease(lease)
         self._lease_pool.shutdown(wait=False)
 
 

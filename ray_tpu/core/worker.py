@@ -20,6 +20,7 @@ import os
 import sys
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -50,6 +51,70 @@ from ray_tpu.exceptions import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+class _NormalTaskQueue:
+    """Sequential normal-task execution with blocked-task yield.
+
+    One runner thread drains the queue (the reference's
+    NormalSchedulingQueue); when the running task blocks in get()/wait()
+    (signalled via on_blocked), a new runner starts for the next queued
+    task — mirroring the raylet's release-CPU-while-blocked oversubscribe
+    (node_manager blocked-worker handling) at worker scope. Pipelined
+    pushes from the submitter therefore can't deadlock tasks that
+    rendezvous with each other."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue: deque = deque()
+        self._active = 0  # runners currently NOT blocked
+        self._tl = threading.local()
+
+    def submit(self, run) -> None:
+        with self._lock:
+            self._queue.append(run)
+            start = self._active == 0
+            if start:
+                self._active += 1
+        if start:
+            threading.Thread(target=self._loop, name="task-exec",
+                             daemon=True).start()
+
+    def _loop(self):
+        self._tl.runner = True
+        self._tl.block_depth = 0
+        while True:
+            with self._lock:
+                if not self._queue:
+                    self._active -= 1
+                    return
+                run = self._queue.popleft()
+            run()
+
+    def on_blocked(self):
+        """Current runner is about to block; let the next queued task run."""
+        if not getattr(self._tl, "runner", False):
+            return
+        self._tl.block_depth = getattr(self._tl, "block_depth", 0) + 1
+        if self._tl.block_depth != 1:
+            return
+        start = False
+        with self._lock:
+            self._active -= 1
+            if self._queue and self._active == 0:
+                self._active += 1
+                start = True
+        if start:
+            threading.Thread(target=self._loop, name="task-exec",
+                             daemon=True).start()
+
+    def on_unblocked(self):
+        if not getattr(self._tl, "runner", False):
+            return
+        self._tl.block_depth -= 1
+        if self._tl.block_depth == 0:
+            with self._lock:
+                self._active += 1
 
 
 class _TaskContext:
@@ -130,6 +195,7 @@ class WorkerRuntime:
         self._subscribed_actors: set[ActorID] = set()
         self._cancelled_tasks: set[TaskID] = set()
         self._device_objects: dict[ObjectID, Any] = {}  # HBM-resident values
+        self._normal_exec = _NormalTaskQueue()
         self._running_tasks: dict[TaskID, threading.Event] = {}
         self._blocked_notified = threading.local()
         self._shutdown = threading.Event()
@@ -256,7 +322,11 @@ class WorkerRuntime:
         if ent is not None:
             return ent
         self._notify_blocked()
-        return self.memory_store.wait_for(oid, self._remaining(deadline))
+        self._normal_exec.on_blocked()
+        try:
+            return self.memory_store.wait_for(oid, self._remaining(deadline))
+        finally:
+            self._normal_exec.on_unblocked()
 
     def _notify_blocked(self):
         """Release our CPU while blocked so nested tasks can schedule
@@ -333,9 +403,14 @@ class WorkerRuntime:
         try:
             if wait:
                 self._notify_blocked()
-            return self.peer_pool.get(owner_addr).call_with_retry(
-                "get_object_status", body,
-                timeout=(body["timeout"] + 10.0))
+                self._normal_exec.on_blocked()
+            try:
+                return self.peer_pool.get(owner_addr).call_with_retry(
+                    "get_object_status", body,
+                    timeout=(body["timeout"] + 10.0))
+            finally:
+                if wait:
+                    self._normal_exec.on_unblocked()
         except Exception as e:
             return {"kind": "lost", "error": str(e)}
 
@@ -388,12 +463,16 @@ class WorkerRuntime:
 
         if need_block and len(ready_ids) < num_returns:
             self._notify_blocked()
-        with cond:
-            cond.wait_for(
-                lambda: len(ready_ids) >= min(num_returns, len(refs)),
-                self._remaining(deadline))
-            finished[0] = True
-            ready_now = set(ready_ids)
+        self._normal_exec.on_blocked()
+        try:
+            with cond:
+                cond.wait_for(
+                    lambda: len(ready_ids) >= min(num_returns, len(refs)),
+                    self._remaining(deadline))
+                finished[0] = True
+                ready_now = set(ready_ids)
+        finally:
+            self._normal_exec.on_unblocked()
         for oid, cb in cleanups:
             self.memory_store.remove_callback(oid, cb)
         ready = [r for r in refs if r.id() in ready_now]
@@ -763,12 +842,28 @@ class WorkerRuntime:
             return self._execute_actor_creation(spec)
         return self._enqueue_actor_task(spec)
 
-    def _execute_normal(self, spec: TaskSpec) -> dict:
+    def _execute_normal(self, spec: TaskSpec):
         if spec.task_id in self._cancelled_tasks:
             return self._error_reply(spec, TaskError(
                 TaskCancelledError(), task_repr=spec.repr_name()))
-        self._blocked_notified.sent = False
-        return self._run_task(spec)
+        # Callers pipeline several pushes onto one lease (submitter
+        # MAX_INFLIGHT_PER_WORKER); execution stays one-at-a-time per
+        # 1-CPU lease (the reference's NormalSchedulingQueue semantics) —
+        # EXCEPT that a task blocked in get()/wait() yields its slot so a
+        # queued task can start (the reference's blocked-worker oversubscribe;
+        # without it, two queued tasks that rendezvous through an actor
+        # deadlock on one worker).
+        reply = DeferredReply()
+
+        def run():
+            self._blocked_notified.sent = False
+            try:
+                reply.send(self._run_task(spec))
+            except BaseException as e:  # noqa: BLE001
+                reply.fail(e)
+
+        self._normal_exec.submit(run)
+        return reply
 
     def _run_task(self, spec: TaskSpec) -> dict:
         prev_task = self._ctx.task_id

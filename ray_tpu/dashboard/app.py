@@ -95,6 +95,7 @@ class Dashboard:
         self._loop = loop
         app = web.Application()
         app.router.add_get("/", self._index)
+        app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/api/{section}", self._api)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
@@ -114,6 +115,22 @@ class Dashboard:
     async def _index(self, request):
         from aiohttp import web
         return web.Response(text=_INDEX, content_type="text/html")
+
+    async def _metrics(self, request):
+        """Prometheus scrape endpoint (reference: dashboard/modules/metrics/
+        + per-node reporter agents; here the CP aggregates node gauges)."""
+        from aiohttp import web
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.core import api
+            from ray_tpu.util.metrics import collect_prometheus
+            text = api._get_runtime().cp_client.call_with_retry(
+                "get_metrics", None, timeout=10.0)
+            return text + collect_prometheus()
+
+        text = await loop.run_in_executor(None, fetch)
+        return web.Response(text=text, content_type="text/plain")
 
     async def _api(self, request):
         from aiohttp import web

@@ -24,6 +24,7 @@ from ray_tpu.data.logical import (
     Filter,
     FlatMap,
     InputData,
+    Join,
     Limit,
     LogicalOp,
     LogicalPlan,
@@ -138,9 +139,23 @@ class Dataset:
         return self._with(Zip(name="Zip",
                               inputs=[self._terminal, other._terminal]))
 
+    def join(self, other: "Dataset", on: str, *, right_on: str | None = None,
+             how: str = "inner", num_partitions: int = 0) -> "Dataset":
+        """Distributed hash join (reference dataset.py join / execution
+        operators/join.py). how: inner | left_outer | right_outer |
+        full_outer."""
+        how = how.replace("_", " ")
+        if how not in ("inner", "left outer", "right outer", "full outer"):
+            raise ValueError(f"unsupported join type: {how!r}")
+        return self._with(Join(
+            name="Join", inputs=[self._terminal, other._terminal],
+            on=on, right_on=right_on, how=how,
+            num_partitions=num_partitions))
+
     # ---- execution -------------------------------------------------------
     def _execute(self) -> Iterator[tuple]:
         ex = StreamingExecutor(LogicalPlan(self._terminal), self._parallelism)
+        self._last_executor = ex
         return ex.run()
 
     def iter_internal_ref_bundles(self) -> Iterator[tuple]:
@@ -318,8 +333,15 @@ class Dataset:
 
     # ---- misc ------------------------------------------------------------
     def stats(self) -> str:
+        """Execution statistics of the last run (reference Dataset.stats /
+        _internal/stats.py): per-op blocks/rows/bytes/wall time. Before any
+        execution, shows the optimized plan."""
         from ray_tpu.data.logical import LogicalPlan as LP, optimize
-        return f"Plan: {optimize(LP(self._terminal))}"
+        plan = f"Plan: {optimize(LP(self._terminal))}"
+        ex = getattr(self, "_last_executor", None)
+        if ex is None:
+            return plan
+        return f"{plan}\n{ex.stats_summary()}"
 
     def __repr__(self):
         return f"Dataset(plan={LogicalPlan(self._terminal)})"

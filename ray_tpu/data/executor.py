@@ -32,6 +32,7 @@ from ray_tpu.data.logical import (
     Aggregate,
     FusedMap,
     InputData,
+    Join,
     Limit,
     LogicalPlan,
     RandomShuffle,
@@ -142,6 +143,20 @@ class PhysicalOp:
         self.out: list[Bundle] = []          # ready output bundles
         self._inputs_done = False
         self.done = False
+        self.throttled = False  # set by the executor's memory backpressure
+        self.wants_empty_bundles = False  # Join overrides: schema via empties
+        # per-op telemetry (reference _internal/stats.py OpStats)
+        self.stats = {"rows": 0, "bytes": 0, "blocks": 0,
+                      "start_ts": None, "end_ts": None}
+
+    def record_output(self, meta) -> None:
+        s = self.stats
+        if s["start_ts"] is None:
+            s["start_ts"] = time.monotonic()
+        s["end_ts"] = time.monotonic()
+        s["rows"] += getattr(meta, "num_rows", 0) or 0
+        s["bytes"] += getattr(meta, "size_bytes", 0) or 0
+        s["blocks"] += 1
 
     def add_input(self, bundle: Bundle, input_index: int = 0):
         raise NotImplementedError
@@ -204,8 +219,7 @@ class TaskMapOp(PhysicalOp):
                 break
             self._in_flight.pop(0)
             meta = ray_tpu.get(m)
-            if meta.num_rows > 0:
-                self.out.append((b, meta))
+            self.out.append((b, meta))
         if self._inputs_done and not self._in_flight:
             self.done = True
 
@@ -249,8 +263,7 @@ class ActorMapOp(PhysicalOp):
                 break
             self._in_flight.pop(0)
             block, meta = ray_tpu.get(ref)
-            if meta.num_rows > 0:
-                self.out.append((ray_tpu.put(block), meta))
+            self.out.append((ray_tpu.put(block), meta))
         if self._inputs_done and not self._in_flight:
             self.done = True
             self.shutdown()
@@ -278,7 +291,8 @@ class ReadOp(TaskMapOp):
         return False
 
     def poll(self):
-        while self._pending and len(self._in_flight) < self.MAX_IN_FLIGHT \
+        while not self.throttled and self._pending \
+                and len(self._in_flight) < self.MAX_IN_FLIGHT \
                 and len(self.out) < self.MAX_OUT_BUFFER:
             task = self._pending.pop(0)
             self._in_flight.append(_read_task.remote(task))
@@ -289,8 +303,7 @@ class ReadOp(TaskMapOp):
                 break
             self._in_flight.pop(0)
             meta = ray_tpu.get(m)
-            if meta.num_rows > 0:
-                self.out.append((b, meta))
+            self.out.append((b, meta))
         if not self._pending and not self._in_flight:
             self.done = True
 
@@ -405,8 +418,7 @@ class AllToAllOp(PhysicalOp):
                     break
                 self._phase2.pop(0)
                 meta = ray_tpu.get(m)
-                if meta.num_rows > 0:
-                    self.out.append((b, meta))
+                self.out.append((b, meta))
             if not self._phase2:
                 self.done = True
 
@@ -535,6 +547,82 @@ def _aggregate_task(key, aggs, *blocks):
     return out, BlockAccessor.for_block(out).metadata()
 
 
+class JoinOp(AllToAllOp):
+    """Distributed hash join (reference: execution/operators/join.py):
+    hash-partition both sides on the key, then per-partition pyarrow hash
+    join — Arrow's native join does the per-partition probe."""
+
+    def __init__(self, name, inputs, on: str, right_on: str | None,
+                 how: str, num_partitions: int):
+        super().__init__(name, inputs)
+        self._on = on
+        self._right_on = right_on or on
+        self._how = how
+        self._n = num_partitions
+        self._left: list[Bundle] = []
+        self._right: list[Bundle] = []
+        self._schemas: list = [None, None]  # per-side, from bundle metadata
+        self.wants_empty_bundles = True  # an all-filtered side still has schema
+
+    def add_input(self, bundle: Bundle, input_index: int = 0):
+        if self._schemas[input_index] is None:
+            self._schemas[input_index] = bundle[1].schema
+        if bundle[1].num_rows:
+            (self._left if input_index == 0 else self._right).append(bundle)
+
+    def _run(self, _bundles):
+        n = self._n or max(1, max(len(self._left), len(self._right)))
+        lparts = ray_tpu.get(
+            [_partition_task.remote(b, n, "hash", self._on)
+             for b, _ in self._left]) if self._left else []
+        rparts = ray_tpu.get(
+            [_partition_task.remote(b, n, "hash", self._right_on)
+             for b, _ in self._right]) if self._right else []
+        for i in range(n):
+            lrefs = [ray_tpu.put(p[i]) for p in lparts]
+            rrefs = [ray_tpu.put(p[i]) for p in rparts]
+            if not lrefs and not rrefs:
+                continue
+            self._phase2.append(_join_task.remote(
+                self._on, self._right_on, self._how, len(lrefs),
+                self._schemas[0], self._schemas[1], *lrefs, *rrefs))
+
+
+@ray_tpu.remote(num_returns=2)
+def _join_task(on: str, right_on: str, how: str, n_left: int,
+               left_schema, right_schema, *blocks):
+    import pyarrow as pa
+    left = list(blocks[:n_left])
+    right = list(blocks[n_left:])
+    # a side with zero blocks joins as an empty table with its known schema,
+    # so outer joins still emit the missing side's columns as nulls
+    if left:
+        lt = BlockAccessor.concat(left)
+    elif left_schema is not None:
+        lt = left_schema.empty_table()
+    else:
+        lt = None
+    if right:
+        rt = BlockAccessor.concat(right)
+    elif right_schema is not None:
+        rt = right_schema.empty_table()
+    else:
+        rt = None
+    if lt is None or rt is None:
+        # schema of the absent side is unknowable (it never produced a
+        # single block): emit the populated side (outer) or nothing (inner)
+        have = lt if lt is not None else rt
+        if have is None:
+            out = pa.table({})
+        elif how == "inner":
+            out = have.slice(0, 0)
+        else:
+            out = have
+        return out, BlockAccessor.for_block(out).metadata()
+    out = lt.join(rt, keys=on, right_keys=right_on, join_type=how)
+    return out, BlockAccessor.for_block(out).metadata()
+
+
 class WriteOp(TaskMapOp):
     def __init__(self, name, inputs, path: str, file_format: str):
         PhysicalOp.__init__(self, name, inputs)
@@ -592,6 +680,9 @@ def build_physical(plan: LogicalPlan, parallelism: int) -> list[PhysicalOp]:
             op = SortOp("Sort", phys_inputs, lop.key, lop.descending)
         elif isinstance(lop, Aggregate):
             op = AggregateOp("Aggregate", phys_inputs, lop.key, lop.aggs)
+        elif isinstance(lop, Join):
+            op = JoinOp("Join", phys_inputs, lop.on, lop.right_on,
+                        lop.how, lop.num_partitions)
         elif isinstance(lop, Union):
             op = UnionOp("Union", phys_inputs)
         elif isinstance(lop, Zip):
@@ -638,8 +729,34 @@ class StreamingExecutor:
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._stopped = threading.Event()
+        self._t0: Optional[float] = None
+        self._t1: Optional[float] = None
+        # memory-based backpressure budget: buffered (not-yet-consumed)
+        # bundle bytes beyond this pause dispatch into map ops (reference
+        # backpressure_policy/ + resource_manager.py)
+        from ray_tpu.core.config import get_config
+        self.memory_budget = max(64 * 1024 * 1024,
+                                 get_config().object_store_memory // 4)
         _live_executors.add(self)
         _install_shutdown_hook()
+
+    # ---- stats (reference _internal/stats.py DatasetStats) -------------
+    def _buffered_bytes(self) -> int:
+        return sum((m.size_bytes or 0) for op in self._ops
+                   for (_, m) in op.out)
+
+    def stats_summary(self) -> str:
+        lines = []
+        total = (self._t1 or time.monotonic()) - (self._t0 or time.monotonic())
+        for op in self._ops:
+            s = op.stats
+            wall = ((s["end_ts"] or 0) - (s["start_ts"] or 0)
+                    if s["start_ts"] else 0.0)
+            lines.append(
+                f"{op.name}: {s['blocks']} blocks, {s['rows']} rows, "
+                f"{s['bytes'] / 1e6:.2f} MB, {wall:.3f}s busy")
+        lines.append(f"Total: {total:.3f}s")
+        return "\n".join(lines)
 
     def run(self) -> Iterator[Bundle]:
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -681,6 +798,7 @@ class StreamingExecutor:
         _live_executors.discard(self)
 
     def _loop(self):
+        self._t0 = time.monotonic()
         try:
             consumers: dict[int, list[tuple[PhysicalOp, int]]] = {}
             for op in self._ops:
@@ -694,13 +812,24 @@ class StreamingExecutor:
                         self._terminal.truncated():
                     for op in self._ops[:-1]:
                         op.shutdown()
+                # memory backpressure: while buffered (unconsumed) bundle
+                # bytes exceed the budget, SOURCE ops stop producing new
+                # blocks; transfers keep flowing so the pipeline drains
+                # (throttling mid-pipeline would trap the buffered bytes and
+                # deadlock). Ref: backpressure_policy/ + resource_manager.py.
+                over_budget = self._buffered_bytes() > self.memory_budget
                 for op in self._ops:
+                    if not op.inputs:
+                        op.throttled = over_budget
                     op.poll()
                     # move outputs downstream (or to the consumer queue)
                     downstream = consumers.get(id(op), [])
                     if not downstream:
                         while op.out:
                             bundle = op.out.pop(0)
+                            if not bundle[1].num_rows:
+                                continue  # consumers never see empty blocks
+                            op.record_output(bundle[1])
                             while not self._stopped.is_set():
                                 try:
                                     self._outq.put(bundle, timeout=0.1)
@@ -715,8 +844,15 @@ class StreamingExecutor:
                             if not targets_ready:
                                 break
                             bundle = op.out.pop(0)
+                            op.record_output(bundle[1])
                             for t, idx in downstream:
-                                t.add_input(bundle, idx)
+                                # empty blocks skip most ops, but schema-
+                                # hungry consumers (Join: an all-filtered
+                                # side must still contribute its columns)
+                                # opt in via wants_empty_bundles
+                                if (bundle[1].num_rows
+                                        or t.wants_empty_bundles):
+                                    t.add_input(bundle, idx)
                             progressed = True
                         if op.done and not op.out:
                             for t, _ in downstream:
@@ -734,6 +870,7 @@ class StreamingExecutor:
             self._outq.put(_ExecutorError(e))
             return
         finally:
+            self._t1 = time.monotonic()
             for op in self._ops:
                 op.shutdown()
         self._outq.put(_DONE)

@@ -103,6 +103,16 @@ class Aggregate(LogicalOp):
 
 
 @dataclasses.dataclass
+class Join(LogicalOp):
+    """Hash join of two datasets (reference: _internal/logical/operators/
+    join_operator.py + execution/operators/join.py)."""
+    on: str = ""
+    right_on: Optional[str] = None
+    how: str = "inner"  # inner | left outer | right outer | full outer
+    num_partitions: int = 0
+
+
+@dataclasses.dataclass
 class Union(LogicalOp):
     pass
 

@@ -137,7 +137,10 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     if t_k % bk:
         bk = t_k
 
-    qf = q.astype(jnp.float32)
+    # Matmuls stay in the inputs' dtype (bf16 on TPU) with fp32 ACCUMULATION
+    # via preferred_element_type — an fp32 cast before the einsum would push
+    # the whole backward off the bf16 MXU path (4x+ slower on v5e).
+    acc32 = dict(preferred_element_type=jnp.float32)
     g32 = g.astype(jnp.float32)
     # delta_i = sum_j P_ij * dP_ij = rowsum(dO * O)  (flash-attn-2 trick)
     delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)  # [B,T,H]
@@ -146,8 +149,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     def stats_body(carry, kb):
         m_prev, l_prev = carry
         k_blk, start = kb
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) \
-            * sm_scale
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk, **acc32) * sm_scale
         if causal:
             rows = jnp.arange(t_q)[:, None]
             cols = start + jnp.arange(bk)[None, :]
@@ -168,19 +170,22 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     # pass 2: accumulate dq; emit dk/dv per block
     def grad_body(dq_acc, kb):
         k_blk, v_blk, start = kb
-        kf = k_blk.astype(jnp.float32)
-        vf = v_blk.astype(jnp.float32)
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sm_scale
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk, **acc32) * sm_scale
         if causal:
             rows = jnp.arange(t_q)[:, None]
             cols = start + jnp.arange(bk)[None, :]
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - m[..., None]) / l[..., None]          # [B,H,Tq,bk]
-        dp = jnp.einsum("bqhd,bkhd->bhqk", g32, vf)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", g, v_blk, **acc32)
         ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * sm_scale
-        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
-        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, g32)
+        # cast the [T, bk] factors down to the input dtype for the second-
+        # stage matmuls (standard flash-attention practice; accumulation
+        # stays fp32)
+        p_lo = p.astype(q.dtype)
+        ds_lo = ds.astype(q.dtype)
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds_lo, k_blk, **acc32)
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds_lo, q, **acc32)
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p_lo, g, **acc32)
         return dq_acc, (dk_blk, dv_blk)
 
     dq0 = jnp.zeros((b, t_q, h, d), jnp.float32)

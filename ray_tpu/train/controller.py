@@ -172,14 +172,18 @@ class TrainController:
                      if rep.checkpoint is not None]
         sharded = [rc for rc in with_ckpt
                    if rc[1].get_metadata().get("shard")]
-        if len(sharded) > 1:
+        if sharded:
             # distributed checkpoint (EXPLICIT opt-in: each rank marked its
             # payload with metadata {"shard": True}): merge the per-rank
             # shards (Orbax-style per-host writes, SURVEY.md §5.4) into one
-            # dir: shard-{rank:05d}/...
-            self._ckpt_manager.register_sharded(
-                sharded, metrics, world_size=world)
-            self._ckpt_manager.write_state()
+            # dir: shard-{rank:05d}/... . A PARTIAL shard set (a resize or
+            # failure flushed an incomplete step) is unusable for restore —
+            # registering it as-is would hand the resumed gang a raw
+            # unmerged shard — so it is dropped, not promoted.
+            if len(sharded) == world:
+                self._ckpt_manager.register_sharded(
+                    sharded, metrics, world_size=world)
+                self._ckpt_manager.write_state()
         elif with_ckpt:
             # default: rank 0's (full) checkpoint wins — reference
             # report_handler semantics

@@ -111,6 +111,20 @@ def test_dashboard_endpoints(ray_start_regular):
         assert nodes and nodes[0]["alive"]
         html = urllib.request.urlopen(base + "/", timeout=30).read().decode()
         assert "ray_tpu dashboard" in html
+
+        # system metrics: run a task so counters move, give the agent one
+        # heartbeat to ship node gauges, then scrape
+        @ray_tpu.remote
+        def probe_task():
+            return 1
+
+        assert ray_tpu.get(probe_task.remote(), timeout=60) == 1
+        time.sleep(1.5)
+        text = urllib.request.urlopen(
+            base + "/metrics", timeout=30).read().decode()
+        assert "ray_tpu_nodes_alive 1" in text
+        assert "ray_tpu_node_workers_total" in text
+        assert "ray_tpu_node_resource_total" in text
     finally:
         db.stop()
 

@@ -119,6 +119,65 @@ def test_global_aggregates(rt):
     assert ds.mean("id") == pytest.approx(4.5)
 
 
+def test_join_inner_and_left(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    left = rdata.from_items(
+        [{"id": i, "x": i * 10} for i in range(8)], parallelism=3)
+    right = rdata.from_items(
+        [{"id": i, "y": i * 100} for i in range(4, 12)], parallelism=2)
+
+    rows = sorted(left.join(right, on="id").take_all(),
+                  key=lambda r: r["id"])
+    assert [r["id"] for r in rows] == [4, 5, 6, 7]
+    assert all(r["y"] == r["id"] * 100 and r["x"] == r["id"] * 10
+               for r in rows)
+
+    louter = sorted(left.join(right, on="id", how="left_outer").take_all(),
+                    key=lambda r: r["id"])
+    assert [r["id"] for r in louter] == list(range(8))
+    assert louter[0]["y"] is None and louter[7]["y"] == 700
+
+
+def test_join_left_outer_empty_right(ray_start_regular):
+    """One side filtered to nothing: outer joins still emit its columns as
+    nulls (schema carried via bundle metadata)."""
+    import ray_tpu.data as rdata
+
+    left = rdata.from_items([{"id": i, "x": i} for i in range(4)],
+                            parallelism=2)
+    right = rdata.from_items([{"id": i, "y": i} for i in range(4)],
+                             parallelism=2).filter(lambda r: r["id"] > 99)
+    rows = sorted(left.join(right, on="id", how="left_outer").take_all(),
+                  key=lambda r: r["id"])
+    assert [r["id"] for r in rows] == [0, 1, 2, 3]
+    assert all(r["y"] is None for r in rows)
+
+
+def test_join_different_key_names(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    left = rdata.from_items([{"k": i} for i in range(5)], parallelism=2)
+    right = rdata.from_items([{"j": i, "v": -i} for i in range(3, 8)],
+                             parallelism=2)
+    rows = sorted(left.join(right, on="k", right_on="j").take_all(),
+                  key=lambda r: r["k"])
+    assert [r["k"] for r in rows] == [3, 4]
+    assert [r["v"] for r in rows] == [-3, -4]
+
+
+def test_stats_after_execution(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(100, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] * 2})
+    assert "Plan:" in ds.stats()
+    ds.take_all()
+    s = ds.stats()
+    assert "rows" in s and "Total:" in s
+    assert "100 rows" in s  # terminal op saw every row
+
+
 def test_union_zip(rt):
     a = rtd.from_items([{"x": 1}, {"x": 2}])
     b = rtd.from_items([{"x": 3}])

@@ -181,6 +181,38 @@ def test_sync_actor_barrier(ray_start_regular):
     assert out == ["value-0", "value-0"]
 
 
+def test_jax_trainer_distributed_init_two_workers(ray_start_regular,
+                                                  tmp_path):
+    """The multi-host coordinator bootstrap path (reference
+    _setup_jax_tpu_environment, train/v2/jax/config.py): rank 0 publishes a
+    coordinator address through the sync actor and every worker runs
+    jax.distributed.initialize. Two CPU-backend JAX processes form one
+    distributed runtime — jax.process_count() must see both."""
+
+    def train_fn(config):
+        import jax
+
+        ctx = rt_train.get_context()
+        assert jax.process_count() == 2
+        assert jax.process_index() == ctx.get_world_rank()
+        # global device view proves both processes joined the coordination
+        # service (initialize blocks until every process connects). Cross-
+        # process CPU collectives aren't exercised — XLA's CPU backend
+        # doesn't ship them; on TPU the same path runs over ICI.
+        assert len(jax.devices()) == 2 * len(jax.local_devices())
+        rt_train.report({"procs": jax.process_count(),
+                         "rank": ctx.get_world_rank()})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=_run_cfg(tmp_path),
+        use_distributed=True)
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["procs"] == 2
+
+
 def test_jax_trainer_cpu_spmd(ray_start_regular, tmp_path):
     """JaxTrainer with a real (tiny) pjit step on the worker's CPU devices."""
 
