@@ -58,7 +58,9 @@ def run(quick: bool = False) -> dict:
     # logical CPUs: every live actor reserves one; sections clean up after
     # themselves but the peak (4 targets + 4 callers + driver tasks) needs
     # headroom. Workload is RPC-bound, not CPU-bound.
-    ray_tpu.init(num_cpus=16)
+    # 2 GiB store: the bandwidth row must measure shm, not disk spill (the
+    # reference's default store is 30% of RAM; 512MB would spill mid-bench)
+    ray_tpu.init(num_cpus=16, object_store_memory=2 * 1024**3)
     results: dict[str, float] = {}
 
     # ---- object plane --------------------------------------------------

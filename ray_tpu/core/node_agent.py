@@ -60,6 +60,7 @@ class _Lease:
     resources: dict[str, float]
     pg_id: PlacementGroupID | None = None
     bundle_index: int = -1
+    lessee: WorkerID | None = None  # holder; reclaimed if it dies
 
 
 class NodeAgent:
@@ -352,7 +353,8 @@ class NodeAgent:
                             if for_actor is not None:
                                 worker.actor_id = for_actor
                             lease = _Lease(uuid.uuid4().hex, worker.worker_id,
-                                           resources, pg_id, bundle_index)
+                                           resources, pg_id, bundle_index,
+                                           lessee=body.get("lessee"))
                             self._leases[lease.lease_id] = lease
                             reserved = False  # consumed by the lease
                             self._report_resources()
@@ -746,12 +748,33 @@ class NodeAgent:
 
     def _on_worker_dead(self, info: _WorkerInfo):
         code = info.proc.returncode if info.proc else None
+        to_kill = []
         with self._lock:
             for lid, lease in list(self._leases.items()):
-                if lease.worker_id == info.worker_id:
+                # release leases ON the dead worker and leases HELD BY it
+                # (a killed actor can't return the task leases it was
+                # holding; leaking them wedges the node's resource view)
+                if (lease.worker_id == info.worker_id
+                        or lease.lessee == info.worker_id):
                     self._unreserve(lease.resources, lease.pg_id, lease.bundle_index)
                     del self._leases[lid]
+                    w = self._workers.get(lease.worker_id)
+                    if w is not None and lease.worker_id != info.worker_id \
+                            and w.actor_id is None:
+                        # the worker may still be mid-execution of the dead
+                        # lessee's orphaned task — marking it idle would
+                        # re-lease a busy CPU; terminate it instead (the
+                        # monitor reaps + a fresh worker spawns clean)
+                        to_kill.append(w.proc)
+                        del self._workers[w.worker_id]
             self._lease_cv.notify_all()
+        for proc in to_kill:
+            try:
+                if proc is not None:
+                    proc.terminate()
+            except Exception:  # noqa: BLE001 - already gone
+                pass
+        self._report_resources()
         if info.actor_id is not None:
             try:
                 self._pool.get(self.cp_addr).notify(

@@ -136,16 +136,25 @@ class NormalTaskSubmitter:
         while not self._stopped.wait(0.25):
             now = time.monotonic()
             to_return = []
+            repump = []
             with self._lock:
-                for st in self._shapes.values():
+                for key, st in self._shapes.items():
                     for lease in list(st.leases):
                         if (lease.inflight == 0 and not st.queue
                                 and now - lease.idle_since
                                 > self.IDLE_LEASE_TTL_S):
                             st.leases.remove(lease)
                             to_return.append(lease)
+                    # starvation guard: a queued shape with no outstanding
+                    # lease requests re-pumps here — the busy-damping above
+                    # deliberately drops requests, and nothing else re-arms
+                    # a shape that holds zero leases
+                    if st.queue and st.requests_in_flight == 0:
+                        repump.append(key)
             for lease in to_return:
                 self._return_lease(lease)
+            for key in repump:
+                self._pump(key)
 
     def _request_lease(self, key):
         resources, pg_id, bundle_index = dict(key[0]), key[1], key[2]
@@ -177,7 +186,11 @@ class NormalTaskSubmitter:
                     max_hops = 1  # do not follow spillback off a constrained node
             for _ in range(max_hops):
                 body = {"resources": resources, "timeout": cfg.lease_timeout_s,
-                        "job_id": self._rt.job_id.hex()}
+                        "job_id": self._rt.job_id.hex(),
+                        # lessee identity: if this runtime dies holding the
+                        # lease (actor kill, crash), the agent reclaims the
+                        # reservation when it reaps our process
+                        "lessee": self._rt.worker_id}
                 if runtime_env:
                     body["runtime_env"] = runtime_env
                 if pg_id is not None:

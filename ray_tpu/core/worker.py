@@ -819,16 +819,24 @@ class WorkerRuntime:
             return {"ok": False, "reason": "actor not hosted here"}
         if target is not None and mine is None:
             return {"ok": False, "reason": "no actor in this worker"}
-        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(1)),
+        threading.Thread(target=self._exit_now, args=(1,),
                          daemon=True).start()
         return {"ok": True}
+
+    def _exit_now(self, code: int):
+        time.sleep(0.05)
+        try:  # return held task leases so the agent's resources don't leak
+            self.normal_submitter.shutdown()
+        except Exception:
+            pass
+        os._exit(code)
 
     def _h_exit_worker(self, body):
         """Same port-reuse guard as kill_actor."""
         target = body.get("worker_id")
         if target is not None and target != self.worker_id:
             return {"ok": False, "reason": "wrong worker"}
-        threading.Thread(target=lambda: (time.sleep(0.05), os._exit(0)),
+        threading.Thread(target=self._exit_now, args=(0,),
                          daemon=True).start()
         return {"ok": True}
 
@@ -858,6 +866,12 @@ class WorkerRuntime:
         def run():
             self._blocked_notified.sent = False
             try:
+                # re-check: a cancel may have landed while this task was
+                # parked in the queue behind a running task
+                if spec.task_id in self._cancelled_tasks:
+                    reply.send(self._error_reply(spec, TaskError(
+                        TaskCancelledError(), task_repr=spec.repr_name())))
+                    return
                 reply.send(self._run_task(spec))
             except BaseException as e:  # noqa: BLE001
                 reply.fail(e)

@@ -423,6 +423,21 @@ class AllToAllOp(PhysicalOp):
                 self.done = True
 
 
+def _stable_hash(x) -> int:
+    """Process-independent hash for shuffle keys. Python's hash() is
+    per-process randomized for str/bytes (PYTHONHASHSEED), and partition
+    tasks for the two sides of a join run in different workers — builtin
+    hash would route the same key to different partitions per side."""
+    import zlib
+    if isinstance(x, (int, np.integer)):
+        return int(x) & 0x7FFFFFFF
+    if isinstance(x, str):
+        return zlib.crc32(x.encode())
+    if isinstance(x, bytes):
+        return zlib.crc32(x)
+    return zlib.crc32(repr(x).encode())
+
+
 @ray_tpu.remote
 def _partition_task(block: Block, n: int, how: str, key=None, seed=None,
                     bounds=None):
@@ -437,7 +452,7 @@ def _partition_task(block: Block, n: int, how: str, key=None, seed=None,
         assign = rng.integers(0, n, size=rows)
     elif how == "hash":
         col = acc.column_to_numpy(key)
-        assign = np.array([hash(x) % n for x in col.tolist()])
+        assign = np.array([_stable_hash(x) % n for x in col.tolist()])
     elif how == "range":
         col = acc.column_to_numpy(key)
         assign = np.searchsorted(np.asarray(bounds), col, side="right")

@@ -154,6 +154,22 @@ def test_join_left_outer_empty_right(ray_start_regular):
     assert all(r["y"] is None for r in rows)
 
 
+def test_join_string_keys_cross_process(ray_start_regular):
+    """String keys must route to the same partition on both sides even
+    though the two sides' partition tasks run in different worker processes
+    (builtin hash() is per-process randomized)."""
+    import ray_tpu.data as rdata
+
+    names = [f"user-{i}" for i in range(12)]
+    left = rdata.from_items([{"k": n, "x": i} for i, n in enumerate(names)],
+                            parallelism=3)
+    right = rdata.from_items([{"k": n, "y": i * 2}
+                              for i, n in enumerate(names)], parallelism=2)
+    rows = left.join(right, on="k", num_partitions=4).take_all()
+    assert len(rows) == 12
+    assert all(r["y"] == r["x"] * 2 for r in rows)
+
+
 def test_join_different_key_names(ray_start_regular):
     import ray_tpu.data as rdata
 
