@@ -40,12 +40,18 @@ def _peak_tflops(device) -> float:
     return _PEAK_TFLOPS["v5e"]  # conservative default
 
 
-def _run_config(cfg, batch: int, seq: int, steps: int, warmup: int, dev):
+def _run_config(cfg, batch: int, seq: int, steps: int, warmup: int, dev,
+                optimizer: str = "adafactor"):
     from ray_tpu.models import llama
     from ray_tpu.train import spmd
 
     mesh = spmd.make_mesh(1, devices=[dev])
-    opt = spmd.default_optimizer(warmup_steps=10, decay_steps=1000)
+    # adafactor: adam's fp32 moments cost 8 bytes/param — most of one v5e's
+    # HBM at 1.5B params; factored state frees it for the "dots" remat
+    # policy (saved matmul outputs, no backward recompute), the single
+    # biggest measured MFU lever on this chip
+    opt = spmd.default_optimizer(warmup_steps=10, decay_steps=1000,
+                                 name=optimizer)
     state, sh = spmd.sharded_create_state(
         lambda: llama.init_params(jax.random.PRNGKey(0), cfg), opt, mesh,
         params_logical_axes=llama.logical_axes(cfg))
@@ -79,22 +85,29 @@ def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if on_tpu:
-        # largest-that-fits on one chip: ~2B params, bf16 + remat + adafactor-
-        # style sharding is future work; adam fp32 states cap us near 1.6B on
-        # 16G HBM. seq 2048 = the 8B config's sequence length.
-        base = llama.llama3_1b(max_seq_len=2048)
-        batch, seq, steps, warmup = 8, 2048, 10, 3
+        # Measured recipe for one v5e chip at 1.5B params / seq 2048 (the 8B
+        # config's sequence length; the 8B model itself needs a pod —
+        # BASELINE's v5p-64): flash attention + "dots" remat (no backward
+        # recompute) + adafactor + batch 4. Sweep results on this chip:
+        # full-remat b8 flash 0.446 MFU, dots b4 flash 0.49-0.51, dense
+        # dots b4 0.42, 3.6B full-remat b4 0.39.
+        base = llama.llama3_1b(max_seq_len=2048, remat_policy="dots",
+                               ce_chunk=2048)
+        batch, seq, steps, warmup = 4, 2048, 10, 3
         impls = ("dense", "flash")
+        optimizer = "adafactor"  # frees adam's 12GB of fp32 moments for dots
     else:
         base = llama.llama_tiny()
         batch, seq, steps, warmup = 8, 64, 5, 2
         impls = ("dense",)  # pallas interpret mode is too slow to bench
+        optimizer = "adamw"  # the BASELINE recipe; tiny model fits anywhere
 
     results: dict[str, float] = {}
     for impl in impls:
         cfg = dataclasses.replace(base, attn_impl=impl)
         try:
-            results[impl] = _run_config(cfg, batch, seq, steps, warmup, dev)
+            results[impl] = _run_config(cfg, batch, seq, steps, warmup, dev,
+                                        optimizer=optimizer)
         except Exception as e:  # noqa: BLE001 - report the surviving impl
             results[impl] = float("nan")
             print(f"# {impl} failed: {e!r}", file=sys.stderr)

@@ -42,8 +42,12 @@ class LlamaConfig:
     remat: bool = True
     # checkpoint policy: "full" recomputes everything; "dots" saves matmul
     # outputs (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) —
-    # less recompute, more HBM
+    # less recompute, more HBM; "outs" saves only block outputs
     remat_policy: str = "full"
+    # cross-entropy chunk (sequence positions whose fp32 logits are live at
+    # once); bigger = less scan serialization, more HBM. T (or more) = one
+    # chunk, i.e. effectively unchunked.
+    ce_chunk: int = 256
 
     @property
     def head_dim(self) -> int:
@@ -206,6 +210,7 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh, *, positions_offset=0):
 
 
 def _layer_fwd(x, layer, cos, sin, cfg: LlamaConfig, mesh):
+    from jax.ad_checkpoint import checkpoint_name
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
     k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
@@ -213,20 +218,35 @@ def _layer_fwd(x, layer, cos, sin, cfg: LlamaConfig, mesh):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn = _attention(q, k, v, cfg, mesh)
-    x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+    attn_out = checkpoint_name(
+        jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"]), "attn_out")
+    x = x + attn_out
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu(h @ layer["mlp"]["w_gate"])
     up = h @ layer["mlp"]["w_up"]
-    x = x + (gate * up) @ layer["mlp"]["w_down"]
+    x = x + checkpoint_name((gate * up) @ layer["mlp"]["w_down"], "mlp_out")
     return x
 
 
 def _remat(body, cfg: LlamaConfig):
-    """Wrap a scan body in jax.checkpoint per cfg.remat_policy."""
+    """Wrap a scan body in jax.checkpoint per cfg.remat_policy.
+
+    "full": recompute everything (min HBM, ~4/3x matmul FLOPs).
+    "dots": save every matmul output — includes the d_ff-wide MLP
+        intermediates, ~0.5 GB/layer at B8/T2048/d2048 (OOMs one v5e at
+        1.5B params even with adafactor).
+    "outs": save only the residual-stream contributions (attn_out/mlp_out,
+        checkpoint_name'd above) — 1/8 the HBM of "dots"; the backward
+        re-runs QKV+attention+MLP but reuses the saved block outputs."""
     if cfg.remat_policy == "dots":
         return jax.checkpoint(
             body,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if cfg.remat_policy == "outs":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "mlp_out"))
     return jax.checkpoint(body)
 
 
@@ -262,9 +282,13 @@ def chunked_cross_entropy(lm_head, hidden, targets, chunk: int = 256):
     """
     b, t, d = hidden.shape
     chunk = min(chunk, t)
-    if t % chunk:
-        chunk = t  # fallback: uneven seq, single chunk
-    n = t // chunk
+    n = -(-t // chunk)  # pad the tail: next-token CE always sees t = T-1,
+    # which is never divisible by a power-of-two chunk — an exact-division
+    # fallback would silently collapse to one full-logits chunk
+    pad = n * chunk - t
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)), constant_values=-1)
     hid = hidden.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
     tgt = targets.reshape(b, n, chunk).transpose(1, 0, 2)
 
@@ -275,7 +299,9 @@ def chunked_cross_entropy(lm_head, hidden, targets, chunk: int = 256):
         h, y = xs
         logits = (h @ lm_head).astype(jnp.float32)       # [B, chunk, V]
         lse = jax.nn.logsumexp(logits, axis=-1)
-        ll = jnp.take_along_axis(logits, y[..., None], axis=-1)[..., 0] - lse
+        ll = jnp.take_along_axis(
+            logits, jnp.maximum(y, 0)[..., None], axis=-1)[..., 0] - lse
+        ll = jnp.where(y >= 0, ll, 0.0)  # padded positions contribute 0
         return acc + jnp.sum(ll), None
 
     total, _ = jax.lax.scan(body, jnp.float32(0.0), (hid, tgt))
@@ -287,7 +313,8 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
     tokens = batch["tokens"] if isinstance(batch, dict) else batch
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     hidden = hidden_states(params, inputs, cfg, mesh)
-    return chunked_cross_entropy(params["lm_head"], hidden, targets)
+    return chunked_cross_entropy(params["lm_head"], hidden, targets,
+                                 chunk=cfg.ce_chunk)
 
 
 # ---------------------------------------------------------------------------

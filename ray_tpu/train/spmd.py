@@ -53,11 +53,28 @@ def default_optimizer(learning_rate: float = 3e-4,
                       weight_decay: float = 0.1,
                       warmup_steps: int = 100,
                       decay_steps: int = 10_000,
-                      grad_clip: float = 1.0) -> optax.GradientTransformation:
-    """AdamW + cosine schedule + global-norm clip — the Llama-pretrain recipe
-    the BASELINE configs assume."""
+                      grad_clip: float = 1.0,
+                      name: str = "adamw") -> optax.GradientTransformation:
+    """Optimizer + cosine schedule + global-norm clip.
+
+    name="adamw" is the Llama-pretrain recipe the BASELINE configs assume;
+    name="adafactor" is the TPU-native memory saver (factored second moment
+    — T5/PaLM recipe): adam's fp32 m+v cost 8 bytes/param (12 GB for 1.5B,
+    most of a v5e chip's HBM), adafactor's factored state is ~0 — the
+    difference between OOM and headroom for remat policies / larger models
+    on one chip."""
     sched = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(decay_steps, warmup_steps + 1))
+    if name == "adafactor":
+        # NO weight decay here: optax.adafactor's weight_decay_rate is NOT
+        # learning-rate-scaled (0.1 would shrink params 10% per step) —
+        # the T5/PaLM adafactor recipe trains without decoupled decay
+        return optax.chain(
+            optax.clip_by_global_norm(grad_clip),
+            optax.adafactor(sched, min_dim_size_to_factor=128),
+        )
+    if name != "adamw":
+        raise ValueError(f"unknown optimizer {name!r} (adamw | adafactor)")
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
         optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),

@@ -210,3 +210,35 @@ def test_logical_rules():
     sh = logical_to_shardings(tree, mesh)
     assert sh["wq"].spec == jax.sharding.PartitionSpec("fsdp", "tensor")
     assert sh["bias"].spec == jax.sharding.PartitionSpec()
+
+
+def test_chunked_cross_entropy_matches_full():
+    """Every chunk size (including non-divisors of T-1 — the padded-tail
+    path) must reproduce the unchunked loss."""
+    import numpy as np
+
+    from ray_tpu.models import llama
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 64)), jnp.int32)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]  # T-1 = 63
+    hidden = llama.hidden_states(params, inputs, cfg)
+    logits = (hidden @ params["lm_head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    full = -jnp.mean(
+        jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0])
+    for chunk in (16, 63, 200):
+        c = llama.chunked_cross_entropy(
+            params["lm_head"], hidden, targets, chunk=chunk)
+        assert abs(float(c - full)) < 1e-4, chunk
+
+
+def test_default_optimizer_names():
+    from ray_tpu.train import spmd
+
+    spmd.default_optimizer(name="adamw")
+    spmd.default_optimizer(name="adafactor")
+    with pytest.raises(ValueError):
+        spmd.default_optimizer(name="lion")
