@@ -31,6 +31,11 @@ class LLMConfig:
     max_prompt_len: int = 512
     max_seq_len: int = 1024           # prompt + generation cap per request
     prefill_chunk: int = 512          # prefill compute chunk
+    # decode steps fused into one dispatched program when the batch is
+    # steady (multi-step decode): token cost ~ dispatch_RTT/decode_block,
+    # which matters enormously when the chip sits behind a network tunnel.
+    # Streaming granularity and stop-token lag grow with it.
+    decode_block: int = 8
 
     # sampling defaults (overridable per request)
     max_tokens: int = 128

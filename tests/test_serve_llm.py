@@ -175,3 +175,42 @@ def test_openai_http_streaming(llm_app):
     finishes = [c["choices"][0]["finish_reason"] for c in chunks]
     assert finishes[-1] == "stop"
     assert isinstance(text, str)
+
+
+def test_slot_reuse_no_kv_corruption():
+    """A freed slot's device page table must be invalidated: otherwise later
+    decode blocks keep scattering its junk KV into pages reallocated to a
+    NEW request, corrupting its completion. Greedy output of a request must
+    not depend on an earlier request having used (and freed) its pages."""
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+    from ray_tpu.serve.llm.engine import LLMEngine
+
+    def make_engine():
+        cfg = LLMConfig(
+            model_id="t", model_config=llama.llama_tiny(vocab_size=512),
+            max_batch_size=2, page_size=16, num_pages=24,
+            max_prompt_len=64, max_seq_len=128, max_tokens=24,
+            decode_block=4)
+        eng = LLMEngine(cfg, rng_seed=7)
+        eng.start()
+        return eng
+
+    probe = [5, 9, 2] * 8
+
+    eng = make_engine()
+    clean = eng.generate(probe, max_tokens=16, temperature=0.0)["tokens"]
+    eng.shutdown()
+
+    eng = make_engine()
+    # short request grabs slot 0 + pages, finishes fast, slot is freed
+    # mid-pipeline while the longer one still decodes
+    a = eng.submit([1] * 4, max_tokens=2, temperature=0.0)
+    b = eng.submit([2] * 30, max_tokens=20, temperature=0.0)
+    eng.result(a, timeout=60)
+    eng.result(b, timeout=60)
+    # new request reuses the freed slot/pages; its greedy output must match
+    # the clean-engine run exactly
+    out = eng.generate(probe, max_tokens=16, temperature=0.0)["tokens"]
+    eng.shutdown()
+    assert out == clean
