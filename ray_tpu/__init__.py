@@ -28,7 +28,7 @@ from ray_tpu.core.api import (
     wait,
 )
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.actor import ActorClass, ActorHandle, method
 from ray_tpu.core.placement_group import (
     PlacementGroup,
     PlacementGroupSchedulingStrategy,
@@ -47,7 +47,8 @@ from ray_tpu import exceptions
 __version__ = "0.1.0"
 
 __all__ = [
-    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "init", "shutdown", "is_initialized", "remote", "method",
+    "get", "put", "wait",
     "kill", "cancel", "get_actor", "exit_actor", "get_runtime_context",
     "cluster_resources", "available_resources", "nodes",
     "ObjectRef", "ActorClass", "ActorHandle",

@@ -14,13 +14,36 @@ from ray_tpu.core.ids import ActorID
 from ray_tpu.core.remote_function import _build_resources, _build_strategy
 
 
+def method(*args, **options):
+    """Method decorator (ref: ray.method — actor.py:792): annotate per-method
+    defaults. Supported: concurrency_group. For num_returns use
+    `.options(num_returns=N)` at the call site — handles here are plain data
+    (reconstructible from an actor id alone) and never see the class body,
+    so a method-level default could not be honored."""
+    def decorate(fn):
+        unknown = set(options) - {"concurrency_group"}
+        if unknown:
+            raise ValueError(
+                f"unsupported @method option(s) {sorted(unknown)}; use "
+                f".options(...) at the call site")
+        if "concurrency_group" in options:
+            fn._concurrency_group = options["concurrency_group"]
+        return fn
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return args[0]
+    return decorate
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1,
-                 max_task_retries: int | None = None):
+                 max_task_retries: int | None = None,
+                 concurrency_group: str = ""):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
         self._max_task_retries = max_task_retries
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs)
@@ -29,7 +52,9 @@ class ActorMethod:
         return ActorMethod(
             self._handle, self._method_name,
             num_returns=opts.get("num_returns", self._num_returns),
-            max_task_retries=opts.get("max_task_retries", self._max_task_retries))
+            max_task_retries=opts.get("max_task_retries", self._max_task_retries),
+            concurrency_group=opts.get("concurrency_group",
+                                       self._concurrency_group))
 
     def _remote(self, args, kwargs):
         from ray_tpu.core import api
@@ -41,7 +66,8 @@ class ActorMethod:
         refs = rt.submit_actor_task(
             h._actor_id, self._method_name, args, kwargs,
             num_returns=self._num_returns, max_task_retries=retries,
-            name=f"{h._class_name}.{self._method_name}")
+            name=f"{h._class_name}.{self._method_name}",
+            concurrency_group=self._concurrency_group)
         if self._num_returns == 1:
             return refs[0]
         return refs
@@ -113,7 +139,8 @@ class ActorClass:
             max_concurrency=int(options.get("max_concurrency", 1000 if is_async else 1)),
             is_async=is_async,
             strategy=_build_strategy(options),
-            runtime_env=options.get("runtime_env"))
+            runtime_env=options.get("runtime_env"),
+            concurrency_groups=options.get("concurrency_groups"))
         handle = ActorHandle(actor_id, self._cls.__name__,
                              max_task_retries=int(options.get("max_task_retries", 0)))
         return handle

@@ -100,6 +100,11 @@ class TaskSpec:
     max_concurrency: int = 1
     is_async_actor: bool = False
     allow_out_of_order: bool = False
+    # concurrency groups (ref: ConcurrencyGroupManager,
+    # task_execution/concurrency_group_manager.h): creation carries the
+    # group->max_concurrency table; actor tasks carry the target group
+    concurrency_groups: dict | None = None
+    concurrency_group: str = ""
     # runtime env / misc
     runtime_env: dict | None = None
     depth: int = 0

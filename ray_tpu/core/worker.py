@@ -159,6 +159,9 @@ class _ActorExecState:
     instance: Any = None
     actor_id: ActorID | None = None
     pool: ThreadPoolExecutor | None = None
+    group_pools: dict = field(default_factory=dict)  # name -> bounded pool
+    group_limits: dict = field(default_factory=dict)  # name -> max
+    group_sems: dict = field(default_factory=dict)   # name -> loop Semaphore
     loop = None  # asyncio loop for async actors
     lock: threading.Lock = field(default_factory=threading.Lock)
     expected_seq: dict[bytes, int] = field(default_factory=dict)
@@ -559,7 +562,8 @@ class WorkerRuntime:
                               max_task_retries: int = 0, max_concurrency: int = 1,
                               is_async: bool = False,
                               strategy: SchedulingStrategy | None = None,
-                              runtime_env: dict | None = None) -> None:
+                              runtime_env: dict | None = None,
+                              concurrency_groups: dict | None = None) -> None:
         if runtime_env:
             from ray_tpu.runtime_env import prepare_runtime_env
             runtime_env = prepare_runtime_env(self, runtime_env)
@@ -574,14 +578,15 @@ class WorkerRuntime:
             actor_id=actor_id, max_restarts=max_restarts,
             max_task_retries=max_task_retries, max_concurrency=max_concurrency,
             is_async_actor=is_async, caller_id=self.worker_id,
-            runtime_env=runtime_env)
+            runtime_env=runtime_env, concurrency_groups=concurrency_groups)
         self.cp_client.call_with_retry(
             "create_actor", {"spec": spec, "name": name, "detached": detached},
             timeout=60.0)
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args: tuple,
                           kwargs: dict, *, num_returns: int = 1,
-                          max_task_retries: int = 0, name: str = "") -> list[ObjectRef]:
+                          max_task_retries: int = 0, name: str = "",
+                          concurrency_group: str = "") -> list[ObjectRef]:
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(self.job_id, actor_id, self._bump_counter()),
             job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
@@ -590,7 +595,8 @@ class WorkerRuntime:
             num_returns=num_returns, resources={},
             max_retries=max_task_retries,
             owner_id=self.worker_id, owner_addr=self.addr,
-            actor_id=actor_id, caller_id=self.worker_id)
+            actor_id=actor_id, caller_id=self.worker_id,
+            concurrency_group=concurrency_group)
         refs = self._register_returns(spec)
         self.task_manager.add_pending(spec)
         self._record_task_event(spec, "SUBMITTED")
@@ -978,6 +984,14 @@ class WorkerRuntime:
             st.pool = ThreadPoolExecutor(
                 max_workers=max(1, spec.max_concurrency),
                 thread_name_prefix="actor-exec")
+            # named concurrency groups: independent bounded pools so e.g.
+            # "io" calls can't starve "compute" (ref:
+            # task_execution/concurrency_group_manager.h)
+            for gname, gmax in (spec.concurrency_groups or {}).items():
+                st.group_pools[gname] = ThreadPoolExecutor(
+                    max_workers=max(1, int(gmax)),
+                    thread_name_prefix=f"actor-{gname}")
+                st.group_limits[gname] = max(1, int(gmax))
             if spec.is_async_actor:
                 import asyncio
                 st.loop = asyncio.new_event_loop()
@@ -1024,8 +1038,35 @@ class WorkerRuntime:
                 st.pending.setdefault(caller, {})[spec.seq_no] = (spec, reply)
         return reply
 
+    def _actor_group_for(self, spec: TaskSpec) -> str:
+        st = self._actor_state
+        group = spec.concurrency_group
+        if not group:
+            method = getattr(st.instance, spec.method_name, None)
+            group = getattr(method, "_concurrency_group", "")
+        if group and group not in st.group_pools:
+            # a typo'd group silently landing in the default (often
+            # 1-wide) pool would reproduce the starvation groups prevent
+            raise ValueError(
+                f"unknown concurrency group {group!r}; declared: "
+                f"{sorted(st.group_pools) or 'none'}")
+        return group
+
+    def _actor_pool_for(self, group: str):
+        st = self._actor_state
+        if group:
+            return st.group_pools[group]
+        return st.pool
+
     def _dispatch_actor_task(self, spec: TaskSpec, reply: DeferredReply):
         st = self._actor_state
+        try:
+            group = self._actor_group_for(spec)
+        except ValueError as e:
+            reply.send(self._error_reply(spec, TaskError(
+                e, task_repr=spec.repr_name())))
+            return
+        pool = self._actor_pool_for(group)
         method = getattr(st.instance, spec.method_name, None)
         import inspect
         if (st.loop is not None and method is not None
@@ -1044,14 +1085,27 @@ class WorkerRuntime:
 
                 async def arun():
                     try:
-                        reply.send(await self._run_actor_task_async(
-                            spec, method, args, kwargs))
+                        sem = None
+                        if group:
+                            # the pool only bounds the scheduling thunk;
+                            # the GROUP bound for coroutines is a loop-side
+                            # semaphore (ref: fiber.h per-group fibers)
+                            sem = st.group_sems.get(group)
+                            if sem is None:
+                                sem = st.group_sems[group] =                                     asyncio.Semaphore(st.group_limits[group])
+                        if sem is not None:
+                            async with sem:
+                                reply.send(await self._run_actor_task_async(
+                                    spec, method, args, kwargs))
+                        else:
+                            reply.send(await self._run_actor_task_async(
+                                spec, method, args, kwargs))
                     except BaseException as e:  # noqa: BLE001
                         reply.fail(e)
 
                 asyncio.run_coroutine_threadsafe(arun(), st.loop)
 
-            st.pool.submit(schedule)
+            pool.submit(schedule)
             return
 
         def run():
@@ -1060,7 +1114,7 @@ class WorkerRuntime:
             except BaseException as e:  # noqa: BLE001
                 reply.fail(e)
 
-        st.pool.submit(run)
+        pool.submit(run)
 
     async def _run_actor_task_async(self, spec: TaskSpec, method,
                                     args, kwargs) -> dict:

@@ -18,6 +18,7 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.grpc_ingress import start_grpc_proxy
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import (
@@ -31,5 +32,5 @@ __all__ = [
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "batch", "delete", "deployment", "get_app_handle",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
-    "run", "shutdown", "start_http_proxy", "status",
+    "run", "shutdown", "start_grpc_proxy", "start_http_proxy", "status",
 ]

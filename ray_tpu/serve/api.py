@@ -207,6 +207,8 @@ def shutdown() -> None:
         ray_tpu.kill(controller)
     except Exception:  # noqa: BLE001 - not running
         pass
+    from ray_tpu.serve.grpc_ingress import _reset_grpc_proxy
+    _reset_grpc_proxy()
     _reset_routers()
 
 

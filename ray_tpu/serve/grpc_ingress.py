@@ -1,0 +1,152 @@
+"""gRPC ingress for serve.
+
+TPU-native analog of the reference's gRPCProxy
+(/root/reference/python/ray/serve/_private/proxy.py:530 gRPCProxy; wire
+protocol src/ray/protobuf/serve.proto:354): a generic-handler gRPC server —
+no compiled service stubs needed — that routes unary calls to deployment
+handles. The fully-qualified method name selects the handler method, and
+request metadata selects the application / deployment / multiplexed model,
+mirroring the reference's metadata keys.
+
+Payloads are opaque bytes end-to-end (the reference passes user-defined
+protobufs the same way): the deployment method receives the raw request
+bytes and returns bytes/str (str is utf-8 encoded; other values are
+pickled). `grpc.health.v1.Health/Check` answers SERVING for probes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+logger = logging.getLogger(__name__)
+
+_HEALTH = "/grpc.health.v1.Health/Check"
+# one-byte protobuf encoding of HealthCheckResponse{status: SERVING}
+_HEALTH_SERVING = b"\x08\x01"
+
+
+def _encode(out) -> bytes:
+    if isinstance(out, bytes):
+        return out
+    if isinstance(out, bytearray):
+        return bytes(out)
+    if isinstance(out, str):
+        return out.encode()
+    import pickle
+    return pickle.dumps(out)
+
+
+class GrpcProxy:
+    """(ref: gRPCProxy — one per node; here one server in this process)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 default_app: str = "default"):
+        import grpc
+
+        self._grpc = grpc
+        self._default_app = default_app
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=16,
+                               thread_name_prefix="grpc-ingress"))
+        self._server.add_generic_rpc_handlers([_GenericHandler(self)])
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._started = False
+        self._lock = threading.Lock()
+
+    def start(self) -> "GrpcProxy":
+        with self._lock:
+            if not self._started:
+                self._server.start()
+                self._started = True
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._started:
+                self._server.stop(grace=1.0)
+                self._started = False
+
+    # -- routing --------------------------------------------------------
+    def handle_unary(self, method: str, request: bytes, metadata: dict,
+                     timeout_s: float = 60.0):
+        """method: '/pkg.Service/Method' — Method maps to the deployment's
+        handler method; metadata keys follow the reference proxy:
+        application, deployment (optional: defaults to the app ingress),
+        multiplexed_model_id, method_name (overrides the path's Method)."""
+        from ray_tpu import serve
+
+        if method == _HEALTH:
+            return _HEALTH_SERVING
+        app = metadata.get("application", self._default_app)
+        call_method = metadata.get("method_name") \
+            or method.rsplit("/", 1)[-1]
+        deployment = metadata.get("deployment")
+        if deployment:
+            handle = serve.get_deployment_handle(deployment, app_name=app)
+        else:
+            handle = serve.get_app_handle(app)
+        handle = handle.options(method_name=call_method)
+        mux = metadata.get("multiplexed_model_id")
+        if mux:
+            handle = handle.options(multiplexed_model_id=mux)
+        out = handle.remote(request).result(timeout_s=timeout_s)
+        return _encode(out)
+
+
+class _GenericHandler:
+    """grpc.GenericRpcHandler accepting every unary method name."""
+
+    def __init__(self, proxy: GrpcProxy):
+        self._proxy = proxy
+
+    def service(self, handler_call_details):
+        import grpc
+
+        method = handler_call_details.method
+        metadata = {k: v for k, v in
+                    (handler_call_details.invocation_metadata or ())}
+
+        def unary_unary(request: bytes, context):
+            try:
+                # respect the client's deadline so hung deployments don't
+                # pin server threads past the point anyone is listening
+                # (and starve health checks); cap at 120s otherwise
+                remaining = context.time_remaining()
+                timeout_s = min(remaining, 120.0) if remaining is not None \
+                    else 60.0
+                return self._proxy.handle_unary(method, request, metadata,
+                                                timeout_s=timeout_s)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except Exception as e:  # noqa: BLE001 — surface to the client
+                logger.exception("grpc ingress failure for %s", method)
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary_unary,
+            request_deserializer=None,   # raw bytes through
+            response_serializer=None)
+
+
+_grpc_proxy: GrpcProxy | None = None
+_grpc_lock = threading.Lock()
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0,
+                     default_app: str = "default") -> GrpcProxy:
+    """Start (or return) the process's gRPC ingress."""
+    global _grpc_proxy
+    with _grpc_lock:
+        if _grpc_proxy is None:
+            _grpc_proxy = GrpcProxy(host, port, default_app).start()
+        return _grpc_proxy
+
+
+def _reset_grpc_proxy() -> None:
+    global _grpc_proxy
+    with _grpc_lock:
+        if _grpc_proxy is not None:
+            _grpc_proxy.stop()
+            _grpc_proxy = None

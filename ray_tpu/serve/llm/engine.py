@@ -178,12 +178,18 @@ class LLMEngine:
     def shutdown(self):
         self._stop.set()
         self._wake.set()
+        loop_alive = False
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=10.0)
+            loop_alive = self._loop_thread.is_alive()
             self._loop_thread = None
         # surface already-computed completions: the loop may exit with
         # dispatched blocks still unharvested, and their waiters would
-        # otherwise time out on results that exist
+        # otherwise time out on results that exist. Skip if the loop thread
+        # is wedged past the join timeout — draining concurrently with it
+        # would race on _pending.
+        if loop_alive:
+            return
         try:
             while self._pending:
                 self._harvest_one()

@@ -29,19 +29,22 @@ def test_spill_and_restore(ray_start_regular):
 
 
 def test_large_object_broadcast_multinode():
-    """A ~256MiB object produced on one node is pulled (chunked, admission-
-    controlled) by consumers on three other nodes (the scaled-down analog of
-    BASELINE's 1-GiB-broadcast-to-50-nodes row)."""
+    """A 1 GiB object produced on one node is pulled (chunked, admission-
+    controlled) by consumers on three other nodes (BASELINE's
+    1-GiB-broadcast row, at 4 nodes instead of 50)."""
     from ray_tpu.core.cluster import Cluster
 
     ray_tpu.shutdown()
     cluster = Cluster()
-    cluster.add_node(num_cpus=2, resources={"src": 1})
+    cap = 3 * (1 << 30) // 2  # 1.5 GiB per node: headroom over the payload
+    cluster.add_node(num_cpus=2, resources={"src": 1},
+                     object_store_memory=cap)
     for i in range(3):
-        cluster.add_node(num_cpus=2, resources={f"dst{i}": 1})
+        cluster.add_node(num_cpus=2, resources={f"dst{i}": 1},
+                         object_store_memory=cap)
     ray_tpu.init(address=cluster.address)
     try:
-        size = 1 << 28  # 256 MiB
+        size = 1 << 30  # 1 GiB
 
         @ray_tpu.remote(resources={"src": 1})
         def produce():

@@ -218,3 +218,35 @@ def test_multiplexed_models(ray_start_regular):
     out2 = h2.remote(0).result(timeout_s=60)
     assert out2["model"] == "model-m2"
     serve.shutdown()
+
+
+def test_grpc_ingress(serve_shutdown):
+    """gRPC ingress (reference gRPCProxy + serve.proto wire protocol):
+    generic unary calls route to deployments by method name + metadata."""
+    import grpc
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, data: bytes) -> bytes:
+            return b"echo:" + data
+
+        def shout(self, data: bytes) -> str:
+            return data.decode().upper()
+
+    serve.run(Echo.bind(), name="gapp")
+    proxy = serve.start_grpc_proxy(port=0, default_app="gapp")
+    try:
+        chan = grpc.insecure_channel(f"127.0.0.1:{proxy.port}")
+        call = chan.unary_unary("/ray_tpu.serve.UserDefined/__call__")
+        out = call(b"hi", timeout=60)
+        assert out == b"echo:hi"
+        shout = chan.unary_unary("/ray_tpu.serve.UserDefined/shout")
+        out = shout(b"quiet", timeout=60,
+                    metadata=(("application", "gapp"),))
+        assert out == b"QUIET"
+        health = chan.unary_unary("/grpc.health.v1.Health/Check")
+        assert health(b"", timeout=30) == b"\x08\x01"
+    finally:
+        serve.shutdown()
