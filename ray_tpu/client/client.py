@@ -195,6 +195,13 @@ class ClientRuntime:
     def in_actor(self) -> bool:
         return False
 
+    def current_task_id(self):
+        return self.job_id  # stable per-connection scope for collectives
+
+    def yield_exec_slot(self):
+        import contextlib
+        return contextlib.nullcontext()
+
     def shutdown(self):
         try:
             self._call("disconnect", {}, timeout=10.0)

@@ -224,8 +224,10 @@ def cancel(ref: ObjectRef, *, force: bool = False):
 def get_actor(name: str, timeout: float = 10.0) -> ActorHandle:
     """(ref: worker.py get_actor — named actors)"""
     rt = _get_runtime()
-    reply = rt.cp_client.call_with_retry(
-        "get_actor_by_name", {"name": name, "timeout": timeout}, timeout=timeout + 10)
+    with rt.yield_exec_slot():
+        reply = rt.cp_client.call_with_retry(
+            "get_actor_by_name", {"name": name, "timeout": timeout},
+            timeout=timeout + 10)
     if reply is None:
         raise ValueError(f"no actor named {name!r}")
     return ActorHandle(reply["actor_id"], reply["spec"].name,

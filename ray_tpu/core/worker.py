@@ -91,6 +91,9 @@ class _NormalTaskQueue:
                 run = self._queue.popleft()
             run()
 
+    def is_runner(self) -> bool:
+        return bool(getattr(self._tl, "runner", False))
+
     def on_blocked(self):
         """Current runner is about to block; let the next queued task run."""
         if not getattr(self._tl, "runner", False):
@@ -330,6 +333,28 @@ class WorkerRuntime:
             return self.memory_store.wait_for(oid, self._remaining(deadline))
         finally:
             self._normal_exec.on_unblocked()
+
+    def yield_exec_slot(self):
+        """Context manager for API-level blocking waits (named-actor
+        resolution, PG readiness): lets the next queued pipelined task run
+        on this worker while we block — the same slot-yield get()/wait()
+        do internally. Fully a no-op outside a normal-task runner thread
+        (actor executor threads must NOT release their lease's CPU here:
+        worker_blocked has no re-acquire path)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            if self._normal_exec.is_runner():
+                self._notify_blocked()
+                self._normal_exec.on_blocked()
+                try:
+                    yield
+                finally:
+                    self._normal_exec.on_unblocked()
+            else:
+                yield
+        return cm()
 
     def _notify_blocked(self):
         """Release our CPU while blocked so nested tasks can schedule
@@ -825,6 +850,9 @@ class WorkerRuntime:
             return {"ok": False, "reason": "actor not hosted here"}
         if target is not None and mine is None:
             return {"ok": False, "reason": "no actor in this worker"}
+        # reject pushes that race the exit window — a call arriving between
+        # kill and process exit must fail with actor-death, not execute
+        self._actor_state.exiting = True
         threading.Thread(target=self._exit_now, args=(1,),
                          daemon=True).start()
         return {"ok": True}
@@ -1013,6 +1041,13 @@ class WorkerRuntime:
         st = self._actor_state
         if st.instance is None:
             return {"results": [], "error": "actor not initialized"}
+        if st.exiting:
+            # killed (or exit_actor'd) but the process hasn't exited yet: a
+            # racing call must observe death, not execute
+            from ray_tpu.exceptions import ActorDiedError
+            return self._error_reply(spec, TaskError(
+                ActorDiedError("actor is exiting"),
+                task_repr=spec.repr_name()))
         caller = spec.caller_id.binary()
         reply = DeferredReply()
         with st.lock:

@@ -103,8 +103,28 @@ class _GroupState:
         return s
 
 
-_groups: dict[str, _GroupState] = {}
+_groups: dict[tuple, _GroupState] = {}
 _lock = threading.Lock()
+
+
+def _scope():
+    """Rank-state scope: the RANK, not the process, owns group state. Two
+    rank-tasks can share one worker process (the submitter pipelines onto
+    warm leases), so module-global state keyed by group name alone would
+    let the second rank's init clobber the first's (rank id + seq counter
+    corruption → permanent barrier hangs). Actors scope by actor id (init
+    and collectives happen in different method calls); tasks by task id."""
+    from ray_tpu.core import api
+    rt = api._try_get_runtime()
+    if rt is None:
+        return None
+    if rt.in_actor():
+        return rt._actor_state.actor_id
+    return rt.current_task_id()
+
+
+def _group_key(group_name: str) -> tuple:
+    return (_scope(), group_name)
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -122,16 +142,21 @@ def init_collective_group(world_size: int, rank: int,
     else:
         actor = ray_tpu.get_actor(name, timeout=60.0)
     with _lock:
-        _groups[group_name] = _GroupState(actor, world_size, rank)
+        _groups[_group_key(group_name)] = _GroupState(actor, world_size, rank)
+        # tasks that exit without destroy_collective_group would otherwise
+        # leak their scoped entries forever in a long-lived worker; keep a
+        # bounded window (dict preserves insertion order -> oldest first)
+        while len(_groups) > 512:
+            _groups.pop(next(iter(_groups)))
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
-    return group_name in _groups
+    return _group_key(group_name) in _groups
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
     with _lock:
-        st = _groups.pop(group_name, None)
+        st = _groups.pop(_group_key(group_name), None)
     if st is not None and st.rank == 0:
         try:
             ray_tpu.kill(st.actor)
@@ -140,15 +165,15 @@ def destroy_collective_group(group_name: str = "default") -> None:
 
 
 def get_rank(group_name: str = "default") -> int:
-    return _groups[group_name].rank
+    return _groups[_group_key(group_name)].rank
 
 
 def get_collective_group_size(group_name: str = "default") -> int:
-    return _groups[group_name].world_size
+    return _groups[_group_key(group_name)].world_size
 
 
 def _state(group_name: str) -> _GroupState:
-    st = _groups.get(group_name)
+    st = _groups.get(_group_key(group_name))
     if st is None:
         raise RuntimeError(
             f"collective group {group_name!r} not initialized; call "

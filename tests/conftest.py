@@ -23,7 +23,36 @@ import jax  # noqa: E402
 # run on the 8-device virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the jit-heavy suites (parallel, train,
+# serve_llm, rllib) spend most of their wall time compiling the same tiny
+# programs every run; cache them across files, runs AND worker subprocesses
+# (env form inherits; jax.config wouldn't reach spawned workers). The
+# reference keeps suite time down with long-lived shared clusters
+# (conftest.py:590) — this is the JAX-native equivalent lever.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/ray_tpu_test_jit_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start_module():
+    """Module-scoped cluster (reference conftest.py:590 fixture reuse):
+    tests that exercise the public API without killing cluster components
+    share one runtime per file. Generous LOGICAL cpus — actors from
+    earlier tests in the module stay alive and each reserves one."""
+    import ray_tpu
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=64, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+    })
+    yield ctx
+    ray_tpu.shutdown()
 
 
 @pytest.fixture

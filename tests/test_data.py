@@ -11,9 +11,9 @@ import ray_tpu
 from ray_tpu import data as rtd
 
 
-@pytest.fixture
-def rt(ray_start_regular):
-    yield ray_start_regular
+@pytest.fixture(scope="module")
+def rt(ray_start_module):
+    yield ray_start_module
 
 
 def test_range_count_schema(rt):
@@ -119,7 +119,7 @@ def test_global_aggregates(rt):
     assert ds.mean("id") == pytest.approx(4.5)
 
 
-def test_join_inner_and_left(ray_start_regular):
+def test_join_inner_and_left(rt):
     import ray_tpu.data as rdata
 
     left = rdata.from_items(
@@ -139,7 +139,7 @@ def test_join_inner_and_left(ray_start_regular):
     assert louter[0]["y"] is None and louter[7]["y"] == 700
 
 
-def test_join_left_outer_empty_right(ray_start_regular):
+def test_join_left_outer_empty_right(rt):
     """One side filtered to nothing: outer joins still emit its columns as
     nulls (schema carried via bundle metadata)."""
     import ray_tpu.data as rdata
@@ -154,7 +154,7 @@ def test_join_left_outer_empty_right(ray_start_regular):
     assert all(r["y"] is None for r in rows)
 
 
-def test_join_string_keys_cross_process(ray_start_regular):
+def test_join_string_keys_cross_process(rt):
     """String keys must route to the same partition on both sides even
     though the two sides' partition tasks run in different worker processes
     (builtin hash() is per-process randomized)."""
@@ -170,7 +170,7 @@ def test_join_string_keys_cross_process(ray_start_regular):
     assert all(r["y"] == r["x"] * 2 for r in rows)
 
 
-def test_join_different_key_names(ray_start_regular):
+def test_join_different_key_names(rt):
     import ray_tpu.data as rdata
 
     left = rdata.from_items([{"k": i} for i in range(5)], parallelism=2)
@@ -182,7 +182,7 @@ def test_join_different_key_names(ray_start_regular):
     assert [r["v"] for r in rows] == [-3, -4]
 
 
-def test_stats_after_execution(ray_start_regular):
+def test_stats_after_execution(rt):
     import ray_tpu.data as rdata
 
     ds = rdata.range(100, parallelism=4).map_batches(

@@ -7,12 +7,9 @@ import pytest
 import ray_tpu
 
 
-@pytest.fixture
-def rt():
-    ray_tpu.shutdown()
-    ray_tpu.init(num_cpus=4)
-    yield
-    ray_tpu.shutdown()
+@pytest.fixture(scope="module")
+def rt(ray_start_module):
+    yield ray_start_module
 
 
 def test_cartpole_env_dynamics():

@@ -10,6 +10,12 @@ import ray_tpu
 from ray_tpu import serve
 
 
+@pytest.fixture(scope="module")
+def ray_start_regular(ray_start_module):
+    yield ray_start_module
+
+
+
 @pytest.fixture
 def serve_shutdown(ray_start_regular):
     yield

@@ -12,6 +12,12 @@ import pytest
 import ray_tpu
 
 
+@pytest.fixture(scope="module")
+def ray_start_regular(ray_start_module):
+    yield ray_start_module
+
+
+
 def _tiny_cfg(**kw):
     from ray_tpu.models import llama
     from ray_tpu.serve.llm import LLMConfig

@@ -11,6 +11,12 @@ from ray_tpu import tune
 from ray_tpu.train import RunConfig
 
 
+@pytest.fixture(scope="module")
+def ray_start_regular(ray_start_module):
+    yield ray_start_module
+
+
+
 def _run_cfg(tmp_path):
     return RunConfig(storage_path=str(tmp_path))
 

@@ -11,6 +11,12 @@ from ray_tpu.util import collective as col
 from ray_tpu.util import metrics, state
 
 
+@pytest.fixture(scope="module")
+def ray_start_regular(ray_start_module):
+    yield ray_start_module
+
+
+
 @ray_tpu.remote
 class Doubler:
     def double(self, v):
