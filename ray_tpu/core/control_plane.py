@@ -77,6 +77,7 @@ class _Node:
     view: NodeView
     missed_health_checks: int = 0
     metrics: dict | None = None  # last heartbeat's system gauges
+    res_version: int = 0  # last applied resource-view version (RaySyncer)
 
 
 class ControlPlane:
@@ -179,12 +180,23 @@ class ControlPlane:
         return {"ok": True}
 
     def _h_report_resources(self, body):
-        """Versioned resource-view sync (ref: ray_syncer.h:87)."""
+        """Versioned resource-view sync (ref: ray_syncer.h:87): stale or
+        reordered snapshots (version <= last applied) are discarded."""
         with self._lock:
             node = self._nodes.get(body["node_id"])
-            if node is not None:
+            if node is not None and self._fresher(node, body):
                 node.view.available = dict(body["available"])
         self._wake_scheduler()
+
+    @staticmethod
+    def _fresher(node, body) -> bool:
+        v = body.get("version")
+        if v is None:
+            return True  # unversioned caller (tests/legacy): accept
+        if v <= node.res_version and node.res_version - v < 1 << 30:
+            return False
+        node.res_version = v
+        return True
 
     def _h_heartbeat(self, body):
         """Agent heartbeat. Returns known=False after a CP restart so the
@@ -194,7 +206,8 @@ class ControlPlane:
             node = self._nodes.get(body["node_id"])
             if node is None or not node.view.alive:
                 return {"known": False}
-            node.view.available = dict(body["available"])
+            if self._fresher(node, body):
+                node.view.available = dict(body["available"])
             node.missed_health_checks = 0
             if body.get("metrics"):
                 node.metrics = body["metrics"]
@@ -658,8 +671,10 @@ class ControlPlane:
             if reply.get("available") is not None:
                 # agent's authoritative post-grant snapshot; subtracting here
                 # instead would double-count when the agent's async resource
-                # report raced ahead of this reply
-                cp_node.view.available = dict(reply["available"])
+                # report raced ahead of this reply. Version-gated: a report
+                # newer than this grant must not be regressed.
+                if self._fresher(cp_node, reply):
+                    cp_node.view.available = dict(reply["available"])
             else:
                 subtract(cp_node.view.available, resources)
             info.node_id = node.node_id

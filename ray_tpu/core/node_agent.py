@@ -94,6 +94,7 @@ class NodeAgent:
         self._pull_inflight_bytes = 0
         self._pulls_in_progress: dict = {}  # ObjectID -> Event (single-flight)
         self._stopped = threading.Event()
+        self._res_version = 0  # versioned resource-view sync (RaySyncer)
         self._server = RpcServer(
             self._handle, host=host, port=port, name="nodeagent",
             blocking_methods={"lease_worker", "pull_object", "wait_object_local"},
@@ -136,12 +137,21 @@ class NodeAgent:
             timeout=get_config().rpc_connect_timeout_s)
 
     def _report_resources(self):
+        """Versioned resource report (ref: RaySyncer versioned views,
+        ray_syncer.h:87): every snapshot carries a monotonically increasing
+        version so the CP can discard stale/reordered updates — notify-based
+        reports race heartbeats, and an out-of-order apply would regress the
+        CP's availability view."""
+        with self._lock:
+            self._res_version += 1
+            body = {"node_id": self.node_id,
+                    "available": dict(self.available),
+                    "version": self._res_version}
         try:
-            self._pool.get(self.cp_addr).notify(
-                "report_resources",
-                {"node_id": self.node_id, "available": dict(self.available)})
+            self._pool.get(self.cp_addr).notify("report_resources", body)
         except Exception:
             pass
+        return body["version"]
 
     # ------------------------------------------------------------------
     def _handle(self, method: str, body, peer):
@@ -357,7 +367,7 @@ class NodeAgent:
                                            lessee=body.get("lessee"))
                             self._leases[lease.lease_id] = lease
                             reserved = False  # consumed by the lease
-                            self._report_resources()
+                            grant_version = self._report_resources()
                             # snapshot rides the reply so the caller can SET
                             # its view instead of subtracting (a subtract
                             # after our async report double-counts the lease
@@ -365,7 +375,8 @@ class NodeAgent:
                             return {"granted": True, "lease_id": lease.lease_id,
                                     "worker_id": worker.worker_id,
                                     "worker_addr": worker.addr,
-                                    "available": dict(self.available)}
+                                    "available": dict(self.available),
+                                    "version": grant_version}
                         if not spawned and self._can_spawn(for_tpu):
                             spawned = need_spawn = True
                         elif not spawned:
@@ -703,11 +714,14 @@ class NodeAgent:
             if now - last_report >= 1.0:
                 last_report = now
                 try:
+                    with self._lock:
+                        self._res_version += 1
+                        hb = {"node_id": self.node_id,
+                              "available": dict(self.available),
+                              "version": self._res_version}
+                    hb["metrics"] = self._system_metrics()
                     r = self._pool.get(self.cp_addr).call(
-                        "heartbeat",
-                        {"node_id": self.node_id,
-                         "available": dict(self.available),
-                         "metrics": self._system_metrics()}, timeout=5.0)
+                        "heartbeat", hb, timeout=5.0)
                     if r is not None and not r.get("known", True):
                         logger.info("control plane lost this node "
                                     "(restart?); re-registering")

@@ -12,11 +12,13 @@ from ray_tpu.rllib.buffer import ReplayBuffer
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, Env, RandomWalk, make_env, register_env
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.models import RLModule
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "ReplayBuffer", "DQN", "DQNConfig",
     "CartPole", "Env", "RandomWalk", "make_env", "register_env",
-    "EnvRunner", "EnvRunnerGroup", "RLModule", "PPO", "PPOConfig",
+    "EnvRunner", "EnvRunnerGroup", "IMPALA", "IMPALAConfig", "RLModule",
+    "PPO", "PPOConfig",
 ]

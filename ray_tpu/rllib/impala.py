@@ -1,0 +1,102 @@
+"""IMPALA: off-policy actor-critic with V-trace corrections
+(ref: rllib/algorithms/impala/impala.py; Espeholt et al. 2018).
+
+Shape for this runtime: EnvRunner actors sample with the policy they were
+LAST sent (one weight broadcast per iteration), so by the time the learner
+updates, the behavior policy lags the target policy — exactly the staleness
+V-trace corrects with clipped importance ratios. The whole update (V-trace
+reverse scan + policy/value/entropy losses) is one jitted program; the scan
+runs over TIME, so trajectories are consumed in order, not shuffled.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+def _vtrace(behavior_logp, target_logp, rewards, dones, values,
+            bootstrap, gamma, rho_clip=1.0, c_clip=1.0):
+    """V-trace targets vs_t and policy-gradient advantages (fp32 [T])."""
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_clip)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_clip)
+    next_values = jnp.concatenate([values[1:], bootstrap[None]])
+    discount = gamma * (1.0 - dones)
+    deltas = rho * (rewards + discount * next_values - values)
+
+    def step(acc, xs):
+        delta, disc, c_t = xs
+        acc = delta + disc * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step, 0.0, (deltas, discount, c), reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], bootstrap[None]])
+    pg_adv = rho * (rewards + discount * next_vs - values)
+    return vs, pg_adv
+
+
+class IMPALA(Algorithm):
+    def setup(self) -> None:
+        kw = self.config.train_kwargs
+        self._vf_coeff = kw.get("vf_loss_coeff", 0.5)
+        self._ent_coeff = kw.get("entropy_coeff", 0.01)
+        self._rho_clip = kw.get("rho_clip", 1.0)
+        self._opt = optax.adam(self.config.lr)
+        self._opt_state = self._opt.init(self.params)
+
+        module, gamma = self.module, self.config.gamma
+        vf_c, ent_c, rho_clip = self._vf_coeff, self._ent_coeff, self._rho_clip
+
+        def loss_fn(params, batch):
+            logits, values = module.forward_train(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            _, last_v = module.forward_train(params, batch["last_obs"][None])
+            vs, pg_adv = _vtrace(
+                batch["logp"], jax.lax.stop_gradient(logp),
+                batch["rewards"], batch["dones"], values,
+                last_v[0], gamma, rho_clip)
+            pg_loss = -(logp * jax.lax.stop_gradient(pg_adv)).mean()
+            vf_loss = ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg_loss + vf_c * vf_loss - ent_c * entropy
+            return total, (pg_loss, vf_loss, entropy)
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, aux
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        samples = self.runners.sample(self.params, cfg.rollout_steps)
+        self._timesteps += cfg.rollout_steps * cfg.num_env_runners
+        last_loss, last_aux = 0.0, (0.0, 0.0, 0.0)
+        # one V-trace pass per runner trajectory, in time order (no shuffle)
+        for s in samples:
+            self.params, self._opt_state, last_loss, last_aux = \
+                self._update(self.params, self._opt_state, s)
+        pg_l, vf_l, ent = last_aux
+        return {"loss": float(last_loss), "policy_loss": float(pg_l),
+                "vf_loss": float(vf_l), "entropy": float(ent)}
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_cls=cls)
+        cfg.lr = 1e-3
+        return cfg
+
+
+def IMPALAConfig() -> AlgorithmConfig:
+    return IMPALA.get_default_config()

@@ -1,0 +1,142 @@
+"""Chaos testing harness: kill cluster components under load.
+
+TPU-native analog of the reference's chaos tooling (SURVEY.md §5.2:
+rpc_chaos.cc deterministic RPC faults — mirrored in ray_tpu.core.rpc — plus
+the release-test node killers, `ray._private.test_utils` get_and_run_
+resource_killer). RPC-level faults live in `core/rpc.py` (config
+`testing_rpc_failure`); this module adds the PROCESS level: a killer thread
+that terminates random worker processes (or whole node agents) while a
+workload runs, so retry/restart/reconstruction paths are exercised
+systematically instead of by hand-written one-off tests.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class WorkerKiller:
+    """Kills random task-executing worker PROCESSES of a cluster at an
+    interval. Drive it around a workload whose tasks have retries:
+
+        killer = WorkerKiller(cluster_or_none, interval_s=0.5)
+        killer.start()
+        try:    ... run workload with max_retries > 0 ...
+        finally: report = killer.stop()
+    """
+
+    def __init__(self, cluster=None, *, interval_s: float = 0.5,
+                 kill_probability: float = 1.0, seed: int = 0,
+                 spare_actors: bool = True):
+        self._cluster = cluster
+        self._interval = interval_s
+        self._prob = kill_probability
+        self._rng = random.Random(seed)
+        self._spare_actors = spare_actors
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.kills = 0
+
+    def _agents(self):
+        if self._cluster is not None:
+            return list(self._cluster.nodes)
+        from ray_tpu.core import api
+        head = api._head
+        return [head[1]] if head is not None else []
+
+    def _victims(self):
+        out = []
+        for agent in self._agents():
+            with agent._lock:
+                for info in agent._workers.values():
+                    if info.proc is None or info.proc.poll() is not None:
+                        continue
+                    if self._spare_actors and info.actor_id is not None:
+                        continue
+                    out.append(info.proc)
+        return out
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            if self._rng.random() > self._prob:
+                continue
+            victims = self._victims()
+            if not victims:
+                continue
+            victim = self._rng.choice(victims)
+            try:
+                victim.kill()
+                self.kills += 1
+            except Exception:  # noqa: BLE001 - already gone
+                pass
+
+    def start(self) -> "WorkerKiller":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="chaos-worker-killer", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        return {"kills": self.kills}
+
+
+class NodeKiller:
+    """Kills (stops) random NON-HEAD node agents of an in-process Cluster —
+    the coarse-grained chaos the reference's release tests run against
+    autoscaled clusters."""
+
+    def __init__(self, cluster, *, interval_s: float = 2.0, seed: int = 0,
+                 max_kills: int = 1):
+        self._cluster = cluster
+        self._interval = interval_s
+        self._rng = random.Random(seed)
+        self._max = max_kills
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.killed: list = []
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            if len(self.killed) >= self._max:
+                return
+            candidates = [a for a in self._cluster.nodes[1:]
+                          if a not in self.killed]
+            if not candidates:
+                continue
+            agent = self._rng.choice(candidates)
+            try:
+                agent.stop()
+                self.killed.append(agent)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def start(self) -> "NodeKiller":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="chaos-node-killer", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        return {"nodes_killed": len(self.killed)}
+
+
+def run_with_chaos(workload, *, killer) -> tuple:
+    """Run `workload()` with `killer` active; returns (result, report)."""
+    killer.start()
+    try:
+        result = workload()
+    finally:
+        report = killer.stop()
+    return result, report

@@ -145,9 +145,13 @@ def init_collective_group(world_size: int, rank: int,
         _groups[_group_key(group_name)] = _GroupState(actor, world_size, rank)
         # tasks that exit without destroy_collective_group would otherwise
         # leak their scoped entries forever in a long-lived worker; keep a
-        # bounded window (dict preserves insertion order -> oldest first)
-        while len(_groups) > 512:
-            _groups.pop(next(iter(_groups)))
+        # bounded window over TASK-scoped entries only (oldest first).
+        # Actor-scoped entries are intentionally long-lived across method
+        # calls and must never be evicted from under a live actor.
+        from ray_tpu.core.ids import TaskID
+        task_keys = [k for k in _groups if isinstance(k[0], TaskID)]
+        for k in task_keys[:max(0, len(task_keys) - 512)]:
+            _groups.pop(k, None)
 
 
 def is_group_initialized(group_name: str = "default") -> bool:

@@ -120,3 +120,21 @@ def test_register_env_and_custom(rt):
         assert r["training_iteration"] == 1
     finally:
         algo.stop()
+
+
+def test_impala_learns_randomwalk(rt):
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (IMPALAConfig()
+            .environment("RandomWalk")
+            .env_runners(num_env_runners=2, rollout_steps=256)
+            .training(lr=2e-3, gamma=0.95, entropy_coeff=0.003)
+            .build())
+    try:
+        for _ in range(12):
+            r = algo.train()
+        assert r["training_iteration"] == 12
+        ev = algo.evaluate(num_episodes=10, max_steps=50)
+        assert ev["episode_return_mean"] >= 0.9
+    finally:
+        algo.stop()
