@@ -94,6 +94,13 @@ class ControlPlane:
         self._jobs: dict[JobID, dict] = {}
         self._subs: dict[str, set[tuple[str, int]]] = {}
         self._sub_strikes: dict[tuple, int] = {}  # (channel, addr) -> fails
+        self._chan_seq: dict[str, int] = {}       # pubsub sequence numbers
+        self._chan_log: dict[str, list] = {}      # bounded history for poll
+        # shares self._lock: subscribe registration, target snapshot and
+        # seq assignment must be atomic w.r.t. each other, or a message
+        # lands in the subscribe/publish window where it is neither pushed
+        # (subscriber not yet in targets) nor polled (seeded seq past it)
+        self._pub_cv = threading.Condition(self._lock)
         self._pool = ClientPool("cp")
         self._pending_actors: list[ActorID] = []
         self._pending_pgs: list[PlacementGroupID] = []
@@ -112,7 +119,7 @@ class ControlPlane:
         self._restore()
         self._server = RpcServer(
             self._handle, host=host, port=port, name="controlplane",
-            blocking_methods={"resolve_actor", "pg_ready", "get_actor_by_name"},
+            blocking_methods={"resolve_actor", "pg_ready", "get_actor_by_name", "pubsub_poll"},
             pool_size=16)
         self.addr = self._server.addr
         self._sched_thread = threading.Thread(
@@ -347,7 +354,48 @@ class ControlPlane:
     def _h_subscribe(self, body):
         with self._lock:
             self._subs.setdefault(body["channel"], set()).add(tuple(body["addr"]))
-        return {"ok": True}
+            seq = self._chan_seq.get(body["channel"], 0)
+        return {"ok": True, "seq": seq}
+
+    def _gc_channels_locked(self):
+        """Bound channel bookkeeping: per-actor channels would otherwise
+        accumulate for the cluster's lifetime. Oldest subscriber-less
+        channels go first (lock held)."""
+        if len(self._chan_log) <= 1024:
+            return
+        for ch in list(self._chan_log):
+            if len(self._chan_log) <= 1024:
+                break
+            if not self._subs.get(ch):
+                self._chan_log.pop(ch, None)
+                self._chan_seq.pop(ch, None)
+
+    def _h_pubsub_poll(self, body):
+        """Long-poll recovery (ref: pubsub.proto:224 SubscriberService /
+        long_poll semantics): the caller sends {channel: last_seen_seq} and
+        blocks until any channel has newer messages (or timeout). Push
+        delivery stays the fast path; this loop guarantees at-least-once —
+        a dropped push is recovered on the next poll with seq-based dedup
+        at the subscriber."""
+        channels: dict = body.get("channels", {})
+        deadline = time.monotonic() + min(float(body.get("timeout", 30.0)), 60.0)
+        while not self._stopped.is_set():
+            out = {}
+            with self._pub_cv:
+                for ch, last in channels.items():
+                    log = self._chan_log.get(ch)
+                    if not log:
+                        continue
+                    fresh = [(seq, msg) for seq, msg in log if seq > last]
+                    if fresh:
+                        out[ch] = fresh
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                self._pub_cv.wait(min(remaining, 1.0))
+        return {}
 
     def _h_unsubscribe(self, body):
         with self._lock:
@@ -359,8 +407,16 @@ class ControlPlane:
         return {"ok": True}
 
     def _publish(self, channel: str, msg):
-        with self._lock:
+        with self._pub_cv:
             targets = list(self._subs.get(channel, ()))
+            seq = self._chan_seq.get(channel, 0) + 1
+            self._chan_seq[channel] = seq
+            log = self._chan_log.setdefault(channel, [])
+            log.append((seq, msg))
+            del log[:-200]  # bounded per-channel history for poll recovery
+            self._gc_channels_locked()
+            self._pub_cv.notify_all()
+        msg = {"__seq": seq, "payload": msg}
         for addr in targets:
             try:
                 self._pool.get(addr).notify("pubsub", {"channel": channel, "msg": msg})

@@ -199,6 +199,9 @@ class WorkerRuntime:
         self._node_addr_cache: dict[NodeID, tuple] = {}
         self._actor_state = _ActorExecState()
         self._subscribed_actors: set[ActorID] = set()
+        self._pubsub_seen: dict[str, int] = {}  # channel -> last seq
+        self._pubsub_lock = threading.Lock()
+        self._pubsub_poll_started = False
         self._cancelled_tasks: set[TaskID] = set()
         self._device_objects: dict[ObjectID, Any] = {}  # HBM-resident values
         self._normal_exec = _NormalTaskQueue()
@@ -213,13 +216,7 @@ class WorkerRuntime:
             pool_size=8)
         self.addr = self._server.addr
         if mode == "driver" and get_config().log_to_driver:
-            try:
-                self.cp_client.notify(
-                    "subscribe",
-                    {"channel": f"worker_logs:{job_id.hex()}",
-                     "addr": self.addr})
-            except Exception:
-                pass
+            self._subscribe_channel(f"worker_logs:{job_id.hex()}")
 
     # ------------------------------------------------------------------
     # identity & context
@@ -804,6 +801,21 @@ class WorkerRuntime:
 
     def _h_pubsub(self, body):
         channel, msg = body["channel"], body["msg"]
+        if isinstance(msg, dict) and "__seq" in msg:
+            # seq-enveloped push (CP also logs it for long-poll recovery).
+            # The watermark only advances CONTIGUOUSLY: if push N was lost
+            # and N+1 arrives, dispatching N+1 and advancing would make the
+            # poll skip N forever — instead the gapped push is dropped and
+            # the recovery poll replays N, N+1 in order.
+            seq, msg = msg["__seq"], msg["payload"]
+            with self._pubsub_lock:
+                seen = self._pubsub_seen.get(channel, 0)
+                if seq != seen + 1:
+                    return {"ok": True}  # stale, or gapped (poll recovers)
+                self._pubsub_seen[channel] = seq
+        return self._dispatch_pubsub(channel, msg)
+
+    def _dispatch_pubsub(self, channel: str, msg):
         if channel.startswith("worker_logs:"):
             # log monitor fan-in: print worker output at the driver with a
             # provenance prefix (ref: _private/log_monitor.py + worker.py
@@ -819,6 +831,16 @@ class WorkerRuntime:
             actor_id = ActorID(bytes.fromhex(channel.split(":", 1)[1]))
             if msg.get("state") == "DEAD":
                 self.actor_submitter.on_actor_death(actor_id, msg.get("reason", ""))
+                # stop polling a channel that will never speak again
+                with self._pubsub_lock:
+                    self._pubsub_seen.pop(channel, None)
+                self._subscribed_actors.discard(actor_id)
+                try:
+                    self.cp_client.notify("unsubscribe",
+                                          {"channel": channel,
+                                           "addr": self.addr})
+                except Exception:
+                    pass
             elif msg.get("state") in ("RESTARTING", "ALIVE"):
                 self.actor_submitter.on_actor_restart(actor_id)
         return {"ok": True}
@@ -827,11 +849,56 @@ class WorkerRuntime:
         if actor_id in self._subscribed_actors:
             return
         self._subscribed_actors.add(actor_id)
+        self._subscribe_channel(f"actor:{actor_id.hex()}")
+
+    def _subscribe_channel(self, channel: str) -> None:
+        """Register for push delivery AND seed the long-poll recovery loop
+        (at-least-once: pushes are best-effort; the poll replays anything
+        missed, dedup'd by sequence number — ref: pubsub long-poll,
+        pubsub.proto:224)."""
         try:
-            self.cp_client.notify(
-                "subscribe", {"channel": f"actor:{actor_id.hex()}", "addr": self.addr})
+            # short + no retries: runtime construction must not stall on a
+            # slow CP; a failed registration still seeds the recovery loop
+            # (seeded at 0 -> the poll replays the channel's recent history)
+            reply = self.cp_client.call(
+                "subscribe", {"channel": channel, "addr": self.addr},
+                timeout=2.0)
         except Exception:
-            pass
+            reply = None
+        with self._pubsub_lock:
+            self._pubsub_seen.setdefault(
+                channel, (reply or {}).get("seq", 0))
+            start = not self._pubsub_poll_started
+            self._pubsub_poll_started = True
+        if start:
+            threading.Thread(target=self._pubsub_recovery_loop,
+                             name=f"{self.mode}-pubsub-poll",
+                             daemon=True).start()
+
+    def _pubsub_recovery_loop(self):
+        while not self._shutdown.is_set():
+            with self._pubsub_lock:
+                channels = dict(self._pubsub_seen)
+            if not channels:
+                time.sleep(1.0)
+                continue
+            try:
+                out = self.cp_client.call(
+                    "pubsub_poll", {"channels": channels, "timeout": 30.0},
+                    timeout=45.0)
+            except Exception:
+                time.sleep(1.0)
+                continue
+            for channel, entries in (out or {}).items():
+                for seq, msg in sorted(entries):
+                    with self._pubsub_lock:
+                        if seq <= self._pubsub_seen.get(channel, 0):
+                            continue
+                        self._pubsub_seen[channel] = seq
+                    try:
+                        self._dispatch_pubsub(channel, msg)
+                    except Exception:  # noqa: BLE001 - keep the loop alive
+                        logger.exception("pubsub recovery dispatch failed")
 
     def _h_cancel_task(self, body):
         """(ref: core_worker.proto:540 CancelTask)"""

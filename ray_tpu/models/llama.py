@@ -417,3 +417,75 @@ def prefill(params, cache, tokens, cfg: LlamaConfig, lengths=None):
     last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)[:, 0]
     logits = (last @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v, "length": lengths}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint io (flat-npz format; the serving engine's checkpoint_path and
+# offline eval both read it — reference models load torch/safetensors via
+# vLLM; here the canonical on-disk form is a flattened jax pytree)
+# ---------------------------------------------------------------------------
+
+def _flatten_params(params, prefix=""):
+    out = {}
+    if isinstance(params, dict):
+        for k, v in params.items():
+            out.update(_flatten_params(v, f"{prefix}{k}/"))
+        return out
+    out[prefix.rstrip("/")] = np.asarray(params)
+    return out
+
+
+def save_params(params, path: str) -> str:
+    """Write params as ONE .npz of flattened pytree paths (atomic rename).
+    `path` may be a file ('x.npz') or a directory (-> dir/params.npz)."""
+    import os
+    if not path.endswith(".npz"):
+        os.makedirs(path, exist_ok=True)
+        path = os.path.join(path, "params.npz")
+    tmp = path + ".tmp.npz"  # keep the suffix: np.savez appends it otherwise
+    try:
+        np.savez(tmp, **_flatten_params(params))
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_params(path: str, cfg: LlamaConfig | None = None):
+    """Load a save_params checkpoint back into the nested pytree. With a
+    cfg, shapes are validated against a fresh init's structure."""
+    import os
+    if os.path.isdir(path):
+        path = os.path.join(path, "params.npz")
+    flat = np.load(path)
+    params: dict = {}
+    for key in flat.files:
+        node = params
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(flat[key])
+    if cfg is not None:
+        expect = jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+        exp_flat = _flatten_params_shapes(expect)
+        got_flat = {k: tuple(np.asarray(flat[k]).shape) for k in flat.files}
+        if exp_flat != got_flat:
+            missing = set(exp_flat) - set(got_flat)
+            extra = set(got_flat) - set(exp_flat)
+            mismatched = {k for k in set(exp_flat) & set(got_flat)
+                          if exp_flat[k] != got_flat[k]}
+            raise ValueError(
+                f"checkpoint does not match config: missing={sorted(missing)[:5]} "
+                f"extra={sorted(extra)[:5]} shape-mismatch={sorted(mismatched)[:5]}")
+    return params
+
+
+def _flatten_params_shapes(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_params_shapes(v, f"{prefix}{k}/"))
+        return out
+    out[prefix.rstrip("/")] = tuple(tree.shape)
+    return out

@@ -21,7 +21,7 @@ class LLMConfig:
     # model
     model_id: str = "llama-tiny"
     model_config: Any = None          # ray_tpu.models.llama.LlamaConfig
-    checkpoint_path: Optional[str] = None  # orbax/npz dir; None = random init
+    checkpoint_path: Optional[str] = None  # llama.save_params npz; None = random init
     tokenizer: str = "byte"           # "byte" | HF tokenizer local path
 
     # engine sizing

@@ -77,8 +77,12 @@ class LLMEngine:
         self._kvc = kvc
 
         if params is None:
-            params = llama.init_params(
-                jax.random.PRNGKey(rng_seed), self.model_cfg)
+            if cfg.checkpoint_path:
+                params = llama.load_params(cfg.checkpoint_path,
+                                           self.model_cfg)
+            else:
+                params = llama.init_params(
+                    jax.random.PRNGKey(rng_seed), self.model_cfg)
         self.params = params
 
         b = cfg.max_batch_size

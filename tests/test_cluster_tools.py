@@ -89,6 +89,33 @@ def test_log_to_driver(ray_start_regular, capfd):
     assert "(pid=" in seen
 
 
+def test_log_streaming_survives_dropped_pushes(tmp_path, capfd):
+    """Pub/sub is at-least-once: with EVERY push delivery chaos-dropped
+    (rpc fault injection), the subscriber's long-poll recovery loop still
+    delivers — seq-dedup'd (ref: pubsub long-poll, pubsub.proto:224)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "testing_rpc_failure": "pubsub:1.0:0",  # drop all pubsub pushes
+    })
+    try:
+        @ray_tpu.remote
+        def noisy():
+            print("poll-recovery-probe-plugh")
+            return 1
+
+        assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+        deadline = time.time() + 20.0
+        seen = ""
+        while time.time() < deadline:
+            seen += capfd.readouterr().out
+            if "poll-recovery-probe-plugh" in seen:
+                break
+            time.sleep(0.3)
+        assert "poll-recovery-probe-plugh" in seen
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_runtime_env_validation(ray_start_regular):
     from ray_tpu.runtime_env import RuntimeEnvError
 
