@@ -96,11 +96,13 @@ class ControlPlane:
         self._sub_strikes: dict[tuple, int] = {}  # (channel, addr) -> fails
         self._chan_seq: dict[str, int] = {}       # pubsub sequence numbers
         self._chan_log: dict[str, list] = {}      # bounded history for poll
-        # shares self._lock: subscribe registration, target snapshot and
-        # seq assignment must be atomic w.r.t. each other, or a message
-        # lands in the subscribe/publish window where it is neither pushed
-        # (subscriber not yet in targets) nor polled (seeded seq past it)
-        self._pub_cv = threading.Condition(self._lock)
+        # DEDICATED pubsub lock (never the CP's global lock: parked/cycling
+        # long-poll threads would starve every other CP operation).
+        # Subscribe registration, target snapshot and seq assignment are all
+        # atomic under it, so a message can never land in the subscribe/
+        # publish window where it is neither pushed (subscriber not yet in
+        # targets) nor polled (seeded seq past it).
+        self._pub_cv = threading.Condition()
         self._pool = ClientPool("cp")
         self._pending_actors: list[ActorID] = []
         self._pending_pgs: list[PlacementGroupID] = []
@@ -352,7 +354,7 @@ class ControlPlane:
 
     # ---- pubsub -------------------------------------------------------
     def _h_subscribe(self, body):
-        with self._lock:
+        with self._pub_cv:
             self._subs.setdefault(body["channel"], set()).add(tuple(body["addr"]))
             seq = self._chan_seq.get(body["channel"], 0)
         return {"ok": True, "seq": seq}
@@ -398,7 +400,7 @@ class ControlPlane:
         return {}
 
     def _h_unsubscribe(self, body):
-        with self._lock:
+        with self._pub_cv:
             self._subs.get(body["channel"], set()).discard(tuple(body["addr"]))
         return {"ok": True}
 
@@ -429,7 +431,7 @@ class ControlPlane:
                 strikes = self._sub_strikes.get((channel, addr), 0) + 1
                 self._sub_strikes[(channel, addr)] = strikes
                 if strikes >= 3:
-                    with self._lock:
+                    with self._pub_cv:
                         self._subs.get(channel, set()).discard(addr)
                     self._sub_strikes.pop((channel, addr), None)
 
