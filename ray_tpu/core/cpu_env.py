@@ -1,0 +1,51 @@
+"""Force a child-process environment to the CPU JAX backend.
+
+TPU hosts in this deployment register an out-of-tree PJRT plugin from a
+``sitecustomize`` on ``PYTHONPATH`` whenever its pool/bootstrap variables are
+set — in *every* interpreter, even ones that asked for ``JAX_PLATFORMS=cpu``.
+Children that must run on the virtual CPU mesh (worker pools, the multichip
+dryrun) therefore have to scrub the plugin's registration hooks from their
+environment, not just set the platform variable.
+
+Kept in a leaf module with no jax import so callers can build the child env
+before jax is ever touched in the parent.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Env-var prefixes that bootstrap the out-of-tree TPU plugin.
+_TPU_PLUGIN_PREFIXES = ("PALLAS_AXON", "AXON_")
+# PYTHONPATH entries whose sitecustomize registers the plugin.
+_TPU_SITE_MARKER = "axon_site"
+
+
+def scrub_tpu_env(env: dict[str, str]) -> dict[str, str]:
+    """Mutate ``env`` in place so a child can only initialize the CPU backend.
+
+    - ``JAX_PLATFORMS=cpu`` (forced, not setdefault: the ambient value names
+      the TPU plugin).
+    - drops every plugin bootstrap variable (``PALLAS_AXON_*``, ``AXON_*``),
+      so the sitecustomize — if still reachable — registers nothing.
+    - strips the plugin's site directory from ``PYTHONPATH`` so the
+      sitecustomize never runs at all.
+    ``TPU_SKIP_MDS_QUERY`` is deliberately left alone: it suppresses a GCE
+    metadata query that hangs off-GCE, and unsetting it makes things worse.
+
+    Belt-and-braces: children that import jax should additionally call
+    ``jax.config.update("jax_platforms", "cpu")`` before any device query —
+    plugins discovered via entry points ignore the env var.
+    """
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_PLATFORM_NAME", None)
+    for var in [k for k in env
+                if k.startswith(_TPU_PLUGIN_PREFIXES)]:
+        env.pop(var, None)
+    pyp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+           if p and _TPU_SITE_MARKER not in os.path.basename(p.rstrip("/"))]
+    if pyp:
+        env["PYTHONPATH"] = os.pathsep.join(pyp)
+    else:
+        env.pop("PYTHONPATH", None)
+    return env

@@ -197,11 +197,8 @@ class NodeAgent:
             # TPU plugin bootstrap env also skips the sitecustomize-time jax
             # import (~2.5s), so CPU worker spawn is fast; jax is imported
             # lazily (CPU backend) only if a task actually uses it.
-            # Force (not setdefault): the ambient env may carry
-            # JAX_PLATFORMS=<tpu plugin> which would make the worker try to
-            # initialize the TPU backend with its bootstrap stripped below.
-            env["JAX_PLATFORMS"] = "cpu"
-            env.pop("PALLAS_AXON_POOL_IPS", None)
+            from ray_tpu.core.cpu_env import scrub_tpu_env
+            scrub_tpu_env(env)
         info = _WorkerInfo(worker_id=worker_id, is_tpu_worker=for_tpu,
                            env_key=env_hash(runtime_env))
         info.ready = threading.Event()
