@@ -28,6 +28,7 @@ from ray_tpu.core.api import (
     wait,
 )
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.streaming import ObjectRefGenerator
 from ray_tpu.core.actor import ActorClass, ActorHandle, method
 from ray_tpu.core.placement_group import (
     PlacementGroup,
@@ -51,7 +52,7 @@ __all__ = [
     "get", "put", "wait",
     "kill", "cancel", "get_actor", "exit_actor", "get_runtime_context",
     "cluster_resources", "available_resources", "nodes",
-    "ObjectRef", "ActorClass", "ActorHandle",
+    "ObjectRef", "ObjectRefGenerator", "ActorClass", "ActorHandle",
     "PlacementGroup", "placement_group", "remove_placement_group",
     "placement_group_table", "tpu_slice_placement_group",
     "PlacementGroupSchedulingStrategy",

@@ -148,6 +148,13 @@ class ClientServer:
         if fn is None:
             raise RuntimeError("function not registered (client must "
                                "register_fn first)")
+        if body["opts"].get("num_returns") == "streaming":
+            # submit_task would hand back an ObjectRefGenerator; pinning it
+            # here would iterate (= block the RPC handler for the stream's
+            # lifetime). Remote-client streaming needs its own protocol.
+            raise ValueError(
+                'num_returns="streaming" is not supported through the '
+                "remote client yet; run the driver in-cluster")
         args, kwargs = self._load_args(s, body)
         refs = s.rt.submit_task(fn, args, kwargs, **body["opts"])
         return {"refs": s.pin(refs)}
@@ -162,6 +169,10 @@ class ClientServer:
         return {"actor_id": body["actor_id"]}
 
     def _h_actor_call(self, s: _Session, body):
+        if body["opts"].get("num_returns") == "streaming":
+            raise ValueError(
+                'num_returns="streaming" is not supported through the '
+                "remote client yet; run the driver in-cluster")
         args, kwargs = self._load_args(s, body)
         refs = s.rt.submit_actor_task(
             body["actor_id"], body["method"], args, kwargs, **body["opts"])

@@ -68,6 +68,8 @@ class ActorMethod:
             num_returns=self._num_returns, max_task_retries=retries,
             name=f"{h._class_name}.{self._method_name}",
             concurrency_group=self._concurrency_group)
+        if self._num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         if self._num_returns == 1:
             return refs[0]
         return refs

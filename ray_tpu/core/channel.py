@@ -19,7 +19,7 @@ Design notes (vs the reference):
   exactly one writer (TSO gives release/acquire on the seq publish).
 
 Layout: [magic u32][capacity u64][num_readers u32][seq u64][len u64]
-        [ack u64 x num_readers][payload capacity bytes]
+        [closed u64][ack u64 x num_readers][payload capacity bytes]
 """
 
 from __future__ import annotations
@@ -31,11 +31,12 @@ import struct
 import time
 import uuid
 
-_MAGIC = 0x52435748  # "RCWH"
+_MAGIC = 0x52435749  # "RCWI" (layout v2: dedicated closed word)
 _HDR = struct.Struct("<IQI")          # magic, capacity, num_readers
 _SEQ_OFF = _HDR.size                  # u64 seq
 _LEN_OFF = _SEQ_OFF + 8               # u64 len
-_ACK_OFF = _LEN_OFF + 8               # u64 * num_readers
+_CLOSED_OFF = _LEN_OFF + 8            # u64 closed flag
+_ACK_OFF = _CLOSED_OFF + 8            # u64 * num_readers
 
 
 class ChannelTimeoutError(TimeoutError):
@@ -44,9 +45,6 @@ class ChannelTimeoutError(TimeoutError):
 
 class ChannelClosedError(RuntimeError):
     pass
-
-
-_CLOSED_SEQ = (1 << 64) - 1
 
 
 def _wait(pred, timeout: float | None, what: str):
@@ -120,7 +118,7 @@ class Channel:
     def write(self, value, timeout: float | None = 10.0) -> None:
         """Blocks until every reader consumed the previous value, then
         publishes this one (ref: MutableObjectManager::WriteAcquire)."""
-        if self._seq() == _CLOSED_SEQ:
+        if self._map.u64(_CLOSED_OFF):
             raise ChannelClosedError("channel closed")
         data = value if isinstance(value, (bytes, bytearray, memoryview)) \
             else pickle.dumps(value, protocol=5)
@@ -140,8 +138,11 @@ class Channel:
         return ChannelReader(self._path, index)
 
     def close(self) -> None:
-        """Mark closed; readers observe ChannelClosedError on next read."""
-        self._map.put_u64(_SEQ_OFF, _CLOSED_SEQ)
+        """Mark closed. Readers first drain any value they have not yet
+        consumed (close is signalled out-of-band of seq, so a write-then-
+        close race cannot clobber the final published message), then
+        observe ChannelClosedError."""
+        self._map.put_u64(_CLOSED_OFF, 1)
 
     def unlink(self) -> None:
         if self._owner:
@@ -165,13 +166,15 @@ class ChannelReader:
 
     def read(self, timeout: float | None = 10.0, raw: bool = False):
         """Blocks for the next value (each reader sees every value exactly
-        once — ref: MutableObjectManager::ReadAcquire/ReadRelease)."""
+        once — ref: MutableObjectManager::ReadAcquire/ReadRelease). On a
+        closed channel, any not-yet-consumed value is delivered first;
+        ChannelClosedError is raised only once fully drained."""
         def ready():
-            s = self._map.u64(_SEQ_OFF)
-            return s > self._seen
+            return (self._map.u64(_SEQ_OFF) > self._seen
+                    or self._map.u64(_CLOSED_OFF))
         _wait(ready, timeout, "read")
         seq = self._map.u64(_SEQ_OFF)
-        if seq == _CLOSED_SEQ:
+        if seq <= self._seen:  # nothing new: woken by close
             raise ChannelClosedError("channel closed by writer")
         n = self._map.u64(_LEN_OFF)
         data = bytes(self._map.mm[self._payload_off:self._payload_off + n])

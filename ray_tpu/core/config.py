@@ -86,6 +86,20 @@ class Config:
     health_check_timeout_s: float = 10.0
     health_check_failure_threshold: int = 5
 
+    # --- watchdog ---
+    # get()/wait() called with no explicit timeout raise GetTimeoutError
+    # after this many seconds (0 disables). A lost reply or dead owner must
+    # fail loudly instead of hanging the process forever; legitimately
+    # longer-blocking work (multi-hour gets on training tasks) should pass
+    # an explicit timeout or raise/disable this. The test suite pins it low
+    # so a wedge surfaces in minutes.
+    blocking_watchdog_s: float = 1800.0
+
+    # --- streaming generator returns ---
+    # Max streamed items the producer may run AHEAD OF THE CONSUMER's
+    # cursor (ref: generator_backpressure_num_objects).
+    streaming_backpressure_items: int = 16
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retries: int = 3

@@ -422,18 +422,25 @@ class ControlPlane:
         for addr in targets:
             try:
                 self._pool.get(addr).notify("pubsub", {"channel": channel, "msg": msg})
-                self._sub_strikes.pop((channel, addr), None)
+                # lock-free pre-check keeps the hot success path uncontended:
+                # the key only exists after a prior delivery failure
+                if (channel, addr) in self._sub_strikes:
+                    with self._pub_cv:
+                        self._sub_strikes.pop((channel, addr), None)
             except Exception:
                 # subscribers that exited without unsubscribing must not
                 # accumulate connect churn forever: drop after 3 consecutive
-                # failed deliveries (a live one re-establishes on success)
+                # failed deliveries (a live one re-establishes on success).
+                # Strike bookkeeping is under _pub_cv: concurrent publisher
+                # threads doing unlocked read-modify-write could drop a live
+                # subscriber before 3 true consecutive failures.
                 self._pool.invalidate(addr)
-                strikes = self._sub_strikes.get((channel, addr), 0) + 1
-                self._sub_strikes[(channel, addr)] = strikes
-                if strikes >= 3:
-                    with self._pub_cv:
+                with self._pub_cv:
+                    strikes = self._sub_strikes.get((channel, addr), 0) + 1
+                    self._sub_strikes[(channel, addr)] = strikes
+                    if strikes >= 3:
                         self._subs.get(channel, set()).discard(addr)
-                    self._sub_strikes.pop((channel, addr), None)
+                        self._sub_strikes.pop((channel, addr), None)
 
     # ---- task events (observability sink; ref: gcs_task_manager.cc) ----
     def _h_report_task_events(self, body):

@@ -14,6 +14,15 @@ for the object's lifetime. Counted sources:
 - containment: stored objects whose serialized payload embeds the ref
   (ref: reference_count.cc nested-ref tracking).
 
+Borrows are ATTRIBUTED to the borrowing process (reference: borrower tracking
+in reference_count.cc WaitForRefRemoved): a serialize-time registration lands
+in the in-flight bucket; when the recipient deserializes the ref it attaches
+the borrow to its own (address, worker_id). The owner probes attributed
+borrowers while any borrow is outstanding and reclaims the borrows of dead
+ones — a borrower that crashes mid-borrow can no longer leak the object
+forever. In-flight (never-deserialized) borrows are not probed; that window
+is the cost of sender-side registration and is narrow in practice.
+
 When the owner's total hits zero the on-zero callback fires: the object is
 dropped from the memory store, unpinned/deleted in shared-memory stores, and its
 lineage entry is released (ref: task_manager.cc lineage pinning).
@@ -21,23 +30,39 @@ lineage entry is released (ref: task_manager.cc lineage pinning).
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Callable
 
 from ray_tpu.core.ids import ObjectID
 
+logger = logging.getLogger(__name__)
+
+# borrower-probe policy (owner side)
+_PROBE_INTERVAL_S = 5.0
+_PROBE_STRIKES = 3
+
+# key for borrows registered at serialize time whose recipient has not yet
+# attached (deserialized the ref)
+_IN_FLIGHT = None
+
 
 @dataclass
 class _Count:
     local: int = 0
-    borrows: int = 0
     deps: int = 0
     contained_in: int = 0
     deleted: bool = False
+    # borrower key -> count. Key is (addr, worker_id_hex) once attached,
+    # _IN_FLIGHT for serialize-time registrations not yet claimed.
+    borrower_counts: dict = field(default_factory=dict)
+
+    def borrows(self) -> int:
+        return sum(self.borrower_counts.values())
 
     def total(self) -> int:
-        return self.local + self.borrows + self.deps + self.contained_in
+        return self.local + self.borrows() + self.deps + self.contained_in
 
 
 class ReferenceCounter:
@@ -51,9 +76,19 @@ class ReferenceCounter:
         # borrowed (non-owned) refs: local count + owner address for release
         self._borrowed: dict[ObjectID, list] = {}  # oid -> [count, owner_addr]
         self._on_zero: Callable[[ObjectID], None] | None = None
+        self._probe_strikes: dict[tuple, int] = {}  # borrower key -> strikes
+        self._probe_thread: threading.Thread | None = None
+        self._probe_stop = threading.Event()
 
     def set_on_zero(self, cb: Callable[[ObjectID], None]):
         self._on_zero = cb
+
+    def shutdown(self):
+        self._probe_stop.set()
+
+    def _my_key(self) -> tuple:
+        rt = self._rt
+        return (tuple(rt.addr), rt.worker_id.hex()) if rt is not None else ()
 
     # ---- ownership registration --------------------------------------
     def add_owned(self, object_id: ObjectID, contained_refs=None):
@@ -100,8 +135,9 @@ class ReferenceCounter:
             self._notify_owner_dec(object_id, release_owner)
 
     def on_ref_deserialized(self, ref):
-        """Record the owner address for later borrow release. The borrow count
-        itself was registered by the sender."""
+        """Record the owner address for later borrow release, and attach the
+        sender-registered in-flight borrow to THIS process so the owner can
+        reclaim it if we die (borrower tracking)."""
         with self._lock:
             if ref.id() in self._owned:
                 # we own it; the sender's borrow-inc on our behalf is dropped
@@ -110,6 +146,16 @@ class ReferenceCounter:
             ent = self._borrowed.get(ref.id())
             if ent is not None:
                 ent[1] = ref.owner_addr
+        if ref.owner_addr is not None and self._rt is not None:
+            try:
+                self._rt.peer_pool.get(ref.owner_addr).notify(
+                    "attach_borrow",
+                    {"object_id": ref.id(), "holder": self._my_key()})
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "attach_borrow to owner %s for %s failed: %r (the borrow "
+                    "stays in-flight and cannot be death-reclaimed)",
+                    ref.owner_addr, ref.id().hex()[:12], e)
 
     # ---- borrows (cross-process) -------------------------------------
     def add_borrow_on_serialize(self, ref):
@@ -119,29 +165,126 @@ class ReferenceCounter:
         with self._lock:
             c = self._owned.get(oid)
             if c is not None:
-                c.borrows += 1
+                c.borrower_counts[_IN_FLIGHT] = \
+                    c.borrower_counts.get(_IN_FLIGHT, 0) + 1
                 return
         self._call_owner(oid, ref.owner_addr, "inc_borrow")
 
-    def inc_borrow(self, object_id: ObjectID):
-        """Owner-side RPC handler."""
+    def inc_borrow(self, object_id: ObjectID, holder: tuple | None = None):
+        """Owner-side RPC handler (serialize-time registration)."""
+        holder = tuple(holder) if holder else _IN_FLIGHT
         with self._lock:
             c = self._owned.setdefault(object_id, _Count())
-            c.borrows += 1
+            c.borrower_counts[holder] = c.borrower_counts.get(holder, 0) + 1
 
-    def dec_borrow(self, object_id: ObjectID):
+    def attach_borrow(self, object_id: ObjectID, holder):
+        """Owner-side: a recipient deserialized the ref — move one in-flight
+        borrow under the recipient's identity so death reclamation covers
+        it. If no in-flight borrow remains (attach raced a release or the
+        registration RPC was lost), count a fresh borrow for the holder: the
+        holder really does hold a live ref and will dec on release."""
+        holder = tuple(holder)
         with self._lock:
             c = self._owned.get(object_id)
             if c is None:
                 return
-            c.borrows -= 1
+            n = c.borrower_counts.get(_IN_FLIGHT, 0)
+            if n > 0:
+                if n == 1:
+                    c.borrower_counts.pop(_IN_FLIGHT, None)
+                else:
+                    c.borrower_counts[_IN_FLIGHT] = n - 1
+            c.borrower_counts[holder] = c.borrower_counts.get(holder, 0) + 1
+        self._ensure_probe_thread()
+
+    def dec_borrow(self, object_id: ObjectID, holder: tuple | None = None):
+        holder = tuple(holder) if holder else _IN_FLIGHT
+        with self._lock:
+            c = self._owned.get(object_id)
+            if c is None:
+                return
+            # release from the holder's bucket; fall back to the in-flight
+            # bucket (attach lost) then to any bucket (legacy callers)
+            for key in (holder, _IN_FLIGHT, *list(c.borrower_counts)):
+                n = c.borrower_counts.get(key, 0)
+                if n > 0:
+                    if n == 1:
+                        c.borrower_counts.pop(key, None)
+                    else:
+                        c.borrower_counts[key] = n - 1
+                    break
             self._maybe_zero(object_id, c)
+
+    def drop_borrower(self, holder: tuple):
+        """Reclaim every borrow attributed to a dead borrower (reference:
+        reference_count.cc borrower death handling)."""
+        holder = tuple(holder)
+        zeroed: list[tuple[ObjectID, _Count]] = []
+        with self._lock:
+            for oid, c in list(self._owned.items()):
+                if c.borrower_counts.pop(holder, 0):
+                    zeroed.append((oid, c))
+            for oid, c in zeroed:
+                self._maybe_zero(oid, c)
+        if zeroed:
+            logger.info("reclaimed borrows of dead borrower %s on %d objects",
+                        holder, len(zeroed))
 
     def release_borrow_after_send(self, ref):
         """Sender-side: after handing a ref to another process, the recipient now
         holds the borrow we registered; if we registered it for an object we own,
         drop the temporary count once the recipient confirms (v1: recipient's
         ObjectRef ctor + our dec make the handoff net-zero, so nothing to do)."""
+
+    # ---- borrower liveness probing ------------------------------------
+    def _ensure_probe_thread(self):
+        if self._probe_thread is not None or self._rt is None:
+            return
+        with self._lock:
+            if self._probe_thread is not None:
+                return
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="borrow-probe", daemon=True)
+            self._probe_thread.start()
+
+    def _attributed_borrowers(self) -> set:
+        with self._lock:
+            out = set()
+            for c in self._owned.values():
+                for key in c.borrower_counts:
+                    if key is not _IN_FLIGHT:
+                        out.add(key)
+            return out
+
+    def _probe_loop(self):
+        """While attributed borrows exist, ping each borrower; after
+        _PROBE_STRIKES consecutive failures (or a worker-id mismatch — the
+        port was reused by a new worker) reclaim its borrows."""
+        while not self._probe_stop.wait(_PROBE_INTERVAL_S):
+            me = self._my_key()
+            for key in self._attributed_borrowers():
+                if key == me:
+                    continue
+                addr, wid = key
+                dead = False
+                try:
+                    # bounded connect: a dead peer refuses instantly and must
+                    # not stall the probe for the full rpc connect-retry
+                    # window per strike
+                    reply = self._rt.peer_pool.get(tuple(addr)).call(
+                        "ping", None, timeout=3.0, connect_timeout=1.0)
+                    replied_wid = (reply or {}).get("worker_id")
+                    if replied_wid is not None and replied_wid != wid:
+                        dead = True  # address reused by a different worker
+                    else:
+                        self._probe_strikes.pop(key, None)
+                except Exception:
+                    strikes = self._probe_strikes.get(key, 0) + 1
+                    self._probe_strikes[key] = strikes
+                    dead = strikes >= _PROBE_STRIKES
+                if dead:
+                    self._probe_strikes.pop(key, None)
+                    self.drop_borrower(key)
 
     # ---- task deps ----------------------------------------------------
     def add_task_dep(self, object_id: ObjectID, owner_addr=None):
@@ -198,16 +341,35 @@ class ReferenceCounter:
         try:
             self._rt.peer_pool.get(owner_addr).call_with_retry(
                 method, object_id, timeout=10.0)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            # An unreachable owner means the object is (or is about to be)
+            # lost anyway, but the failure must be visible: silent borrow
+            # under-registration can free an object a live process still uses.
+            logger.warning("%s to owner %s for %s failed: %r",
+                           method, owner_addr, object_id.hex()[:12], e)
 
     def _notify_owner_dec(self, object_id: ObjectID, owner_addr):
         if owner_addr is None or self._rt is None:
             return
         try:
-            self._rt.peer_pool.get(owner_addr).notify("dec_borrow", object_id)
-        except Exception:
-            pass
+            self._rt.peer_pool.get(owner_addr).notify(
+                "dec_borrow",
+                {"object_id": object_id, "holder": self._my_key()})
+        except Exception as e:  # noqa: BLE001
+            logger.warning("dec_borrow to owner %s for %s failed: %r "
+                           "(owner's probe loop will reclaim on our death)",
+                           owner_addr, object_id.hex()[:12], e)
+
+    def drop_if_unreferenced(self, object_id: ObjectID) -> bool:
+        """Free an owned object that has a zero count but never saw a dec
+        event (e.g. a buffered stream item whose ref was never created).
+        No-op if anything still references it."""
+        with self._lock:
+            c = self._owned.get(object_id)
+            if c is None or c.total() > 0:
+                return False
+            self._maybe_zero(object_id, c)
+            return True
 
     # ---- introspection -------------------------------------------------
     def owned_count(self, object_id: ObjectID) -> int:

@@ -81,6 +81,8 @@ class RemoteFunction:
             retry_exceptions=bool(options.get("retry_exceptions", False)),
             name=options.get("name", "") or self._fn.__name__,
             runtime_env=options.get("runtime_env"))
+        if num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         if num_returns == 1:
             return refs[0]
         return refs

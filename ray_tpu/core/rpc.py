@@ -261,7 +261,7 @@ class RpcClient:
         self._next_id = 0
         self._closed = False
 
-    def _ensure_conn(self) -> socket.socket:
+    def _ensure_conn(self, connect_timeout: float | None = None) -> socket.socket:
         """Returns the live socket (never read self._sock without the lock —
         the reader thread nulls it on connection loss)."""
         with self._lock:
@@ -270,7 +270,9 @@ class RpcClient:
             if self._closed:
                 raise ConnectionLost("client closed")
             cfg = get_config()
-            deadline = time.monotonic() + cfg.rpc_connect_timeout_s
+            if connect_timeout is None:
+                connect_timeout = cfg.rpc_connect_timeout_s
+            deadline = time.monotonic() + connect_timeout
             last = None
             while time.monotonic() < deadline:
                 try:
@@ -319,14 +321,15 @@ class RpcClient:
                     ent[1], ent[2] = False, err
                     ent[0].set()
 
-    def call(self, method: str, body: Any = None, timeout: float | None = None) -> Any:
+    def call(self, method: str, body: Any = None, timeout: float | None = None,
+             connect_timeout: float | None = None) -> Any:
         ev = threading.Event()
         with self._lock:
             self._next_id += 1
             msg_id = self._next_id
             self._pending[msg_id] = ent = [ev, None, None]
         try:
-            sock = self._ensure_conn()
+            sock = self._ensure_conn(connect_timeout)
             try:
                 _send_frame(sock, _REQ, pickle.dumps((msg_id, method, body)), self._wlock)
             except OSError as e:

@@ -1,0 +1,264 @@
+"""Streaming generator returns (``num_returns="streaming"``).
+
+TPU-native analog of the reference's streaming-generator protocol
+(/root/reference/src/ray/protobuf/core_worker.proto:513
+``ReportGeneratorItemReturns`` + the stream bookkeeping in
+src/ray/core_worker/task_manager.cc): a task or actor method whose function
+is a generator reports each yielded value to its owner AS IT IS PRODUCED;
+the owner hands out an :class:`ObjectRefGenerator` whose ``next()`` blocks
+for the next item's ref. The executor applies backpressure — at most
+``streaming_backpressure_items`` unacknowledged items in flight — so a fast
+producer cannot flood a slow consumer (reference:
+``generator_backpressure_num_objects``).
+
+Item identity is deterministic (``ObjectID.for_return(task_id, index+1)``),
+so a retried generator re-produces the same ids and the owner's cursor is
+unaffected; stale-attempt reports are dropped exactly like stale task
+replies. If the producing task fails terminally, the stream is failed: the
+consumer's next ``next()`` returns a ref holding the error.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import TYPE_CHECKING
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID, TaskID
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ray_tpu.core.task_spec import TaskSpec
+
+
+class _Stream:
+    """Owner-side state of one generator task's output stream."""
+
+    def __init__(self, task_id: TaskID):
+        self.task_id = task_id
+        self.items: dict[int, ObjectID] = {}   # index -> ready object
+        self.total: int | None = None          # set by the done marker
+        self.cursor = 0                        # next index to hand out
+        self.cv = threading.Condition()
+
+    def put(self, index: int, oid: ObjectID):
+        with self.cv:
+            self.items[index] = oid
+            self.cv.notify_all()
+
+    def finish(self, count: int):
+        with self.cv:
+            if self.total is None or count < self.total:
+                self.total = count
+            self.cv.notify_all()
+
+
+class StreamManager:
+    """Owner-side registry of live streams (one per streaming task)."""
+
+    def __init__(self, runtime):
+        self._rt = runtime
+        self._lock = threading.Lock()
+        self._streams: dict[TaskID, _Stream] = {}
+        # streams dropped by their consumer before draining: producers are
+        # told to cancel on their next report/poll
+        self._abandoned: set[TaskID] = set()
+
+    def register(self, spec: "TaskSpec") -> "ObjectRefGenerator":
+        st = _Stream(spec.task_id)
+        with self._lock:
+            self._streams[spec.task_id] = st
+        return ObjectRefGenerator(st, self._rt, spec.owner_id,
+                                  spec.owner_addr)
+
+    def get(self, task_id: TaskID) -> _Stream | None:
+        with self._lock:
+            return self._streams.get(task_id)
+
+    def discard(self, task_id: TaskID):
+        with self._lock:
+            self._streams.pop(task_id, None)
+
+    def on_item(self, body: dict) -> dict:
+        """Owner-side handler for executor item reports
+        (ReportGeneratorItemReturns analog). The reply carries the
+        consumer's cursor so the executor can throttle to consumption, not
+        just delivery.
+
+        Works with NO live stream too: lineage reconstruction re-runs the
+        generator after the consumer finished iterating — replayed items
+        whose refs are still held must be re-stored even though the stream
+        itself is gone."""
+        from ray_tpu.core.serialization import SerializedObject
+
+        tid = body["task_id"]
+        with self._lock:
+            abandoned = tid in self._abandoned
+            if abandoned and body.get("done"):
+                self._abandoned.discard(tid)  # producer wound down
+        if abandoned:
+            return {"ok": True, "cancel": True}
+        pending = self._rt.task_manager.get_pending_spec(tid)
+        if pending is None or body.get("attempt", 0) != pending.attempt_number:
+            return {"ok": True, "stale": True}
+        st = self.get(tid)
+        if body.get("done"):
+            if st is not None:
+                st.finish(body["count"])
+            return {"ok": True, "consumed": self._consumed(st)}
+        oid, kind, data, is_error = body["item"]
+        already_consumed = (st is not None
+                            and body["index"] < self._consumed(st))
+        if (st is None or already_consumed) \
+                and self._rt.reference_counter.owned_count(oid) <= 0:
+            # nobody holds (or will ever get) this item's ref — a retry
+            # replaying consumed indices, or a stream that's gone; storing
+            # it would pin it forever
+            return {"ok": True, "consumed": self._consumed(st)}
+        if kind == "inline":
+            self._rt.memory_store.put_inline(
+                oid, SerializedObject.from_buffer(data), is_error)
+        else:
+            self._rt.memory_store.put_location(oid, data)
+            # lineage: a lost shm item is reconstructed by re-running the
+            # whole generator (deterministic ids make the replay line up)
+            self._rt.task_manager.add_stream_lineage(oid, pending)
+        if st is not None and not already_consumed:
+            self._rt.reference_counter.add_owned(oid)
+            st.put(body["index"], oid)
+            if self.get(tid) is None:
+                # abandon() raced this report after our stream lookup;
+                # its cleanup missed this item — drop it ourselves
+                self._rt.reference_counter.drop_if_unreferenced(oid)
+        return {"ok": True, "consumed": self._consumed(st)}
+
+    def _consumed(self, st: _Stream | None) -> int:
+        """Consumer progress for executor backpressure; an absent (finished
+        or abandoned) stream reports 'everything consumed' so the producer
+        never blocks on a consumer that will not come back."""
+        if st is None:
+            return 1 << 62
+        with st.cv:
+            return st.cursor
+
+    def on_consumed_query(self, body: dict) -> dict:
+        """Executor poll while backpressure-blocked (the consumer advancing
+        its cursor does not otherwise reach the executor)."""
+        tid = body["task_id"]
+        with self._lock:
+            if tid in self._abandoned:
+                return {"cancel": True}
+        return {"consumed": self._consumed(self.get(tid))}
+
+    def abandon(self, task_id: TaskID):
+        """Consumer dropped the generator before draining it: forget the
+        stream, free buffered items nobody will ever pop (their refs were
+        never handed out, so no dec event would ever fire), and tell the
+        producer to stop on its next report/poll."""
+        st = self.get(task_id)
+        if st is None:
+            return
+        with self._lock:
+            self._abandoned.add(task_id)
+            if len(self._abandoned) > 4096:  # bound: ids of dead producers
+                self._abandoned.pop()
+        self.discard(task_id)
+        with st.cv:
+            pending_items = list(st.items.values())
+            st.items.clear()
+            st.total = st.cursor  # unblock any concurrent next()
+            st.cv.notify_all()
+        for oid in pending_items:
+            self._rt.reference_counter.drop_if_unreferenced(oid)
+
+    def fail(self, spec: "TaskSpec", error_sobj):
+        """Terminal task failure: surface the error as the stream's next
+        item so consumers unblock instead of hanging."""
+        st = self.get(spec.task_id)
+        if st is None:
+            return
+        with st.cv:
+            idx = (max(st.items) + 1) if st.items else 0
+            idx = max(idx, st.cursor)
+            oid = ObjectID.for_return(spec.task_id, idx + 1)
+            self._rt.memory_store.put_inline(oid, error_sobj, is_error=True)
+            self._rt.reference_counter.add_owned(oid)
+            st.items[idx] = oid
+            st.total = idx + 1
+            st.cv.notify_all()
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's item refs (reference:
+    python/ray/_raylet ObjectRefGenerator). Each ``next()`` returns an
+    ``ObjectRef`` as soon as the executor has reported that item; pass it to
+    ``ray_tpu.get`` (or nested tasks) like any ref."""
+
+    def __init__(self, stream: _Stream, runtime, owner_id, owner_addr):
+        self._stream = stream
+        self._rt = runtime
+        self._owner_id = owner_id
+        self._owner_addr = owner_addr
+
+    def __del__(self):
+        # abandoned before StopIteration: release buffered items (the
+        # producer unblocks via the absent-stream consumed sentinel)
+        try:
+            st = self._stream
+            if st.total is None or st.cursor < st.total:
+                self._rt.stream_manager.abandon(st.task_id)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._next_ref(timeout=None)
+
+    def next_ready(self):
+        """Non-blocking: the next ref if already reported, else None."""
+        try:
+            return self._next_ref(timeout=0.0)
+        except StopIteration:
+            raise
+        except Exception:
+            return None
+
+    def _next_ref(self, timeout: float | None):
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.exceptions import GetTimeoutError
+
+        st = self._stream
+        watchdog = timeout is None and get_config().blocking_watchdog_s > 0
+        if watchdog:
+            timeout = get_config().blocking_watchdog_s
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with st.cv:
+            while True:
+                if st.cursor in st.items:
+                    oid = st.items.pop(st.cursor)
+                    st.cursor += 1
+                    return ObjectRef(oid, self._owner_id, self._owner_addr)
+                if st.total is not None and st.cursor >= st.total:
+                    self._rt.stream_manager.discard(st.task_id)
+                    raise StopIteration
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"stream next() timed out after {timeout:.0f}s"
+                        + (" (blocking watchdog; pass an explicit timeout or "
+                           "raise RAY_TPU_BLOCKING_WATCHDOG_S)"
+                           if watchdog else ""))
+                st.cv.wait(remaining if remaining is None
+                           else min(remaining, 1.0))
+
+    def completed_count(self) -> int:
+        with self._stream.cv:
+            return self._stream.cursor
+
+    def is_finished(self) -> bool:
+        st = self._stream
+        with st.cv:
+            return st.total is not None and st.cursor >= st.total

@@ -336,12 +336,17 @@ class NormalTaskSubmitter:
 
     def shutdown(self):
         self._stopped.set()
-        # return still-held leases so agents free their workers promptly
+        # Return only IDLE leases so agents free those workers promptly.
+        # Leases with pushed tasks still in flight must NOT be returned: the
+        # agent would mark the worker free and could re-lease a CPU that is
+        # still executing the orphaned task — those are left to the agent's
+        # dead-lessee reclamation, which terminates the mid-task worker.
         with self._lock:
-            leases = [l for st in self._shapes.values() for l in st.leases]
+            idle = [l for st in self._shapes.values() for l in st.leases
+                    if l.inflight == 0]
             for st in self._shapes.values():
                 st.leases.clear()
-        for lease in leases:
+        for lease in idle:
             self._return_lease(lease)
         self._lease_pool.shutdown(wait=False)
 

@@ -76,6 +76,14 @@ class TaskManager:
             ent = self._pending.get(task_id)
             return ent.spec if ent else None
 
+    def add_stream_lineage(self, object_id: ObjectID, spec: TaskSpec):
+        """Streamed items are reported one at a time; record lineage as they
+        arrive (a lost shm item re-runs the whole generator — deterministic
+        item ids make the replay line up)."""
+        with self._lock:
+            if get_config().enable_object_reconstruction:
+                self._lineage[object_id] = spec
+
     # ---- lineage ------------------------------------------------------
     def release_lineage(self, object_id: ObjectID):
         """Called when the owned ref count hits zero."""

@@ -105,6 +105,9 @@ class TaskSpec:
     # group->max_concurrency table; actor tasks carry the target group
     concurrency_groups: dict | None = None
     concurrency_group: str = ""
+    # streaming generator returns (num_returns="streaming"; ref:
+    # core_worker.proto:513 ReportGeneratorItemReturns)
+    streaming: bool = False
     # runtime env / misc
     runtime_env: dict | None = None
     depth: int = 0

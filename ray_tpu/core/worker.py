@@ -199,8 +199,11 @@ class WorkerRuntime:
         self._node_addr_cache: dict[NodeID, tuple] = {}
         self._actor_state = _ActorExecState()
         self._subscribed_actors: set[ActorID] = set()
+        from ray_tpu.core.streaming import StreamManager
+        self.stream_manager = StreamManager(self)
         self._pubsub_seen: dict[str, int] = {}  # channel -> last seq
         self._pubsub_lock = threading.Lock()
+        self._pubsub_dispatch_locks: dict[str, threading.Lock] = {}
         self._pubsub_poll_started = False
         self._cancelled_tasks: set[TaskID] = set()
         self._device_objects: dict[ObjectID, Any] = {}  # HBM-resident values
@@ -267,10 +270,23 @@ class WorkerRuntime:
         self.memory_store.put_location(oid, self.node_id)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        watchdog = timeout is None and get_config().blocking_watchdog_s > 0
+        if watchdog:
+            timeout = get_config().blocking_watchdog_s
         deadline = None if timeout is None else time.monotonic() + timeout
         out: list[Any] = []
         for ref in refs:
-            out.append(self._get_one(ref, deadline))
+            try:
+                out.append(self._get_one(ref, deadline))
+            except GetTimeoutError:
+                if not watchdog:
+                    raise
+                raise GetTimeoutError(
+                    f"get() watchdog: no result after {timeout:.0f}s on "
+                    f"{ref.id().hex()[:12]} — a lost reply or dead owner "
+                    "would otherwise hang forever. For legitimately longer "
+                    "work pass an explicit timeout or raise/disable "
+                    "RAY_TPU_BLOCKING_WATCHDOG_S (0 disables).") from None
         return out
 
     def _remaining(self, deadline) -> float | None:
@@ -461,6 +477,9 @@ class WorkerRuntime:
         """Event-driven wait (ref: CoreWorker::Wait core_worker.h:695 + the
         raylet's WaitManager): owned refs wake on memory-store availability,
         borrowed refs on owner long-poll replies — no per-ref poll loop."""
+        watchdog = timeout is None and get_config().blocking_watchdog_s > 0
+        if watchdog:
+            timeout = get_config().blocking_watchdog_s
         deadline = None if timeout is None else time.monotonic() + timeout
         cond = threading.Condition()
         ready_ids: set = set()
@@ -500,6 +519,13 @@ class WorkerRuntime:
             self._normal_exec.on_unblocked()
         for oid, cb in cleanups:
             self.memory_store.remove_callback(oid, cb)
+        if watchdog and len(ready_now) < min(num_returns, len(refs)):
+            raise GetTimeoutError(
+                f"wait() watchdog: {len(ready_now)}/{min(num_returns, len(refs))} "
+                f"refs ready after {timeout:.0f}s with no explicit timeout — "
+                "a lost reply or dead owner would otherwise hang forever. For "
+                "legitimately longer work pass an explicit timeout or "
+                "raise/disable RAY_TPU_BLOCKING_WATCHDOG_S (0 disables).")
         ready = [r for r in refs if r.id() in ready_now]
         if len(ready) > num_returns:
             ready = ready[:num_returns]
@@ -509,16 +535,33 @@ class WorkerRuntime:
     def _owner_wait_async(self, ref: ObjectRef, mark, finished, deadline):
         """Long-poll the owner for a borrowed ref's status; re-arms itself on
         'pending' replies until the wait finishes (event-driven borrower side
-        of get_object_status, ref: core_worker.proto:492)."""
+        of get_object_status, ref: core_worker.proto:492).
+
+        Transport failures re-arm with backoff rather than abandoning the
+        ref: one dropped RPC to a live owner must not turn a blocking wait
+        into a permanent hang. Only an explicit 'lost' status gives up."""
         owner_addr = ref.owner_addr
         oid = ref.id()
         if owner_addr is None:
             return
+        backoff = [0.05]
+
+        def retry_later():
+            if finished[0]:
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                return
+            delay = backoff[0]
+            backoff[0] = min(delay * 2, 2.0)
+            t = threading.Timer(delay, issue)
+            t.daemon = True
+            t.start()
 
         def on_reply(ok, status):
             if finished[0]:
                 return
             if ok and isinstance(status, dict):
+                backoff[0] = 0.05  # owner is healthy
                 kind = status.get("kind")
                 if kind == "shm":
                     self.memory_store.put_location(oid, status["node_id"])
@@ -533,12 +576,15 @@ class WorkerRuntime:
                 if kind == "lost":
                     return  # never becomes ready
             elif not ok:
-                return  # owner unreachable: ref won't resolve here
+                retry_later()  # transient transport failure: re-arm
+                return
             if deadline is not None and time.monotonic() >= deadline:
                 return
             issue()
 
         def issue():
+            if finished[0]:
+                return
             t = self._remaining(deadline)
             body = {"object_id": oid, "wait": True,
                     "timeout": min(t, 5.0) if t is not None else 5.0}
@@ -546,37 +592,40 @@ class WorkerRuntime:
                 self.peer_pool.get(owner_addr).call_async(
                     "get_object_status", body, callback=on_reply)
             except Exception:
-                pass
+                retry_later()
 
         issue()
 
     # ------------------------------------------------------------------
     # task submission
     def submit_task(self, fn: Callable, args: tuple, kwargs: dict, *,
-                    num_returns: int = 1, resources: dict | None = None,
+                    num_returns: int | str = 1, resources: dict | None = None,
                     strategy: SchedulingStrategy | None = None,
                     max_retries: int | None = None, retry_exceptions: bool = False,
-                    name: str = "", runtime_env: dict | None = None) -> list[ObjectRef]:
+                    name: str = "", runtime_env: dict | None = None):
         cfg = get_config()
         if runtime_env:
             from ray_tpu.runtime_env import prepare_runtime_env
             runtime_env = prepare_runtime_env(self, runtime_env)
+        streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=self._next_task_id(), job_id=self.job_id,
             task_type=TaskType.NORMAL, name=name or getattr(fn, "__name__", "task"),
             function_id=self.function_manager.export(fn),
             args=self._serialize_args(args, kwargs),
-            num_returns=num_returns, resources=resources or {"CPU": 1.0},
+            num_returns=0 if streaming else num_returns,
+            streaming=streaming, resources=resources or {"CPU": 1.0},
             strategy=strategy or DefaultStrategy(),
             max_retries=cfg.task_max_retries if max_retries is None else max_retries,
             retry_exceptions=retry_exceptions, runtime_env=runtime_env,
             owner_id=self.worker_id, owner_addr=self.addr,
             caller_id=self.worker_id, depth=self._depth() + 1)
         refs = self._register_returns(spec)
+        gen = self.stream_manager.register(spec) if streaming else None
         self.task_manager.add_pending(spec)
         self._record_task_event(spec, "SUBMITTED")
         self.normal_submitter.submit(spec)
-        return refs
+        return gen if streaming else refs
 
     def submit_actor_creation(self, cls, args: tuple, kwargs: dict, *, actor_id: ActorID,
                               resources: dict | None = None, name: str = "",
@@ -606,24 +655,27 @@ class WorkerRuntime:
             timeout=60.0)
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args: tuple,
-                          kwargs: dict, *, num_returns: int = 1,
+                          kwargs: dict, *, num_returns: int | str = 1,
                           max_task_retries: int = 0, name: str = "",
-                          concurrency_group: str = "") -> list[ObjectRef]:
+                          concurrency_group: str = ""):
+        streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(self.job_id, actor_id, self._bump_counter()),
             job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
             name=name, method_name=method_name,
             args=self._serialize_args(args, kwargs),
-            num_returns=num_returns, resources={},
+            num_returns=0 if streaming else num_returns,
+            streaming=streaming, resources={},
             max_retries=max_task_retries,
             owner_id=self.worker_id, owner_addr=self.addr,
             actor_id=actor_id, caller_id=self.worker_id,
             concurrency_group=concurrency_group)
         refs = self._register_returns(spec)
+        gen = self.stream_manager.register(spec) if streaming else None
         self.task_manager.add_pending(spec)
         self._record_task_event(spec, "SUBMITTED")
         self.actor_submitter.submit(spec)
-        return refs
+        return gen if streaming else refs
 
     def _bump_counter(self) -> int:
         with self._task_counter_lock:
@@ -685,6 +737,20 @@ class WorkerRuntime:
             self.fail_task(spec, TaskError(formatted=str(reply["error"]),
                                            task_repr=spec.repr_name()))
             return
+        if reply.get("app_error"):
+            # streaming task raised with retry_exceptions: re-run the whole
+            # generator, or fail the stream once retries are exhausted
+            retry = self.task_manager.should_retry_app_error(spec.task_id)
+            if retry is not None:
+                logger.info("retrying streaming task %s after application "
+                            "error", spec.repr_name())
+                self.resubmit_spec(retry)
+                return
+            err = self.serialization.deserialize(
+                SerializedObject.from_buffer(reply["app_error"]))
+            self.fail_task(spec, err if isinstance(err, TaskError)
+                           else TaskError(err, task_repr=spec.repr_name()))
+            return
         results = reply.get("results", [])
         if any(is_err for (_, _, _, is_err) in results):
             retry = self.task_manager.should_retry_app_error(spec.task_id)
@@ -708,6 +774,9 @@ class WorkerRuntime:
         sobj = self.serialization.serialize(error)
         for oid in spec.return_ids():
             self.memory_store.put_inline(oid, sobj, is_error=True)
+        if spec.streaming:
+            # consumers blocked in next() must observe the failure
+            self.stream_manager.fail(spec, sobj)
         self._release_deps(spec)
         self.task_manager.complete(spec.task_id)
         self._record_task_event(spec, "FAILED")
@@ -761,14 +830,28 @@ class WorkerRuntime:
         return fn(body)
 
     def _h_ping(self, body):
-        return {"ok": True}
+        # worker_id lets borrow-probing owners detect a reused port
+        return {"ok": True, "worker_id": self.worker_id.hex()}
 
     def _h_inc_borrow(self, body):
-        self.reference_counter.inc_borrow(body)
+        if isinstance(body, dict):
+            self.reference_counter.inc_borrow(
+                body["object_id"], body.get("holder"))
+        else:
+            self.reference_counter.inc_borrow(body)
+        return {"ok": True}
+
+    def _h_attach_borrow(self, body):
+        self.reference_counter.attach_borrow(
+            body["object_id"], body["holder"])
         return {"ok": True}
 
     def _h_dec_borrow(self, body):
-        self.reference_counter.dec_borrow(body)
+        if isinstance(body, dict):
+            self.reference_counter.dec_borrow(
+                body["object_id"], body.get("holder"))
+        else:
+            self.reference_counter.dec_borrow(body)
         return {"ok": True}
 
     def _h_get_object_status(self, body):
@@ -807,13 +890,30 @@ class WorkerRuntime:
             # and N+1 arrives, dispatching N+1 and advancing would make the
             # poll skip N forever — instead the gapped push is dropped and
             # the recovery poll replays N, N+1 in order.
+            # The watermark advance + dispatch are atomic per channel (the
+            # ordering lock): otherwise the push thread (msg N+1) and the
+            # recovery-poll thread (msg N) could dispatch concurrently and
+            # apply state transitions out of seq order (e.g. an actor
+            # ALIVE processed after its later DEAD).
             seq, msg = msg["__seq"], msg["payload"]
-            with self._pubsub_lock:
-                seen = self._pubsub_seen.get(channel, 0)
-                if seq != seen + 1:
-                    return {"ok": True}  # stale, or gapped (poll recovers)
-                self._pubsub_seen[channel] = seq
+            with self._pubsub_order_lock(channel):
+                with self._pubsub_lock:
+                    seen = self._pubsub_seen.get(channel, 0)
+                    if seq != seen + 1:
+                        return {"ok": True}  # stale/gapped (poll recovers)
+                    self._pubsub_seen[channel] = seq
+                return self._dispatch_pubsub(channel, msg)
         return self._dispatch_pubsub(channel, msg)
+
+    def _pubsub_order_lock(self, channel: str) -> threading.Lock:
+        """Per-channel lock serializing watermark-advance + dispatch so
+        message application follows sequence order across the push and
+        recovery-poll threads."""
+        with self._pubsub_lock:
+            lock = self._pubsub_dispatch_locks.get(channel)
+            if lock is None:
+                lock = self._pubsub_dispatch_locks[channel] = threading.Lock()
+            return lock
 
     def _dispatch_pubsub(self, channel: str, msg):
         if channel.startswith("worker_logs:"):
@@ -891,14 +991,15 @@ class WorkerRuntime:
                 continue
             for channel, entries in (out or {}).items():
                 for seq, msg in sorted(entries):
-                    with self._pubsub_lock:
-                        if seq <= self._pubsub_seen.get(channel, 0):
-                            continue
-                        self._pubsub_seen[channel] = seq
-                    try:
-                        self._dispatch_pubsub(channel, msg)
-                    except Exception:  # noqa: BLE001 - keep the loop alive
-                        logger.exception("pubsub recovery dispatch failed")
+                    with self._pubsub_order_lock(channel):
+                        with self._pubsub_lock:
+                            if seq <= self._pubsub_seen.get(channel, 0):
+                                continue
+                            self._pubsub_seen[channel] = seq
+                        try:
+                            self._dispatch_pubsub(channel, msg)
+                        except Exception:  # noqa: BLE001 keep the loop alive
+                            logger.exception("pubsub recovery dispatch failed")
 
     def _h_cancel_task(self, body):
         """(ref: core_worker.proto:540 CancelTask)"""
@@ -1026,6 +1127,8 @@ class WorkerRuntime:
         return tuple(args), kwargs
 
     def _success_reply(self, spec: TaskSpec, result) -> dict:
+        if spec.streaming:
+            return self._stream_out(spec, result)
         if spec.num_returns == 0:
             return {"results": [], "error": None}
         values = [result] if spec.num_returns == 1 else list(result)
@@ -1044,6 +1147,138 @@ class WorkerRuntime:
                 self._store_return_shm(oid, sobj, spec)
                 out.append((oid, "shm", self.node_id, False))
         return {"results": out, "error": None, "attempt": spec.attempt_number}
+
+    def _stream_out(self, spec: TaskSpec, gen) -> dict:
+        """Executor side of streaming returns: report each yielded item to
+        the owner as it's produced, throttled to CONSUMPTION — at most
+        ``streaming_backpressure_items`` items beyond the consumer's cursor
+        (ref: core_worker.proto:513 ReportGeneratorItemReturns +
+        generator_backpressure_num_objects). Item-report replies carry the
+        cursor; while blocked the executor polls it (the consumer advancing
+        has no push path back here)."""
+        cfg = get_config()
+        owner = self.peer_pool.get(spec.owner_addr)
+        window = max(1, cfg.streaming_backpressure_items)
+        cv = threading.Condition()
+        inflight = [0]
+        consumed = [0]
+        cancelled = [False]
+
+        def on_ack(ok, reply):
+            with cv:
+                inflight[0] -= 1
+                if ok and isinstance(reply, dict):
+                    if reply.get("cancel"):
+                        cancelled[0] = True
+                    consumed[0] = max(consumed[0],
+                                      reply.get("consumed", 0))
+                cv.notify_all()
+
+        def check_cancelled():
+            if cancelled[0]:
+                # consumer abandoned the stream: stop producing instead of
+                # running the generator to completion for nobody
+                raise TaskCancelledError("stream consumer abandoned")
+
+        def throttle(next_idx: int):
+            poll_failures = 0
+            while True:
+                check_cancelled()
+                with cv:
+                    if next_idx - consumed[0] < window \
+                            and inflight[0] < window:
+                        return
+                    cv.wait(0.2)
+                    if next_idx - consumed[0] < window \
+                            and inflight[0] < window:
+                        return
+                check_cancelled()
+                try:
+                    r = owner.call("stream_consumed",
+                                   {"task_id": spec.task_id}, timeout=5.0)
+                    poll_failures = 0
+                    with cv:
+                        if (r or {}).get("cancel"):
+                            cancelled[0] = True
+                        consumed[0] = max(consumed[0],
+                                          (r or {}).get("consumed", 0))
+                except Exception:
+                    poll_failures += 1
+                    if poll_failures >= 60:  # owner unreachable ~1 min
+                        raise RuntimeError(
+                            "stream owner unreachable; aborting generator")
+
+        def send(payload, next_idx: int):
+            throttle(next_idx)
+            with cv:
+                inflight[0] += 1
+            try:
+                owner.call_async("stream_item", payload, callback=on_ack)
+            except Exception:
+                with cv:
+                    inflight[0] -= 1
+                    cv.notify_all()
+                raise
+
+        idx = 0
+        try:
+            it = iter(gen)
+            while True:
+                try:
+                    value = next(it)
+                except StopIteration:
+                    break
+                oid = ObjectID.for_return(spec.task_id, idx + 1)
+                sobj = self.serialization.serialize(value)
+                if (sobj.serialized_size() <= cfg.max_inline_object_size
+                        or self.agent_addr is None):
+                    item = (oid, "inline", sobj.to_bytes(), False)
+                else:
+                    self._store_return_shm(oid, sobj, spec)
+                    item = (oid, "shm", self.node_id, False)
+                send({"task_id": spec.task_id, "index": idx, "item": item,
+                      "attempt": spec.attempt_number}, idx)
+                idx += 1
+        except TaskCancelledError:
+            # abandoned stream: nothing to report, nobody listening
+            return {"results": [], "error": None,
+                    "attempt": spec.attempt_number}
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else TaskError(
+                e, task_repr=spec.repr_name())
+            sobj = self.serialization.serialize(err)
+            if spec.retry_exceptions:
+                # match non-streaming semantics: a retryable app error
+                # re-runs the whole generator via the owner's retry
+                # machinery instead of surfacing mid-stream
+                return {"results": [], "app_error": sobj.to_bytes(),
+                        "attempt": spec.attempt_number}
+            oid = ObjectID.for_return(spec.task_id, idx + 1)
+            send({"task_id": spec.task_id, "index": idx,
+                  "item": (oid, "inline", sobj.to_bytes(), True),
+                  "attempt": spec.attempt_number}, idx)
+            idx += 1
+        send({"task_id": spec.task_id, "index": idx, "done": True,
+              "count": idx, "attempt": spec.attempt_number}, idx)
+        # Barrier on all acks BEFORE replying to the task push: the
+        # completion reply travels on a different connection than the item
+        # reports and would otherwise race them — the owner marks the task
+        # complete and then drops the late item reports as stale, hanging
+        # the consumer. (call_async always fires its callback, including on
+        # transport failure; the deadline is a backstop.)
+        deadline = time.monotonic() + 60.0
+        with cv:
+            while inflight[0] > 0 and time.monotonic() < deadline:
+                cv.wait(1.0)
+        return {"results": [], "error": None, "attempt": spec.attempt_number}
+
+    def _h_stream_item(self, body):
+        """Owner-side item report (ref: ReportGeneratorItemReturns)."""
+        return self.stream_manager.on_item(body)
+
+    def _h_stream_consumed(self, body):
+        """Executor backpressure poll: the consumer's cursor."""
+        return self.stream_manager.on_consumed_query(body)
 
     def _store_return_shm(self, oid: ObjectID, sobj: SerializedObject, spec: TaskSpec):
         size = sobj.serialized_size()

@@ -159,14 +159,29 @@ class LLMEngine:
         return all_toks, last, kv, new_lens, rng
 
     def _prefill_fn(self, bucket: int):
+        """Prefill + first-token sampling fused in ONE jitted program.
+
+        Sampling on device keeps admission fully asynchronous: the engine
+        loop never blocks on a host round trip per request (the old
+        ``int(tok[0])`` sync serialized ~1 RTT per admission — the dominant
+        cost of the serving stack on a tunneled chip). The sampled token is
+        returned as a device scalar; the harvest pipeline records it.
+
+        top_k is the ENGINE's (static — per-request values would compile a
+        new program per distinct k, each stalling the loop; decode already
+        uses the engine setting, see submit())."""
         fn = self._prefill_cache.get(bucket)
         if fn is None:
             jax = self._jax
+            top_k = self.cfg.top_k
 
-            def impl(params, kv, page_table, tokens, true_len):
-                return self._kvc.paged_prefill(
+            def impl(params, kv, page_table, tokens, true_len, rng, temp):
+                logits, kv = self._kvc.paged_prefill(
                     params, kv, page_table, tokens, true_len,
                     self.model_cfg, self.cfg.page_size)
+                tok = self._kvc.sample_tokens(
+                    logits[None, :], rng, temp, top_k)
+                return tok[0], kv
 
             fn = jax.jit(impl, donate_argnums=(1,))
             self._prefill_cache[bucket] = fn
@@ -221,12 +236,13 @@ class LLMEngine:
             top_k=self.cfg.top_k if top_k is None else top_k,
             stop_token=getattr(self.tokenizer, "eos_token_id", None))
         if req.top_k != self.cfg.top_k:
-            # the fused decode program samples every slot with the ENGINE's
-            # top_k (static shape; per-slot k would need bucketed programs);
-            # a per-request override only shapes the first (prefill) token
+            # All sampling (prefill first token + fused decode) uses the
+            # ENGINE's top_k: k is static to the compiled programs, and a
+            # per-request k would compile (and loop-stall on) a new program
+            # per distinct value.
             logger.warning(
-                "request top_k=%s differs from engine top_k=%s; decode "
-                "steps use the engine setting", req.top_k, self.cfg.top_k)
+                "request top_k=%s differs from engine top_k=%s; sampling "
+                "uses the engine setting", req.top_k, self.cfg.top_k)
         with self._lock:
             self._requests[req.request_id] = req
             self._waiting.append(req)
@@ -326,8 +342,11 @@ class LLMEngine:
             admitted += 1
 
     def _prefill(self, req: _Request):
+        """Dispatch prefill WITHOUT waiting for it: the sampled first token
+        stays on device (fed to the next decode block as a scatter) and is
+        recorded on the host by the harvest pipeline, in order, like any
+        decode block's tokens."""
         jnp = self._jnp
-        t0 = time.monotonic()
         plen = len(req.prompt_tokens)
         bucket = self._bucket(plen)
         toks = np.full((1, bucket), 0, np.int32)
@@ -335,37 +354,22 @@ class LLMEngine:
         table = np.zeros((self.max_pages_per_seq,), np.int32)
         table[: len(req.pages)] = req.pages
         fn = self._prefill_fn(bucket)
-        logits, self.kv = fn(self.params, self.kv, jnp.asarray(table),
-                             jnp.asarray(toks), jnp.int32(plen))
-        # first generated token comes from the prefill logits
         self._rng, sub = self._jax.random.split(self._rng)
-        tok = self._kvc.sample_tokens(
-            logits[None, :], sub,
-            jnp.asarray([req.temperature], jnp.float32), req.top_k)
-        tok = int(tok[0])
-        done_now = False
+        tok_dev, self.kv = fn(
+            self.params, self.kv, jnp.asarray(table), jnp.asarray(toks),
+            jnp.int32(plen), sub,
+            jnp.asarray([req.temperature], jnp.float32))
         with self._lock:
-            self._record_token(req, tok)
             req.dispatched = 1
-            if req.done:
-                # single-token completion: never occupies a decode slot
-                self.free_slots.append(req.slot)
-                req.slot = -1
-                done_now = True
-            else:
-                self.page_tables[req.slot] = table
-                self.seq_lens[req.slot] = plen
-                self.slot_req[req.slot] = req
-                self._dirty_slots[req.slot] = (plen, req.temperature)
-                # the next decode step feeds this token into the slot (the
-                # on-device token carry knows nothing about fresh prefills)
-                self._overrides[req.slot] = tok
-        if done_now:
-            self.allocator.free(req.pages)
-            req.pages = []
-            req.done_event.set()
+            self.page_tables[req.slot] = table
+            self.seq_lens[req.slot] = plen
+            self.slot_req[req.slot] = req
+            self._dirty_slots[req.slot] = (plen, req.temperature)
+            # the next decode block feeds this token into the slot (the
+            # on-device token carry knows nothing about fresh prefills)
+            self._overrides[req.slot] = tok_dev
+            self._pending.append((tok_dev, [(0, req.slot, req)], 1))
         self.stats["prefills"] += 1
-        _ = t0
 
     def _record_token(self, req: _Request, tok: int) -> None:
         """Append a sampled token; mark done on stop/max. Lock held."""
@@ -397,7 +401,7 @@ class LLMEngine:
         admissions are pending so new requests don't wait a whole block."""
         jnp = self._jnp
         with self._lock:
-            snapshot = [(i, req) for i, req in enumerate(self.slot_req)
+            snapshot = [(i, i, req) for i, req in enumerate(self.slot_req)
                         if req is not None
                         and req.dispatched < req.max_tokens]
             if not snapshot:
@@ -411,7 +415,7 @@ class LLMEngine:
                 else self.cfg.decode_block
             dirty, self._dirty_slots = self._dirty_slots, {}
             overrides, self._overrides = self._overrides, {}
-            for i, req in snapshot:
+            for _col, _slot, req in snapshot:
                 req.dispatched += k
         if dirty:
             order = sorted(dirty)
@@ -426,8 +430,11 @@ class LLMEngine:
         if toks is None:
             toks = jnp.zeros((self.cfg.max_batch_size,), jnp.int32)
         if overrides:
+            # values are device scalars from async prefills: stacking and
+            # scattering them stays on device — no host sync
             oidx = jnp.asarray(list(overrides.keys()), jnp.int32)
-            ovals = jnp.asarray(list(overrides.values()), jnp.int32)
+            ovals = jnp.stack([jnp.asarray(v, jnp.int32)
+                               for v in overrides.values()])
             toks = toks.at[oidx].set(ovals)
         all_toks, last, self.kv, self._sl_dev, self._rng = self._decode(
             self.params, self.kv, self._pt_dev, self._sl_dev, toks,
@@ -440,28 +447,35 @@ class LLMEngine:
         return True
 
     def _harvest_one(self) -> None:
-        """Block on the OLDEST in-flight block's tokens and record them."""
-        dev_toks, snapshot, k = self._pending.pop(0)
+        """Block on the OLDEST in-flight block's tokens and record them.
+
+        Entries are either decode blocks (tokens [k, B], snapshot column ==
+        slot) or prefill first-tokens (scalar, column 0); snapshot rows are
+        (token_column, slot, request)."""
+        with self._lock:
+            if not self._pending:
+                return
+            dev_toks, snapshot, k = self._pending.pop(0)
         host_toks = np.asarray(dev_toks)  # sync point: oldest block only
         host_toks = host_toks.reshape(k, -1)
         finished: list[_Request] = []
         with self._lock:
             for step in range(k):
-                for i, req in snapshot:
+                for col, slot, req in snapshot:
                     if req.done:
                         continue  # stop/max lag: discard overshoot tokens
-                    self._record_token(req, int(host_toks[step, i]))
+                    self._record_token(req, int(host_toks[step, col]))
                     if req.done:
                         finished.append(req)
-                        if self.slot_req[i] is req:
-                            self.slot_req[i] = None
-                            self.free_slots.append(i)
-                            self.page_tables[i] = 0
-                            self.seq_lens[i] = 0
+                        if self.slot_req[slot] is req:
+                            self.slot_req[slot] = None
+                            self.free_slots.append(slot)
+                            self.page_tables[slot] = 0
+                            self.seq_lens[slot] = 0
                             # invalidate the DEVICE row too: a stale device
                             # page table keeps scattering this slot's junk
                             # KV into pages after they're reallocated
-                            self._dirty_slots[i] = (0, 0.0)
+                            self._dirty_slots[slot] = (0, 0.0)
         for req in finished:
             self.allocator.free(req.pages)
             req.pages = []

@@ -48,10 +48,18 @@ class HTTPProxy:
             self._thread = None
 
     def _serve_thread(self):
+        from concurrent.futures import ThreadPoolExecutor
+
         from aiohttp import web
 
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
+        # Blocking calls (router.assign, ray_tpu.get for the whole
+        # generation) run on the loop's default executor. Its stdlib default
+        # is min(32, cpus+4) threads — ~5 on a small host — which silently
+        # caps proxy concurrency far below the replicas' batch capacity.
+        loop.set_default_executor(
+            ThreadPoolExecutor(max_workers=128, thread_name_prefix="proxy-io"))
         self._loop = loop
 
         app = web.Application()

@@ -24,9 +24,18 @@ class ServeReplica:
     def __init__(self, deployment_name: str, serialized_cls, init_args,
                  init_kwargs, user_config, max_ongoing: int):
         import cloudpickle
+        from concurrent.futures import ThreadPoolExecutor
         cls_or_fn = cloudpickle.loads(serialized_cls)
         self._deployment_name = deployment_name
         self._max_ongoing = max_ongoing
+        # Sync callables run on this pool. Sized to max_ongoing: the stdlib
+        # default executor is min(32, cpus+4) threads — ~5 on a small host —
+        # which would cap a replica's real concurrency far below
+        # max_ongoing_requests (e.g. an LLM engine admitting batch 16 would
+        # only ever see ~5 outstanding generations).
+        self._exec = ThreadPoolExecutor(
+            max_workers=max(4, max_ongoing),
+            thread_name_prefix=f"replica-{deployment_name}")
         self._ongoing = 0
         self._total = 0
         self._is_fn = not isinstance(cls_or_fn, type)
@@ -71,7 +80,8 @@ class ServeReplica:
             # generation waiting on the chip) can't starve the event loop —
             # health checks and concurrent requests keep flowing (reference:
             # sync methods execute on the replica's thread pool)
-            result = await asyncio.to_thread(target, *args, **kwargs)
+            result = await asyncio.get_running_loop().run_in_executor(
+                self._exec, lambda: target(*args, **kwargs))
             if inspect.iscoroutine(result):
                 result = await result
             return result
@@ -99,7 +109,9 @@ class ServeReplica:
             elif inspect.isgenerator(result):
                 # drain sync generators on a thread (same loop-starvation
                 # concern as handle_request)
-                chunks.extend(await asyncio.to_thread(list, result))
+                chunks.extend(await asyncio.get_running_loop()
+                              .run_in_executor(self._exec,
+                                               lambda: list(result)))
             else:
                 if inspect.iscoroutine(result):
                     result = await result

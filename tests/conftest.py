@@ -40,6 +40,10 @@ jax.config.update(
 
 import pytest  # noqa: E402
 
+# The suite must be unable to hang: any bare get()/wait() that would block
+# forever raises in minutes instead (inherited by worker subprocesses).
+os.environ.setdefault("RAY_TPU_BLOCKING_WATCHDOG_S", "300")
+
 
 @pytest.fixture(scope="module")
 def ray_start_module():
