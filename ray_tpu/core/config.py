@@ -100,6 +100,13 @@ class Config:
     # cursor (ref: generator_backpressure_num_objects).
     streaming_backpressure_items: int = 16
 
+    # --- data (streaming executor; ref: resource_manager.py budgets) ---
+    # Per-operator cap on BYTES of input blocks with in-flight transform
+    # tasks (a 100 MB block charges 100 MB, not "1 task").
+    data_op_inflight_bytes: int = 128 * 1024 * 1024
+    # Per-operator cap on bytes buffered in its output queue.
+    data_op_output_buffer_bytes: int = 128 * 1024 * 1024
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retries: int = 3

@@ -487,26 +487,31 @@ class SpillingStore:
         for oid in list(self._lru):
             if used + need <= self._high_water:
                 break
-            if oid not in self._sealed:
-                continue
             # grace window: a reader that just fetched this object's meta
             # may still be copying out of the mapping — don't pull the
             # extent out from under it (full safety needs client read
             # leases, plasma client.cc; this closes the practical window)
             if now - self._last_read.get(oid, 0.0) < 5.0:
                 continue
-            out = self._b.read_bytes(oid)
-            if out is None:
-                self._lru.pop(oid, None)
-                continue
-            _total, data = out
-            with open(self._spill_path(oid), "wb") as f:
-                f.write(data)
-            self._b.delete(oid)
-            self._spilled[oid] = len(data)
+            if self._spill_one(oid):
+                used = self._b.stats()["used_bytes"]
+
+    def _spill_one(self, oid: ObjectID) -> bool:
+        """Spill one sealed object to disk. Lock held."""
+        if oid not in self._sealed:
+            return False
+        out = self._b.read_bytes(oid)
+        if out is None:
             self._lru.pop(oid, None)
-            self.num_spilled += 1
-            used = self._b.stats()["used_bytes"]
+            return False
+        _total, data = out
+        with open(self._spill_path(oid), "wb") as f:
+            f.write(data)
+        self._b.delete(oid)
+        self._spilled[oid] = len(data)
+        self._lru.pop(oid, None)
+        self.num_spilled += 1
+        return True
 
     def _restore(self, oid: ObjectID) -> bool:
         """Bring a spilled object back into shm. Lock held."""
@@ -529,9 +534,28 @@ class SpillingStore:
 
     # store interface ----------------------------------------------------
     def create(self, object_id: ObjectID, size: int, device_hint: str = ""):
+        from ray_tpu.exceptions import ObjectStoreFullError
         with self._lock:
             self._maybe_spill(size)
-            name_off = self._b.create(object_id, size, device_hint)
+            while True:
+                try:
+                    name_off = self._b.create(object_id, size, device_hint)
+                    break
+                except ObjectStoreFullError:
+                    if size > self._high_water:
+                        raise  # spilling can never make this fit
+                    # Grace-window skips or arena fragmentation (freed bytes
+                    # but no contiguous extent): force-spill LRU objects one
+                    # at a time — a shuffle burst must grind through disk,
+                    # not fail the task. Only when nothing is left to spill
+                    # is the store truly full.
+                    spilled = False
+                    for oid in list(self._lru):
+                        if self._spill_one(oid):
+                            spilled = True
+                            break
+                    if not spilled:
+                        raise
             self._lru[object_id] = size
             self._pinned[object_id] = True
             return name_off

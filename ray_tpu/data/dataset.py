@@ -113,10 +113,16 @@ class Dataset:
         return self._with(Limit(name=f"Limit({n})", inputs=[self._terminal],
                                 limit=n))
 
-    def repartition(self, num_blocks: int) -> "Dataset":
+    def repartition(self, num_blocks: int, *,
+                    key: Optional[str] = None) -> "Dataset":
+        """Redistribute into ``num_blocks`` blocks. With ``key``, rows are
+        HASH-partitioned on that column (all rows with equal keys land in
+        the same output block — the distributed hash shuffle, reference:
+        _internal/execution/operators/hash_shuffle.py); otherwise blocks are
+        rebalanced round-robin."""
         return self._with(Repartition(name="Repartition",
                                       inputs=[self._terminal],
-                                      num_blocks=num_blocks))
+                                      num_blocks=num_blocks, key=key))
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
         return self._with(RandomShuffle(name="RandomShuffle",

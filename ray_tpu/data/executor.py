@@ -9,10 +9,12 @@ object-store refs between operators; each map stage is a ray_tpu task (or a
 call on a pooled actor for stateful transforms) returning (block, metadata)
 as two refs so the driver schedules on metadata without fetching data.
 
-Backpressure: each operator has a bounded in-flight task budget and a bounded
-output buffer; the terminal output queue is bounded and consumer-driven, so a
-slow consumer stalls the whole pipeline instead of buffering the dataset in
-memory (the reference's resource_manager budget, simplified to counts).
+Backpressure: each operator budgets its in-flight tasks and output buffer by
+BYTES (BlockMetadata.size_bytes) as well as counts, and the executor throttles
+source ops while total buffered bytes exceed a global budget; the terminal
+output queue is bounded and consumer-driven, so a slow consumer stalls the
+whole pipeline instead of buffering the dataset in memory (the reference's
+resource_manager.py budgets).
 """
 
 from __future__ import annotations
@@ -149,6 +151,18 @@ class PhysicalOp:
         self.stats = {"rows": 0, "bytes": 0, "blocks": 0,
                       "start_ts": None, "end_ts": None}
 
+    def _init_budgets(self):
+        """Byte budgets for admission control (reference
+        resource_manager.py); counts alone let a few huge blocks
+        oversubscribe memory."""
+        from ray_tpu.core.config import get_config
+        self._in_flight_bytes = 0
+        self._inflight_budget = get_config().data_op_inflight_bytes
+        self._outbuf_budget = get_config().data_op_output_buffer_bytes
+
+    def _out_bytes(self) -> int:
+        return sum((m.size_bytes or 0) for _, m in self.out)
+
     def record_output(self, meta) -> None:
         s = self.stats
         if s["start_ts"] is None:
@@ -183,7 +197,12 @@ class InputOp(PhysicalOp):
 
 
 class TaskMapOp(PhysicalOp):
-    """Fused task-based map (reference TaskPoolMapOperator)."""
+    """Fused task-based map (reference TaskPoolMapOperator).
+
+    Admission is budgeted by BYTES as well as counts (reference
+    resource_manager.py): a 100 MB block charges its real size against the
+    in-flight and output budgets, so big-block pipelines stop over-
+    subscribing memory long before the count caps bite."""
 
     MAX_IN_FLIGHT = 8
     MAX_OUT_BUFFER = 16
@@ -193,31 +212,36 @@ class TaskMapOp(PhysicalOp):
         super().__init__(name, inputs)
         self._stages = stages
         self._resources = dict(resources or {})
-        self._in_flight: list[tuple] = []  # (block_ref, meta_ref)
+        self._in_flight: list[tuple] = []  # (block_ref, meta_ref, in_bytes)
+        self._init_budgets()
 
     def can_accept(self) -> bool:
         return (len(self._in_flight) < self.MAX_IN_FLIGHT
-                and len(self.out) < self.MAX_OUT_BUFFER)
+                and len(self.out) < self.MAX_OUT_BUFFER
+                and self._in_flight_bytes < self._inflight_budget
+                and self._out_bytes() < self._outbuf_budget)
 
-    def _submit(self, block_ref):
+    def _submit(self, block_ref, in_bytes: int = 0):
         opts = {}
         if self._resources:
             opts["resources"] = self._resources
         b, m = _map_task.options(**opts).remote(block_ref, self._stages)
-        self._in_flight.append((b, m))
+        self._in_flight.append((b, m, in_bytes))
+        self._in_flight_bytes += in_bytes
 
     def add_input(self, bundle: Bundle, input_index: int = 0):
-        self._submit(bundle[0])
+        self._submit(bundle[0], bundle[1].size_bytes or 0)
 
     def poll(self):
         # Emit strictly in submission order (head-of-line) so downstream
         # consumers see a deterministic block order (reference preserve_order).
         while self._in_flight:
-            b, m = self._in_flight[0]
+            b, m, nbytes = self._in_flight[0]
             ready, _ = ray_tpu.wait([m], num_returns=1, timeout=0)
             if not ready:
                 break
             self._in_flight.pop(0)
+            self._in_flight_bytes -= nbytes
             meta = ray_tpu.get(m)
             self.out.append((b, meta))
         if self._inputs_done and not self._in_flight:
@@ -237,31 +261,38 @@ class ActorMapOp(PhysicalOp):
         opts = {"resources": dict(resources)} if resources else {}
         self._actors = [_MapWorker.options(**opts).remote(stages)
                         for _ in range(num_actors)]
-        self._in_flight: list = []
+        self._in_flight: list = []  # (result_ref, in_bytes)
+        self._init_budgets()
         self._next = 0
         self._shutdown = False
 
     def can_accept(self) -> bool:
-        return len(self._in_flight) < len(self._actors) * self.MAX_IN_FLIGHT_PER_ACTOR
+        return (len(self._in_flight)
+                < len(self._actors) * self.MAX_IN_FLIGHT_PER_ACTOR
+                and self._in_flight_bytes < self._inflight_budget)
 
     def add_input(self, bundle: Bundle, input_index: int = 0):
         actor = self._actors[self._next % len(self._actors)]
         self._next += 1
-        self._in_flight.append(actor.map.remote(bundle[0]))
+        nbytes = bundle[1].size_bytes or 0
+        self._in_flight.append((actor.map.remote(bundle[0]), nbytes))
+        self._in_flight_bytes += nbytes
 
     def poll(self):
         if self._shutdown:
             # actors were killed (early-exit / executor stop): drop in-flight
             # refs instead of get()ing results from dead actors
             self._in_flight = []
+            self._in_flight_bytes = 0
             self.done = True
             return
         while self._in_flight:
-            ref = self._in_flight[0]
+            ref, nbytes = self._in_flight[0]
             ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
             if not ready:
                 break
             self._in_flight.pop(0)
+            self._in_flight_bytes -= nbytes
             block, meta = ray_tpu.get(ref)
             self.out.append((ray_tpu.put(block), meta))
         if self._inputs_done and not self._in_flight:
@@ -284,6 +315,10 @@ class ReadOp(TaskMapOp):
         self._stages = []
         self._resources = {}
         self._in_flight = []
+        # in-flight READS are not byte-budgeted (block sizes are unknown
+        # until the task returns metadata); the output-buffer byte cap and
+        # the executor's global source throttle bound read memory instead
+        self._init_budgets()
         self._pending = list(read_tasks)
         self._inputs_done = True
 
@@ -293,7 +328,8 @@ class ReadOp(TaskMapOp):
     def poll(self):
         while not self.throttled and self._pending \
                 and len(self._in_flight) < self.MAX_IN_FLIGHT \
-                and len(self.out) < self.MAX_OUT_BUFFER:
+                and len(self.out) < self.MAX_OUT_BUFFER \
+                and self._out_bytes() < self._outbuf_budget:
             task = self._pending.pop(0)
             self._in_flight.append(_read_task.remote(task))
         while self._in_flight:
@@ -441,7 +477,11 @@ def _stable_hash(x) -> int:
 @ray_tpu.remote
 def _partition_task(block: Block, n: int, how: str, key=None, seed=None,
                     bounds=None):
-    """Split one block into n parts (round-robin / random / hash / range)."""
+    """Split one block into n parts (round-robin / random / hash / range).
+
+    Callers invoke it with ``options(num_returns=n)``: each shard becomes
+    its OWN object-store ref, so shuffles move refs — the driver never
+    materializes partition data (reference hash_shuffle.py map side)."""
     acc = BlockAccessor.for_block(block)
     rows = acc.num_rows()
     if how == "round":
@@ -461,7 +501,25 @@ def _partition_task(block: Block, n: int, how: str, key=None, seed=None,
     return [acc.take_indices(np.nonzero(assign == i)[0]) for i in range(n)]
 
 
+def _partition_refs(bundles, n: int, how: str, key=None, seed=None,
+                    bounds=None) -> list[list]:
+    """Map side of a shuffle: per input block, n shard REFS (no driver
+    materialization)."""
+    if n == 1:
+        # every row lands in shard 0 regardless of `how` — the shard IS the
+        # input block (num_returns=1 would wrap the 1-element list as the
+        # single return value)
+        return [[b] for b, _ in bundles]
+    return [list(_partition_task.options(num_returns=n).remote(
+        b, n, how, key, seed, bounds)) for b, _ in bundles]
+
+
 class RepartitionOp(AllToAllOp):
+    """Distributed shuffle (round-robin / random / HASH): map tasks emit one
+    shard ref per output partition, reduce tasks concat their shard refs —
+    data moves store-to-store, never through the driver (reference
+    hash_shuffle.py map/reduce split)."""
+
     def __init__(self, name, inputs, num_blocks: int, how: str = "round",
                  key=None, seed=None, local_shuffle: bool = False):
         super().__init__(name, inputs)
@@ -474,13 +532,10 @@ class RepartitionOp(AllToAllOp):
         n = self._n
         if not bundles:
             return
-        part_refs = [_partition_task.remote(b, n, self._how, self._key,
-                                            self._seed) for b, _ in bundles]
-        parts = ray_tpu.get(part_refs)  # list (per input block) of n blocks
+        parts = _partition_refs(bundles, n, self._how, self._key, self._seed)
         for i in range(n):
-            shard = [p[i] for p in parts]
-            refs = [ray_tpu.put(s) for s in shard]
-            self._phase2.append(_concat_task.remote(*refs))
+            shard_refs = [p[i] for p in parts]
+            self._phase2.append(_concat_task.remote(*shard_refs))
 
 
 class SortOp(AllToAllOp):
@@ -496,27 +551,21 @@ class SortOp(AllToAllOp):
         if not bundles:
             return
         n = max(1, len(bundles))
-        blocks = [ray_tpu.get(b) for b, _ in bundles]
-        samples = []
-        for blk in blocks:
-            acc = BlockAccessor.for_block(blk)
-            if acc.num_rows():
-                samples.append(acc.sample(min(20, acc.num_rows()))
-                               .column(self._key).to_numpy(zero_copy_only=False))
+        # sample remotely: the driver sees only the samples, never the data
+        samples = ray_tpu.get([_sample_task.remote(b, self._key)
+                               for b, _ in bundles])
+        samples = [s for s in samples if len(s)]
         if not samples:
             return
         allsamp = np.sort(np.concatenate(samples))
         bounds = [allsamp[int(len(allsamp) * (i + 1) / n)]
                   for i in range(n - 1)] if n > 1 else []
-        part_refs = [_partition_task.remote(b, n, "range", self._key, None,
-                                            bounds) for b, _ in bundles]
-        parts = ray_tpu.get(part_refs)
+        parts = _partition_refs(bundles, n, "range", self._key, None, bounds)
         order = range(n - 1, -1, -1) if self._desc else range(n)
         for i in order:
-            shard = [p[i] for p in parts]
-            refs = [ray_tpu.put(s) for s in shard]
+            shard_refs = [p[i] for p in parts]
             self._phase2.append(_sort_merge_task.remote(
-                self._key, self._desc, *refs))
+                self._key, self._desc, *shard_refs))
 
 
 @ray_tpu.remote(num_returns=2)
@@ -524,6 +573,15 @@ def _sort_merge_task(key: str, descending: bool, *blocks):
     out = BlockAccessor.concat(list(blocks))
     out = BlockAccessor.for_block(out).sort(key, descending)
     return out, BlockAccessor.for_block(out).metadata()
+
+
+@ray_tpu.remote
+def _sample_task(block: Block, key: str, k: int = 20):
+    acc = BlockAccessor.for_block(block)
+    if not acc.num_rows():
+        return np.empty((0,))
+    return acc.sample(min(k, acc.num_rows())) \
+        .column(key).to_numpy(zero_copy_only=False)
 
 
 class AggregateOp(AllToAllOp):
@@ -544,14 +602,11 @@ class AggregateOp(AllToAllOp):
                 None, self._aggs, *refs))
             return
         n = min(4, len(bundles))
-        part_refs = [_partition_task.remote(b, n, "hash", self._key)
-                     for b, _ in bundles]
-        parts = ray_tpu.get(part_refs)
+        parts = _partition_refs(bundles, n, "hash", self._key)
         for i in range(n):
-            shard = [p[i] for p in parts]
-            refs = [ray_tpu.put(s) for s in shard]
+            shard_refs = [p[i] for p in parts]
             self._phase2.append(_aggregate_task.remote(
-                self._key, self._aggs, *refs))
+                self._key, self._aggs, *shard_refs))
 
 
 @ray_tpu.remote(num_returns=2)
@@ -587,15 +642,13 @@ class JoinOp(AllToAllOp):
 
     def _run(self, _bundles):
         n = self._n or max(1, max(len(self._left), len(self._right)))
-        lparts = ray_tpu.get(
-            [_partition_task.remote(b, n, "hash", self._on)
-             for b, _ in self._left]) if self._left else []
-        rparts = ray_tpu.get(
-            [_partition_task.remote(b, n, "hash", self._right_on)
-             for b, _ in self._right]) if self._right else []
+        lparts = _partition_refs(self._left, n, "hash", self._on) \
+            if self._left else []
+        rparts = _partition_refs(self._right, n, "hash", self._right_on) \
+            if self._right else []
         for i in range(n):
-            lrefs = [ray_tpu.put(p[i]) for p in lparts]
-            rrefs = [ray_tpu.put(p[i]) for p in rparts]
+            lrefs = [p[i] for p in lparts]
+            rrefs = [p[i] for p in rparts]
             if not lrefs and not rrefs:
                 continue
             self._phase2.append(_join_task.remote(
@@ -644,6 +697,7 @@ class WriteOp(TaskMapOp):
         self._stages = []
         self._resources = {}
         self._in_flight = []
+        self._init_budgets()
         self._path = path
         self._fmt = file_format
         self._index = 0
@@ -651,7 +705,9 @@ class WriteOp(TaskMapOp):
     def add_input(self, bundle: Bundle, input_index: int = 0):
         b, m = _write_task.remote(bundle[0], self._path, self._fmt, self._index)
         self._index += 1
-        self._in_flight.append((b, m))
+        nbytes = bundle[1].size_bytes or 0
+        self._in_flight.append((b, m, nbytes))
+        self._in_flight_bytes += nbytes
 
 
 @ray_tpu.remote(num_returns=2)
@@ -686,7 +742,9 @@ def build_physical(plan: LogicalPlan, parallelism: int) -> list[PhysicalOp]:
         elif isinstance(lop, Limit):
             op = LimitOp(lop.name or "Limit", phys_inputs, lop.limit)
         elif isinstance(lop, Repartition):
-            op = RepartitionOp("Repartition", phys_inputs, lop.num_blocks)
+            op = RepartitionOp(
+                "Repartition", phys_inputs, lop.num_blocks,
+                how="hash" if lop.key else "round", key=lop.key)
         elif isinstance(lop, RandomShuffle):
             op = RepartitionOp("RandomShuffle", phys_inputs,
                                max(1, parallelism), how="random",

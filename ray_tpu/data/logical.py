@@ -83,6 +83,9 @@ class Limit(LogicalOp):
 @dataclasses.dataclass
 class Repartition(LogicalOp):
     num_blocks: int = 1
+    # hash-partition on this column instead of round-robin (reference:
+    # _internal/execution/operators/hash_shuffle.py)
+    key: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -297,3 +297,72 @@ def test_executor_error_propagates(rt):
 
     with pytest.raises(Exception, match="kaboom"):
         rtd.range(10).map_batches(boom).take_all()
+
+
+def test_hash_repartition_colocates_keys(rt):
+    """repartition(key=...) is a hash shuffle: all rows with equal keys land
+    in the same output block (reference hash_shuffle.py semantics)."""
+    ds = rtd.range(1000, parallelism=8).map_batches(
+        lambda b: {"id": b["id"], "key": b["id"] % 7})
+    out = ds.repartition(4, key="key")
+    per_block = out.map_batches(
+        lambda b: {"keys": np.unique(np.asarray(b["key"])),
+                   "n": np.full(len(np.unique(np.asarray(b["key"]))),
+                                len(b["key"]))})
+    rows = per_block.take_all()
+    seen: dict = {}
+    for r in rows:
+        assert r["keys"] not in seen, \
+            f"key {r['keys']} appears in multiple output blocks"
+        seen[r["keys"]] = True
+    assert len(seen) == 7
+    assert out.count() == 1000
+
+
+def test_distributed_hash_shuffle_1gb_two_nodes():
+    """VERDICT r2 #7: shuffle >=1 GB across a 2-node cluster under per-node
+    object-store caps. The shuffle moves shard REFS (map emits one ref per
+    output partition; reduce concats) — partition data never passes through
+    the driver (reference hash_shuffle.py map/reduce split)."""
+    from ray_tpu.core.cluster import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cap = 3 * (1 << 30) // 2  # 1.5 GiB per node store
+    cluster.add_node(num_cpus=2, object_store_memory=cap)
+    cluster.add_node(num_cpus=2, object_store_memory=cap)
+    ray_tpu.init(address=cluster.address)
+    try:
+        n_rows = 1 << 26  # 64M rows -> id+key columns = 1 GiB into shuffle
+        n_keys = 64
+        ds = rtd.range(n_rows, parallelism=16).map_batches(
+            lambda b: {"id": b["id"], "key": b["id"] % n_keys})
+        out = ds.repartition(8, key="key")
+        # verify without materializing at the driver: per-output-block key
+        # sets (small) + conserved row count
+        per_block = out.map_batches(
+            lambda b: {"keys": np.unique(np.asarray(b["key"]))})
+        key_sets = [set(np.atleast_1d(r["keys"]).tolist())
+                    for r in per_block.take_all()]
+        merged: set = set()
+        # a key appears in exactly one output block (keys within one output
+        # block may span multiple source blocks -> true shuffle happened)
+        flat = [k for s in key_sets for k in set(s)]
+        assert len(flat) == len(set(flat)), "key split across output blocks"
+        for s in key_sets:
+            merged |= s
+        assert merged == set(range(n_keys))
+        assert out.count() == n_rows
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_repartition_single_block(rt):
+    """n=1 shuffle: the shard is the input block itself (regression: the
+    num_returns=1 path wrapped the 1-element shard list as one object)."""
+    assert rtd.range(50, parallelism=4).repartition(1).count() == 50
+    ds = rtd.range(20, parallelism=2).map_batches(
+        lambda b: {"g": b["id"] % 2, "v": b["id"]})
+    one = ds.groupby("g").sum("v").take_all()
+    assert sum(r["sum(v)"] for r in one) == sum(range(20))
