@@ -23,9 +23,9 @@ _NEG_INF = -1e30
 _STATS_LANES = 128  # stats tiles are [block_q, 128] to satisfy TPU tiling
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  sm_scale: float, causal: bool, block_q: int, block_k: int,
-                  num_k_blocks: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                  *, sm_scale: float, causal: bool, block_q: int,
+                  block_k: int, num_k_blocks: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -67,6 +67,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l = l_scr[:, 0]
         l = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        # log-sum-exp per row, consumed by the backward kernels (FA2).
+        # Shape [bq, 1]: TPU block tiling wants the last two dims divisible
+        # by (8, 128) or equal to the array dims — a trailing singleton
+        # axis satisfies that and broadcasts cleanly in the backward.
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(l))[:, None]
 
 
 def _flash_bh(q, k, v, *, causal: bool, sm_scale: float, block_q: int,
@@ -93,8 +98,14 @@ def _flash_bh(q, k, v, *, causal: bool, sm_scale: float, block_q: int,
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),  # lse
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # running max
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),  # running sum
@@ -106,94 +117,217 @@ def _flash_bh(q, k, v, *, causal: bool, sm_scale: float, block_q: int,
     )(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_core(q, k, v, causal, sm_scale, block_q, block_k,
-                          interpret):
-    b, t, h, d = q.shape
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), causal=causal,
-                    sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-                    interpret=interpret)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_scr, dv_scr, *,
+                           sm_scale: float, causal: bool, block_q: int,
+                           block_k: int, num_q_blocks: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # causal: this whole q-block precedes the k-block → no contribution
+    needed = (not causal) or (q_start + block_q - 1 >= k_start)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                           # [bq, bk]
+        # dv += pᵀ · dO
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp = dO · vᵀ ; ds = p (dp - delta) · scale
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0]) * sm_scale).astype(q.dtype)
+        # dk += dsᵀ · q
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, sm_scale: float, causal: bool,
+                         block_q: int, block_k: int, num_k_blocks: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    needed = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0]) * sm_scale).astype(q.dtype)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_bh(q, k, v, g, lse, delta, *, causal: bool, sm_scale: float,
+                  block_q: int, block_k: int, interpret: bool):
+    """Pallas flash backward over [BH, T, D] inputs → (dq, dk, dv).
+
+    Two kernels (the canonical FA2 split): dk/dv accumulate over q blocks
+    with the k block resident in VMEM; dq accumulates over k blocks. Both
+    recompute p from (q, k, lse) — nothing [T, T]-shaped ever exists, and
+    every matmul runs on the MXU in the input dtype with fp32 accumulation.
+    Replaces a pure-JAX blockwise backward whose [B,H,T,block] fp32
+    intermediates ran the train-step backward at ~2% MXU utilization (it
+    was ~24% of the whole train step at 1.5B scale)."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    if t_q % block_q or t_k % block_k:
+        raise ValueError(f"seq lens ({t_q},{t_k}) must divide blocks "
+                         f"({block_q},{block_k})")
+    num_q = t_q // block_q
+    num_k = t_k // block_k
+
+    kv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),   # g
+        pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),   # lse
+        pl.BlockSpec((1, block_q, 1), lambda b, ki, qi: (b, qi, 0)),   # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q_blocks=num_q),
+        grid=(bh, num_k, num_q),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),   # g
+        pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),   # lse
+        pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),   # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=num_k),
+        grid=(bh, num_q, num_k),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = _flash_attention_core(q, k, v, causal, sm_scale, block_q, block_k,
-                                interpret)
-    return out, (q, k, v, out)
+    from jax.ad_checkpoint import checkpoint_name
+    b, t, h, d = q.shape
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    out_bh, lse = _flash_bh(to_bh(q), to_bh(k), to_bh(v), causal=causal,
+                            sm_scale=sm_scale, block_q=block_q,
+                            block_k=block_k, interpret=interpret)
+    out = out_bh.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    # "attn_lse" lets remat policies save the softmax stats so the backward
+    # does not re-run the forward kernel just to rebuild them (the output
+    # residual aliases the primal, which callers tag "attn").
+    lse = checkpoint_name(lse, "attn_lse")
+    return out, (q, k, v, out, lse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_core(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret):
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret)[0]
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    """Blockwise-recompute backward (flash-attention-2 style), pure JAX:
-    scans over k/v blocks so peak memory is O(T·block) not O(T²); every op
-    is a batched matmul the MXU likes. Recomputes the softmax normalizer
-    from scratch (two passes) instead of saving per-row stats — trades a
-    forward-shaped matmul for not materializing [T,T] anywhere."""
-    q, k, v, out = res
+    """Pallas flash-attention backward (FA2): p is recomputed per block from
+    (q, k) + the forward's saved log-sum-exp; delta = rowsum(dO · O)."""
+    q, k, v, out, lse = res
     b, t_q, h, d = q.shape
     t_k = k.shape[1]
-    bk = min(block_k, t_k)
-    n_blocks = t_k // bk if t_k % bk == 0 else 1
-    if t_k % bk:
-        bk = t_k
-
-    # Matmuls stay in the inputs' dtype (bf16 on TPU) with fp32 ACCUMULATION
-    # via preferred_element_type — an fp32 cast before the einsum would push
-    # the whole backward off the bf16 MXU path (4x+ slower on v5e).
-    acc32 = dict(preferred_element_type=jnp.float32)
-    g32 = g.astype(jnp.float32)
-    # delta_i = sum_j P_ij * dP_ij = rowsum(dO * O)  (flash-attn-2 trick)
-    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)  # [B,T,H]
-
-    # pass 1: softmax stats (m, l) per q row, streaming over k blocks
-    def stats_body(carry, kb):
-        m_prev, l_prev = carry
-        k_blk, start = kb
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk, **acc32) * sm_scale
-        if causal:
-            rows = jnp.arange(t_q)[:, None]
-            cols = start + jnp.arange(bk)[None, :]
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        l_new = l_prev * jnp.exp(m_prev - m_new) + \
-            jnp.sum(jnp.exp(s - m_new[..., None]), axis=-1)
-        return (m_new, l_new), None
-
-    starts = jnp.arange(n_blocks) * bk
-    k_blocks = k.reshape(b, n_blocks, bk, h, d).transpose(1, 0, 2, 3, 4)
-    v_blocks = v.reshape(b, n_blocks, bk, h, d).transpose(1, 0, 2, 3, 4)
-    m0 = jnp.full((b, h, t_q), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t_q), jnp.float32)
-    (m, l), _ = jax.lax.scan(stats_body, (m0, l0), (k_blocks, starts))
-    l = jnp.where(l > 0, l, 1.0)
-
-    # pass 2: accumulate dq; emit dk/dv per block
-    def grad_body(dq_acc, kb):
-        k_blk, v_blk, start = kb
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk, **acc32) * sm_scale
-        if causal:
-            rows = jnp.arange(t_q)[:, None]
-            cols = start + jnp.arange(bk)[None, :]
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - m[..., None]) / l[..., None]          # [B,H,Tq,bk]
-        dp = jnp.einsum("bqhd,bkhd->bhqk", g, v_blk, **acc32)
-        ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * sm_scale
-        # cast the [T, bk] factors down to the input dtype for the second-
-        # stage matmuls (standard flash-attention practice; accumulation
-        # stays fp32)
-        p_lo = p.astype(q.dtype)
-        ds_lo = ds.astype(q.dtype)
-        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds_lo, k_blk, **acc32)
-        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds_lo, q, **acc32)
-        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p_lo, g, **acc32)
-        return dq_acc, (dk_blk, dv_blk)
-
-    dq0 = jnp.zeros((b, t_q, h, d), jnp.float32)
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        grad_body, dq0, (k_blocks, v_blocks, starts))
-    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h, d)
-    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    g_bh = to_bh(g)
+    delta = jnp.sum(g_bh.astype(jnp.float32) *
+                    to_bh(out).astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, Tq, 1]
+    dq, dk, dv = _flash_bwd_bh(
+        to_bh(q), to_bh(k), to_bh(v), g_bh, lse, delta,
+        causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    from_bh = lambda x, t: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return (from_bh(dq, t_q).astype(q.dtype),
+            from_bh(dk, t_k).astype(k.dtype),
+            from_bh(dv, t_k).astype(v.dtype))
 
 
 _flash_attention_core.defvjp(_flash_fwd, _flash_bwd)
