@@ -62,11 +62,13 @@ def main():
             max_prompt_len=256, max_seq_len=512,
             max_tokens=args.max_tokens)
     else:
-        # ~1.2B on one v5e chip, bf16 weights + paged bf16 KV
+        # ~1.2B on one v5e chip, bf16 weights + paged bf16 KV. 32 decode
+        # slots: admission must keep up with the offered concurrency or
+        # TTFT becomes queue wait (r3: b16 under 32-deep load queued ~7s)
         model_cfg = llama.llama3_1b(max_seq_len=2048)
         llm_cfg = LLMConfig(
             model_id="llama3-1b", model_config=model_cfg,
-            max_batch_size=16, page_size=128, num_pages=288,
+            max_batch_size=32, page_size=128, num_pages=288,
             max_prompt_len=1024, max_seq_len=2048,
             max_tokens=args.max_tokens,
             ray_actor_options={"resources": {"TPU": 1}})

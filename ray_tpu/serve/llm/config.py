@@ -36,6 +36,16 @@ class LLMConfig:
     # which matters enormously when the chip sits behind a network tunnel.
     # Streaming granularity and stop-token lag grow with it.
     decode_block: int = 8
+    # dispatched-but-unharvested decode blocks. TTFT under load is bounded
+    # below by pipeline_depth * decode_block * step_time (a fresh prefill
+    # executes behind the in-flight blocks), so latency-sensitive configs
+    # at large batch want SMALL blocks and a shallow pipeline; pure
+    # throughput wants them big/deep to amortize dispatch RTT.
+    pipeline_depth: int = 3
+
+    # compile all (bucket width, block) decode programs at start() instead
+    # of on first use mid-traffic (a compile stalls every active request)
+    warmup_compile: bool = True
 
     # sampling defaults (overridable per request)
     max_tokens: int = 128

@@ -111,52 +111,73 @@ class LLMEngine:
         # behind. Token latency then tracks step execution time instead of
         # the host<->device round trip (which dominates through the axon
         # tunnel: ~280ms/step synced vs ~10-30ms/step pipelined).
-        self.PIPELINE_DEPTH = 3
-        self._pending: list = []       # [(dev_tokens, [(slot, req)])]
-        self._dev_tokens = None        # [B] device array, last dispatched
+        self.PIPELINE_DEPTH = cfg.pipeline_depth
+        self._pending: list = []   # [(dev_tokens, [(col, slot, req)], k)]
+        self._dev_tokens = None    # [B+1] device array (incl. trash row)
         self._overrides: dict[int, int] = {}  # slot -> first token (prefill)
         # device-resident decode state (page tables / seq lens / temps);
         # slot admissions mark entries dirty and patch them with one small
-        # update before the next dispatch
-        self._pt_dev = jnp.zeros_like(jnp.asarray(self.page_tables))
-        self._sl_dev = jnp.zeros((b,), jnp.int32)
-        self._temps_dev = jnp.zeros((b,), jnp.float32)
+        # update before the next dispatch. Row b (one past the last slot)
+        # is a PERMANENT TRASH ROW: bucketed dispatch pads its packed slot
+        # index vector with it, so padding lanes write into the trash page
+        # (page-table row of zeros) instead of any live slot's KV.
+        self._pt_dev = jnp.zeros((b + 1, self.max_pages_per_seq), jnp.int32)
+        self._sl_dev = jnp.zeros((b + 1,), jnp.int32)
+        self._temps_dev = jnp.zeros((b + 1,), jnp.float32)
         self._dirty_slots: dict[int, tuple] = {}  # slot -> (seq_len, temp)
 
         # jitted programs. The KV pool is DONATED: it's the dominant HBM
         # allocation and the step rewrites it in place — without donation
         # every step would materialize a second full pool (2x HBM + a full
-        # pool copy of bandwidth per token).
+        # pool copy of bandwidth per token). The decode program gathers the
+        # packed active rows by index on device, runs the fused block at the
+        # PACKED width, and scatters the carried state back — one program
+        # per (bucket width, block length), so a lightly loaded engine pays
+        # for the requests it has, not for max_batch_size.
         self._decode = jax.jit(
-            lambda params, kv, pt, sl, toks, rng, temp, n: self._decode_impl(
-                params, kv, pt, sl, toks, rng, temp, n),
-            donate_argnums=(1, 3), static_argnums=(7,))
+            lambda params, kv, pt, sl, toks, rng, temp, idx, n:
+            self._decode_impl(params, kv, pt, sl, toks, rng, temp, idx, n),
+            donate_argnums=(1, 3, 4), static_argnums=(8,))
         self._prefill_cache: dict[int, Any] = {}
 
     # ---- compiled impls ------------------------------------------------
-    def _decode_impl(self, params, kv, page_tables, seq_lens, tokens, rng,
-                     temperature, num_steps: int = 1):
-        """num_steps fused decode iterations in ONE program (lax.scan).
+    def _decode_impl(self, params, kv, pt_full, sl_full, toks_full, rng,
+                     temps_full, idx, num_steps: int = 1):
+        """num_steps fused decode iterations in ONE program (lax.scan), at
+        the PACKED width ``len(idx)``.
 
         On a tunneled chip each host->device dispatch costs a round trip;
         fusing K steps amortizes it to RTT/K per token (the standard TPU
-        serving shape — cf. multi-step decode in TPU LLM stacks). Returns
-        all K sampled tokens [K, B] plus the carried state."""
+        serving shape — cf. multi-step decode in TPU LLM stacks). ``idx``
+        selects the active slots (padded with the trash row); the gather /
+        scatter of the [W]-sized state stays on device. Returns all K
+        sampled tokens [K, W] plus the full-size carried state."""
         jax = self._jax
+        jnp = self._jnp
+        pt = pt_full[idx]
+        lens0 = sl_full[idx]
+        toks0 = toks_full[idx]
+        temps = temps_full[idx]
 
         def one(carry, _):
             kv_c, lens, toks, key = carry
             key, sub = jax.random.split(key)
             logits, kv_c, lens = self._kvc.paged_decode_step(
-                params, kv_c, page_tables, lens, toks, self.model_cfg,
+                params, kv_c, pt, lens, toks, self.model_cfg,
                 self.cfg.page_size)
             toks = self._kvc.sample_tokens(
-                logits, sub, temperature, self.cfg.top_k)
+                logits, sub, temps, self.cfg.top_k)
             return (kv_c, lens, toks, key), toks
 
         (kv, new_lens, last, rng), all_toks = jax.lax.scan(
-            one, (kv, seq_lens, tokens, rng), None, length=num_steps)
-        return all_toks, last, kv, new_lens, rng
+            one, (kv, lens0, toks0, rng), None, length=num_steps)
+        # padding lanes must not accumulate garbage into the trash row
+        # (its seq_len would creep toward int32 overflow on a long-lived
+        # engine): pin it back to zero on scatter
+        trash = self.cfg.max_batch_size
+        sl_full = sl_full.at[idx].set(jnp.where(idx == trash, 0, new_lens))
+        toks_full = toks_full.at[idx].set(last)
+        return all_toks, toks_full, kv, sl_full, rng
 
     def _prefill_fn(self, bucket: int):
         """Prefill + first-token sampling fused in ONE jitted program.
@@ -190,9 +211,35 @@ class LLMEngine:
     # ---- public API ----------------------------------------------------
     def start(self):
         if self._loop_thread is None:
+            if self.cfg.warmup_compile:
+                self._warmup_decode_programs()
             self._loop_thread = threading.Thread(
                 target=self._loop, name="llm-engine", daemon=True)
             self._loop_thread.start()
+
+    def _warmup_decode_programs(self):
+        """Compile every (bucket width, block length) decode program before
+        serving: a first-use compile mid-traffic stalls ALL active
+        generations for the whole XLA compile (tens of seconds on a
+        tunneled chip) and wrecks tail latency. All-trash index vectors
+        make the warmup dispatches write only into the trash page."""
+        jnp = self._jnp
+        trash = self.cfg.max_batch_size
+        # derive from _bucket_width so the warmed set can never diverge
+        # from the widths _step actually dispatches
+        widths = sorted({self._bucket_width(n)
+                         for n in range(1, self.cfg.max_batch_size + 1)})
+        toks = self._dev_tokens
+        if toks is None:
+            toks = jnp.zeros((self.cfg.max_batch_size + 1,), jnp.int32)
+        for w in widths:
+            idx = jnp.full((w,), trash, jnp.int32)
+            for k in {1, self.cfg.decode_block}:
+                _all, toks, self.kv, self._sl_dev, self._rng = self._decode(
+                    self.params, self.kv, self._pt_dev, self._sl_dev,
+                    toks, self._rng, self._temps_dev, idx, k)
+        self._dev_tokens = toks
+        self._jax.block_until_ready(toks)
 
     def shutdown(self):
         self._stop.set()
@@ -307,6 +354,12 @@ class LLMEngine:
         while not self._stop.is_set():
             self._admit()
             dispatched = self._step()
+            # Eager harvest: pop every block whose device result already
+            # landed (is_ready) — holding computed tokens unharvested just
+            # adds their age to TTFT/ITL. The blocking PIPELINE_DEPTH trim
+            # in _step still bounds the queue when results are slow.
+            while self._pending and self._ready(self._pending[0][0]):
+                self._harvest_one()
             if not dispatched:
                 if self._pending:
                     self._harvest_one()  # drain the pipeline tail
@@ -314,11 +367,26 @@ class LLMEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
+    @staticmethod
+    def _ready(dev_arr) -> bool:
+        try:
+            return dev_arr.is_ready()
+        except AttributeError:  # older jax: no readiness API
+            return False
+
     def _bucket(self, n: int) -> int:
         b = 16
         while b < n:
             b *= 2
         return min(b, self.cfg.max_prompt_len)
+
+    def _bucket_width(self, n: int) -> int:
+        """Packed decode width: smallest power-of-two ≥ n (floor 4), capped
+        at max_batch_size — a handful of compiled widths total."""
+        w = 4
+        while w < n:
+            w *= 2
+        return min(w, self.cfg.max_batch_size)
 
     def _admit(self) -> int:
         """Move waiting requests into free slots (prefill each)."""
@@ -419,16 +487,16 @@ class LLMEngine:
                 req.dispatched += k
         if dirty:
             order = sorted(dirty)
-            idx = jnp.asarray(order, jnp.int32)
-            self._pt_dev = self._pt_dev.at[idx].set(
+            didx = jnp.asarray(order, jnp.int32)
+            self._pt_dev = self._pt_dev.at[didx].set(
                 jnp.asarray(self.page_tables[order]))
-            self._sl_dev = self._sl_dev.at[idx].set(
+            self._sl_dev = self._sl_dev.at[didx].set(
                 jnp.asarray([dirty[i][0] for i in order], jnp.int32))
-            self._temps_dev = self._temps_dev.at[idx].set(
+            self._temps_dev = self._temps_dev.at[didx].set(
                 jnp.asarray([dirty[i][1] for i in order], jnp.float32))
         toks = self._dev_tokens
         if toks is None:
-            toks = jnp.zeros((self.cfg.max_batch_size,), jnp.int32)
+            toks = jnp.zeros((self.cfg.max_batch_size + 1,), jnp.int32)
         if overrides:
             # values are device scalars from async prefills: stacking and
             # scattering them stays on device — no host sync
@@ -436,10 +504,18 @@ class LLMEngine:
             ovals = jnp.stack([jnp.asarray(v, jnp.int32)
                                for v in overrides.values()])
             toks = toks.at[oidx].set(ovals)
-        all_toks, last, self.kv, self._sl_dev, self._rng = self._decode(
-            self.params, self.kv, self._pt_dev, self._sl_dev, toks,
-            self._rng, self._temps_dev, k)
-        self._dev_tokens = last
+        # bucketed width: pack the active slots, pad with the trash row —
+        # a lightly loaded engine runs a narrow program
+        active_slots = [slot for _c, slot, _r in snapshot]
+        w = self._bucket_width(len(active_slots))
+        trash = self.cfg.max_batch_size
+        idx = jnp.asarray(
+            active_slots + [trash] * (w - len(active_slots)), jnp.int32)
+        snapshot = [(col, slot, req)
+                    for col, (_c, slot, req) in enumerate(snapshot)]
+        all_toks, self._dev_tokens, self.kv, self._sl_dev, self._rng = \
+            self._decode(self.params, self.kv, self._pt_dev, self._sl_dev,
+                         toks, self._rng, self._temps_dev, idx, k)
         self._pending.append((all_toks, snapshot, k))
         self.stats["steps"] += k
         if len(self._pending) > self.PIPELINE_DEPTH:
@@ -449,8 +525,10 @@ class LLMEngine:
     def _harvest_one(self) -> None:
         """Block on the OLDEST in-flight block's tokens and record them.
 
-        Entries are either decode blocks (tokens [k, B], snapshot column ==
-        slot) or prefill first-tokens (scalar, column 0); snapshot rows are
+        Entries are either decode blocks (tokens [k, W] at the PACKED
+        bucket width — the column is the request's position in that
+        block's packed index vector, NOT its slot id) or prefill
+        first-tokens (scalar, column 0); snapshot rows are
         (token_column, slot, request)."""
         with self._lock:
             if not self._pending:
