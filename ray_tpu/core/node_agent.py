@@ -344,12 +344,26 @@ class NodeAgent:
             0.5, body.get("timeout", cfg.lease_timeout_s))
         reserved = False
         spawned = False
+        spawned_wid = None  # THIS lease's spawn (reap is per-lease)
         try:
             while not self._stopped.is_set():
                 need_spawn = False
                 try_redirect = False
                 evict_proc = None
                 with self._lock:
+                    # reap spawns that died BEFORE registering (e.g. killed
+                    # by chaos mid-boot): without this, `spawned` stays set
+                    # and the lease waits out its full timeout on a corpse.
+                    # Only OUR OWN dead spawn resets our flag — resetting on
+                    # any death would double-spawn for other live leases.
+                    dead = [wid for wid, i in self._workers.items()
+                            if i.proc is not None and i.addr is None
+                            and i.proc.poll() is not None]
+                    for wid in dead:
+                        del self._workers[wid]
+                    if spawned and spawned_wid in dead:
+                        spawned = False
+                        spawned_wid = None
                     if not reserved:
                         reserved = self._try_reserve(resources, pg_id, bundle_index)
                     if reserved:
@@ -400,7 +414,8 @@ class NodeAgent:
                     except Exception:  # noqa: BLE001 - already gone
                         pass
                 if need_spawn:
-                    self._spawn_worker(for_tpu, runtime_env)
+                    spawned_wid = self._spawn_worker(
+                        for_tpu, runtime_env).worker_id
                 if try_redirect:
                     target = self._find_remote_node(resources)
                     if target is not None:

@@ -14,11 +14,21 @@ from ray_tpu.rllib.env import CartPole, Env, RandomWalk, make_env, register_env
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.models import RLModule
+from ray_tpu.rllib.offline import (
+    BC,
+    BCConfig,
+    CQL,
+    CQLConfig,
+    OfflineData,
+    record_episodes,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "ReplayBuffer", "DQN", "DQNConfig",
     "CartPole", "Env", "RandomWalk", "make_env", "register_env",
     "EnvRunner", "EnvRunnerGroup", "IMPALA", "IMPALAConfig", "RLModule",
-    "PPO", "PPOConfig",
+    "PPO", "PPOConfig", "SAC", "SACConfig", "BC", "BCConfig", "CQL",
+    "CQLConfig", "OfflineData", "record_episodes",
 ]

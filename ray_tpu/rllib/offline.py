@@ -1,0 +1,219 @@
+"""Offline RL: episode recording, BC, and discrete CQL.
+
+Mirrors the reference's offline stack (rllib/offline/ — offline_data.py
+feeds recorded episodes through Ray Data; rllib/algorithms/bc,
+rllib/algorithms/cql). Episodes are recorded to npz; `OfflineData` serves
+shuffled minibatches either from the file or from a ray_tpu.data Dataset
+(the reference's route), so the data plane and the RL library compose.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.models import mlp_apply, mlp_init
+
+
+def record_episodes(env_spec, policy: Callable[[np.ndarray], int], path: str,
+                    *, num_episodes: int = 100, max_steps: int = 500,
+                    seed: int = 0) -> str:
+    """Roll out `policy` and save (obs, actions, rewards, next_obs, dones)
+    transitions to an npz (ref: rllib/offline/offline_env_runner.py)."""
+    env = make_env(env_spec)
+    obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+    for ep in range(num_episodes):
+        obs = env.reset(seed=seed + ep)
+        for _ in range(max_steps):
+            a = int(policy(obs))
+            nxt, r, term, trunc = env.step(a)
+            obs_l.append(obs)
+            act_l.append(a)
+            rew_l.append(r)
+            next_l.append(nxt)
+            done_l.append(float(term))
+            obs = nxt
+            if term or trunc:
+                break
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, obs=np.asarray(obs_l, np.float32),
+             actions=np.asarray(act_l, np.int32),
+             rewards=np.asarray(rew_l, np.float32),
+             next_obs=np.asarray(next_l, np.float32),
+             dones=np.asarray(done_l, np.float32))
+    return path
+
+
+class OfflineData:
+    """Minibatch server over recorded transitions (ref: offline_data.py).
+
+    Accepts an npz path or a ray_tpu.data Dataset whose columns match the
+    transition schema."""
+
+    def __init__(self, source, seed: int = 0):
+        if isinstance(source, str):
+            z = np.load(source)
+            self._data = {k: z[k] for k in
+                          ("obs", "actions", "rewards", "next_obs", "dones")}
+        else:  # ray_tpu.data Dataset
+            cols: dict[str, list] = {}
+            for batch in source.iter_batches(batch_size=4096,
+                                             batch_format="numpy"):
+                for k, v in batch.items():
+                    cols.setdefault(k, []).append(np.asarray(v))
+            def densify(a):
+                # arrow list columns come back as object arrays of rows
+                if a.dtype == object:
+                    return np.stack([np.asarray(x, np.float32) for x in a])
+                return a
+            self._data = {k: densify(np.concatenate(v))
+                          for k, v in cols.items()}
+            self._data["actions"] = self._data["actions"].astype(np.int32)
+        self._n = len(self._data["obs"])
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self._rng.integers(0, self._n, batch_size)
+        return {k: v[idx] for k, v in self._data.items()}
+
+
+class _OfflineAlgorithm(Algorithm):
+    """Base for offline algos: no env runners are sampled during training
+    (the dataset IS the experience); evaluate() still uses the env."""
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        src = config.train_kwargs.get("input_")
+        if src is None:
+            raise ValueError(
+                "offline algorithms need config.training(input_=<npz path "
+                "or ray_tpu.data Dataset>)")
+        self.data = OfflineData(src, seed=config.seed)
+
+
+class BC(_OfflineAlgorithm):
+    """Behavior cloning (ref: rllib/algorithms/bc/bc.py): cross-entropy on
+    the dataset's actions."""
+
+    def setup(self) -> None:
+        kw = self.config.train_kwargs
+        self._batch_size = kw.get("train_batch_size", 256)
+        self._updates_per_iter = kw.get("updates_per_iter", 100)
+        self._opt = optax.adam(self.config.lr)
+        self._opt_state = self._opt.init(self.params["pi"])
+
+        def loss_fn(pi, b):
+            logits = mlp_apply(pi, b["obs"])
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.take_along_axis(
+                logp, b["actions"][:, None], axis=1).mean()
+
+        @jax.jit
+        def update(pi, opt_state, b):
+            loss, grads = jax.value_and_grad(loss_fn)(pi, b)
+            updates, opt_state = self._opt.update(grads, opt_state, pi)
+            return optax.apply_updates(pi, updates), opt_state, loss
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        loss = 0.0
+        for _ in range(self._updates_per_iter):
+            b = self.data.sample(self._batch_size)
+            self.params["pi"], self._opt_state, loss = self._update(
+                self.params["pi"], self._opt_state, b)
+        self._timesteps += self._updates_per_iter * self._batch_size
+        return {"bc_loss": float(loss), "dataset_size": len(self.data)}
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_cls=cls)
+        cfg.lr = 1e-3
+        cfg.num_env_runners = 0
+        return cfg
+
+
+class CQL(_OfflineAlgorithm):
+    """Discrete conservative Q-learning (ref: rllib/algorithms/cql/):
+    double-DQN TD loss + the CQL regularizer
+    alpha_cql * E[logsumexp_a Q(s,a) - Q(s, a_data)], which pushes down
+    out-of-distribution action values so the greedy policy stays inside the
+    dataset's support."""
+
+    def setup(self) -> None:
+        kw = self.config.train_kwargs
+        self._batch_size = kw.get("train_batch_size", 256)
+        self._updates_per_iter = kw.get("updates_per_iter", 100)
+        self._target_update_freq = kw.get("target_update_freq", 100)
+        self._alpha_cql = kw.get("cql_alpha", 1.0)
+        env = make_env(self.config.env_spec)
+        sizes = [env.observation_dim, *self.config.hidden, env.num_actions]
+        k = jax.random.PRNGKey(self.config.seed + 2)
+        q = mlp_init(k, sizes)
+        # the greedy policy IS the Q net: share it under "pi" so
+        # compute_single_action / evaluate need no special-casing
+        self.params = {"pi": q}
+        self._target = jax.tree.map(jnp.copy, q)
+        self._opt = optax.adam(self.config.lr)
+        self._opt_state = self._opt.init(self.params)
+        gamma, alpha_cql = self.config.gamma, self._alpha_cql
+
+        def loss_fn(params, target, b):
+            q = mlp_apply(params["pi"], b["obs"])
+            a = b["actions"][:, None]
+            q_sa = jnp.take_along_axis(q, a, axis=1)[:, 0]
+            next_online = mlp_apply(params["pi"], b["next_obs"])
+            next_a = jnp.argmax(next_online, axis=1)
+            next_q = jnp.take_along_axis(
+                mlp_apply(target, b["next_obs"]), next_a[:, None], axis=1)[:, 0]
+            td_target = b["rewards"] + gamma * (1.0 - b["dones"]) * \
+                jax.lax.stop_gradient(next_q)
+            td_loss = ((q_sa - td_target) ** 2).mean()
+            cql_loss = (jax.scipy.special.logsumexp(q, axis=1) - q_sa).mean()
+            return td_loss + alpha_cql * cql_loss, (td_loss, cql_loss)
+
+        @jax.jit
+        def update(params, target, opt_state, b):
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target, b)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, aux
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        td = cql = 0.0
+        for i in range(self._updates_per_iter):
+            b = self.data.sample(self._batch_size)
+            self.params, self._opt_state, (td, cql) = self._update(
+                self.params, self._target, self._opt_state, b)
+            if (i + 1) % self._target_update_freq == 0:
+                self._target = jax.tree.map(jnp.copy, self.params["pi"])
+        self._timesteps += self._updates_per_iter * self._batch_size
+        return {"td_loss": float(td), "cql_loss": float(cql),
+                "dataset_size": len(self.data)}
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_cls=cls)
+        cfg.lr = 1e-3
+        cfg.num_env_runners = 0
+        return cfg
+
+
+def BCConfig() -> AlgorithmConfig:
+    return BC.get_default_config()
+
+
+def CQLConfig() -> AlgorithmConfig:
+    return CQL.get_default_config()

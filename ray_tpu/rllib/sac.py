@@ -1,0 +1,145 @@
+"""Discrete SAC (ref: rllib/algorithms/sac/ — re-shaped for the discrete
+builtin envs; math per the discrete-SAC formulation of Christodoulou 2019).
+
+Twin soft Q networks with polyak-averaged targets, a categorical policy,
+and auto-tuned entropy temperature — replay on the host, all three updates
+fused into one jitted step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.buffer import ReplayBuffer
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.models import mlp_apply, mlp_init
+
+
+class SAC(Algorithm):
+    def setup(self) -> None:
+        kw = self.config.train_kwargs
+        env = make_env(self.config.env_spec)
+        obs_dim, n_act = env.observation_dim, env.num_actions
+        self._buffer = ReplayBuffer(kw.get("buffer_size", 50_000), obs_dim,
+                                    seed=self.config.seed)
+        self._batch_size = kw.get("train_batch_size", 128)
+        self._updates_per_iter = kw.get("updates_per_iter", 64)
+        self._learn_start = kw.get("learning_starts", 500)
+        self._tau = kw.get("tau", 0.01)  # polyak target rate
+        # discrete target entropy: a fraction of the uniform-policy entropy
+        self._target_entropy = kw.get(
+            "target_entropy", 0.5 * float(np.log(n_act)))
+        # initial temperature. Starting high (alpha=1) inflates the soft
+        # bootstrap early ("entropy farming": Q learns that staying alive
+        # collects alpha*H per step) and the inflated values linger long
+        # after alpha anneals; start low and let the temperature loss raise
+        # it only if the policy over-sharpens.
+        init_alpha = kw.get("initial_alpha", 0.1)
+
+        # twin Qs next to the base module's categorical policy
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(
+            self.config.seed + 1), 3)
+        sizes = [obs_dim, *self.config.hidden, n_act]
+        self.params = {
+            "pi": self.params["pi"],
+            # the EnvRunner's sample path evaluates forward_train (logits +
+            # value) for its batch metadata; SAC doesn't train a V head but
+            # must keep one so rollouts work
+            "vf": self.params["vf"],
+            "q1": mlp_init(k1, sizes),
+            "q2": mlp_init(k2, sizes),
+            "log_alpha": jnp.asarray(float(np.log(init_alpha))),
+        }
+        self._target = {
+            "q1": jax.tree.map(jnp.copy, self.params["q1"]),
+            "q2": jax.tree.map(jnp.copy, self.params["q2"]),
+        }
+        self._opt = optax.adam(self.config.lr)
+        self._opt_state = self._opt.init(self.params)
+        gamma, tau = self.config.gamma, self._tau
+        target_entropy = self._target_entropy
+
+        def losses(params, target, b):
+            logits = mlp_apply(params["pi"], b["obs"])
+            logp = jax.nn.log_softmax(logits)
+            probs = jnp.exp(logp)
+            alpha = jnp.exp(params["log_alpha"])
+
+            # soft state value under the CURRENT policy at s'
+            nlogits = mlp_apply(params["pi"], b["next_obs"])
+            nlogp = jax.nn.log_softmax(nlogits)
+            nprobs = jnp.exp(nlogp)
+            nq = jnp.minimum(mlp_apply(target["q1"], b["next_obs"]),
+                             mlp_apply(target["q2"], b["next_obs"]))
+            v_next = jnp.sum(nprobs * (nq - jax.lax.stop_gradient(alpha)
+                                       * nlogp), axis=1)
+            td_target = b["rewards"] + gamma * (1.0 - b["dones"]) * \
+                jax.lax.stop_gradient(v_next)
+
+            q1 = mlp_apply(params["q1"], b["obs"])
+            q2 = mlp_apply(params["q2"], b["obs"])
+            a = b["actions"][:, None]
+            q1_sa = jnp.take_along_axis(q1, a, axis=1)[:, 0]
+            q2_sa = jnp.take_along_axis(q2, a, axis=1)[:, 0]
+            critic_loss = ((q1_sa - td_target) ** 2).mean() + \
+                ((q2_sa - td_target) ** 2).mean()
+
+            # actor: minimize E_s pi(s)·(alpha·log pi - min Q)
+            q_min = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+            actor_loss = jnp.sum(
+                probs * (jax.lax.stop_gradient(alpha) * logp - q_min),
+                axis=1).mean()
+
+            # temperature: drive policy entropy toward the target
+            entropy = -jnp.sum(probs * logp, axis=1).mean()
+            alpha_loss = params["log_alpha"] * jax.lax.stop_gradient(
+                entropy - target_entropy)
+            return critic_loss + actor_loss + alpha_loss, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "alpha": alpha, "entropy": entropy}
+
+        @jax.jit
+        def update(params, target, opt_state, b):
+            (_, metrics), grads = jax.value_and_grad(
+                losses, has_aux=True)(params, target, b)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree.map(
+                lambda t, p: (1.0 - tau) * t + tau * p, target,
+                {"q1": params["q1"], "q2": params["q2"]})
+            return params, target, opt_state, metrics
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        samples = self.runners.sample(self.params, cfg.rollout_steps,
+                                      explore=True)
+        for s in samples:
+            self._buffer.add_batch(s)
+        self._timesteps += cfg.rollout_steps * cfg.num_env_runners
+
+        if len(self._buffer) < self._learn_start:
+            return {"buffer_size": len(self._buffer)}
+
+        metrics = {}
+        for _ in range(self._updates_per_iter):
+            b = self._buffer.sample(self._batch_size)
+            self.params, self._target, self._opt_state, metrics = \
+                self._update(self.params, self._target, self._opt_state, b)
+        return {k: float(v) for k, v in metrics.items()} | {
+            "buffer_size": len(self._buffer)}
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_cls=cls)
+        cfg.lr = 3e-3
+        return cfg
+
+
+def SACConfig() -> AlgorithmConfig:
+    return SAC.get_default_config()

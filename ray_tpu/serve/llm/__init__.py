@@ -12,14 +12,24 @@ Public surface:
 - LLMServer            — serve deployment class (continuous batching replica)
 - build_openai_app     — Application serving /v1/completions + /v1/chat/...
 - LLMEngine            — the engine itself (usable standalone, e.g. bench)
+- build_disagg_openai_app — prefill/decode-disaggregated application
+  (prefill replicas hand KV pages to decode replicas; serve/llm/disagg.py)
 """
 
 from ray_tpu.serve.llm.config import LLMConfig
+from ray_tpu.serve.llm.disagg import (
+    DecodeEngine,
+    DisaggLLMServer,
+    PrefillServer,
+    build_disagg_openai_app,
+    prefill_only,
+)
 from ray_tpu.serve.llm.engine import LLMEngine
 from ray_tpu.serve.llm.llm_server import LLMServer, build_llm_deployment
 from ray_tpu.serve.llm.openai_api import build_openai_app
 
 __all__ = [
     "LLMConfig", "LLMEngine", "LLMServer", "build_llm_deployment",
-    "build_openai_app",
+    "build_openai_app", "build_disagg_openai_app", "PrefillServer",
+    "DisaggLLMServer", "DecodeEngine", "prefill_only",
 ]

@@ -1,0 +1,335 @@
+"""Prefill/decode disaggregated serving.
+
+TPU-native analog of the reference's prefill-decode disaggregation
+(python/ray/llm/_internal/serve/deployments/prefill_decode_disagg/
+prefill_decode_disagg.py:1): prefill replicas run ONLY the prompt pass and
+hand the resulting KV pages to decode replicas, which run ONLY the
+continuous-batching token loop. Prefill is compute-bound and bursty; decode
+is memory-bandwidth-bound and steady — separating them lets each replica
+pool scale and batch independently.
+
+KV handoff rides the OBJECT PLANE (the reference uses vLLM KV-transfer
+connectors/NIXL): the prefill replica extracts the request's KV pages to
+host memory, the blob travels as a task return through the shared-memory
+object store (chunked cross-node pulls when the pools live on different
+hosts), and the decode replica scatters it into its own paged pool with a
+donated-buffer jitted program (no full-pool copy per injection).
+
+Pieces:
+- ``prefill_only(engine, ...)``     — prompt pass + KV extraction on a
+  NON-started LLMEngine (prefill replicas have no decode loop).
+- ``DecodeEngine.submit_prefilled`` — admits a prefilled request into the
+  decode loop: allocates slot+pages, scatters the KV blob, continues from
+  the handed-off first token.
+- ``build_disagg_openai_app``       — OpenAI ingress whose completions
+  path is prefill-replica → KV blob → local decode engine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.serve.llm.config import LLMConfig
+from ray_tpu.serve.llm.engine import LLMEngine, _Request
+
+
+# ---------------------------------------------------------------------------
+# prefill side
+# ---------------------------------------------------------------------------
+
+def prefill_only(eng: LLMEngine, prompt, *, temperature: float | None = None,
+                 top_k: int | None = None) -> dict:
+    """Run the prompt pass on a prefill-role engine and extract the KV.
+
+    The engine must NOT have its decode loop started; calls are serialized
+    on the engine lock (prefill replicas scale by replica count, not by
+    intra-process concurrency — each call owns the chip while it runs).
+
+    Returns a host-side handoff blob:
+      {prompt_tokens, plen, n_pages, first_token, kv_k, kv_v,
+       temperature, prefill_ttft_s}
+    """
+    jnp = eng._jnp
+    t0 = time.monotonic()
+    if isinstance(prompt, str):
+        toks = eng.tokenizer.encode(prompt)
+    else:
+        toks = list(prompt)
+    toks = toks[: eng.cfg.max_prompt_len]
+    temperature = eng.cfg.temperature if temperature is None else temperature
+    if top_k is not None and top_k != eng.cfg.top_k:
+        pass  # sampling uses the engine top_k (static to the programs)
+
+    plen = max(1, len(toks))
+    n_pages = -(-plen // eng.cfg.page_size)
+    if n_pages > eng.cfg.num_pages - 1:  # page 0 is the trash page
+        raise ValueError(
+            f"prompt needs {n_pages} KV pages but the pool has "
+            f"{eng.cfg.num_pages - 1}; raise num_pages or page_size")
+    with eng._lock:
+        # each call allocates AND frees inside this lock scope, so the pool
+        # is always fully free here — a failed alloc can never resolve by
+        # waiting (hence the hard error above instead of a retry loop)
+        pages = eng.allocator.alloc(n_pages)
+        if pages is None:
+            raise RuntimeError("prefill page pool unexpectedly exhausted")
+        try:
+            table = np.zeros((eng.max_pages_per_seq,), np.int32)
+            table[:n_pages] = pages
+            bucket = eng._bucket(plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = toks
+            fn = eng._prefill_fn(bucket)
+            eng._rng, sub = eng._jax.random.split(eng._rng)
+            tok_dev, eng.kv = fn(
+                eng.params, eng.kv, jnp.asarray(table), jnp.asarray(padded),
+                jnp.int32(plen), sub,
+                jnp.asarray([temperature], jnp.float32))
+            # extract this request's pages to host (the handoff payload)
+            pidx = jnp.asarray(table[:n_pages], jnp.int32)
+            kv_k = np.asarray(eng.kv["k"][:, pidx])
+            kv_v = np.asarray(eng.kv["v"][:, pidx])
+            first = int(tok_dev)
+        finally:
+            eng.allocator.free(pages)
+        eng.stats["prefills"] += 1
+    return {
+        "prompt_tokens": toks, "plen": plen, "n_pages": n_pages,
+        "first_token": first, "kv_k": kv_k, "kv_v": kv_v,
+        "temperature": temperature,
+        "prefill_ttft_s": time.monotonic() - t0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# decode side
+# ---------------------------------------------------------------------------
+
+class DecodeEngine(LLMEngine):
+    """LLMEngine that can admit PREFILLED requests: the prompt KV arrives
+    as a host blob and is scattered into the local paged pool; decode
+    continues from the handed-off first token."""
+
+    def __init__(self, cfg: LLMConfig, params=None, rng_seed: int = 0):
+        super().__init__(cfg, params=params, rng_seed=rng_seed)
+        self._inject_q: list[tuple[_Request, dict]] = []
+        self._inject_fn = None
+
+    def submit_prefilled(self, state: dict, *,
+                         max_tokens: Optional[int] = None,
+                         request_id: Optional[str] = None) -> str:
+        toks = list(state["prompt_tokens"])
+        req = _Request(
+            request_id=request_id or uuid.uuid4().hex[:16],
+            prompt_tokens=toks,
+            max_tokens=max(1, min(max_tokens or self.cfg.max_tokens,
+                                  self.cfg.max_seq_len - len(toks))),
+            temperature=float(state.get("temperature", 0.0)),
+            top_k=self.cfg.top_k,
+            stop_token=getattr(self.tokenizer, "eos_token_id", None))
+        req.dispatched = 1
+        with self._lock:
+            self._requests[req.request_id] = req
+            self.stats["requests"] += 1
+            # the first token already exists — record it through the normal
+            # bookkeeping so stop/max handling is uniform
+            self._record_token(req, int(state["first_token"]))
+            if req.done:
+                req.done_event.set()
+                return req.request_id
+            self._inject_q.append((req, state))
+        self._wake.set()
+        return req.request_id
+
+    def _admissions_blocked(self) -> bool:
+        # prefilled requests queued for injection count as blocked
+        # admissions too: shrink decode blocks so their pages/slots free up
+        # promptly (lock held by _step)
+        return super()._admissions_blocked() or (
+            bool(self._inject_q) and bool(self.free_slots))
+
+    def engine_stats(self) -> dict:
+        stats = super().engine_stats()
+        stats["waiting"] += len(self._inject_q)
+        return stats
+
+    def _admit(self) -> int:
+        admitted = super()._admit()
+        while True:
+            with self._lock:
+                if not self._inject_q or not self.free_slots:
+                    return admitted
+                req, state = self._inject_q[0]
+                need = -(-max(state["plen"] + req.max_tokens, 1)
+                         // self.cfg.page_size)
+                need = min(need, self.max_pages_per_seq)
+                pages = self.allocator.alloc(need)
+                if pages is None:
+                    return admitted  # page pool exhausted; retry next loop
+                self._inject_q.pop(0)
+                slot = self.free_slots.pop()
+                req.slot = slot
+                req.pages = pages
+            self._inject(req, state)
+            admitted += 1
+
+    def _inject(self, req: _Request, state: dict):
+        """Scatter the handed-off KV pages into the local pool and arm the
+        slot (loop thread only)."""
+        jnp = self._jnp
+        n_src = state["n_pages"]
+        table = np.zeros((self.max_pages_per_seq,), np.int32)
+        table[: len(req.pages)] = req.pages
+        # pad the blob to max_pages_per_seq so ONE program shape covers
+        # every prompt length (targets pad onto the trash page 0)
+        mp = self.max_pages_per_seq
+        _l, _n, ps, h, d = state["kv_k"].shape
+        pad = ((0, 0), (0, mp - n_src), (0, 0), (0, 0), (0, 0))
+        blob_k = jnp.asarray(np.pad(state["kv_k"], pad))
+        blob_v = jnp.asarray(np.pad(state["kv_v"], pad))
+        tgt = np.zeros((mp,), np.int32)
+        tgt[:n_src] = req.pages[:n_src]
+        if self._inject_fn is None:
+            jax = self._jax
+
+            def impl(kv, bk, bv, pages):
+                # donated pool: injection rewrites the pages in place
+                # instead of copying the (GB-scale) pool per admission
+                return {"k": kv["k"].at[:, pages].set(bk),
+                        "v": kv["v"].at[:, pages].set(bv)}
+
+            self._inject_fn = jax.jit(impl, donate_argnums=(0,))
+        self.kv = self._inject_fn(self.kv, blob_k, blob_v,
+                                  jnp.asarray(tgt, jnp.int32))
+        with self._lock:
+            self.page_tables[req.slot] = table
+            self.seq_lens[req.slot] = state["plen"]
+            self.slot_req[req.slot] = req
+            self._dirty_slots[req.slot] = (state["plen"], req.temperature)
+            # continue decoding from the handed-off first token
+            self._overrides[req.slot] = int(state["first_token"])
+
+
+# ---------------------------------------------------------------------------
+# serve deployments
+# ---------------------------------------------------------------------------
+
+class PrefillServer:
+    """Prefill-role replica: owns a non-started engine; each call runs one
+    prompt pass and returns the KV handoff blob (reference: the "p" servers
+    of prefill_decode_disagg)."""
+
+    def __init__(self, llm_config: LLMConfig | dict):
+        if isinstance(llm_config, dict):
+            llm_config = LLMConfig(**llm_config)
+        self.cfg = llm_config
+        self.engine = LLMEngine(llm_config)  # loop NOT started
+
+    def prefill(self, prompt, sampling: dict) -> dict:
+        return prefill_only(
+            self.engine, prompt,
+            temperature=sampling.get("temperature"),
+            top_k=sampling.get("top_k"))
+
+    def check_health(self) -> bool:
+        return True
+
+
+class DisaggLLMServer:
+    """Decode-role ingress: completions run prefill on a prefill replica
+    (via its deployment handle), then decode locally from the handed-off
+    KV (reference: the "d" servers + PDProxyServer routing)."""
+
+    def __init__(self, llm_config: LLMConfig | dict, prefill_handle):
+        if isinstance(llm_config, dict):
+            llm_config = LLMConfig(**llm_config)
+        self.cfg = llm_config
+        self.prefill = prefill_handle
+        self.engine = DecodeEngine(llm_config)
+        self.engine.start()
+
+    # ---- OpenAI surface (mirrors llm_server.LLMServer) ----------------
+    def completions(self, payload: dict) -> Any:
+        prompt = payload.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        return self._run(prompt, payload, chat=False)
+
+    def chat(self, payload: dict) -> Any:
+        from ray_tpu.serve.llm.llm_server import _chat_prompt
+        return self._run(_chat_prompt(payload.get("messages", [])),
+                         payload, chat=True)
+
+    def _run(self, prompt, payload: dict, chat: bool) -> Any:
+        from ray_tpu.serve.llm.llm_server import LLMServer
+        sampling = {k: payload[k] for k in ("temperature", "top_k")
+                    if payload.get(k) is not None}
+        t0 = time.monotonic()
+        state = self.prefill.options(
+            method_name="prefill", timeout_s=600.0).remote(
+            prompt, sampling).result(timeout_s=600.0)
+        rid = self.engine.submit_prefilled(
+            state, max_tokens=payload.get("max_tokens"))
+        out = self.engine.result(rid, timeout=600.0)
+        out["ttft_s"] = state["prefill_ttft_s"]
+        out["latency_s"] = time.monotonic() - t0
+        # reuse the OpenAI response shaping
+        return LLMServer._completion_response(self, out, chat=chat)
+
+    def models(self) -> dict:
+        return {"object": "list",
+                "data": [{"id": self.cfg.model_id, "object": "model",
+                          "owned_by": "ray_tpu", "mode": "disagg"}]}
+
+    def engine_stats(self) -> dict:
+        return {**self.engine.engine_stats(), "mode": "disagg"}
+
+    def check_health(self) -> bool:
+        return True
+
+    def handle_http(self, path: str, method: str, payload: Any) -> Any:
+        path = "/" + path.strip("/")
+        # chat first: "/chat/completions".endswith("/completions") is True
+        if path.endswith("/chat/completions"):
+            return self.chat(payload if isinstance(payload, dict) else {})
+        if path.endswith("/completions"):
+            return self.completions(
+                payload if isinstance(payload, dict) else {})
+        if path.endswith("/models"):
+            return self.models()
+        if path.endswith("/stats"):
+            return self.engine_stats()
+        return {"error": {"message": f"no route for {path}", "code": 404}}
+
+
+def build_disagg_openai_app(llm_config: LLMConfig | dict,
+                            route_prefix: str = "/v1",
+                            num_prefill: int = 1, num_decode: int = 1,
+                            prefill_actor_options: dict | None = None,
+                            decode_actor_options: dict | None = None):
+    """Disaggregated OpenAI application: num_prefill prefill replicas feed
+    num_decode decode ingress replicas (reference:
+    prefill_decode_disagg.build_pd_app)."""
+    from ray_tpu import serve
+
+    if isinstance(llm_config, dict):
+        llm_config = LLMConfig(**llm_config)
+    prefill_dep = serve.deployment(
+        PrefillServer, name=f"{llm_config.name}-prefill",
+        num_replicas=num_prefill,
+        max_ongoing_requests=2,  # a prefill owns the chip while it runs
+        ray_actor_options=dict(prefill_actor_options or {}),
+        health_check_timeout_s=600.0)
+    decode_dep = serve.deployment(
+        DisaggLLMServer, name=f"{llm_config.name}-decode",
+        num_replicas=num_decode,
+        max_ongoing_requests=4 * llm_config.max_batch_size,
+        ray_actor_options=dict(decode_actor_options or {}),
+        health_check_timeout_s=600.0)
+    decode_dep.route_prefix = route_prefix
+    return decode_dep.bind(llm_config, prefill_dep.bind(llm_config))

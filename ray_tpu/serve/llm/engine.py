@@ -380,6 +380,12 @@ class LLMEngine:
             b *= 2
         return min(b, self.cfg.max_prompt_len)
 
+    def _admissions_blocked(self) -> bool:
+        """Requests waiting while slots are free (= page-pool starved):
+        shrink decode blocks so page reclamation isn't a whole block late.
+        Lock held. Subclasses with extra admission queues extend this."""
+        return bool(self._waiting) and bool(self.free_slots)
+
     def _bucket_width(self, n: int) -> int:
         """Packed decode width: smallest power-of-two ≥ n (floor 4), capped
         at max_batch_size — a handful of compiled widths total."""
@@ -479,8 +485,7 @@ class LLMEngine:
             # exactly two programs compile. Overshoot past a request's
             # max_tokens is by-design safe: extra writes land in the slot's
             # own tail pages or the trash page, and harvest discards them.
-            k = 1 if (self._waiting and self.free_slots) \
-                else self.cfg.decode_block
+            k = 1 if self._admissions_blocked() else self.cfg.decode_block
             dirty, self._dirty_slots = self._dirty_slots, {}
             overrides, self._overrides = self._overrides, {}
             for _col, _slot, req in snapshot:

@@ -44,6 +44,27 @@ import pytest  # noqa: E402
 # forever raises in minutes instead (inherited by worker subprocesses).
 os.environ.setdefault("RAY_TPU_BLOCKING_WATCHDOG_S", "300")
 
+# Hang forensics. The blocking watchdog covers get()/wait(); a deadlock on
+# a raw Lock/Condition it cannot see. Arm a per-test stack-dump timer: any
+# test stuck longer than PER_TEST_HANG_DUMP_S dumps EVERY thread's stack
+# and aborts the run — a silent futex park becomes a diagnosable failure.
+# SIGUSR1 dumps stacks on demand for a live run (kill -USR1 <pytest pid>).
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+
+PER_TEST_HANG_DUMP_S = float(os.environ.get("PER_TEST_HANG_DUMP_S", "480"))
+try:
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+except (AttributeError, ValueError):  # non-main thread / unsupported
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _hang_dump():
+    faulthandler.dump_traceback_later(PER_TEST_HANG_DUMP_S, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
 
 @pytest.fixture(scope="module")
 def ray_start_module():

@@ -319,6 +319,15 @@ def test_hash_repartition_colocates_keys(rt):
     assert out.count() == 1000
 
 
+def test_repartition_single_block(rt):
+    """n=1 shuffle: the shard is the input block itself (regression: the
+    num_returns=1 path wrapped the 1-element shard list as one object)."""
+    assert rtd.range(50, parallelism=4).repartition(1).count() == 50
+    ds = rtd.range(20, parallelism=2).map_batches(
+        lambda b: {"g": b["id"] % 2, "v": b["id"]})
+    one = ds.groupby("g").sum("v").take_all()
+    assert sum(r["sum(v)"] for r in one) == sum(range(20))
+
 def test_distributed_hash_shuffle_1gb_two_nodes():
     """VERDICT r2 #7: shuffle >=1 GB across a 2-node cluster under per-node
     object-store caps. The shuffle moves shard REFS (map emits one ref per
@@ -357,12 +366,3 @@ def test_distributed_hash_shuffle_1gb_two_nodes():
         ray_tpu.shutdown()
         cluster.shutdown()
 
-
-def test_repartition_single_block(rt):
-    """n=1 shuffle: the shard is the input block itself (regression: the
-    num_returns=1 path wrapped the 1-element shard list as one object)."""
-    assert rtd.range(50, parallelism=4).repartition(1).count() == 50
-    ds = rtd.range(20, parallelism=2).map_batches(
-        lambda b: {"g": b["id"] % 2, "v": b["id"]})
-    one = ds.groupby("g").sum("v").take_all()
-    assert sum(r["sum(v)"] for r in one) == sum(range(20))

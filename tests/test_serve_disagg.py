@@ -1,0 +1,135 @@
+"""Prefill/decode disaggregation tests (reference:
+python/ray/llm/_internal/serve/deployments/prefill_decode_disagg/
+prefill_decode_disagg.py + its serve tests). Tiny-Llama on CPU."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _tiny_cfg(**kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+
+    d = dict(model_config=llama.llama_tiny(vocab_size=512),
+             max_batch_size=4, page_size=16, num_pages=64,
+             max_prompt_len=64, max_seq_len=128, max_tokens=8)
+    d.update(kw)
+    return LLMConfig(**d)
+
+
+def test_prefill_handoff_matches_monolithic():
+    """A prompt prefilled on engine A and decoded on engine B must emit the
+    same greedy tokens as one engine doing both — the KV pages really carry
+    the prompt state across the handoff."""
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm.disagg import DecodeEngine, prefill_only
+    from ray_tpu.serve.llm.engine import LLMEngine
+
+    cfg = _tiny_cfg(max_tokens=6)
+    mc = cfg.llama()
+    params = llama.init_params(jax.random.PRNGKey(3), mc)
+
+    mono = LLMEngine(cfg, params=params)
+    mono.start()
+    want = mono.generate([7, 3, 9, 1, 4] * 4, max_tokens=6,
+                         temperature=0.0)["tokens"]
+    mono.shutdown()
+
+    pre = LLMEngine(cfg, params=params)       # prefill role: loop NOT started
+    dec = DecodeEngine(cfg, params=params)    # decode role
+    dec.start()
+    try:
+        state = prefill_only(pre, [7, 3, 9, 1, 4] * 4, temperature=0.0)
+        assert state["plen"] == 20
+        assert state["kv_k"].shape[1] == state["n_pages"]
+        rid = dec.submit_prefilled(state, max_tokens=6)
+        got = dec.result(rid, timeout=120.0)
+        assert got["error"] is None
+        assert got["tokens"] == want
+        # pages recycled on both sides
+        assert pre.engine_stats()["free_pages"] == cfg.num_pages - 1
+    finally:
+        dec.shutdown()
+
+
+def test_disagg_decode_concurrency_and_page_recycling():
+    """Several prefilled requests stream through one decode engine; slots
+    and pages fully recycle."""
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm.disagg import DecodeEngine, prefill_only
+    from ray_tpu.serve.llm.engine import LLMEngine
+
+    cfg = _tiny_cfg(max_batch_size=2, num_pages=32, max_tokens=5)
+    mc = cfg.llama()
+    params = llama.init_params(jax.random.PRNGKey(5), mc)
+    pre = LLMEngine(cfg, params=params)
+    dec = DecodeEngine(cfg, params=params)
+    dec.start()
+    try:
+        rids = []
+        for i in range(5):
+            state = prefill_only(pre, [i + 1] * 8, temperature=0.0)
+            rids.append(dec.submit_prefilled(state, max_tokens=5))
+        outs = [dec.result(r, timeout=120.0) for r in rids]
+        assert all(o["error"] is None for o in outs)
+        assert all(o["num_generated_tokens"] == 5 for o in outs)
+        stats = dec.engine_stats()
+        assert stats["active_slots"] == 0
+        assert stats["free_pages"] == 31
+    finally:
+        dec.shutdown()
+
+
+@pytest.fixture
+def disagg_app(ray_start_module):
+    from ray_tpu import serve
+    from ray_tpu.serve.llm.disagg import build_disagg_openai_app
+
+    app = build_disagg_openai_app(_tiny_cfg(), route_prefix="/v1",
+                                  num_prefill=2, num_decode=1)
+    serve.run(app, name="llm-disagg", route_prefix="/v1")
+    proxy = serve.start_http_proxy(port=0)
+    yield f"http://127.0.0.1:{proxy.port}"
+    serve.shutdown()
+
+
+def test_disagg_openai_http_e2e(disagg_app):
+    """End-to-end: distinct prefill replicas and a decode ingress serving
+    OpenAI requests over HTTP (VERDICT r2 item 4's done-bar)."""
+    def post(payload):
+        req = urllib.request.Request(
+            f"{disagg_app}/v1/completions",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    outs = [post({"prompt": f"hello {i}", "max_tokens": 4,
+                  "temperature": 0.0}) for i in range(4)]
+    for out in outs:
+        assert out["object"] == "text_completion"
+        assert out["usage"]["completion_tokens"] == 4
+        assert out["ray_tpu"]["ttft_s"] is not None
+
+    # chat route must NOT fall through to the plain completions path
+    req = urllib.request.Request(
+        f"{disagg_app}/v1/chat/completions",
+        data=json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                         "max_tokens": 3}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        chat = json.loads(r.read())
+    assert chat["choices"][0]["message"]["role"] == "assistant"
+
+    with urllib.request.urlopen(f"{disagg_app}/v1/models", timeout=30) as r:
+        models = json.loads(r.read())
+    assert models["data"][0]["mode"] == "disagg"
