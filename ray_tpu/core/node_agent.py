@@ -163,6 +163,37 @@ class NodeAgent:
     def _h_ping(self, body):
         return {"ok": True}
 
+    def _h_dump_node_stacks(self, body):
+        """Stack snapshot of the agent AND every registered worker on this
+        node (ref: dashboard reporter profiling endpoints). A worker that
+        doesn't answer within the per-worker budget is reported as such —
+        exactly the workers you most want flagged."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu.util.profiling import dump_thread_stacks
+        out = {"agent": dump_thread_stacks()}
+        with self._lock:
+            targets = [(w.hex()[:12], i.addr) for w, i in
+                       self._workers.items() if i.addr is not None]
+
+        def probe(item):
+            wid, addr = item
+            try:
+                r = self._pool.get(tuple(addr)).call(
+                    "dump_stacks", None, timeout=5.0, connect_timeout=2.0)
+                return wid, r.get("stacks", "")
+            except Exception as e:  # noqa: BLE001
+                return wid, f"<unreachable: {e!r}>"
+
+        if targets:
+            # concurrent: N wedged workers must cost ~one per-worker budget,
+            # not N of them serially (the caller's timeout would fire and
+            # lose the whole node's dump — the diagnostic you needed most)
+            with ThreadPoolExecutor(max_workers=min(16, len(targets))) as ex:
+                for wid, text in ex.map(probe, targets):
+                    out[f"worker-{wid}"] = text
+        return out
+
     # ---- worker pool ---------------------------------------------------
     def _spawn_worker(self, for_tpu: bool = False,
                       runtime_env: dict | None = None) -> _WorkerInfo:
@@ -361,7 +392,12 @@ class NodeAgent:
                             and i.proc.poll() is not None]
                     for wid in dead:
                         del self._workers[wid]
-                    if spawned and spawned_wid in dead:
+                    # not "in dead": a CONCURRENT lease loop may have reaped
+                    # our corpse in its own iteration — absence from the
+                    # pool is the durable signal (a healthy registered spawn
+                    # stays in the dict)
+                    if spawned and spawned_wid is not None \
+                            and spawned_wid not in self._workers:
                         spawned = False
                         spawned_wid = None
                     if not reserved:

@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import logging
 import threading
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID, TaskID
@@ -26,6 +27,7 @@ logger = logging.getLogger(__name__)
 class _PendingTask:
     spec: TaskSpec
     retries_left: int
+    submitted_ts: float = field(default_factory=time.monotonic)
 
 
 class TaskManager:
@@ -46,10 +48,14 @@ class TaskManager:
                 for oid in spec.return_ids():
                     self._lineage[oid] = spec
 
-    def complete(self, task_id: TaskID):
+    def complete(self, task_id: TaskID) -> float | None:
+        """Returns the submit-to-completion latency (None if unknown) for
+        the owner's latency histograms (ref: dashboard task metrics)."""
         with self._lock:
-            self._pending.pop(task_id, None)
+            ent = self._pending.pop(task_id, None)
             self._reconstructing.discard(task_id)
+            return (None if ent is None
+                    else time.monotonic() - ent.submitted_ts)
 
     def should_retry_system_failure(self, task_id: TaskID) -> TaskSpec | None:
         """Worker crash / connection loss: consume one retry

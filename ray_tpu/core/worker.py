@@ -210,6 +210,14 @@ class WorkerRuntime:
         self._normal_exec = _NormalTaskQueue()
         self._running_tasks: dict[TaskID, threading.Event] = {}
         self._blocked_notified = threading.local()
+        # Eager: lazy init would race on the reply threads and register the
+        # same Prometheus series twice (the registry doesn't dedup).
+        from ray_tpu.util.metrics import Histogram
+        self._latency_hist = Histogram(
+            "ray_tpu_task_latency_seconds",
+            "Submit-to-completion latency of tasks owned by this process",
+            boundaries=[0.005, 0.02, 0.1, 0.5, 2, 10, 60, 300],
+            tag_keys=("type",))
         self._shutdown = threading.Event()
         self._driver_task_id = TaskID.for_driver(job_id)
         self.task_events: list[dict] = []  # flushed to CP (TaskEventBuffer)
@@ -765,7 +773,8 @@ class WorkerRuntime:
             else:
                 self.memory_store.put_location(oid, data)
         self._release_deps(spec)
-        self.task_manager.complete(spec.task_id)
+        elapsed = self.task_manager.complete(spec.task_id)
+        self._observe_latency(spec, elapsed)
         self._record_task_event(spec, "FINISHED")
 
     def fail_task(self, spec: TaskSpec, error: TaskError):
@@ -778,13 +787,22 @@ class WorkerRuntime:
             # consumers blocked in next() must observe the failure
             self.stream_manager.fail(spec, sobj)
         self._release_deps(spec)
-        self.task_manager.complete(spec.task_id)
+        elapsed = self.task_manager.complete(spec.task_id)
+        self._observe_latency(spec, elapsed)
         self._record_task_event(spec, "FAILED")
 
     def _release_deps(self, spec: TaskSpec):
         for a in spec.args:
             if a.is_ref:
                 self.reference_counter.remove_task_dep(a.ref[0], a.ref[2])
+
+    def _observe_latency(self, spec: TaskSpec, elapsed: float | None):
+        """Owner-side submit→finish latency histogram (ref: the dashboard's
+        task-latency metrics; would localize a slow/wedged call path in one
+        /metrics scrape)."""
+        if elapsed is not None:
+            self._latency_hist.observe(elapsed,
+                                       {"type": spec.task_type.name})
 
     def _on_ref_zero(self, oid: ObjectID):
         """Owned count hit zero: drop the value everywhere
@@ -832,6 +850,15 @@ class WorkerRuntime:
     def _h_ping(self, body):
         # worker_id lets borrow-probing owners detect a reused port
         return {"ok": True, "worker_id": self.worker_id.hex()}
+
+    def _h_dump_stacks(self, body):
+        """Every thread's Python stack, on demand (ref: the dashboard's
+        py-spy/profile endpoints, dashboard/modules/reporter/
+        profile_manager.py:191 — this is how a wedged worker gets
+        diagnosed without attaching a debugger)."""
+        from ray_tpu.util.profiling import dump_thread_stacks
+        return {"worker_id": self.worker_id.hex(), "pid": os.getpid(),
+                "stacks": dump_thread_stacks()}
 
     def _h_inc_borrow(self, body):
         if isinstance(body, dict):

@@ -156,6 +156,11 @@ class Dashboard:
                 tail = int(request.query.get("tail", "100"))
                 logs = state.worker_logs(worker_id=wid, tail=tail)
                 return [{"file": k, "content": v} for k, v in logs.items()]
+            if section == "stacks":
+                # on-demand whole-cluster stack snapshot (ref: dashboard
+                # reporter profiling endpoints) — hang diagnosis in one GET
+                return [{"process": k, "stacks": v}
+                        for k, v in state.dump_cluster_stacks().items()]
             return None
 
         data = await loop.run_in_executor(None, fetch)

@@ -80,12 +80,19 @@ def _jax_backend(ctx) -> None:
     port = int(os.environ.get("RAY_TPU_JAX_COORD_PORT", "0")) or \
         _free_port()
     addr = f"{socket.gethostbyname(socket.gethostname())}:{port}"
-    coord = ray_tpu.get(sync.broadcast_from_rank_zero.remote(rank, addr))
+    coord = ray_tpu.get(sync.broadcast_from_rank_zero.remote(rank, addr),
+                        timeout=120.0)
 
     import jax
     try:
+        # Bounded: the free-port choice is racy (another process can grab
+        # it between probe and bind) and a worker connecting to a hijacked
+        # port wedges INSIDE the C++ coordination client where no Python
+        # watchdog can see it. A timeout converts the wedge into a worker
+        # failure the trainer's FailurePolicy retries with a fresh port.
         jax.distributed.initialize(
-            coordinator_address=coord, num_processes=world, process_id=rank)
+            coordinator_address=coord, num_processes=world, process_id=rank,
+            initialization_timeout=120)
     except RuntimeError as e:
         # Already initialized (worker restart reusing the process) is fine.
         if "already" not in str(e).lower():

@@ -60,3 +60,20 @@ def profile_step(fn, *args, logdir: str = "/tmp/ray_tpu_prof", **kwargs):
 
         jax.block_until_ready(out)
     return out
+
+
+def dump_thread_stacks() -> str:
+    """Every thread's Python stack as text (named), for on-demand hang
+    diagnosis (ref: dashboard/modules/reporter/profile_manager.py:191 —
+    the reference shells out to py-spy; a pure-Python snapshot needs no
+    debugger attach and works from an RPC handler)."""
+    import sys
+    import threading
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid})\n"
+                   + "".join(traceback.format_stack(frame)))
+    return "\n".join(out)

@@ -154,3 +154,32 @@ def worker_logs(worker_id: Optional[str] = None,
             if lines:
                 out[name] = "".join(lines[-tail:])
     return out
+
+
+def dump_cluster_stacks() -> dict[str, str]:
+    """Python stack snapshot of every process in the cluster — the driver,
+    each node agent, and each registered worker (ref: the dashboard's
+    py-spy profiling endpoints, dashboard/modules/reporter/
+    profile_manager.py:191). The tool that turns "the job is stuck" into a
+    diagnosis in one call."""
+    from ray_tpu.core import api
+    from ray_tpu.util.profiling import dump_thread_stacks
+
+    rt = api._get_runtime()
+    out = {"driver": dump_thread_stacks()}
+    try:
+        nodes = rt.cp_client.call_with_retry("get_nodes", None, timeout=10.0)
+    except Exception as e:  # noqa: BLE001
+        out["control-plane"] = f"<unreachable: {e!r}>"
+        return out
+    for n in nodes:
+        nid = n["node_id"].hex()[:12] if hasattr(n["node_id"], "hex") \
+            else str(n["node_id"])[:12]
+        try:
+            stacks = rt.peer_pool.get(tuple(n["addr"])).call(
+                "dump_node_stacks", None, timeout=30.0, connect_timeout=3.0)
+            for name, text in stacks.items():
+                out[f"node-{nid}/{name}"] = text
+        except Exception as e:  # noqa: BLE001
+            out[f"node-{nid}"] = f"<unreachable: {e!r}>"
+    return out

@@ -53,17 +53,50 @@ import faulthandler  # noqa: E402
 import signal  # noqa: E402
 
 PER_TEST_HANG_DUMP_S = float(os.environ.get("PER_TEST_HANG_DUMP_S", "480"))
+# A REAL file, not sys.stderr: under pytest's fd-level capture a default
+# dump lands in the per-test capture tempfile and vanishes with the process.
+HANG_DUMP_PATH = os.environ.get("HANG_DUMP_PATH", "/tmp/ray_tpu_hang_dump.txt")
+_hang_dump_file = open(HANG_DUMP_PATH, "a")  # noqa: SIM115 — lives forever
 try:
-    faulthandler.register(signal.SIGUSR1, all_threads=True)
+    faulthandler.register(signal.SIGUSR1, all_threads=True,
+                          file=_hang_dump_file)
 except (AttributeError, ValueError):  # non-main thread / unsupported
     pass
 
+# Custom watchdog instead of faulthandler.dump_traceback_later: that caps
+# the dump at 100 threads and the suite accumulates several hundred daemon
+# threads — the main thread and the actual lock holder land in the
+# truncated tail. This dumper names every thread and has no cap.
+import sys  # noqa: E402
+import threading as _threading  # noqa: E402
+import traceback as _traceback  # noqa: E402
+
+_watchdog_timer = None
+
+
+def _dump_all_threads_and_exit(nodeid: str):
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    f = _hang_dump_file
+    f.write(f"\n!!! HANG ({PER_TEST_HANG_DUMP_S:.0f}s) in {nodeid}\n")
+    for tid, frame in sys._current_frames().items():
+        f.write(f"\n--- thread {names.get(tid, '?')} ({tid})\n")
+        f.write("".join(_traceback.format_stack(frame)))
+    f.flush()
+    os._exit(70)
+
 
 @pytest.fixture(autouse=True)
-def _hang_dump():
-    faulthandler.dump_traceback_later(PER_TEST_HANG_DUMP_S, exit=True)
+def _hang_dump(request):
+    global _watchdog_timer
+    _hang_dump_file.write(f"=== arm: {request.node.nodeid}\n")
+    _hang_dump_file.flush()
+    _watchdog_timer = _threading.Timer(
+        PER_TEST_HANG_DUMP_S, _dump_all_threads_and_exit,
+        args=(request.node.nodeid,))
+    _watchdog_timer.daemon = True
+    _watchdog_timer.start()
     yield
-    faulthandler.cancel_dump_traceback_later()
+    _watchdog_timer.cancel()
 
 
 @pytest.fixture(scope="module")
