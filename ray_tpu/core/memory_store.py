@@ -31,7 +31,9 @@ class ObjectEntry:
 
 class MemoryStore:
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock: belt-and-braces against destructor/callback re-entry
+        # (see object_ref.py deferred releases)
+        self._lock = threading.RLock()
         self._objects: dict[ObjectID, ObjectEntry] = {}
         self._waiters: dict[ObjectID, list[threading.Event]] = {}
         self._callbacks: dict[ObjectID, list[Callable[[ObjectEntry], None]]] = {}

@@ -85,10 +85,29 @@ def _runtime_add_local_ref(ref: ObjectRef) -> None:
 
 
 def _runtime_remove_local_ref(ref: ObjectRef) -> None:
+    """__del__ side of refcounting — DEFERRED, never synchronous.
+
+    A destructor runs wherever the garbage collector fires, i.e. inside
+    ANY allocation — including while the current thread holds framework
+    locks. A synchronous remove_local_ref from here re-enters the
+    reference counter → on-zero → task manager/memory store on the same
+    thread and self-deadlocks on their non-reentrant locks (observed: GC
+    during TaskManager.add_pending's dict insert → release_lineage on the
+    already-held lock wedged the whole process; the round-2 suite hang).
+    So __del__ only enqueues the id; the runtime drains the queue from
+    plain API call stacks that hold no locks.
+    """
     try:
         from ray_tpu.core import api
         rt = api._try_get_runtime()
-        if rt is not None:
+        if rt is None:
+            return
+        defer = getattr(rt, "defer_release", None)
+        if defer is not None:
+            defer(ref.id())
+        else:
+            # client-mode runtime: its ref counter only batches a release
+            # RPC (no framework locks), so the synchronous path is safe
             rt.reference_counter.remove_local_ref(ref.id())
     except Exception:
         # interpreter shutdown or runtime already gone

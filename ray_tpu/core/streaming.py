@@ -202,11 +202,15 @@ class ObjectRefGenerator:
 
     def __del__(self):
         # abandoned before StopIteration: release buffered items (the
-        # producer unblocks via the absent-stream consumed sentinel)
+        # producer unblocks via the absent-stream consumed sentinel).
+        # DEFERRED like ObjectRef.__del__ — abandon takes stream/refcount/
+        # memory-store locks and a destructor can fire while this thread
+        # holds them (GC-reentrancy; see object_ref.py).
         try:
             st = self._stream
             if st.total is None or st.cursor < st.total:
-                self._rt.stream_manager.abandon(st.task_id)
+                mgr = self._rt.stream_manager
+                self._rt.defer_call(lambda: mgr.abandon(st.task_id))
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
 

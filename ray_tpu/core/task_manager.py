@@ -33,7 +33,12 @@ class _PendingTask:
 class TaskManager:
     def __init__(self, runtime):
         self._rt = runtime
-        self._lock = threading.Lock()
+        # RLock: the deferred-release queue keeps destructor side effects
+        # off arbitrary stacks; if a re-entrant call (lineage release inside
+        # add_pending) ever slips through anyway, it executes NESTED on the
+        # same thread instead of self-deadlocking — the individual dict ops
+        # are each atomic, so nested execution is safe here
+        self._lock = threading.RLock()
         self._pending: dict[TaskID, _PendingTask] = {}
         # lineage: owned object -> spec of the task that creates it
         self._lineage: dict[ObjectID, TaskSpec] = {}

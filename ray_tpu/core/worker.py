@@ -210,6 +210,12 @@ class WorkerRuntime:
         self._normal_exec = _NormalTaskQueue()
         self._running_tasks: dict[TaskID, threading.Event] = {}
         self._blocked_notified = threading.local()
+        # ObjectRef.__del__ enqueues here instead of calling into the
+        # reference counter synchronously: destructors fire inside arbitrary
+        # allocations, where the current thread may already hold framework
+        # locks (GC-reentrancy self-deadlock; see object_ref.py). deque
+        # append/popleft are GIL-atomic — no lock in the destructor path.
+        self._release_q: deque = deque()
         # Eager: lazy init would race on the reply threads and register the
         # same Prometheus series twice (the registry doesn't dedup).
         from ray_tpu.util.metrics import Histogram
@@ -245,7 +251,36 @@ class WorkerRuntime:
 
     # ------------------------------------------------------------------
     # public ops: put / get / wait
+    def defer_release(self, oid: ObjectID) -> None:
+        """Queue a local-ref release from ObjectRef.__del__ (lock-free)."""
+        self._release_q.append(oid)
+
+    def defer_call(self, fn: Callable) -> None:
+        """Queue arbitrary destructor-side cleanup (e.g. stream abandon) to
+        run on a safe stack — same GC-reentrancy rules as defer_release."""
+        self._release_q.append(fn)
+
+    def drain_releases(self) -> None:
+        """Apply queued __del__ releases. Called from plain API entry
+        points (no framework locks held) and the pubsub poll loop, so the
+        on-zero cascade (refcount → task manager → memory store → remote
+        store deletes) runs on a safe stack."""
+        q = self._release_q
+        while True:
+            try:
+                item = q.popleft()
+            except IndexError:
+                return
+            try:
+                if callable(item):
+                    item()
+                else:
+                    self.reference_counter.remove_local_ref(item)
+            except Exception:  # noqa: BLE001 — release must never throw
+                logger.exception("deferred release failed")
+
     def put(self, value: Any, *, device_hint: str = "") -> ObjectRef:
+        self.drain_releases()
         self._ctx.put_counter += 1
         oid = ObjectID.for_put(self.current_task_id(), self._ctx.put_counter)
         if _is_device_array(value):
@@ -278,6 +313,7 @@ class WorkerRuntime:
         self.memory_store.put_location(oid, self.node_id)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        self.drain_releases()
         watchdog = timeout is None and get_config().blocking_watchdog_s > 0
         if watchdog:
             timeout = get_config().blocking_watchdog_s
@@ -485,6 +521,7 @@ class WorkerRuntime:
         """Event-driven wait (ref: CoreWorker::Wait core_worker.h:695 + the
         raylet's WaitManager): owned refs wake on memory-store availability,
         borrowed refs on owner long-poll replies — no per-ref poll loop."""
+        self.drain_releases()
         watchdog = timeout is None and get_config().blocking_watchdog_s > 0
         if watchdog:
             timeout = get_config().blocking_watchdog_s
@@ -611,6 +648,7 @@ class WorkerRuntime:
                     strategy: SchedulingStrategy | None = None,
                     max_retries: int | None = None, retry_exceptions: bool = False,
                     name: str = "", runtime_env: dict | None = None):
+        self.drain_releases()
         cfg = get_config()
         if runtime_env:
             from ray_tpu.runtime_env import prepare_runtime_env
@@ -666,6 +704,7 @@ class WorkerRuntime:
                           kwargs: dict, *, num_returns: int | str = 1,
                           max_task_retries: int = 0, name: str = "",
                           concurrency_group: str = ""):
+        self.drain_releases()
         streaming = num_returns == "streaming"
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(self.job_id, actor_id, self._bump_counter()),
@@ -1004,6 +1043,7 @@ class WorkerRuntime:
 
     def _pubsub_recovery_loop(self):
         while not self._shutdown.is_set():
+            self.drain_releases()  # idle processes still free refs promptly
             with self._pubsub_lock:
                 channels = dict(self._pubsub_seen)
             if not channels:
