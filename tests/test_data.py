@@ -334,8 +334,16 @@ def test_distributed_hash_shuffle_1gb_two_nodes():
     output partition; reduce concats) — partition data never passes through
     the driver (reference hash_shuffle.py map/reduce split)."""
     from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.config import get_config
 
     ray_tpu.shutdown()
+    # GiB-scale arrow ops monopolize this 1-core box for seconds at a time;
+    # the default health-check budget declares the (in-process) node dead
+    # mid-shuffle. Loosen it for this test only.
+    cfg = get_config()
+    saved = (cfg.health_check_timeout_s, cfg.health_check_failure_threshold)
+    cfg.health_check_timeout_s = 120.0
+    cfg.health_check_failure_threshold = 120
     cluster = Cluster()
     cap = 3 * (1 << 30) // 2  # 1.5 GiB per node store
     cluster.add_node(num_cpus=2, object_store_memory=cap)
@@ -365,4 +373,5 @@ def test_distributed_hash_shuffle_1gb_two_nodes():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+        cfg.health_check_timeout_s, cfg.health_check_failure_threshold = saved
 
