@@ -637,6 +637,14 @@ class NodeAgent:
     def _h_store_get_meta(self, body):
         return self.store.get_meta(body["object_id"])
 
+    def _h_store_read_done(self, body):
+        """Reader finished deserializing: release its read lease so the
+        spill/delete paths may touch the extent again."""
+        read_done = getattr(self.store, "read_done", None)
+        if read_done is not None:
+            read_done(body["object_id"])
+        return {"ok": True}
+
     def _h_store_contains(self, body):
         return self.store.contains(body["object_id"])
 

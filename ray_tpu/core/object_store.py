@@ -453,6 +453,15 @@ class SpillingStore:
         self._sealed: set[ObjectID] = set()
         self._spilled: dict[ObjectID, int] = {}  # oid -> size on disk
         self._last_read: dict[ObjectID, float] = {}  # grace vs read races
+        # READ LEASES: arena extents are reused after spill/delete, and
+        # readers deserialize zero-copy over the mapping (arrow tables keep
+        # aliasing it) — spilling an object mid-read segfaults the reader
+        # in native code. get_meta takes a lease; the reader releases it
+        # after deserializing; spill skips leased objects (expiry bounds a
+        # crashed reader).
+        self._read_leases: dict[ObjectID, int] = {}
+        self._lease_expiry: dict[ObjectID, float] = {}
+        self._pending_delete: set[ObjectID] = set()
         self.num_spilled = 0
         self.num_restored = 0
 
@@ -496,10 +505,30 @@ class SpillingStore:
             if self._spill_one(oid):
                 used = self._b.stats()["used_bytes"]
 
+    def _lease_active(self, oid: ObjectID) -> bool:
+        """Lock held. Expired leases (crashed/lost readers — read_done is a
+        best-effort notify) are swept here so they cannot leak pending
+        deletes or embargo spilling forever."""
+        if self._read_leases.get(oid, 0) <= 0:
+            return False
+        if time.monotonic() < self._lease_expiry.get(oid, 0.0):
+            return True
+        self._read_leases.pop(oid, None)
+        self._lease_expiry.pop(oid, None)
+        return False
+
     def _spill_one(self, oid: ObjectID) -> bool:
         """Spill one sealed object to disk. Lock held."""
         if oid not in self._sealed:
             return False
+        if self._lease_active(oid):
+            return False  # a reader still aliases this extent
+        if oid in self._pending_delete:
+            # condemned while a (now-gone) reader held it: free the memory
+            # instead of wasting disk I/O on a dead object
+            self._pending_delete.discard(oid)
+            self._drop_locked(oid)
+            return True
         out = self._b.read_bytes(oid)
         if out is None:
             self._lru.pop(oid, None)
@@ -523,7 +552,8 @@ class SpillingStore:
         self._maybe_spill(size)
         with open(path, "rb") as f:
             data = f.read()
-        self._b.write_bytes(oid, data)
+        self._alloc_with_forced_spill(
+            lambda: self._b.write_bytes(oid, data), size, exclude=oid)
         self._b.pin(oid, self._pinned.get(oid, False))
         self._lru[oid] = size
         self._sealed.add(oid)
@@ -532,30 +562,46 @@ class SpillingStore:
         self.num_restored += 1
         return True
 
+    def _alloc_with_forced_spill(self, attempt, size: int, exclude=None):
+        """Run an allocating backend op, force-spilling LRU objects one at
+        a time on ObjectStoreFullError (grace-window skips or arena
+        fragmentation must grind through disk, not fail the task). Lock
+        held. Raises only when the op can never fit or nothing is left to
+        spill."""
+        while True:
+            try:
+                return attempt()
+            except ObjectStoreFullError:
+                if size > self._high_water:
+                    raise  # spilling can never make this fit
+                spilled = False
+                for oid in list(self._lru):
+                    if oid != exclude and self._spill_one(oid):
+                        spilled = True
+                        break
+                if not spilled:
+                    raise
+
+    def _drop_locked(self, oid: ObjectID):
+        """Forget an object entirely (lock held)."""
+        import os
+        self._lru.pop(oid, None)
+        self._pinned.pop(oid, None)
+        self._sealed.discard(oid)
+        self._last_read.pop(oid, None)
+        if self._spilled.pop(oid, None) is not None:
+            try:
+                os.remove(self._spill_path(oid))
+            except OSError:
+                pass
+        self._b.delete(oid)
+
     # store interface ----------------------------------------------------
     def create(self, object_id: ObjectID, size: int, device_hint: str = ""):
-        from ray_tpu.exceptions import ObjectStoreFullError
         with self._lock:
             self._maybe_spill(size)
-            while True:
-                try:
-                    name_off = self._b.create(object_id, size, device_hint)
-                    break
-                except ObjectStoreFullError:
-                    if size > self._high_water:
-                        raise  # spilling can never make this fit
-                    # Grace-window skips or arena fragmentation (freed bytes
-                    # but no contiguous extent): force-spill LRU objects one
-                    # at a time — a shuffle burst must grind through disk,
-                    # not fail the task. Only when nothing is left to spill
-                    # is the store truly full.
-                    spilled = False
-                    for oid in list(self._lru):
-                        if self._spill_one(oid):
-                            spilled = True
-                            break
-                    if not spilled:
-                        raise
+            name_off = self._alloc_with_forced_spill(
+                lambda: self._b.create(object_id, size, device_hint), size)
             self._lru[object_id] = size
             self._pinned[object_id] = True
             return name_off
@@ -574,7 +620,32 @@ class SpillingStore:
             if meta is not None:
                 self._lru.move_to_end(object_id, last=True)
                 self._last_read[object_id] = time.monotonic()
+                # read lease: the caller will map/alias this extent; it
+                # must not be spilled until read_done (expiry backstops a
+                # crashed reader)
+                self._read_leases[object_id] = \
+                    self._read_leases.get(object_id, 0) + 1
+                # expiry scales with size: copy-out + deserialize of a
+                # GiB-scale object on a busy host can exceed a flat minute
+                self._lease_expiry[object_id] = time.monotonic() + 60.0 + \
+                    meta[2] / (16 * 1024 * 1024)
             return meta
+
+    def read_done(self, object_id: ObjectID):
+        """Reader finished deserializing: release one read lease (and apply
+        a deletion that arrived mid-read)."""
+        do_delete = False
+        with self._lock:
+            n = self._read_leases.get(object_id, 0)
+            if n <= 1:
+                self._read_leases.pop(object_id, None)
+                self._lease_expiry.pop(object_id, None)
+                do_delete = object_id in self._pending_delete
+            else:
+                self._read_leases[object_id] = n - 1
+        if do_delete:
+            self._pending_delete.discard(object_id)
+            self.delete(object_id)
 
     def contains(self, object_id: ObjectID) -> bool:
         return self._b.contains(object_id) or object_id in self._spilled
@@ -585,18 +656,15 @@ class SpillingStore:
         self._b.pin(object_id, pinned)
 
     def delete(self, object_id: ObjectID):
-        import os
         with self._lock:
-            self._lru.pop(object_id, None)
-            self._pinned.pop(object_id, None)
-            self._sealed.discard(object_id)
-            self._last_read.pop(object_id, None)
-            if self._spilled.pop(object_id, None) is not None:
-                try:
-                    os.remove(self._spill_path(object_id))
-                except OSError:
-                    pass
-        self._b.delete(object_id)
+            if self._lease_active(object_id):
+                # a reader is mid-copy over the extent: freeing it now
+                # would reuse the memory under the copy (torn buffer) —
+                # defer to read_done / the expiry sweep in _spill_one
+                self._pending_delete.add(object_id)
+                return
+            self._pending_delete.discard(object_id)
+            self._drop_locked(object_id)
 
     def read_bytes(self, object_id: ObjectID, offset: int = 0,
                    size: int | None = None):

@@ -46,6 +46,7 @@ from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.exceptions import (
     GetTimeoutError,
     ObjectLostError,
+    ObjectStoreFullError,
     TaskCancelledError,
     TaskError,
 )
@@ -437,7 +438,14 @@ class WorkerRuntime:
         if self.agent_addr is None:
             return None, False
         agent = self.peer_pool.get(self.agent_addr)
-        meta = agent.call_with_retry("store_get_meta", {"object_id": oid}, timeout=30.0)
+        try:
+            meta = agent.call_with_retry(
+                "store_get_meta", {"object_id": oid}, timeout=30.0)
+        except ObjectStoreFullError:
+            # the meta fetch can RESTORE a spilled object; under transient
+            # pressure that can fail — back off, let the get loop re-poll
+            time.sleep(0.2)
+            return None, False
         if meta is None:
             # not local: pull from a remote holder (ref: pull_manager.h:49)
             for node_id in list(locations or []):
@@ -446,25 +454,55 @@ class WorkerRuntime:
                 remote_addr = self._node_addr(node_id)
                 if remote_addr is None:
                     continue
-                r = agent.call_with_retry(
-                    "pull_object",
-                    {"object_id": oid, "from_addr": remote_addr, "owner_addr": owner_addr},
-                    timeout=120.0)
+                try:
+                    r = agent.call_with_retry(
+                        "pull_object",
+                        {"object_id": oid, "from_addr": remote_addr,
+                         "owner_addr": owner_addr},
+                        timeout=120.0)
+                except ObjectStoreFullError:
+                    # destination store momentarily full of UNSEALED inbound
+                    # chunks (nothing spillable): back off and let the
+                    # caller's get loop re-poll — pressure resolves as
+                    # in-flight transfers seal and consumers release
+                    # (reference: plasma blocks creates under pressure)
+                    time.sleep(0.2)
+                    return None, False
                 if r.get("ok"):
-                    meta = agent.call_with_retry(
-                        "store_get_meta", {"object_id": oid}, timeout=30.0)
+                    try:
+                        meta = agent.call_with_retry(
+                            "store_get_meta", {"object_id": oid},
+                            timeout=30.0)
+                    except ObjectStoreFullError:
+                        # the freshly pulled copy was spilled and its
+                        # restore hit pressure: back off and re-poll
+                        time.sleep(0.2)
+                        return None, False
                     break
             if meta is None:
                 return None, False
         shm_name, offset, size, _device = meta[:4]
         copy_on_read = bool(meta[4]) if len(meta) > 4 else False
-        mv = self.shm_client.map(shm_name, size, offset)
-        if copy_on_read:
-            # arena-backed extents are reused after eviction; deserialized
-            # buffers must not alias the mapping (see NativeShmStore.get_meta)
-            mv = memoryview(bytes(mv))
-        sobj = SerializedObject.from_buffer(mv)
-        return self.serialization.deserialize(sobj), True
+        try:
+            mv = self.shm_client.map(shm_name, size, offset)
+            if copy_on_read:
+                # arena-backed extents are reused after eviction;
+                # deserialized buffers must not alias the mapping (see
+                # NativeShmStore.get_meta)
+                mv = memoryview(bytes(mv))
+            sobj = SerializedObject.from_buffer(mv)
+            return self.serialization.deserialize(sobj), True
+        finally:
+            # Release the read lease get_meta took. Until this, the store
+            # must not spill/delete the extent: an overwrite during the
+            # copy-out hands the deserializer a TORN buffer, and arrow's
+            # IPC parser segfaults on corrupt bytes (observed in dmesg).
+            # Arena extents are copy_on_read, python-backend segments stay
+            # valid while mapped — so after deserialize the lease can drop.
+            try:
+                agent.notify("store_read_done", {"object_id": oid})
+            except Exception:  # noqa: BLE001
+                pass
 
     def _node_addr(self, node_id: NodeID):
         addr = self._node_addr_cache.get(node_id)
@@ -1350,9 +1388,21 @@ class WorkerRuntime:
     def _store_return_shm(self, oid: ObjectID, sobj: SerializedObject, spec: TaskSpec):
         size = sobj.serialized_size()
         agent = self.peer_pool.get(self.agent_addr)
-        reply = agent.call_with_retry(
-            "store_create", {"object_id": oid, "size": size,
-                             "owner_addr": spec.owner_addr}, timeout=30.0)
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                reply = agent.call_with_retry(
+                    "store_create", {"object_id": oid, "size": size,
+                                     "owner_addr": spec.owner_addr},
+                    timeout=30.0)
+                break
+            except ObjectStoreFullError:
+                # transient pressure (unsealed inbound transfers, nothing
+                # spillable yet): wait for the store to breathe rather than
+                # failing the task (reference: plasma create blocks)
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
         mv = self.shm_client.map(reply["shm_name"], size, reply.get("offset", 0))
         _write_serialized(mv, sobj)
         agent.call_with_retry("store_seal", {"object_id": oid}, timeout=30.0)
