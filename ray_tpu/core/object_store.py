@@ -429,12 +429,12 @@ class SpillingStore:
     TPU-native analog of the reference's LocalObjectManager spilling
     (/root/reference/src/ray/raylet/local_object_manager.h:44,
     SpillObjects:114 + SpilledObjectReader): when a create would exceed the
-    high-water mark, sealed+unpinned objects are spilled to local disk in
-    LRU order instead of evicted (deleted); a get of a spilled object
-    restores it into shared memory transparently. Only objects the backend
-    would otherwise evict are spilled, so spilling never changes semantics —
-    it just turns "object lost, reconstruct" into "object restored from
-    disk".
+    high-water mark, sealed objects are spilled to local disk in LRU order
+    (pinned or not — spill preserves the value, so it never changes
+    semantics; a get of a spilled object restores it transparently). The
+    wrapper owns ALL reclamation: every object stays backend-pinned so the
+    backend's lease-blind LRU eviction can never reuse an extent under a
+    live reader (see pin()).
     """
 
     def __init__(self, backend, spill_dir: str, capacity_bytes: int,
@@ -449,7 +449,6 @@ class SpillingStore:
         self._lock = threading.Lock()
         # our own LRU + seal view (backend internals differ); oid -> size
         self._lru: OrderedDict[ObjectID, int] = OrderedDict()
-        self._pinned: dict[ObjectID, bool] = {}
         self._sealed: set[ObjectID] = set()
         self._spilled: dict[ObjectID, int] = {}  # oid -> size on disk
         self._last_read: dict[ObjectID, float] = {}  # grace vs read races
@@ -554,7 +553,7 @@ class SpillingStore:
             data = f.read()
         self._alloc_with_forced_spill(
             lambda: self._b.write_bytes(oid, data), size, exclude=oid)
-        self._b.pin(oid, self._pinned.get(oid, False))
+        # stays backend-pinned (see pin()): reclamation is wrapper-only
         self._lru[oid] = size
         self._sealed.add(oid)
         self._spilled.pop(oid, None)
@@ -586,7 +585,6 @@ class SpillingStore:
         """Forget an object entirely (lock held)."""
         import os
         self._lru.pop(oid, None)
-        self._pinned.pop(oid, None)
         self._sealed.discard(oid)
         self._last_read.pop(oid, None)
         if self._spilled.pop(oid, None) is not None:
@@ -603,7 +601,6 @@ class SpillingStore:
             name_off = self._alloc_with_forced_spill(
                 lambda: self._b.create(object_id, size, device_hint), size)
             self._lru[object_id] = size
-            self._pinned[object_id] = True
             return name_off
 
     def seal(self, object_id: ObjectID):
@@ -651,9 +648,13 @@ class SpillingStore:
         return self._b.contains(object_id) or object_id in self._spilled
 
     def pin(self, object_id: ObjectID, pinned: bool = True):
-        with self._lock:
-            self._pinned[object_id] = pinned
-        self._b.pin(object_id, pinned)
+        """Deliberately INERT under spilling. The backend must never see
+        unpinned objects: its internal LRU eviction reuses extents without
+        consulting our read leases, which tore buffers under live remote
+        reads (libarrow segfaults parsing the corrupt copy). With every
+        object backend-pinned, ALL reclamation flows through this
+        wrapper's spill/delete, which honor leases — and spilling pinned
+        objects is safe by design, so pin state doesn't gate anything."""
 
     def delete(self, object_id: ObjectID):
         with self._lock:
@@ -679,10 +680,9 @@ class SpillingStore:
     def write_bytes(self, object_id: ObjectID, data: bytes):
         with self._lock:
             self._maybe_spill(len(data))
-        self._b.write_bytes(object_id, data)
-        with self._lock:
+            self._alloc_with_forced_spill(
+                lambda: self._b.write_bytes(object_id, data), len(data))
             self._lru[object_id] = len(data)
-            self._pinned[object_id] = True
             self._sealed.add(object_id)
 
     def write_chunk(self, object_id: ObjectID, offset: int, data: bytes,
@@ -690,10 +690,15 @@ class SpillingStore:
         if offset == 0:
             with self._lock:
                 self._maybe_spill(total)
-        self._b.write_chunk(object_id, offset, data, total)
+                # first chunk allocates the extent: grind through spill on
+                # pressure like every other allocating path
+                self._alloc_with_forced_spill(
+                    lambda: self._b.write_chunk(object_id, offset, data,
+                                                total), total)
+        else:
+            self._b.write_chunk(object_id, offset, data, total)
         with self._lock:
             self._lru[object_id] = total
-            self._pinned.setdefault(object_id, True)
             if offset + len(data) >= total:
                 self._sealed.add(object_id)
 
