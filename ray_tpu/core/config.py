@@ -101,6 +101,12 @@ class Config:
     streaming_backpressure_items: int = 16
 
     # --- data (streaming executor; ref: resource_manager.py budgets) ---
+    # Read tasks stream blocks through ObjectRefGenerators (first block
+    # flows downstream before the datasource finishes). Default OFF: an
+    # intermittent libarrow fault under the early-exit (take/limit) cancel
+    # path is still being chased — see tests/test_data.py
+    # test_streaming_read_incremental, which opts in.
+    data_streaming_reads: bool = False
     # Per-operator cap on BYTES of input blocks with in-flight transform
     # tasks (a 100 MB block charges 100 MB, not "1 task").
     data_op_inflight_bytes: int = 128 * 1024 * 1024

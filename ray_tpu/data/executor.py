@@ -103,10 +103,26 @@ def _map_task(block: Block, stages: list):
 
 @ray_tpu.remote(num_returns=2)
 def _read_task(task):
+    """Non-streaming fallback (remote-client drivers: the client protocol
+    doesn't carry ObjectRefGenerators yet)."""
     blocks = list(task())
     block = BlockAccessor.concat(blocks)
     return block, BlockAccessor.for_block(block).metadata(
         input_files=task.input_files)
+
+
+@ray_tpu.remote(num_returns="streaming")
+def _read_stream_task(task):
+    """Streaming read: each produced block reaches the executor AS SOON AS
+    the datasource yields it (reference: read tasks return streaming
+    generators consumed by the executor, core_worker.proto:513 +
+    _internal/execution/operators/task_pool_map_operator.py). Items
+    alternate (metadata, block): the small inline metadata lets the driver
+    schedule downstream work without ever fetching block data."""
+    for block in task():
+        acc = BlockAccessor.for_block(block)
+        yield acc.metadata(input_files=task.input_files)
+        yield block
 
 
 @ray_tpu.remote(num_returns=2)
@@ -310,36 +326,103 @@ class ActorMapOp(PhysicalOp):
 
 
 class ReadOp(TaskMapOp):
+    """Source op over streaming read tasks: blocks surface as the remote
+    datasource yields them (the executor consumes ObjectRefGenerators —
+    a whole file list no longer has to finish before the first block flows
+    downstream)."""
+
     def __init__(self, name, read_tasks):
         PhysicalOp.__init__(self, name, [])
         self._stages = []
         self._resources = {}
-        self._in_flight = []
+        self._in_flight = []  # [(generator, pending_meta | None)]
         # in-flight READS are not byte-budgeted (block sizes are unknown
-        # until the task returns metadata); the output-buffer byte cap and
+        # until metadata streams back); the output-buffer byte cap and
         # the executor's global source throttle bound read memory instead
         self._init_budgets()
         self._pending = list(read_tasks)
         self._inputs_done = True
+        # decided once: neither the flag nor the runtime mode changes
+        # mid-dataset, and poll() runs every scheduler tick
+        from ray_tpu.core import api
+        from ray_tpu.core.config import get_config
+        self._streaming = get_config().data_streaming_reads and \
+            getattr(api._get_runtime(), "mode", "") != "client"
 
     def can_accept(self):
         return False
 
+    def shutdown(self):
+        """Early exit (limit satisfied / executor stop): stop submitting
+        reads and explicitly abandon live generators so producers cancel
+        NOW on a controlled stack, instead of whenever GC finds them."""
+        self._pending = []
+        from ray_tpu.core import api
+        rt = api._try_get_runtime()
+        for ent in self._in_flight:
+            if ent[0] != "fallback" and rt is not None:
+                try:
+                    rt.stream_manager.abandon(ent[0]._stream.task_id)
+                except Exception:  # noqa: BLE001
+                    pass
+        self._in_flight = []
+        self.done = True
+
     def poll(self):
+        streaming_ok = self._streaming
+        # NOTE: non-head streams buffer up to streaming_backpressure_items
+        # (~8 blocks each) of produced-but-unconsumed items that no byte
+        # budget counts; the per-stream window bounds it, but large-block
+        # sources should size MAX_IN_FLIGHT/window accordingly.
         while not self.throttled and self._pending \
                 and len(self._in_flight) < self.MAX_IN_FLIGHT \
                 and len(self.out) < self.MAX_OUT_BUFFER \
                 and self._out_bytes() < self._outbuf_budget:
             task = self._pending.pop(0)
-            self._in_flight.append(_read_task.remote(task))
+            if streaming_ok:
+                self._in_flight.append(
+                    [_read_stream_task.remote(task), None])
+            else:
+                # remote-client driver: the client protocol can't carry
+                # ObjectRefGenerators — fall back to whole-task reads
+                self._in_flight.append(
+                    ["fallback", _read_task.remote(task)])
+        # Emit ONLY from the head stream so blocks keep submission order
+        # (reference preserve_order; take() depends on it). Later streams
+        # still produce concurrently up to their backpressure windows —
+        # that's the prefetch.
         while self._in_flight:
-            b, m = self._in_flight[0]
-            ready, _ = ray_tpu.wait([m], num_returns=1, timeout=0)
-            if not ready:
+            ent = self._in_flight[0]
+            if ent[0] == "fallback":
+                b, m = ent[1]
+                ready, _ = ray_tpu.wait([m], num_returns=1, timeout=0)
+                if not ready:
+                    break
+                self._in_flight.pop(0)
+                self.out.append((b, ray_tpu.get(m)))
+                continue
+            gen, pending_meta = ent
+            advanced = False
+            while True:
+                if len(self.out) >= self.MAX_OUT_BUFFER or \
+                        self._out_bytes() >= self._outbuf_budget:
+                    break
+                try:
+                    ref = gen.next_ready()
+                except StopIteration:
+                    self._in_flight.pop(0)
+                    advanced = True
+                    break
+                if ref is None:
+                    break
+                if pending_meta is None:
+                    # metadata item: tiny + inline — get() is immediate
+                    ent[1] = pending_meta = ray_tpu.get(ref)
+                else:
+                    self.out.append((ref, pending_meta))
+                    ent[1] = pending_meta = None
+            if not advanced:
                 break
-            self._in_flight.pop(0)
-            meta = ray_tpu.get(m)
-            self.out.append((b, meta))
         if not self._pending and not self._in_flight:
             self.done = True
 
