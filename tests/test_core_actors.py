@@ -88,11 +88,17 @@ def test_named_actor(ray_start_regular):
 
 
 def test_kill_actor(ray_start_regular):
+    import time as _time
+
     c = Counter.remote()
     assert ray_tpu.get(c.inc.remote()) == 1
     ray_tpu.kill(c)
+    # kill is ASYNC (reference semantics): a call racing the kill RPC may
+    # still execute; keep calling until the death lands
     with pytest.raises((exceptions.TaskError, exceptions.ActorDiedError)):
-        ray_tpu.get(c.inc.remote(), timeout=30)
+        for _ in range(100):
+            ray_tpu.get(c.inc.remote(), timeout=30)
+            _time.sleep(0.1)
 
 
 def test_actor_restart(ray_start_regular):
