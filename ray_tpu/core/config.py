@@ -88,12 +88,13 @@ class Config:
 
     # --- watchdog ---
     # get()/wait() called with no explicit timeout raise GetTimeoutError
-    # after this many seconds (0 disables). A lost reply or dead owner must
-    # fail loudly instead of hanging the process forever; legitimately
-    # longer-blocking work (multi-hour gets on training tasks) should pass
-    # an explicit timeout or raise/disable this. The test suite pins it low
-    # so a wedge surfaces in minutes.
-    blocking_watchdog_s: float = 1800.0
+    # after this many seconds. Default 0 = disabled: bare get() blocks
+    # indefinitely, matching the reference's ray.get semantics — a
+    # legitimate multi-hour driver-side get on a training task must not
+    # fail in production. Opt in (RAY_TPU_BLOCKING_WATCHDOG_S) to convert
+    # wedges into loud GetTimeoutErrors; the test suite pins it to 300 so
+    # a wedge surfaces in minutes (tests/conftest.py).
+    blocking_watchdog_s: float = 0.0
 
     # --- streaming generator returns ---
     # Max streamed items the producer may run AHEAD OF THE CONSUMER's

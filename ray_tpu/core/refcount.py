@@ -46,6 +46,20 @@ _PROBE_STRIKES = 3
 # key for borrows registered at serialize time whose recipient has not yet
 # attached (deserialized the ref)
 _IN_FLIGHT = None
+# sentinel distinct from every bucket key (incl. _IN_FLIGHT)
+_NO_BUCKET = object()
+
+
+def _take_one(bucket: dict, key) -> bool:
+    """Decrement ``bucket[key]``, dropping the entry at zero. False if absent."""
+    n = bucket.get(key, 0)
+    if n <= 0:
+        return False
+    if n == 1:
+        bucket.pop(key, None)
+    else:
+        bucket[key] = n - 1
+    return True
 
 
 @dataclass
@@ -57,6 +71,12 @@ class _Count:
     # borrower key -> count. Key is (addr, worker_id_hex) once attached,
     # _IN_FLIGHT for serialize-time registrations not yet claimed.
     borrower_counts: dict = field(default_factory=dict)
+    # holder key -> count of decs that arrived before (or without) the
+    # holder's attach. attach_borrow consumes one instead of counting a
+    # fresh borrow — attach/dec are one-way notifies with no cross-message
+    # ordering guarantee, and a reordered attach must not create a phantom
+    # borrow that pins the object until the borrower process dies.
+    unmatched_decs: dict = field(default_factory=dict)
 
     def borrows(self) -> int:
         return sum(self.borrower_counts.values())
@@ -180,20 +200,28 @@ class ReferenceCounter:
     def attach_borrow(self, object_id: ObjectID, holder):
         """Owner-side: a recipient deserialized the ref — move one in-flight
         borrow under the recipient's identity so death reclamation covers
-        it. If no in-flight borrow remains (attach raced a release or the
-        registration RPC was lost), count a fresh borrow for the holder: the
-        holder really does hold a live ref and will dec on release."""
+        it. If the holder's dec already arrived (one-way notifies can
+        reorder: a fast deserialize-then-release lands dec first, which
+        consumed the in-flight registration), consume the dec tombstone and
+        do nothing — counting a fresh borrow here would pin the object until
+        the borrower process dies.
+
+        Deliberate tradeoff: a tombstone left by a LOST (not reordered)
+        attach can swallow this holder's next genuine attach for the same
+        object, leaving that borrow in the unprobed in-flight bucket. We
+        accept that (it narrows death-reclaim in a rare, already-logged RPC
+        -loss case) because the alternative — attributing an in-flight
+        borrow despite the tombstone — can misattribute a DIFFERENT sender's
+        in-flight registration to this holder, whose later death-reclaim
+        would free an object someone still references."""
         holder = tuple(holder)
         with self._lock:
             c = self._owned.get(object_id)
             if c is None:
                 return
-            n = c.borrower_counts.get(_IN_FLIGHT, 0)
-            if n > 0:
-                if n == 1:
-                    c.borrower_counts.pop(_IN_FLIGHT, None)
-                else:
-                    c.borrower_counts[_IN_FLIGHT] = n - 1
+            if _take_one(c.unmatched_decs, holder):
+                return
+            _take_one(c.borrower_counts, _IN_FLIGHT)
             c.borrower_counts[holder] = c.borrower_counts.get(holder, 0) + 1
         self._ensure_probe_thread()
 
@@ -203,16 +231,37 @@ class ReferenceCounter:
             c = self._owned.get(object_id)
             if c is None:
                 return
-            # release from the holder's bucket; fall back to the in-flight
-            # bucket (attach lost) then to any bucket (legacy callers)
-            for key in (holder, _IN_FLIGHT, *list(c.borrower_counts)):
-                n = c.borrower_counts.get(key, 0)
-                if n > 0:
-                    if n == 1:
-                        c.borrower_counts.pop(key, None)
-                    else:
-                        c.borrower_counts[key] = n - 1
+            # Release from the holder's bucket, else the in-flight bucket
+            # (the attach-not-yet-arrived reorder; holder-less decs such as
+            # task-dep releases target in-flight directly). Never raid
+            # another holder's bucket — a misattributed dec would let that
+            # holder's later death-reclaim free an object someone still
+            # references.
+            matched_key = _NO_BUCKET
+            for key in (holder, _IN_FLIGHT):
+                if _take_one(c.borrower_counts, key):
+                    matched_key = key
                     break
+            if holder is not _IN_FLIGHT and matched_key is not holder:
+                # An attributed dec that did not find its holder's bucket:
+                # its attach is late (reorder) or lost. Leave a tombstone so
+                # the late attach is a no-op instead of a phantom borrow.
+                # Holder-less decs (task deps) never reach here, so normal
+                # operation does not accumulate tombstones.
+                c.unmatched_decs[holder] = c.unmatched_decs.get(holder, 0) + 1
+            if matched_key is _NO_BUCKET:
+                if holder is _IN_FLIGHT:
+                    logger.warning(
+                        "unmatched holder-less dec_borrow for %s (no borrow "
+                        "bucket; registration lost or consumed by an attach?) "
+                        "— count unchanged",
+                        object_id.hex()[:12])
+                else:
+                    logger.warning(
+                        "unmatched dec_borrow for %s from %s (no borrow "
+                        "bucket; registration lost?) — recorded tombstone, "
+                        "count unchanged",
+                        object_id.hex()[:12], holder)
             self._maybe_zero(object_id, c)
 
     def drop_borrower(self, holder: tuple):
@@ -222,6 +271,7 @@ class ReferenceCounter:
         zeroed: list[tuple[ObjectID, _Count]] = []
         with self._lock:
             for oid, c in list(self._owned.items()):
+                c.unmatched_decs.pop(holder, None)
                 if c.borrower_counts.pop(holder, 0):
                     zeroed.append((oid, c))
             for oid, c in zeroed:
@@ -305,7 +355,12 @@ class ReferenceCounter:
                 self._maybe_zero(object_id, c)
                 return
         if owner_addr is not None:
-            self._notify_owner_dec(object_id, owner_addr)
+            # holder-less: the dep registration went to the in-flight bucket
+            # (add_task_dep → inc_borrow with no holder) and is never
+            # attached, so its release must target in-flight symmetrically —
+            # an attributed dec here would tombstone on every normal release
+            # and later swallow a genuine attach from this worker.
+            self._notify_owner_dec(object_id, owner_addr, attributed=False)
 
     # ---- internals -----------------------------------------------------
     def _inc_any(self, ref, kind: str):
@@ -333,7 +388,11 @@ class ReferenceCounter:
                         self._maybe_zero(ref.id(), cc)
                         continue
                 if ref.owner_addr is not None:
-                    self._notify_owner_dec(ref.id(), ref.owner_addr)
+                    # holder-less for the same reason as remove_task_dep:
+                    # the containment registration (add_borrow_on_serialize)
+                    # went to the in-flight bucket and is never attached.
+                    self._notify_owner_dec(ref.id(), ref.owner_addr,
+                                           attributed=False)
 
     def _call_owner(self, object_id: ObjectID, owner_addr, method: str):
         if owner_addr is None or self._rt is None:
@@ -348,13 +407,15 @@ class ReferenceCounter:
             logger.warning("%s to owner %s for %s failed: %r",
                            method, owner_addr, object_id.hex()[:12], e)
 
-    def _notify_owner_dec(self, object_id: ObjectID, owner_addr):
+    def _notify_owner_dec(self, object_id: ObjectID, owner_addr,
+                          attributed: bool = True):
         if owner_addr is None or self._rt is None:
             return
         try:
             self._rt.peer_pool.get(owner_addr).notify(
                 "dec_borrow",
-                {"object_id": object_id, "holder": self._my_key()})
+                {"object_id": object_id,
+                 "holder": self._my_key() if attributed else None})
         except Exception as e:  # noqa: BLE001
             logger.warning("dec_borrow to owner %s for %s failed: %r "
                            "(owner's probe loop will reclaim on our death)",

@@ -62,7 +62,10 @@ class StreamManager:
         self._streams: dict[TaskID, _Stream] = {}
         # streams dropped by their consumer before draining: producers are
         # told to cancel on their next report/poll
-        self._abandoned: set[TaskID] = set()
+        # insertion-ordered so the size bound evicts the OLDEST entry — an
+        # arbitrary eviction could drop a producer that has not yet polled,
+        # leaving it running the generator to completion for nobody
+        self._abandoned: dict[TaskID, None] = {}
 
     def register(self, spec: "TaskSpec") -> "ObjectRefGenerator":
         st = _Stream(spec.task_id)
@@ -95,7 +98,7 @@ class StreamManager:
         with self._lock:
             abandoned = tid in self._abandoned
             if abandoned and body.get("done"):
-                self._abandoned.discard(tid)  # producer wound down
+                self._abandoned.pop(tid, None)  # producer wound down
         if abandoned:
             return {"ok": True, "cancel": True}
         pending = self._rt.task_manager.get_pending_spec(tid)
@@ -159,9 +162,9 @@ class StreamManager:
         if st is None:
             return
         with self._lock:
-            self._abandoned.add(task_id)
+            self._abandoned[task_id] = None
             if len(self._abandoned) > 4096:  # bound: ids of dead producers
-                self._abandoned.pop()
+                self._abandoned.pop(next(iter(self._abandoned)))
         self.discard(task_id)
         with st.cv:
             pending_items = list(st.items.values())

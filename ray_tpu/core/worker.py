@@ -1375,6 +1375,18 @@ class WorkerRuntime:
         with cv:
             while inflight[0] > 0 and time.monotonic() < deadline:
                 cv.wait(1.0)
+            unacked = inflight[0]
+        if unacked > 0:
+            # A success reply now would race ahead of the unacked item
+            # reports: the owner marks the task complete and drops them as
+            # stale, hanging the consumer on the missing index. Fail the
+            # reply instead so the owner's retry/failure machinery runs.
+            return {"results": [],
+                    "error": (f"stream {spec.task_id.hex()[:12]}: {unacked} "
+                              f"item report(s) unacknowledged after 60s "
+                              f"barrier; failing task instead of completing "
+                              f"with items possibly dropped"),
+                    "attempt": spec.attempt_number}
         return {"results": [], "error": None, "attempt": spec.attempt_number}
 
     def _h_stream_item(self, body):
