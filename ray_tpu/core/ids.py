@@ -25,12 +25,13 @@ _NIL = b"\xff"
 
 class BaseID:
     LEN = 0
-    __slots__ = ("_bin",)
+    __slots__ = ("_bin", "_hash")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.LEN:
             raise ValueError(f"{type(self).__name__} requires {self.LEN} bytes, got {len(binary)}")
         self._bin = binary
+        self._hash = None
 
     @classmethod
     def from_random(cls):
@@ -53,7 +54,12 @@ class BaseID:
         return type(other) is type(self) and other._bin == self._bin
 
     def __hash__(self) -> int:
-        return hash((type(self).__name__, self._bin))
+        # cached: IDs key every hot-path dict (pending tasks, refcounts,
+        # memory store) and are hashed many times per task
+        h = self._hash
+        if h is None:
+            h = self._hash = hash((type(self).__name__, self._bin))
+        return h
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self._bin.hex()})"

@@ -376,11 +376,12 @@ class RpcClient:
                 time.sleep(min(0.1 * 2 ** attempt, 1.0))
         raise last  # type: ignore[misc]
 
-    def notify(self, method: str, body: Any = None):
+    def notify(self, method: str, body: Any = None,
+               connect_timeout: float | None = None):
         with self._lock:
             self._next_id += 1
             msg_id = self._next_id
-        sock = self._ensure_conn()
+        sock = self._ensure_conn(connect_timeout)
         try:
             _send_frame(sock, _ONEWAY, pickle.dumps((msg_id, method, body)), self._wlock)
         except OSError as e:

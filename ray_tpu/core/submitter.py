@@ -41,6 +41,70 @@ class _ShapeState:
     last_busy: float = 0.0  # ts of last busy (saturated) lease reply
 
 
+class _Flusher:
+    """Rate-adaptive coalescing pump shared by both submitters: submit paths
+    mark a key dirty and set the event; this thread drains dirty keys via
+    the supplied callback until quiescent. A lone call finds the thread idle
+    and ships immediately; a tight fan-out loop outruns the thread, so each
+    drain picks up whatever accumulated — batching scales with submission
+    pressure with no artificial delay. Also keeps frame pickling + sends off
+    the submitting thread (normal_task_submitter.cc keeps submission
+    non-blocking the same way via the asio io-service)."""
+
+    def __init__(self, name: str, drain):
+        self._drain = drain
+        self._lock = threading.Lock()
+        self._dirty: set = set()
+        self._event = threading.Event()
+        self._stopped = False
+        threading.Thread(target=self._loop, name=name, daemon=True).start()
+
+    def mark(self, key):
+        with self._lock:
+            self._dirty.add(key)
+        self._event.set()
+
+    def stop(self):
+        self._stopped = True
+        self._event.set()
+
+    def _loop(self):
+        while True:
+            self._event.wait()
+            self._event.clear()
+            if self._stopped:
+                return
+            while True:
+                with self._lock:
+                    dirty, self._dirty = self._dirty, set()
+                if not dirty:
+                    break
+                for key in dirty:
+                    if self._stopped:
+                        return
+                    self._drain(key)
+
+
+def _take_batch(queue: deque, limit: int) -> list[TaskSpec]:
+    """Pop up to ``limit`` specs for one frame, stopping BEFORE any spec
+    that consumes a ref produced by a spec already in the batch. A frame's
+    replies are aggregated into one response, so an intra-frame consumer
+    would block resolving its arg while its producer's completed result sits
+    unsent in the same aggregate — a head-of-line deadlock. Cross-frame
+    dependencies are fine: each frame replies independently."""
+    batch = [queue.popleft()]
+    produced = {batch[0].task_id.binary()}
+    while queue and len(batch) < limit:
+        spec = queue[0]
+        if any(r and r[0].task_id().binary() in produced
+               for r in spec.ref_args()):
+            break
+        queue.popleft()
+        batch.append(spec)
+        produced.add(spec.task_id.binary())
+    return batch
+
+
 def _shape_key(spec: TaskSpec):
     """Tasks are queued per (resources, strategy, runtime_env) shape so a
     cached lease only serves tasks with identical placement constraints AND
@@ -64,6 +128,7 @@ class _Lease:
     worker_addr: tuple
     worker_id: object
     inflight: int = 0  # pushed-not-replied tasks pipelined on this worker
+    frames: int = 0    # batch frames in flight (≤ MAX_FRAMES_PER_WORKER)
     idle_since: float = 0.0  # monotonic ts when inflight last hit 0
 
 
@@ -74,7 +139,16 @@ class NormalTaskSubmitter:
     # opens once no lease requests are outstanding — otherwise a 2-task burst
     # on a 2-node cluster would bind both tasks to the first granted worker
     # instead of spreading (and breadth is what the scheduler promised).
-    MAX_INFLIGHT_PER_WORKER = 8
+    MAX_INFLIGHT_PER_WORKER = 32
+    # Queued bursts coalesce into one push_task_batch frame (amortizes
+    # pickling, syscalls and handler dispatch — the interpreted-runtime
+    # analog of the reference's cheap per-task C++ pushes). A sync
+    # call-loop's queue never holds more than one task, so it still gets
+    # per-task frames with no added latency.
+    MAX_BATCH = 16
+    # Frames in flight per worker: 2 keeps a frame queued executor-side
+    # while the previous one runs (overlap), without deep HOL queues.
+    MAX_FRAMES_PER_WORKER = 2
     # Granted leases linger briefly after their queue drains so sync
     # call-loops reuse a warm worker instead of re-leasing per task
     # (ref: worker lease idle keep-alive).
@@ -89,15 +163,30 @@ class NormalTaskSubmitter:
             target=self._reap_idle_leases, name="lease-reaper", daemon=True)
         self._stopped = threading.Event()
         self._reaper.start()
+        self._flusher = _Flusher("task-flush", self._pump)
 
     def submit(self, spec: TaskSpec):
         key = _shape_key(spec)
+        push = None
         with self._lock:
             st = self._shapes.setdefault(key, _ShapeState())
             st.strategy = spec.strategy
             st.runtime_env = spec.runtime_env
-            st.queue.append(spec)
-        self._pump(key)
+            # Fast path for interactive (sync call-loop) traffic: with
+            # nothing queued or in flight for this shape, skip the flusher
+            # handoff and push the singleton frame inline. Any concurrency
+            # (in-flight work) routes through the flusher so bursts batch.
+            if not st.queue and st.requests_in_flight == 0 and st.leases \
+                    and all(l.inflight == 0 for l in st.leases):
+                push = st.leases[0]
+                push.inflight += 1
+                push.frames += 1
+            if push is None:
+                st.queue.append(spec)
+        if push is not None:
+            self._push(key, push, [spec])
+        else:
+            self._flusher.mark(key)
 
     def _pump(self, key):
         """Dispatch queued tasks onto lease capacity; request more leases if
@@ -111,24 +200,33 @@ class NormalTaskSubmitter:
             depth = (self.MAX_INFLIGHT_PER_WORKER
                      if st.requests_in_flight == 0 else 1)
             while st.queue and st.leases:
-                lease = min(st.leases, key=lambda l: l.inflight)
-                if lease.inflight >= depth:
+                open_leases = [l for l in st.leases
+                               if l.frames < self.MAX_FRAMES_PER_WORKER
+                               and l.inflight < depth]
+                if not open_leases:
                     break
-                lease.inflight += 1
-                to_push.append((lease, st.queue.popleft()))
+                lease = min(open_leases, key=lambda l: l.inflight)
+                batch = _take_batch(
+                    st.queue,
+                    min(depth - lease.inflight, self.MAX_BATCH))
+                lease.inflight += len(batch)
+                lease.frames += 1
+                to_push.append((lease, batch))
             new_requests = min(
                 max(0, len(st.queue) - st.requests_in_flight),
                 self.MAX_LEASES_PER_SHAPE
                 - len(st.leases) - st.requests_in_flight)
-            if time.monotonic() - st.last_busy < 0.5:
-                # the cluster just said it's saturated for this shape:
-                # don't storm it with more lease requests; pipelining onto
-                # held leases carries the queue meanwhile
+            # The cluster just said it's saturated for this shape: don't
+            # storm it with more lease requests; pipelining onto held leases
+            # carries the queue meanwhile. With NO leases held there is
+            # nothing to pipeline onto — retry much sooner or this shape
+            # stalls in 0.5s sawtooths while competitors hold the workers.
+            if time.monotonic() - st.last_busy < (0.5 if st.leases else 0.15):
                 new_requests = 0
             if new_requests > 0:
                 st.requests_in_flight += new_requests
-        for lease, spec in to_push:
-            self._push(key, lease, spec)
+        for lease, batch in to_push:
+            self._push(key, lease, batch)
         for _ in range(max(0, new_requests)):
             self._lease_pool.submit(self._request_lease, key)
 
@@ -270,61 +368,76 @@ class NormalTaskSubmitter:
         except Exception:
             return None
 
-    def _push(self, key, lease: _Lease, spec: TaskSpec):
-        """(ref: PushNormalTask normal_task_submitter.cc:183)"""
+    def _push(self, key, lease: _Lease, batch: list[TaskSpec]):
+        """Push a coalesced frame of specs (ref: PushNormalTask
+        normal_task_submitter.cc:183; batching is ours — see MAX_BATCH)."""
         client = self._rt.peer_pool.get(lease.worker_addr)
 
         def on_reply(ok, body):
             if ok:
-                self._rt.process_task_reply(spec, body)
-                self._on_worker_idle(key, lease)
+                for spec, rep in zip(batch, body["replies"]):
+                    self._rt.process_task_reply(spec, rep)
+                self._on_worker_idle(key, lease, len(batch))
             else:
-                self._on_push_failed(key, lease, spec, body)
+                self._on_push_failed(key, lease, batch, body)
 
-        client.call_async("push_task", {"spec": spec}, callback=on_reply)
+        client.call_async("push_task_batch", {"specs": batch},
+                          callback=on_reply)
 
-    def _on_worker_idle(self, key, lease: _Lease):
+    def _on_worker_idle(self, key, lease: _Lease, done: int):
         """(ref: OnWorkerIdle normal_task_submitter.cc:139). A fully idle
         lease is NOT returned here — it lingers IDLE_LEASE_TTL_S (reaper
         thread) so sync call-loops reuse the warm worker."""
-        next_spec = None
+        next_batch = None
         repump = False
         with self._lock:
             st = self._shapes.get(key)
             if st is None:
                 self._return_lease(lease)
                 return
-            lease.inflight -= 1
+            lease.inflight -= done
+            lease.frames -= 1
             if lease not in st.leases:
                 # _on_push_failed declared this worker dead while other
                 # pipelined calls were still in flight: never dispatch onto
                 # it again (it would burn a retry on a known-dead address)
                 repump = bool(st.queue)
             elif st.queue:
-                lease.inflight += 1
-                next_spec = st.queue.popleft()
+                # same depth gate as _pump: while lease requests are still
+                # outstanding, continuations must not drain the queue onto
+                # this one worker — breadth is what the scheduler promised
+                depth = (self.MAX_INFLIGHT_PER_WORKER
+                         if st.requests_in_flight == 0 else 1)
+                limit = min(depth - lease.inflight, self.MAX_BATCH)
+                if limit > 0:
+                    next_batch = _take_batch(st.queue, limit)
+                    lease.inflight += len(next_batch)
+                    lease.frames += 1
             elif lease.inflight == 0:
                 lease.idle_since = time.monotonic()
-        if next_spec is not None:
-            self._push(key, lease, next_spec)
+        if next_batch is not None:
+            self._push(key, lease, next_batch)
         elif repump:
             self._pump(key)
 
-    def _on_push_failed(self, key, lease: _Lease, spec: TaskSpec, err):
+    def _on_push_failed(self, key, lease: _Lease, batch: list[TaskSpec], err):
         with self._lock:
             st = self._shapes.get(key)
             if st is not None and lease in st.leases:
                 st.leases.remove(lease)
         self._rt.peer_pool.invalidate(lease.worker_addr)
-        retry_spec = self._rt.task_manager.should_retry_system_failure(spec.task_id)
-        if retry_spec is not None:
-            logger.info("retrying task %s after worker failure (%s)",
-                        spec.repr_name(), err)
-            self.submit(retry_spec)
-        else:
-            self._rt.fail_task(spec, TaskError(
-                WorkerCrashedError(f"worker at {lease.worker_addr} died: {err}"),
-                task_repr=spec.repr_name()))
+        for spec in batch:
+            retry_spec = self._rt.task_manager.should_retry_system_failure(
+                spec.task_id)
+            if retry_spec is not None:
+                logger.info("retrying task %s after worker failure (%s)",
+                            spec.repr_name(), err)
+                self.submit(retry_spec)
+            else:
+                self._rt.fail_task(spec, TaskError(
+                    WorkerCrashedError(
+                        f"worker at {lease.worker_addr} died: {err}"),
+                    task_repr=spec.repr_name()))
         self._pump(key)
 
     def _return_lease(self, lease: _Lease):
@@ -336,6 +449,7 @@ class NormalTaskSubmitter:
 
     def shutdown(self):
         self._stopped.set()
+        self._flusher.stop()
         # Return only IDLE leases so agents free those workers promptly.
         # Leases with pushed tasks still in flight must NOT be returned: the
         # agent would mark the worker free and could re-lease a CPU that is
@@ -358,17 +472,26 @@ class _ActorState:
     state: str = "RESOLVING"  # RESOLVING | ALIVE | DEAD
     seq: int = 0
     queued: deque = field(default_factory=deque)       # waiting for address
+    outbox: deque = field(default_factory=deque)        # awaiting the flusher
     inflight: dict = field(default_factory=dict)        # seq -> spec
     death_cause: str = ""
     resolving: bool = False
 
 
 class ActorTaskSubmitter:
+    # Submissions enqueue to a per-actor outbox drained by a _Flusher into
+    # push_task_batch frames, with NO in-flight cap (async actors
+    # legitimately run thousands of concurrent calls). Order across frames
+    # is restored executor-side by seq_no (the
+    # sequential_actor_submit_queue.cc analog in worker._enqueue_actor_task).
+    MAX_BATCH = 32
+
     def __init__(self, runtime):
         self._rt = runtime
         self._lock = threading.Lock()
         self._actors: dict[ActorID, _ActorState] = {}
         self._resolve_pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="actor-resolve")
+        self._flusher = _Flusher("actor-flush", self._drain_actor)
 
     def _state(self, actor_id: ActorID) -> _ActorState:
         st = self._actors.get(actor_id)
@@ -377,8 +500,8 @@ class ActorTaskSubmitter:
         return st
 
     def submit(self, spec: TaskSpec):
-        send_to = None
         dead_cause = None
+        fast_addr = None
         with self._lock:
             st = self._state(spec.actor_id)
             spec.seq_no = st.seq
@@ -387,32 +510,57 @@ class ActorTaskSubmitter:
                 dead_cause = st.death_cause
             elif st.state == "ALIVE" and st.addr is not None:
                 st.inflight[spec.seq_no] = spec
-                send_to = st.addr
+                # Fast path for interactive (sync call-loop) traffic: with
+                # nothing outstanding on this actor, skip the flusher
+                # handoff and send the singleton frame inline. Concurrent
+                # traffic routes through the flusher so bursts batch.
+                if not st.outbox and len(st.inflight) == 1:
+                    fast_addr = st.addr
+                else:
+                    st.outbox.append(spec)
             else:
                 st.queued.append(spec)
                 if not st.resolving:
                     st.resolving = True
                     self._resolve_pool.submit(self._resolve, spec.actor_id)
-        # _send outside the lock: a synchronous connect failure invokes the
-        # on_reply callback inline, and _on_connection_lost takes self._lock
-        if send_to is not None:
-            self._send(st, send_to, spec)
-        elif dead_cause is not None:
+        if dead_cause is not None:
             self._rt.fail_task(spec, TaskError(
                 ActorDiedError(f"actor is dead: {dead_cause}"), task_repr=spec.repr_name()))
+        elif fast_addr is not None:
+            self._send_batch(st, fast_addr, [spec])
+        else:
+            self._flusher.mark(spec.actor_id)
 
-    def _send(self, st: _ActorState, addr, spec: TaskSpec):
+    def _drain_actor(self, actor_id: ActorID):
+        # sends happen outside the lock: a synchronous connect failure
+        # invokes the on_reply callback inline, and _on_connection_lost
+        # takes self._lock
+        sends = []
+        with self._lock:
+            st = self._actors.get(actor_id)
+            if st is None:
+                return
+            while st.outbox and st.state == "ALIVE" and st.addr is not None:
+                batch = _take_batch(st.outbox, self.MAX_BATCH)
+                sends.append((st.addr, batch))
+        for addr, batch in sends:
+            self._send_batch(st, addr, batch)
+
+    def _send_batch(self, st: _ActorState, addr, batch: list[TaskSpec]):
         client = self._rt.peer_pool.get(addr)
 
         def on_reply(ok, body):
             if ok:
                 with self._lock:
-                    st.inflight.pop(spec.seq_no, None)
-                self._rt.process_task_reply(spec, body)
+                    for spec in batch:
+                        st.inflight.pop(spec.seq_no, None)
+                for spec, rep in zip(batch, body["replies"]):
+                    self._rt.process_task_reply(spec, rep)
             else:
-                self._on_connection_lost(spec.actor_id, addr, str(body))
+                self._on_connection_lost(st.actor_id, addr, str(body))
 
-        client.call_async("push_task", {"spec": spec}, callback=on_reply)
+        client.call_async("push_task_batch", {"specs": batch},
+                          callback=on_reply)
 
     def _resolve(self, actor_id: ActorID):
         """Resolve the actor address from the control plane, then flush the
@@ -422,7 +570,8 @@ class ActorTaskSubmitter:
                 "resolve_actor", {"actor_id": actor_id, "timeout": 120.0}, timeout=130.0)
         except Exception as e:
             reply = {"state": "DEAD", "death_cause": f"resolve failed: {e}"}
-        to_send, to_fail = [], []
+        to_fail = []
+        flush = False
         with self._lock:
             st = self._state(actor_id)
             st.resolving = False
@@ -439,17 +588,20 @@ class ActorTaskSubmitter:
                     spec.seq_no = st.seq
                     st.seq += 1
                     st.inflight[spec.seq_no] = spec
-                    to_send.append((st.addr, spec))
+                    st.outbox.append(spec)
+                if st.outbox:
+                    flush = True
             else:
                 st.state = "DEAD"
                 st.death_cause = reply.get("death_cause", reply.get("state", "unknown"))
                 while st.queued:
                     to_fail.append(st.queued.popleft())
+                st.outbox.clear()  # outbox specs are all in inflight too
                 inflight = list(st.inflight.values())
                 st.inflight.clear()
                 to_fail.extend(inflight)
-        for addr, spec in to_send:
-            self._send(self._actors[actor_id], addr, spec)
+        if flush:
+            self._flusher.mark(actor_id)
         for spec in to_fail:
             self._rt.fail_task(spec, TaskError(
                 ActorDiedError(f"actor is dead: {self._actors[actor_id].death_cause}"),
@@ -465,6 +617,7 @@ class ActorTaskSubmitter:
                 st.addr = None
                 st.state = "RESOLVING"
             self._rt.peer_pool.invalidate(addr)
+            st.outbox.clear()  # outbox specs are all in inflight too
             inflight = sorted(st.inflight.items())
             st.inflight.clear()
             requeue, fail = [], []
@@ -495,6 +648,7 @@ class ActorTaskSubmitter:
             st.addr = None
             while st.queued:
                 to_fail.append(st.queued.popleft())
+            st.outbox.clear()  # outbox specs are all in inflight too
             to_fail.extend(st.inflight.values())
             st.inflight.clear()
         for spec in to_fail:
@@ -513,4 +667,5 @@ class ActorTaskSubmitter:
                 self._resolve_pool.submit(self._resolve, actor_id)
 
     def shutdown(self):
+        self._flusher.stop()
         self._resolve_pool.shutdown(wait=False)

@@ -28,6 +28,11 @@ class _PendingTask:
     spec: TaskSpec
     retries_left: int
     submitted_ts: float = field(default_factory=time.monotonic)
+    # set by claim_reply: a terminal reply/failure for this attempt is being
+    # processed; duplicates (e.g. a batch frame's early reply racing its
+    # aggregate copy) are rejected atomically instead of by a check-then-act
+    # pending probe that both copies can pass concurrently
+    reply_claimed: bool = False
 
 
 class TaskManager:
@@ -62,12 +67,36 @@ class TaskManager:
             return (None if ent is None
                     else time.monotonic() - ent.submitted_ts)
 
+    def claim_reply(self, task_id: TaskID, attempt: int | None) -> TaskSpec | None:
+        """Atomically claim the right to process a terminal reply (or
+        failure) for the task. Exactly one caller gets the spec; concurrent
+        duplicates — an overdue batch frame's early reply racing the frame's
+        aggregate copy, or a failure path racing a reply — get None instead
+        of double-releasing deps / double-storing results. ``attempt`` of
+        None matches any attempt (failure paths); otherwise a stale
+        attempt's reply is rejected. A retry resubmission re-arms the claim
+        (should_retry_*)."""
+        with self._lock:
+            ent = self._pending.get(task_id)
+            if ent is None or ent.reply_claimed:
+                return None
+            if attempt is not None and attempt != ent.spec.attempt_number:
+                return None
+            ent.reply_claimed = True
+            return ent.spec
+
     def should_retry_system_failure(self, task_id: TaskID) -> TaskSpec | None:
         """Worker crash / connection loss: consume one retry
         (ref: task_manager.cc RetryTaskIfPossible)."""
         with self._lock:
             ent = self._pending.get(task_id)
             if ent is None or ent.retries_left <= 0:
+                return None
+            if ent.reply_claimed:
+                # a reply for this task is being processed right now (e.g.
+                # an early reply raced the connection loss): the task is
+                # completing — resubmitting would re-execute it and un-claim
+                # the in-flight reply processing
                 return None
             ent.retries_left -= 1
             ent.spec.attempt_number += 1
@@ -80,6 +109,7 @@ class TaskManager:
                 return None
             ent.retries_left -= 1
             ent.spec.attempt_number += 1
+            ent.reply_claimed = False  # the retry's reply must be processable
             return ent.spec
 
     def get_pending_spec(self, task_id: TaskID) -> TaskSpec | None:

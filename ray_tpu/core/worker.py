@@ -53,6 +53,82 @@ from ray_tpu.exceptions import (
 
 logger = logging.getLogger(__name__)
 
+# A push_task_batch frame older than this ships completed sub-replies to the
+# owner eagerly instead of waiting for the frame's aggregate reply — a fast
+# concurrent call must not be held hostage by a slow batch-mate. Bursts of
+# quick tasks finish under the threshold and pay one aggregate frame.
+_EARLY_REPLY_S = 0.01
+
+
+class _BatchFrame:
+    """Aggregates the sub-replies of one push_task_batch frame (see
+    WorkerRuntime._h_push_task_batch). The frame janitor calls flush_early on
+    frames that outlive _EARLY_REPLY_S, shipping completed sub-replies to the
+    owner ahead of the aggregate; the owner deduplicates (the aggregate's
+    copy finds the task no longer pending)."""
+
+    __slots__ = ("rt", "specs", "agg", "t0", "_lock", "_slots",
+                 "_early_sent", "_remaining", "complete")
+
+    def __init__(self, rt, specs):
+        self.rt = rt
+        self.specs = specs
+        self.agg = DeferredReply()
+        self.t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._slots: list = [None] * len(specs)
+        self._early_sent = [False] * len(specs)
+        self._remaining = len(specs)
+        self.complete = False
+
+    def finisher(self, i: int):
+        return lambda ok, res: self.done(i, ok, res)
+
+    def done(self, i: int, ok: bool, res):
+        if not ok:
+            res = {"results": [],
+                   "error": f"executor error: {res!r}",
+                   "attempt": self.specs[i].attempt_number}
+        with self._lock:
+            self._slots[i] = res
+            self._remaining -= 1
+            last = self._remaining == 0
+            if last:
+                self.complete = True
+        if last:
+            self.agg.send({"replies": self._slots})
+        # Completed-but-unsent sub-replies of an overdue frame are shipped by
+        # the janitor (≤ one _EARLY_REPLY_S period away) — NOT inline here:
+        # done() runs on the task-execution thread, and a blocking notify to
+        # a dead owner (connect retries up to rpc_connect_timeout_s) would
+        # freeze task execution for every other owner's tasks on this worker.
+
+    def flush_early(self):
+        to_send = []
+        with self._lock:
+            if self.complete:
+                return
+            for i, res in enumerate(self._slots):
+                if res is not None and not self._early_sent[i] \
+                        and self.specs[i].owner_addr is not None:
+                    self._early_sent[i] = True
+                    to_send.append((self.specs[i], res))
+        for spec, res in to_send:
+            addr = tuple(spec.owner_addr)
+            # Runs on the shared janitor thread: a dead owner must not
+            # stall other frames' early replies, so connects are bounded
+            # and failing owners are skipped for a while (the aggregate
+            # reply still carries every result).
+            if self.rt._early_send_suspended(addr):
+                continue
+            try:
+                self.rt.peer_pool.get(addr).notify(
+                    "task_reply_early",
+                    {"task_id": spec.task_id, "reply": res},
+                    connect_timeout=0.5)
+            except Exception:  # noqa: BLE001 — the aggregate still carries it
+                self.rt._suspend_early_sends(addr)
+
 
 class _NormalTaskQueue:
     """Sequential normal-task execution with blocked-task yield.
@@ -226,6 +302,11 @@ class WorkerRuntime:
             boundaries=[0.005, 0.02, 0.1, 0.5, 2, 10, 60, 300],
             tag_keys=("type",))
         self._shutdown = threading.Event()
+        self._open_frames: set = set()  # batch frames awaiting early flush
+        self._frames_lock = threading.Lock()
+        self._frames_event = threading.Event()
+        self._frame_janitor_started = False
+        self._early_send_failures: dict[tuple, float] = {}  # addr -> ts
         self._driver_task_id = TaskID.for_driver(job_id)
         self.task_events: list[dict] = []  # flushed to CP (TaskEventBuffer)
         self._server = RpcServer(
@@ -809,18 +890,19 @@ class WorkerRuntime:
     # ------------------------------------------------------------------
     # reply processing (owner side)
     def process_task_reply(self, spec: TaskSpec, reply: dict):
-        # Guard against late replies for tasks already completed (cancelled,
-        # failed via actor death) or superseded by a retry attempt — a stale
-        # reply must not double-release deps or overwrite the recorded result
-        # (ref: task_manager.cc attempt-number checks).
-        pending = self.task_manager.get_pending_spec(spec.task_id)
-        if pending is None:
-            return
-        if reply.get("attempt", spec.attempt_number) != pending.attempt_number:
+        # Atomically claim this reply: late/duplicate copies (a task already
+        # completed, cancelled, failed via actor death, superseded by a
+        # retry attempt — or a batch frame's early reply racing the frame's
+        # aggregate copy) must not double-release deps or overwrite the
+        # recorded result (ref: task_manager.cc attempt-number checks).
+        claimed = self.task_manager.claim_reply(
+            spec.task_id, reply.get("attempt", spec.attempt_number))
+        if claimed is None:
             return
         if reply.get("error"):
             self.fail_task(spec, TaskError(formatted=str(reply["error"]),
-                                           task_repr=spec.repr_name()))
+                                           task_repr=spec.repr_name()),
+                           _already_claimed=True)
             return
         if reply.get("app_error"):
             # streaming task raised with retry_exceptions: re-run the whole
@@ -834,7 +916,8 @@ class WorkerRuntime:
             err = self.serialization.deserialize(
                 SerializedObject.from_buffer(reply["app_error"]))
             self.fail_task(spec, err if isinstance(err, TaskError)
-                           else TaskError(err, task_repr=spec.repr_name()))
+                           else TaskError(err, task_repr=spec.repr_name()),
+                           _already_claimed=True)
             return
         results = reply.get("results", [])
         if any(is_err for (_, _, _, is_err) in results):
@@ -854,9 +937,13 @@ class WorkerRuntime:
         self._observe_latency(spec, elapsed)
         self._record_task_event(spec, "FINISHED")
 
-    def fail_task(self, spec: TaskSpec, error: TaskError):
-        if self.task_manager.get_pending_spec(spec.task_id) is None:
-            return  # already completed/failed; don't double-release deps
+    def fail_task(self, spec: TaskSpec, error: TaskError,
+                  _already_claimed: bool = False):
+        # already completed/failed, or a reply is being processed right now:
+        # don't double-release deps (claim_reply is the atomic arbiter)
+        if not _already_claimed and \
+                self.task_manager.claim_reply(spec.task_id, None) is None:
+            return
         sobj = self.serialization.serialize(error)
         for oid in spec.return_ids():
             self.memory_store.put_inline(oid, sobj, is_error=True)
@@ -1157,6 +1244,76 @@ class WorkerRuntime:
             return self._execute_actor_creation(spec)
         return self._enqueue_actor_task(spec)
 
+    def _h_push_task_batch(self, body):
+        """Coalesced pushes: one frame carries many specs, one reply carries
+        their replies in submission order. The submitter batches bursts so
+        per-task interpreter + syscall costs amortize — the wire-level analog
+        of the reference's C++ in-flight push pipelining
+        (normal_task_submitter.cc:139,183), where per-task RPCs are cheap
+        enough not to need it. Sub-replies aggregate through each task's
+        DeferredReply, so nothing here blocks the handler thread."""
+        specs: list[TaskSpec] = body["specs"]
+        frame = _BatchFrame(self, specs)
+        for i, spec in enumerate(specs):
+            try:
+                r = self._h_push_task({"spec": spec})
+            except BaseException as e:  # noqa: BLE001
+                frame.done(i, False, e)
+                continue
+            if isinstance(r, DeferredReply):
+                r._bind(frame.finisher(i))
+            else:
+                frame.done(i, True, r)
+        self._watch_frame(frame)
+        return frame.agg
+
+    def _watch_frame(self, frame: "_BatchFrame"):
+        """Hand a still-open batch frame to the janitor, which flushes
+        completed sub-replies early once the frame outlives _EARLY_REPLY_S
+        (a fast concurrent call must not wait on a slow batch-mate)."""
+        with self._frames_lock:
+            if frame.complete:
+                return
+            self._open_frames.add(frame)
+            start = not self._frame_janitor_started
+            self._frame_janitor_started = True
+        if start:
+            threading.Thread(target=self._frame_janitor_loop,
+                             name="frame-janitor", daemon=True).start()
+        self._frames_event.set()
+
+    def _early_send_suspended(self, addr: tuple) -> bool:
+        ts = self._early_send_failures.get(addr)
+        if ts is None:
+            return False
+        if time.monotonic() - ts > 30.0:
+            self._early_send_failures.pop(addr, None)
+            return False
+        return True
+
+    def _suspend_early_sends(self, addr: tuple):
+        self._early_send_failures[addr] = time.monotonic()
+
+    def _frame_janitor_loop(self):
+        while not self._shutdown.is_set():
+            # clear BEFORE the snapshot: a frame registered after an empty
+            # snapshot but before a clear would lose its wakeup and wait out
+            # the full backstop timeout instead of ~one janitor period
+            self._frames_event.clear()
+            with self._frames_lock:
+                frames = list(self._open_frames)
+            if not frames:
+                self._frames_event.wait(5.0)
+                continue
+            now = time.monotonic()
+            for frame in frames:
+                if frame.complete:
+                    with self._frames_lock:
+                        self._open_frames.discard(frame)
+                elif now - frame.t0 > _EARLY_REPLY_S:
+                    frame.flush_early()
+            time.sleep(_EARLY_REPLY_S)
+
     def _execute_normal(self, spec: TaskSpec):
         if spec.task_id in self._cancelled_tasks:
             return self._error_reply(spec, TaskError(
@@ -1388,6 +1545,16 @@ class WorkerRuntime:
                               f"with items possibly dropped"),
                     "attempt": spec.attempt_number}
         return {"results": [], "error": None, "attempt": spec.attempt_number}
+
+    def _h_task_reply_early(self, body):
+        """Owner side: a push_task_batch frame gated by a slow batch-mate
+        ships completed sub-replies ahead of the aggregate (see
+        _h_push_task_batch). The aggregate's later copy is ignored because
+        the task is no longer pending."""
+        spec = self.task_manager.get_pending_spec(body["task_id"])
+        if spec is not None:
+            self.process_task_reply(spec, body["reply"])
+        return {"ok": True}
 
     def _h_stream_item(self, body):
         """Owner-side item report (ref: ReportGeneratorItemReturns)."""
