@@ -10,6 +10,16 @@ Public surface mirrors the reference's `ray` module
 (/root/reference/python/ray/__init__.py).
 """
 
+import os as _os
+
+# pyarrow's bundled mimalloc pool segfaults under this runtime's thread
+# profile (short-lived executor threads creating/freeing tables — reproduced
+# reliably with batched arrow-returning tasks; exit code -11 in
+# pa.Table construction/nbytes, gone with the system pool). Default every
+# ray_tpu process to the system allocator BEFORE pyarrow can be imported;
+# users can still override by setting the variable themselves.
+_os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+
 from ray_tpu.core.api import (
     available_resources,
     cancel,

@@ -218,6 +218,9 @@ class NodeAgent:
             if pypath:
                 env["PYTHONPATH"] = os.pathsep.join(
                     pypath + [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+        # see ray_tpu/__init__.py: arrow's mimalloc pool is unsafe under the
+        # worker's thread profile; pin the system pool unless the user set one
+        env.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
         env["RAY_TPU_CP_ADDR"] = f"{self.cp_addr[0]}:{self.cp_addr[1]}"
         env["RAY_TPU_AGENT_ADDR"] = f"{self.addr[0]}:{self.addr[1]}"
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
@@ -818,6 +821,9 @@ class NodeAgent:
 
     def _on_worker_dead(self, info: _WorkerInfo):
         code = info.proc.returncode if info.proc else None
+        logger.info("worker %s (pid %s, actor=%s) died, exit code %s",
+                    info.worker_id.hex()[:8], info.pid,
+                    info.actor_id.hex()[:8] if info.actor_id else None, code)
         to_kill = []
         with self._lock:
             for lid, lease in list(self._leases.items()):

@@ -141,8 +141,15 @@ class _NormalTaskQueue:
     pushes from the submitter therefore can't deadlock tasks that
     rendezvous with each other."""
 
+    # An idle runner lingers before exiting: thread churn is pure overhead
+    # on the task hot path, and rapid create/destroy of executor threads is
+    # exactly the profile that tickled arrow-mimalloc's thread-local-heap
+    # fault (see ray_tpu/__init__.py ARROW_DEFAULT_MEMORY_POOL note).
+    IDLE_LINGER_S = 5.0
+
     def __init__(self):
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._queue: deque = deque()
         self._active = 0  # runners currently NOT blocked
         self._tl = threading.local()
@@ -153,6 +160,8 @@ class _NormalTaskQueue:
             start = self._active == 0
             if start:
                 self._active += 1
+            else:
+                self._cv.notify()
         if start:
             threading.Thread(target=self._loop, name="task-exec",
                              daemon=True).start()
@@ -163,8 +172,10 @@ class _NormalTaskQueue:
         while True:
             with self._lock:
                 if not self._queue:
-                    self._active -= 1
-                    return
+                    self._cv.wait(timeout=self.IDLE_LINGER_S)
+                    if not self._queue:
+                        self._active -= 1
+                        return
                 run = self._queue.popleft()
             run()
 
