@@ -39,6 +39,40 @@ def _rate(n: int, t: float) -> float:
     return n / t if t > 0 else float("inf")
 
 
+def _wait_worker_quiesce(timeout_s: float = 120.0) -> None:
+    """Block until worker processes stop burning CPU (spawn storm over).
+
+    A warm fan-out asks for the full lease breadth, and the agent answers by
+    SPAWNING workers — each ~1.7s of import CPU. On a 1-core box those
+    imports keep running long after the fan-out's gets return, stealing the
+    core from whichever section measures next (observed: 200/s vs 2,100/s
+    for the SAME sync-task section depending on spawn-storm timing). Settle
+    until aggregate worker CPU is flat for 3 consecutive seconds."""
+    import os
+
+    def worker_cpu() -> int:
+        tot = 0
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                if "worker_main" in open(f"/proc/{pid}/cmdline").read():
+                    parts = open(f"/proc/{pid}/stat").read().split()
+                    tot += int(parts[13]) + int(parts[14])
+            except OSError:
+                continue
+        return tot
+
+    deadline = time.monotonic() + timeout_s
+    prev = worker_cpu()
+    quiet = 0
+    while time.monotonic() < deadline and quiet < 3:
+        time.sleep(1.0)
+        cur = worker_cpu()
+        quiet = quiet + 1 if cur - prev <= 2 else 0
+        prev = cur
+
+
 def _timeit(fn, n: int) -> float:
     t0 = time.perf_counter()
     fn()
@@ -93,9 +127,10 @@ def run(quick: bool = False) -> dict:
     # steady-state task throughput (what the reference's numbers report from
     # its warmed multi-round suite, ray_perf.py), not process creation.
     ray_tpu.get([nop.remote() for _ in range(N(1000))])
-    # settle: drain the warm fan-out's deferred ref releases and let the
-    # lease pool quiesce — the first post-fan-out section otherwise absorbs
-    # the cleanup storm (measured 224/s vs 2200/s steady-state)
+    # settle: wait out the spawn storm the fan-out triggered (worker import
+    # CPU would otherwise contaminate the next sections), then drain the
+    # fan-out's deferred ref releases and let the lease pool quiesce
+    _wait_worker_quiesce()
     for _ in range(30):
         ray_tpu.get(nop.remote())
     time.sleep(1.0)
@@ -116,6 +151,7 @@ def run(quick: bool = False) -> dict:
     clients = [Client.remote() for _ in range(m)]
     k = N(500)
     ray_tpu.get([c.fire.remote(50) for c in clients])  # warm
+    _wait_worker_quiesce(60.0)
     time.sleep(0.5)
     t0 = time.perf_counter()
     ray_tpu.get([c.fire.remote(k) for c in clients], timeout=300)
@@ -145,6 +181,7 @@ def run(quick: bool = False) -> dict:
 
     actors = [Sync.remote() for _ in range(4)]
     ray_tpu.get([b.m.remote() for b in actors])
+    _wait_worker_quiesce(60.0)  # actor creation spawns pool backfill workers
     n = N(3000)
     t0 = time.perf_counter()
     ray_tpu.get([actors[i % 4].m.remote() for i in range(n)])
@@ -161,6 +198,7 @@ def run(quick: bool = False) -> dict:
     callers = [Caller.remote(actors[i]) for i in range(4)]
     k = N(800)
     ray_tpu.get([c.drive.remote(50) for c in callers])
+    _wait_worker_quiesce(60.0)
     time.sleep(0.5)
     t0 = time.perf_counter()
     ray_tpu.get([c.drive.remote(k) for c in callers], timeout=300)
@@ -194,6 +232,7 @@ def run(quick: bool = False) -> dict:
     acallers = [Caller.remote(async_actors[i]) for i in range(4)]
     k = N(800)
     ray_tpu.get([c.drive.remote(50) for c in acallers])
+    _wait_worker_quiesce(60.0)
     time.sleep(0.5)
     t0 = time.perf_counter()
     ray_tpu.get([c.drive.remote(k) for c in acallers], timeout=300)

@@ -1275,7 +1275,11 @@ class WorkerRuntime:
                 r._bind(frame.finisher(i))
             else:
                 frame.done(i, True, r)
-        self._watch_frame(frame)
+        if len(specs) > 1:
+            # singleton frames have no batch-mate to wait behind: the
+            # aggregate reply IS the (only) task's reply, so the janitor's
+            # early-flush machinery would be pure overhead
+            self._watch_frame(frame)
         return frame.agg
 
     def _watch_frame(self, frame: "_BatchFrame"):

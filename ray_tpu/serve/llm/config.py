@@ -30,12 +30,21 @@ class LLMConfig:
     num_pages: int = 256              # total pages in the HBM pool
     max_prompt_len: int = 512
     max_seq_len: int = 1024           # prompt + generation cap per request
-    prefill_chunk: int = 512          # prefill compute chunk
+    # prompts longer than this prefill in chunks of this many tokens,
+    # interleaved with decode blocks (chunked prefill): a long admission
+    # stalls active generations by at most one chunk, not the whole prompt
+    prefill_chunk: int = 512
     # decode steps fused into one dispatched program when the batch is
     # steady (multi-step decode): token cost ~ dispatch_RTT/decode_block,
     # which matters enormously when the chip sits behind a network tunnel.
     # Streaming granularity and stop-token lag grow with it.
     decode_block: int = 8
+    # decode block while requests queue for slots (slot-starved): smaller
+    # blocks detect stop tokens (and free slots for the queue) sooner, at
+    # the cost of less dispatch amortization — the TTFT/throughput knob
+    # under saturation. 1-2 for latency-sensitive serving, decode_block to
+    # disable the tier.
+    pressure_decode_block: int = 2
     # dispatched-but-unharvested decode blocks. TTFT under load is bounded
     # below by pipeline_depth * decode_block * step_time (a fresh prefill
     # executes behind the in-flight blocks), so latency-sensitive configs

@@ -52,6 +52,7 @@ class _Request:
     pages: list[int] = field(default_factory=list)
     generated: list[int] = field(default_factory=list)
     dispatched: int = 0  # tokens whose computation has been dispatched
+    prefill_pos: int = 0  # prompt tokens already prefilled (chunked prefill)
     drained_upto: int = 0
     done: bool = False
     error: Optional[str] = None
@@ -97,6 +98,11 @@ class LLMEngine:
 
         self._lock = threading.Lock()
         self._waiting: list[_Request] = []
+        # chunked prefill: admitted (slot+pages held) but prompt not fully
+        # prefilled; the loop dispatches one chunk per request per iteration
+        # interleaved with decode blocks, so a long admission never stalls
+        # active generations for its whole prompt pass
+        self._prefilling: list[_Request] = []
         self._requests: dict[str, _Request] = {}
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -208,6 +214,31 @@ class LLMEngine:
             self._prefill_cache[bucket] = fn
         return fn
 
+    def _chunk_fn(self, clen: int):
+        """Chunked-prefill program for a chunk of ``clen`` tokens: write the
+        chunk's KV through the page pool, attend over everything cached so
+        far, and sample a (candidate) next token on device — only the final
+        chunk's sample is used. One program per chunk bucket (full chunks
+        share one shape; the padded tail adds at most log2(prefill_chunk))."""
+        key = ("chunk", clen)
+        fn = self._prefill_cache.get(key)
+        if fn is None:
+            jax = self._jax
+            top_k = self.cfg.top_k
+
+            def impl(params, kv, page_table, tokens, start, true_len, rng,
+                     temp):
+                logits, kv = self._kvc.paged_prefill_chunk(
+                    params, kv, page_table, tokens, start, true_len,
+                    self.model_cfg, self.cfg.page_size)
+                tok = self._kvc.sample_tokens(
+                    logits[None, :], rng, temp, top_k)
+                return tok[0], kv
+
+            fn = jax.jit(impl, donate_argnums=(1,))
+            self._prefill_cache[key] = fn
+        return fn
+
     # ---- public API ----------------------------------------------------
     def start(self):
         if self._loop_thread is None:
@@ -234,7 +265,9 @@ class LLMEngine:
             toks = jnp.zeros((self.cfg.max_batch_size + 1,), jnp.int32)
         for w in widths:
             idx = jnp.full((w,), trash, jnp.int32)
-            for k in {1, self.cfg.decode_block}:
+            for k in {1, max(1, min(self.cfg.pressure_decode_block,
+                                    self.cfg.decode_block)),
+                      self.cfg.decode_block}:
                 _all, toks, self.kv, self._sl_dev, self._rng = self._decode(
                     self.params, self.kv, self._pt_dev, self._sl_dev,
                     toks, self._rng, self._temps_dev, idx, k)
@@ -346,14 +379,22 @@ class LLMEngine:
         with self._lock:
             active = sum(1 for r in self.slot_req if r is not None)
             waiting = len(self._waiting)
-        return {**self.stats, "active_slots": active, "waiting": waiting,
+            prefilling = len(self._prefilling)
+        # mid-chunked-prefill requests hold a slot + pages but are not yet
+        # in slot_req: load monitoring must see them (as waiting) or
+        # autoscaling under-counts
+        return {**self.stats, "active_slots": active,
+                "waiting": waiting + prefilling, "prefilling": prefilling,
                 "free_pages": self.allocator.available()}
 
     # ---- engine loop ---------------------------------------------------
     def _loop(self):
         while not self._stop.is_set():
             self._admit()
-            dispatched = self._step()
+            chunks = self._prefill_chunks()
+            # chunk dispatches count as progress: an otherwise-idle engine
+            # mid-chunked-prefill must not sleep between chunks
+            dispatched = self._step() or chunks > 0
             # Eager harvest: pop every block whose device result already
             # landed (is_ready) — holding computed tokens unharvested just
             # adds their age to TTFT/ITL. The blocking PIPELINE_DEPTH trim
@@ -381,10 +422,13 @@ class LLMEngine:
         return min(b, self.cfg.max_prompt_len)
 
     def _admissions_blocked(self) -> bool:
-        """Requests waiting while slots are free (= page-pool starved):
-        shrink decode blocks so page reclamation isn't a whole block late.
-        Lock held. Subclasses with extra admission queues extend this."""
-        return bool(self._waiting) and bool(self.free_slots)
+        """Requests waiting while slots are free (= page-pool starved), or
+        a chunked prefill mid-flight: shrink decode blocks so page
+        reclamation isn't a whole block late and prefill chunks interleave
+        tightly. Lock held. Subclasses with extra admission queues extend
+        this."""
+        return (bool(self._waiting) and bool(self.free_slots)) \
+            or bool(self._prefilling)
 
     def _bucket_width(self, n: int) -> int:
         """Packed decode width: smallest power-of-two ≥ n (floor 4), capped
@@ -412,7 +456,15 @@ class LLMEngine:
                 slot = self.free_slots.pop()
                 req.slot = slot
                 req.pages = pages
-            self._prefill(req)
+            if (self.cfg.prefill_chunk > 0
+                    and len(req.prompt_tokens) > self.cfg.prefill_chunk):
+                # long prompt: prefill in chunks interleaved with decode
+                # blocks (the loop drives _prefill_chunks) so active
+                # generations stall at most one chunk, not the whole prompt
+                with self._lock:
+                    self._prefilling.append(req)
+            else:
+                self._prefill(req)
             admitted += 1
 
     def _prefill(self, req: _Request):
@@ -433,17 +485,57 @@ class LLMEngine:
             self.params, self.kv, jnp.asarray(table), jnp.asarray(toks),
             jnp.int32(plen), sub,
             jnp.asarray([req.temperature], jnp.float32))
+        self._arm_slot(req, table, tok_dev, plen)
+
+    def _arm_slot(self, req: _Request, table, tok_dev, plen: int) -> None:
+        """Publish a freshly prefilled slot to the decode loop: host/device
+        state patch, first-token override (the on-device token carry knows
+        nothing about fresh prefills), and a harvest entry for the sampled
+        first token."""
         with self._lock:
             req.dispatched = 1
             self.page_tables[req.slot] = table
             self.seq_lens[req.slot] = plen
             self.slot_req[req.slot] = req
             self._dirty_slots[req.slot] = (plen, req.temperature)
-            # the next decode block feeds this token into the slot (the
-            # on-device token carry knows nothing about fresh prefills)
             self._overrides[req.slot] = tok_dev
             self._pending.append((tok_dev, [(0, req.slot, req)], 1))
         self.stats["prefills"] += 1
+
+    def _prefill_chunks(self) -> int:
+        """Dispatch ONE prefill chunk per in-progress chunked admission
+        (loop thread). The final chunk's on-device sampled token arms the
+        slot exactly like _prefill's; intermediate chunks only extend the
+        cached KV. Chunks are dispatched async — the decode block that
+        follows in this loop iteration queues behind them on the device
+        stream, which is the interleaving."""
+        jnp = self._jnp
+        with self._lock:
+            active = list(self._prefilling)
+        for req in active:
+            plen = len(req.prompt_tokens)
+            start = req.prefill_pos
+            remaining = plen - start
+            final = remaining <= self.cfg.prefill_chunk
+            clen = (self._bucket(remaining) if final
+                    else self.cfg.prefill_chunk)
+            toks = np.zeros((1, clen), np.int32)
+            seg = req.prompt_tokens[start: start + clen]
+            toks[0, : len(seg)] = seg
+            table = np.zeros((self.max_pages_per_seq,), np.int32)
+            table[: len(req.pages)] = req.pages
+            fn = self._chunk_fn(clen)
+            self._rng, sub = self._jax.random.split(self._rng)
+            tok_dev, self.kv = fn(
+                self.params, self.kv, jnp.asarray(table), jnp.asarray(toks),
+                jnp.int32(start), jnp.int32(plen), sub,
+                jnp.asarray([req.temperature], jnp.float32))
+            req.prefill_pos = min(start + clen, plen)
+            if req.prefill_pos >= plen:
+                with self._lock:
+                    self._prefilling.remove(req)
+                self._arm_slot(req, table, tok_dev, plen)
+        return len(active)
 
     def _record_token(self, req: _Request, tok: int) -> None:
         """Append a sampled token; mark done on stop/max. Lock held."""
@@ -480,12 +572,23 @@ class LLMEngine:
                         and req.dispatched < req.max_tokens]
             if not snapshot:
                 return False
-            # k is STATIC to the jitted program: only two values ever
-            # occur (1 while admissions wait, decode_block otherwise), so
-            # exactly two programs compile. Overshoot past a request's
+            # k is STATIC to the jitted program: only three values ever
+            # occur (1 while admissions wait, pressure_decode_block while
+            # requests queue for slots, decode_block otherwise), so at most
+            # three programs compile per width. Overshoot past a request's
             # max_tokens is by-design safe: extra writes land in the slot's
             # own tail pages or the trash page, and harvest discards them.
-            k = 1 if self._admissions_blocked() else self.cfg.decode_block
+            # The slot-starved middle tier trades dispatch amortization for
+            # TTFT: a finishing request's stop token is detected (and its
+            # slot freed for the queue) within ~pipeline_depth*k steps, so
+            # big blocks at saturation hold slots long past completion.
+            if self._admissions_blocked():
+                k = 1
+            elif self._waiting:
+                k = max(1, min(self.cfg.pressure_decode_block,
+                               self.cfg.decode_block))
+            else:
+                k = self.cfg.decode_block
             dirty, self._dirty_slots = self._dirty_slots, {}
             overrides, self._overrides = self._overrides, {}
             for _col, _slot, req in snapshot:

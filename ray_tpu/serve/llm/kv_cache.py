@@ -214,6 +214,79 @@ def paged_prefill(params, kv, page_table, tokens, true_len,
     return logits, {"k": new_k, "v": new_v}
 
 
+def paged_prefill_chunk(params, kv, page_table, tokens, start, true_len,
+                        cfg: LlamaConfig, page_size: int):
+    """One CHUNK of a long prompt's prefill (chunked prefill: the engine
+    interleaves prompt chunks with decode blocks so a long admission never
+    stalls active generations for the whole prompt pass — the scheduling
+    intent the reference delegates to vLLM's chunked-prefill/priority
+    scheduler, vllm_engine.py:101).
+
+    tokens: [1, C] the chunk (bucket-padded); start: scalar position of the
+    chunk's first token; true_len: scalar total prompt length. The chunk's
+    queries attend to every cached position < start (earlier chunks, read
+    back through the page pool) plus causally within the chunk. Returns
+    (last-token logits [vocab] — meaningful only on the final chunk, new_kv).
+    """
+    b = 1
+    c = tokens.shape[1]
+    max_pages = page_table.shape[0]
+    max_len = max_pages * page_size
+
+    x = params["embed"][tokens].astype(cfg.dtype)                 # [1,C,D]
+    pos = start + jnp.arange(c)                                   # [C]
+    cos, sin = rope_freqs(cfg, pos[None, :])
+    in_range = pos < true_len
+    page_idx = jnp.where(in_range, jnp.take(page_table, pos // page_size), 0)
+    offset = pos % page_size
+    # keys: the whole paged view (earlier chunks + this one after write)
+    kpos = jnp.arange(max_len)                                    # [L]
+    valid = (kpos[None, :] <= pos[:, None]) & (kpos[None, :] < true_len)
+    sm = cfg.head_dim ** -0.5
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+
+    def body(carry, inputs):
+        (x,) = carry
+        layer, k_cache, v_cache = inputs
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # write the chunk's k/v first, then attend through the paged view —
+        # the same write-then-gather shape as paged_decode_step, so the
+        # chunk sees earlier chunks AND itself causally
+        k_cache = k_cache.at[page_idx, offset].set(k[0].astype(k_cache.dtype))
+        v_cache = v_cache.at[page_idx, offset].set(v[0].astype(v_cache.dtype))
+        k_seq = jnp.take(k_cache, page_table, axis=0).reshape(
+            b, max_len, cfg.n_kv_heads, cfg.head_dim)
+        v_seq = jnp.take(v_cache, page_table, axis=0).reshape(
+            b, max_len, cfg.n_kv_heads, cfg.head_dim)
+        k_full = _gqa_expand(k_seq, n_rep)
+        v_full = _gqa_expand(v_seq, n_rep)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
+            jnp.float32) * sm
+        logits = jnp.where(valid[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
+        up = h2 @ layer["mlp"]["w_up"]
+        x = x + (gate * up) @ layer["mlp"]["w_down"]
+        return (x,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["layers"], kv["k"], kv["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # last REAL token's position relative to this chunk's start
+    rel = jnp.clip(true_len - 1 - start, 0, c - 1)
+    last = jnp.take_along_axis(x, rel[None, None, None], axis=1)[:, 0]
+    logits = (last @ params["lm_head"]).astype(jnp.float32)[0]
+    return logits, {"k": new_k, "v": new_v}
+
+
 def sample_tokens(logits, rng, temperature, top_k: int = 0):
     """Greedy/temperature/top-k sampling on device. logits: [B, V];
     temperature: [B] (0 → greedy)."""
