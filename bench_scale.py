@@ -1,0 +1,143 @@
+"""Scale-envelope stress bench: many nodes / actors / queued tasks / PGs.
+
+Proves the control plane + scheduler survive the reference's published
+envelope SHAPE (reference: release/benchmarks/README.md — 250+ nodes, 10k+
+actors, 1M queued tasks, 1k PGs on a real cluster; numbers in
+release/perf_metrics/benchmarks/many_*.json) at single-box scale: >=50
+virtual nodes, >=1,000 actors, >=10,000 queued tasks, >=500 placement
+groups, all against ONE control plane.
+
+Workers run IN-PROCESS (threads, not subprocesses — Cluster.add_node
+inproc_workers=True, the fake_multi_node-style harness): the box has one
+core, so the measurement is control-plane/scheduler capacity, not fork
+throughput.
+
+Writes SCALE_BENCH.json and prints one JSON line per section.
+
+Usage: python bench_scale.py [--nodes 50] [--actors 1000] [--tasks 10000]
+                             [--pgs 500] [--out SCALE_BENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=50)
+    ap.add_argument("--actors", type=int, default=1000)
+    ap.add_argument("--tasks", type=int, default=10000)
+    ap.add_argument("--pgs", type=int, default=500)
+    ap.add_argument("--out", default="SCALE_BENCH.json")
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu.core.cluster import Cluster
+
+    results: dict = {"config": vars(args)}
+
+    # ---- many nodes ----------------------------------------------------
+    cpus_per_node = max(1, -(-args.actors // args.nodes))
+    t0 = time.monotonic()
+    cluster = Cluster()
+    for _ in range(args.nodes):
+        cluster.add_node(num_cpus=cpus_per_node,
+                         object_store_memory=8 * 1024 * 1024,
+                         inproc_workers=True)
+    ray_tpu.init(address=cluster.address)
+    # the CP must see every node alive
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        alive = sum(1 for n in ray_tpu.nodes() if n.get("alive", True))
+        if alive >= args.nodes:
+            break
+        time.sleep(0.5)
+    dt = time.monotonic() - t0
+    alive = sum(1 for n in ray_tpu.nodes() if n.get("alive", True))
+    results["nodes"] = {"target": args.nodes, "alive": alive,
+                        "bringup_s": round(dt, 2),
+                        "nodes_per_s": round(args.nodes / dt, 1)}
+    print(json.dumps({"section": "nodes", **results["nodes"]}))
+    assert alive >= args.nodes, f"only {alive}/{args.nodes} nodes alive"
+
+    # ---- many queued tasks --------------------------------------------
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    t0 = time.monotonic()
+    refs = [nop.remote() for _ in range(args.tasks)]
+    t_submit = time.monotonic() - t0
+    ray_tpu.get(refs, timeout=600.0)
+    t_total = time.monotonic() - t0
+    results["tasks"] = {
+        "count": args.tasks,
+        "submit_per_s": round(args.tasks / t_submit, 1),
+        "throughput_per_s": round(args.tasks / t_total, 1),
+        "wall_s": round(t_total, 2)}
+    print(json.dumps({"section": "tasks", **results["tasks"]}))
+    del refs
+
+    # ---- many actors ---------------------------------------------------
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return 1
+
+    t0 = time.monotonic()
+    actors = [Sink.options(scheduling_strategy="SPREAD").remote()
+              for _ in range(args.actors)]
+    # one ping per actor proves every one of them is scheduled + running
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=900.0)
+    t_up = time.monotonic() - t0
+    t0 = time.monotonic()
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=900.0)
+    t_ping = time.monotonic() - t0
+    t0 = time.monotonic()
+    for a in actors:
+        ray_tpu.kill(a)
+    t_kill = time.monotonic() - t0
+    results["actors"] = {
+        "count": args.actors,
+        "create_to_first_ping_per_s": round(args.actors / t_up, 1),
+        "steady_ping_per_s": round(args.actors / t_ping, 1),
+        "kill_per_s": round(args.actors / t_kill, 1),
+        "bringup_s": round(t_up, 2)}
+    print(json.dumps({"section": "actors", **results["actors"]}))
+    del actors
+    time.sleep(2.0)  # let kill/reap churn drain before the PG section
+
+    # ---- many placement groups ----------------------------------------
+    from ray_tpu import placement_group, remove_placement_group
+
+    t0 = time.monotonic()
+    pgs = [placement_group([{"CPU": 0.01}]) for _ in range(args.pgs)]
+    for pg in pgs:
+        pg.ready(timeout=300.0)
+    t_create = time.monotonic() - t0
+    t0 = time.monotonic()
+    for pg in pgs:
+        remove_placement_group(pg)
+    t_remove = time.monotonic() - t0
+    results["pgs"] = {
+        "count": args.pgs,
+        "create_per_s": round(args.pgs / t_create, 1),
+        "remove_per_s": round(args.pgs / t_remove, 1)}
+    print(json.dumps({"section": "pgs", **results["pgs"]}))
+
+    results["ts"] = time.time()
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"metric": "scale_envelope",
+                      "value": args.actors, "unit": "actors",
+                      "ok": True}))
+
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

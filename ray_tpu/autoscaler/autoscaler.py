@@ -25,9 +25,13 @@ logger = logging.getLogger(__name__)
 class AutoscalerConfig:
     min_workers: int = 0
     max_workers: int = 4
-    # what one launched node provides (must match the provider's nodes)
+    # what ONE HOST of a launched node provides (must match the provider)
     node_resources: dict = dataclasses.field(default_factory=dict)
     node_labels: dict = dataclasses.field(default_factory=dict)
+    # hosts per provider node: a multi-host TPU slice launches as ONE
+    # provider node whose create brings up all host agents (matching GCE,
+    # where one slice create yields every host VM)
+    hosts_per_node: int = 1
     idle_timeout_s: float = 60.0
     poll_interval_s: float = 1.0
 
@@ -87,29 +91,32 @@ class Autoscaler:
         # provider-name -> CP node mapping (cloud nodes carry a
         # provider_node_name label; the fake provider also exposes agent())
         now = time.monotonic()
-        by_pname: dict[str, dict] = {}
+        by_pname: dict[str, list] = {}
         for n in alive:
             pname = (n.get("labels") or {}).get("provider_node_name")
             if pname:
-                by_pname[pname] = n
-        get_agent = getattr(self._provider, "agent", lambda _n: None)
+                by_pname.setdefault(pname, []).append(n)
+        get_agents = getattr(
+            self._provider, "agents",
+            lambda _n: [a for a in [getattr(self._provider, "agent",
+                                            lambda _x: None)(_n)] if a])
 
-        def cp_node_for(name: str):
-            node = by_pname.get(name)
-            if node is not None:
-                return node
-            agent = get_agent(name)
-            if agent is not None:
-                for n in alive:
-                    if tuple(n["addr"]) == tuple(agent.addr):
-                        return n
-            return None
+        def cp_nodes_for(name: str) -> list[dict]:
+            """All CP nodes belonging to one provider node (a multi-host
+            slice registers one CP node per host)."""
+            nodes = by_pname.get(name)
+            if nodes:
+                return nodes
+            addrs = {tuple(a.addr) for a in get_agents(name)}
+            return [n for n in alive if tuple(n["addr"]) in addrs]
 
+        hosts = max(1, self._cfg.hosts_per_node)
         cur = self._provider.non_terminated_nodes()
-        # registration drains the launching set; boots past the grace period
-        # stop counting (the node may have failed — allow a replacement)
+        # registration (all hosts) drains the launching set; boots past the
+        # grace period stop counting (the node may have failed — allow a
+        # replacement)
         for name in list(self._launching):
-            if (cp_node_for(name) is not None
+            if (len(cp_nodes_for(name)) >= hosts
                     or name not in cur
                     or now - self._launching[name] > self.launch_grace_s):
                 self._launching.pop(name, None)
@@ -117,10 +124,11 @@ class Autoscaler:
         want_new = 0
         if unplaceable > 0 and self._cfg.node_resources:
             import math
-            per_node_cap = max(
+            per_host_cap = max(
                 1, int(min(self._cfg.node_resources.get(k, 0) / v
                            for s in shapes[:1] for k, v in s.items()
                            if v > 0) or 1))
+            per_node_cap = per_host_cap * hosts
             want_new = min(
                 math.ceil(unplaceable / per_node_cap) - len(self._launching),
                 self._cfg.max_workers - len(cur))
@@ -128,17 +136,25 @@ class Autoscaler:
         for _ in range(max(0, want_new)):
             name = self._provider.create_node(
                 {"resources": dict(self._cfg.node_resources),
-                 "labels": dict(self._cfg.node_labels)})
+                 "labels": dict(self._cfg.node_labels),
+                 "hosts": hosts})
             self._launching[name] = now
             self.num_launched += 1
             logger.info("autoscaler launched node %s (unplaceable=%d)",
                         name, unplaceable)
 
-        # scale down: provider nodes idle (full availability) past timeout
+        # scale down: provider nodes whose EVERY host is idle (full
+        # availability) past the timeout — a slice terminates whole or not
+        # at all
         for name in list(self._provider.non_terminated_nodes()):
-            node = cp_node_for(name)
-            idle = (node is not None
-                    and node["available"] == node["resources"])
+            nodes = cp_nodes_for(name)
+            # a partially-registered slice is BOOTING, not idle: host 0 can
+            # register minutes before host N on real TPU slices, and
+            # draining it would churn launch/terminate forever while the
+            # slice PG never places
+            idle = (name not in self._launching
+                    and len(nodes) >= hosts
+                    and all(n["available"] == n["resources"] for n in nodes))
             if not idle:
                 self._idle_since.pop(name, None)
                 continue
@@ -147,14 +163,20 @@ class Autoscaler:
                 > self._cfg.min_workers
             if over_min and now - first >= self._cfg.idle_timeout_s:
                 logger.info("autoscaler terminating idle node %s", name)
-                try:
-                    self._cp.call("drain_node",
-                                  {"node_id": node["node_id"]}, timeout=10.0)
-                except Exception:  # noqa: BLE001
-                    pass
-                self._provider.terminate_node(name)
-                self._idle_since.pop(name, None)
+                for node in nodes:
+                    try:
+                        self._cp.call(
+                            "drain_node",
+                            {"node_id": node["node_id"]}, timeout=10.0)
+                    except Exception:  # noqa: BLE001
+                        pass
+                # count at decision time (same as num_launched): a provider
+                # terminate may take seconds tearing the node down, and
+                # observers polling non_terminated_nodes() would see the
+                # node gone before a post-call increment landed
                 self.num_terminated += 1
+                self._idle_since.pop(name, None)
+                self._provider.terminate_node(name)
 
     def _loop(self) -> None:
         while not self._stopped.is_set():

@@ -27,11 +27,18 @@ class NodeProvider:
 
 
 class FakeNodeProvider(NodeProvider):
-    """Launches real in-process NodeAgents against a control plane."""
+    """Launches real in-process NodeAgents against a control plane.
 
-    def __init__(self, cp_addr: tuple[str, int]):
+    One provider node may be a MULTI-HOST TPU slice (``hosts`` in the node
+    config): a single create_node brings up all of its host agents sharing a
+    slice_name label — matching the cloud provider, where one TPU slice
+    create yields every host VM at once (GCETPUNodeProvider ssh --worker=all).
+    """
+
+    def __init__(self, cp_addr: tuple[str, int], inproc_workers: bool = False):
         self._cp_addr = tuple(cp_addr)
-        self._agents: dict[str, object] = {}
+        self._inproc = bool(inproc_workers)
+        self._agents: dict[str, list] = {}  # name -> [NodeAgent, ...]
         self._counter = 0
 
     def create_node(self, node_config: dict) -> str:
@@ -39,24 +46,40 @@ class FakeNodeProvider(NodeProvider):
 
         self._counter += 1
         name = f"fake-{self._counter}"
-        labels = dict(node_config.get("labels") or {})
-        labels["provider_node_name"] = name
-        agent = NodeAgent(self._cp_addr,
-                          resources=dict(node_config.get("resources") or {}),
-                          labels=labels)
-        self._agents[name] = agent
+        hosts = max(1, int(node_config.get("hosts", 1)))
+        agents = []
+        for i in range(hosts):
+            labels = dict(node_config.get("labels") or {})
+            labels["provider_node_name"] = name
+            if hosts > 1:
+                # slice identity: every host carries the slice name and its
+                # worker index (what the real TPU metadata server provides)
+                labels.setdefault("slice_name", name)
+                labels["tpu_worker_id"] = str(i)
+                labels.setdefault("topology", "")
+            agents.append(NodeAgent(
+                self._cp_addr,
+                resources=dict(node_config.get("resources") or {}),
+                labels=labels, inproc_workers=self._inproc))
+        self._agents[name] = agents
         return name
 
     def terminate_node(self, name: str) -> None:
-        agent = self._agents.pop(name, None)
-        if agent is not None:
-            agent.stop()
+        for agent in self._agents.pop(name, []):
+            try:
+                agent.stop()
+            except Exception:  # noqa: BLE001 - drain may have raced parts
+                pass
 
     def non_terminated_nodes(self) -> list[str]:
         return list(self._agents)
 
     def agent(self, name: str):
-        return self._agents.get(name)
+        agents = self._agents.get(name)
+        return agents[0] if agents else None
+
+    def agents(self, name: str) -> list:
+        return list(self._agents.get(name, []))
 
 
 class GCETPUNodeProvider(NodeProvider):

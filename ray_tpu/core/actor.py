@@ -94,7 +94,9 @@ class ActorHandle:
         return self._actor_id
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        if name.startswith("_") and name != "__rtpu_call__":
+            # __rtpu_call__ is the generic run-a-callable-on-the-actor
+            # entry (reference: actor.__ray_call__)
             raise AttributeError(name)
         return ActorMethod(self, name)
 

@@ -26,17 +26,29 @@ from ray_tpu.exceptions import RayTpuError
 _lock = threading.RLock()
 _runtime = None
 _head = None  # (control_plane, node_agent) when we started them
+# Per-thread runtime override: in-process workers (the fake_multi_node-style
+# scale/autoscaler harness) host several WorkerRuntimes in ONE process, so
+# task-executing threads bind "their" runtime here; everything else falls
+# through to the process-global one (subprocess workers bind the same object
+# the global already holds — a no-op).
+_thread_runtime = threading.local()
 
 
 def _get_runtime():
-    rt = _runtime
+    rt = getattr(_thread_runtime, "rt", None) or _runtime
     if rt is None:
         raise RayTpuError("ray_tpu.init() has not been called")
     return rt
 
 
 def _try_get_runtime():
-    return _runtime
+    return getattr(_thread_runtime, "rt", None) or _runtime
+
+
+def _bind_thread_runtime(rt):
+    """Bind the calling thread's API surface to ``rt`` (executor threads of
+    in-process workers call this at task entry)."""
+    _thread_runtime.rt = rt
 
 
 def _set_runtime(rt):

@@ -137,6 +137,19 @@ class Channel:
             raise ValueError(f"reader index {index} out of range")
         return ChannelReader(self._path, index)
 
+    def remote_reader(self, index: int) -> "RemoteChannelReader":
+        """Reader handle usable from ANY node: consumers on the writer's
+        node attach the shm segment directly; consumers elsewhere get an
+        agent-relayed shadow channel (the cross-node mutable-object push —
+        ref: node_manager.proto:509-512 RegisterMutableObject/
+        PushMutableObject)."""
+        if not 0 <= index < self.num_readers:
+            raise ValueError(f"reader index {index} out of range")
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        return RemoteChannelReader(
+            self._path, index, self.capacity, tuple(rt.agent_addr))
+
     def close(self) -> None:
         """Mark closed. Readers first drain any value they have not yet
         consumed (close is signalled out-of-band of seq, so a write-then-
@@ -150,6 +163,59 @@ class Channel:
                 os.unlink(self._path)
             except OSError:
                 pass
+
+
+class RemoteChannelReader:
+    """Location-transparent reader handle.
+
+    Same-node (same node agent) consumers attach the writer's segment
+    directly — zero copies, exactly the local ChannelReader. Cross-node
+    consumers create a local SHADOW channel and ask the writer's node agent
+    to relay every published value into it (agent thread: read as a
+    dedicated upstream reader -> RPC push -> shadow write). Backpressure is
+    preserved end to end: the upstream slot acks only as the relay consumes,
+    and the relay pushes synchronously into the shadow, which blocks until
+    the consumer acks."""
+
+    def __init__(self, path: str, index: int, capacity: int,
+                 writer_agent_addr: tuple):
+        self._path = path
+        self._index = index
+        self._capacity = capacity
+        self._writer_agent = tuple(writer_agent_addr)
+        self._reader: ChannelReader | None = None
+        self._shadow: Channel | None = None
+
+    def __reduce__(self):
+        return (RemoteChannelReader,
+                (self._path, self._index, self._capacity, self._writer_agent))
+
+    def _ensure(self) -> ChannelReader:
+        if self._reader is not None:
+            return self._reader
+        from ray_tpu.core import api
+        rt = api._get_runtime()
+        if tuple(rt.agent_addr) == self._writer_agent:
+            self._reader = ChannelReader(self._path, self._index)
+            return self._reader
+        shadow = Channel(capacity=self._capacity, num_readers=1)
+        rt.peer_pool.get(self._writer_agent).call(
+            "channel_relay_open",
+            {"path": self._path, "index": self._index,
+             "target_agent": tuple(rt.agent_addr),
+             "target_path": shadow._path},
+            timeout=30.0)
+        self._shadow = shadow
+        self._reader = shadow.reader(0)
+        return self._reader
+
+    def read(self, timeout: float | None = 10.0, raw: bool = False):
+        return self._ensure().read(timeout=timeout, raw=raw)
+
+    def close(self) -> None:
+        if self._shadow is not None:
+            self._shadow.unlink()
+            self._shadow = None
 
 
 class ChannelReader:

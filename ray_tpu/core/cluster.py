@@ -53,8 +53,11 @@ class Cluster:
                  labels: dict | None = None,
                  object_store_memory: int | None = None,
                  tpu_slice: str | None = None, tpu_worker_id: int = 0,
-                 tpu_chips: int = 4, pod_type: str = "v5p-16") -> NodeAgent:
-        """Add a node. ``tpu_slice`` fakes TPU slice membership via labels."""
+                 tpu_chips: int = 4, pod_type: str = "v5p-16",
+                 inproc_workers: bool = False) -> NodeAgent:
+        """Add a node. ``tpu_slice`` fakes TPU slice membership via labels.
+        ``inproc_workers`` hosts the node's workers as threads in this
+        process (scale/autoscaler harness) instead of subprocesses."""
         res = dict(resources or {})
         res.setdefault("CPU", float(num_cpus))
         lab = dict(labels or {})
@@ -63,7 +66,8 @@ class Cluster:
             lab.update({"slice_name": tpu_slice, "tpu_worker_id": str(tpu_worker_id),
                         "pod_type": pod_type, "topology": ""})
         agent = NodeAgent(self.control_plane.addr, resources=res, labels=lab,
-                          object_store_memory=object_store_memory)
+                          object_store_memory=object_store_memory,
+                          inproc_workers=inproc_workers)
         self.nodes.append(agent)
         return agent
 

@@ -36,6 +36,48 @@ from ray_tpu.core.scheduler import add, fits, subtract
 logger = logging.getLogger(__name__)
 
 
+class _InProcHandle:
+    """Process-like facade over an in-process WorkerRuntime, so the agent's
+    monitor/kill/reap paths (poll/terminate/kill/wait/returncode) work
+    unchanged for in-process workers — the fake_multi_node-style harness
+    that lets scale and autoscaler tests run hundreds of workers as threads
+    instead of processes (reference:
+    python/ray/autoscaler/_private/fake_multi_node/node_provider.py)."""
+
+    def __init__(self, rt):
+        self._rt = rt
+        self._exited = threading.Event()
+        self.returncode: int | None = None
+
+    def exit(self, code: int = 0) -> None:
+        """Soft process-exit: bound to WorkerRuntime.on_exit."""
+        if self._exited.is_set():
+            return
+        self.returncode = code
+        self._exited.set()
+        threading.Thread(target=self._shutdown, daemon=True).start()
+
+    def _shutdown(self):
+        try:
+            self._rt.shutdown()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+    # Popen facade ------------------------------------------------------
+    def poll(self):
+        return self.returncode if self._exited.is_set() else None
+
+    def terminate(self):
+        self.exit(-15)
+
+    def kill(self):
+        self.exit(-9)
+
+    def wait(self, timeout: float | None = None):
+        self._exited.wait(timeout)
+        return self.returncode
+
+
 @dataclass
 class _WorkerInfo:
     worker_id: WorkerID
@@ -68,8 +110,12 @@ class NodeAgent:
                  resources: dict[str, float] | None = None,
                  labels: dict[str, str] | None = None,
                  object_store_memory: int | None = None,
-                 node_id: NodeID | None = None):
+                 node_id: NodeID | None = None,
+                 inproc_workers: bool = False):
         cfg = get_config()
+        # in-process workers: WorkerRuntimes as threads instead of
+        # subprocesses (see _InProcHandle) — the scale/autoscaler harness
+        self._inproc_workers = bool(inproc_workers)
         self.node_id = node_id or NodeID.from_random()
         self.cp_addr = tuple(cp_addr)
         self._lock = threading.RLock()
@@ -91,13 +137,16 @@ class NodeAgent:
         self.store.on_evict = self._on_store_evict
         self._object_owners: dict = {}  # ObjectID -> owner addr, for evict notices
         self._pull_cv = threading.Condition()
+        self._relay_channels: dict[str, object] = {}  # shadow path -> Channel
+        self._channel_relay_stops: dict = {}  # (path, index) -> stop Event
         self._pull_inflight_bytes = 0
         self._pulls_in_progress: dict = {}  # ObjectID -> Event (single-flight)
         self._stopped = threading.Event()
         self._res_version = 0  # versioned resource-view sync (RaySyncer)
         self._server = RpcServer(
             self._handle, host=host, port=port, name="nodeagent",
-            blocking_methods={"lease_worker", "pull_object", "wait_object_local"},
+            blocking_methods={"lease_worker", "pull_object",
+                              "wait_object_local", "channel_push"},
             pool_size=16)
         self.addr = self._server.addr
         self._register_with_cp()
@@ -163,6 +212,96 @@ class NodeAgent:
     def _h_ping(self, body):
         return {"ok": True}
 
+    # ---- cross-node mutable channels (ref: node_manager.proto:509-512
+    # RegisterMutableObject/PushMutableObject) -------------------------
+    def _h_channel_relay_open(self, body):
+        """Writer-node side: start relaying one reader slot of a local
+        channel to a shadow channel on another node's agent. A reader index
+        has ONE live attachment: re-attaching (consumer restarted elsewhere)
+        replaces the previous relay; a value already consumed by the old
+        relay may be delivered to the old attachment."""
+        key = (body["path"], int(body["index"]))
+        stop = threading.Event()
+        with self._lock:
+            old = self._channel_relay_stops.pop(key, None)
+            self._channel_relay_stops[key] = stop
+        if old is not None:
+            old.set()
+        threading.Thread(
+            target=self._channel_relay_loop,
+            args=(body["path"], int(body["index"]),
+                  tuple(body["target_agent"]), body["target_path"], stop),
+            name="chan-relay", daemon=True).start()
+        return {"ok": True}
+
+    def _channel_relay_loop(self, path, index, target_agent, target_path,
+                            relay_stop):
+        from ray_tpu.core.channel import (
+            ChannelClosedError,
+            ChannelReader,
+            ChannelTimeoutError,
+        )
+        reader = ChannelReader(path, index)
+        client = self._pool.get(target_agent)
+        while not self._stopped.is_set() and not relay_stop.is_set():
+            try:
+                data = reader.read(timeout=1.0, raw=True)
+            except ChannelTimeoutError:
+                continue
+            except ChannelClosedError:
+                try:
+                    client.call("channel_close", {"path": target_path},
+                                timeout=10.0)
+                except Exception:  # noqa: BLE001 - consumer may be gone
+                    pass
+                return
+            except OSError:
+                return  # writer unlinked the segment
+            try:
+                # synchronous push: the shadow write blocks until the
+                # consumer acks, carrying backpressure upstream (our ack
+                # above releases the writer slot only once per relayed value)
+                client.call("channel_push",
+                            {"path": target_path, "data": data},
+                            timeout=600.0)
+            except Exception as e:  # noqa: BLE001 - consumer died/stalled
+                # close the shadow so the consumer sees ChannelClosedError
+                # instead of blocking forever on a relay that will never
+                # deliver again (the in-hand value is lost — log it)
+                logger.warning(
+                    "channel relay %s[%d] -> %s push failed (%r); closing "
+                    "the shadow and stopping the relay", path, index,
+                    target_path, e)
+                try:
+                    client.call("channel_close", {"path": target_path},
+                                timeout=10.0)
+                except Exception:  # noqa: BLE001 - consumer gone entirely
+                    pass
+                return
+
+    def _h_channel_push(self, body):
+        from ray_tpu.core.channel import Channel
+        path = body["path"]
+        with self._lock:
+            ch = self._relay_channels.get(path)
+            if ch is None:
+                ch = self._relay_channels[path] = Channel(0, 0, _attach=path)
+        ch.write(body["data"], timeout=600.0)
+        return {"ok": True}
+
+    def _h_channel_close(self, body):
+        from ray_tpu.core.channel import Channel
+        path = body["path"]
+        with self._lock:
+            ch = self._relay_channels.pop(path, None)
+        if ch is None:
+            try:
+                ch = Channel(0, 0, _attach=path)
+            except OSError:
+                return {"ok": False}
+        ch.close()
+        return {"ok": True}
+
     def _h_dump_node_stacks(self, body):
         """Stack snapshot of the agent AND every registered worker on this
         node (ref: dashboard reporter profiling endpoints). A worker that
@@ -195,10 +334,41 @@ class NodeAgent:
         return out
 
     # ---- worker pool ---------------------------------------------------
+    def _spawn_inproc_worker(self, for_tpu: bool,
+                             runtime_env: dict | None) -> _WorkerInfo:
+        """In-process spawn: a WorkerRuntime hosted on threads in THIS
+        process, registered synchronously (no call-home round trip).
+        Process-level runtime_env isolation does not apply — acceptable for
+        the scale/autoscaler harness this mode exists for."""
+        from ray_tpu.core.ids import JobID
+        from ray_tpu.core.worker import WorkerRuntime
+        from ray_tpu.runtime_env import env_hash
+
+        worker_id = WorkerID.from_random()
+        rt = WorkerRuntime(
+            mode="worker", cp_addr=self.cp_addr, agent_addr=self.addr,
+            job_id=JobID.from_int(0), worker_id=worker_id,
+            node_id=self.node_id)
+        handle = _InProcHandle(rt)
+        rt.on_exit = handle.exit
+        info = _WorkerInfo(worker_id=worker_id, is_tpu_worker=for_tpu,
+                           env_key=env_hash(runtime_env))
+        info.ready = threading.Event()
+        info.proc = handle
+        info.pid = os.getpid()
+        info.addr = rt.addr
+        with self._lock:
+            self._workers[worker_id] = info
+            info.ready.set()
+            self._lease_cv.notify_all()
+        return info
+
     def _spawn_worker(self, for_tpu: bool = False,
                       runtime_env: dict | None = None) -> _WorkerInfo:
         from ray_tpu.runtime_env import env_hash, materialize_runtime_env
 
+        if self._inproc_workers:
+            return self._spawn_inproc_worker(for_tpu, runtime_env)
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         cwd = os.getcwd()
@@ -207,10 +377,11 @@ class NodeAgent:
         from ray_tpu.core.config import package_parent_path
         env["PYTHONPATH"] = (package_parent_path() + os.pathsep
                              + env.get("PYTHONPATH", ""))
+        python_exe = sys.executable
         if runtime_env:
             # materialize BEFORE spawn (reference: runtime_env agent creates
             # the env, then the worker starts inside it)
-            env_vars, env_cwd, pypath = materialize_runtime_env(
+            env_vars, env_cwd, pypath, venv_py = materialize_runtime_env(
                 self._pool.get(self.cp_addr), runtime_env)
             env.update(env_vars)
             if env_cwd:
@@ -218,6 +389,11 @@ class NodeAgent:
             if pypath:
                 env["PYTHONPATH"] = os.pathsep.join(
                     pypath + [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+            if venv_py:
+                # pip envs: the worker runs on the spec's virtualenv
+                # interpreter, so its installed packages shadow the base
+                # environment's (reference pip/uv plugin semantics)
+                python_exe = venv_py
         # see ray_tpu/__init__.py: arrow's mimalloc pool is unsafe under the
         # worker's thread profile; pin the system pool unless the user set one
         env.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
@@ -246,7 +422,7 @@ class NodeAgent:
         err_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.err")
         with open(out_path, "ab") as fout, open(err_path, "ab") as ferr:
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                [python_exe, "-m", "ray_tpu.core.worker_main"],
                 env=env, cwd=cwd, stdout=fout, stderr=ferr)
         info.proc, info.pid = proc, proc.pid
         info.log_paths = (out_path, err_path)

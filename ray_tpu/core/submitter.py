@@ -566,8 +566,17 @@ class ActorTaskSubmitter:
         """Resolve the actor address from the control plane, then flush the
         queue (ref: actor_task_submitter.cc ConnectActor)."""
         try:
-            reply = self._rt.cp_client.call_with_retry(
-                "resolve_actor", {"actor_id": actor_id, "timeout": 120.0}, timeout=130.0)
+            while True:
+                reply = self._rt.cp_client.call_with_retry(
+                    "resolve_actor",
+                    {"actor_id": actor_id, "timeout": 120.0}, timeout=130.0)
+                # TIMEOUT is only the long-poll bound, NOT a death verdict:
+                # actor creation is legitimately unbounded (model loads,
+                # compile warmup). Keep polling until ALIVE or DEAD — the
+                # reference blocks the same way (resolve ends only when the
+                # GCS reports a terminal state).
+                if reply.get("state") != "TIMEOUT":
+                    break
         except Exception as e:
             reply = {"state": "DEAD", "death_cause": f"resolve failed: {e}"}
         to_fail = []

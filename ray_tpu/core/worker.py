@@ -298,6 +298,11 @@ class WorkerRuntime:
         self._normal_exec = _NormalTaskQueue()
         self._running_tasks: dict[TaskID, threading.Event] = {}
         self._blocked_notified = threading.local()
+        # Process-exit hook: worker_main's default is the real thing; the
+        # in-process worker mode (scale/autoscaler test harness — the
+        # fake_multi_node analog) routes it to a soft shutdown so a worker
+        # "exit" cannot kill the host process.
+        self.on_exit = os._exit
         # ObjectRef.__del__ enqueues here instead of calling into the
         # reference counter synchronously: destructors fire inside arbitrary
         # allocations, where the current thread may already hold framework
@@ -1234,7 +1239,7 @@ class WorkerRuntime:
             self.normal_submitter.shutdown()
         except Exception:
             pass
-        os._exit(code)
+        self.on_exit(code)
 
     def _h_exit_worker(self, body):
         """Same port-reuse guard as kill_actor."""
@@ -1358,7 +1363,16 @@ class WorkerRuntime:
         self._normal_exec.submit(run)
         return reply
 
+    def _bind_exec_thread(self):
+        """Point the calling (executor) thread's API surface at this
+        runtime: with in-process workers several runtimes share the
+        process, and task bodies calling ray_tpu.get/put/remote must reach
+        THEIR worker's runtime, not the process-global one."""
+        from ray_tpu.core import api
+        api._bind_thread_runtime(self)
+
     def _run_task(self, spec: TaskSpec) -> dict:
+        self._bind_exec_thread()
         prev_task = self._ctx.task_id
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
@@ -1372,7 +1386,7 @@ class WorkerRuntime:
                 logger.info("task %s setup: fn_get=%.3fs args=%.3fs",
                             spec.repr_name(), t1 - t0, t2 - t1)
             if spec.task_type == TaskType.ACTOR_TASK:
-                method = getattr(self._actor_state.instance, spec.method_name)
+                method = self._actor_method(spec.method_name)
                 result = method(*args, **kwargs)
             else:
                 result = fn(*args, **kwargs)
@@ -1612,6 +1626,7 @@ class WorkerRuntime:
         logger.debug("executing actor creation %s", spec.actor_id.hex()[:8])
         st = self._actor_state
         try:
+            self._bind_exec_thread()
             cls = self.function_manager.get(spec.function_id)
             args, kwargs = self._resolve_args(spec)
             prev = self._ctx.task_id
@@ -1686,6 +1701,17 @@ class WorkerRuntime:
                 st.pending.setdefault(caller, {})[spec.seq_no] = (spec, reply)
         return reply
 
+    def _actor_method(self, name: str):
+        """Resolve an actor method by name. ``__rtpu_call__`` is the generic
+        entry (reference: actor.__ray_call__): the first argument is a
+        callable invoked as fn(instance, *args, **kwargs) — what lets
+        framework code (e.g. the compiled-pipeline stage loop) run on ANY
+        user actor without the class pre-declaring a method."""
+        inst = self._actor_state.instance
+        if name == "__rtpu_call__":
+            return lambda fn, *a, **k: fn(inst, *a, **k)
+        return getattr(inst, name)
+
     def _actor_group_for(self, spec: TaskSpec) -> str:
         st = self._actor_state
         group = spec.concurrency_group
@@ -1715,7 +1741,8 @@ class WorkerRuntime:
                 e, task_repr=spec.repr_name())))
             return
         pool = self._actor_pool_for(group)
-        method = getattr(st.instance, spec.method_name, None)
+        method = (None if spec.method_name == "__rtpu_call__"
+                  else getattr(st.instance, spec.method_name, None))
         import inspect
         if (st.loop is not None and method is not None
                 and inspect.iscoroutinefunction(method)):
@@ -1766,6 +1793,7 @@ class WorkerRuntime:
 
     async def _run_actor_task_async(self, spec: TaskSpec, method,
                                     args, kwargs) -> dict:
+        self._bind_exec_thread()
         st = self._actor_state
         prev = self._ctx.task_id
         self._ctx.task_id = spec.task_id
@@ -1787,12 +1815,13 @@ class WorkerRuntime:
         return reply
 
     def _run_actor_task(self, spec: TaskSpec) -> dict:
+        self._bind_exec_thread()
         st = self._actor_state
         prev = self._ctx.task_id
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
         try:
-            method = getattr(st.instance, spec.method_name)
+            method = self._actor_method(spec.method_name)
             args, kwargs = self._resolve_args(spec)
             import inspect
             if inspect.iscoroutinefunction(method) and st.loop is not None:
@@ -1831,7 +1860,7 @@ class WorkerRuntime:
             except Exception:
                 pass
             time.sleep(0.1)
-            os._exit(0)
+            self.on_exit(0)
 
         threading.Thread(target=exit_later, daemon=True).start()
 

@@ -1,0 +1,21 @@
+"""Compiled host-side pipelines over mutable channels (aDAG analog).
+
+TPU-native counterpart of the reference's compiled graphs
+(python/ray/dag/compiled_dag_node.py:805 CompiledDAG +
+experimental/channel/): a fixed actor pipeline is compiled ONCE into a
+chain of mutable shared-memory channels (ray_tpu.core.channel) — no
+per-call task submission, no object-store churn; each execute() writes the
+input channel and the stages stream values through.
+
+Scope note (deliberate redesign): the reference's compiled graphs also
+schedule ACCELERATOR work (NCCL groups, GPU futures). On TPU the on-chip
+dataflow belongs to XLA — one jitted program owns fusion and collectives —
+so the DAG here is the HOST-side pipeline: feeding, pre/post-processing,
+and stage-to-stage handoff (e.g. prefill→decode KV blobs,
+serve/llm/disagg.py). Cross-node edges ride the agent channel relay
+(channel.RemoteChannelReader).
+"""
+
+from ray_tpu.dag.compiled import CompiledPipeline, PipelineRef
+
+__all__ = ["CompiledPipeline", "PipelineRef"]

@@ -1,0 +1,166 @@
+"""CompiledPipeline: bind actors -> compile to a channel chain -> execute.
+
+Reference parity: python/ray/dag/compiled_dag_node.py:805 (CompiledDAG —
+bind, experimental_compile, execute returning a ref) re-shaped for this
+runtime: stages are existing actors, each edge is one mutable channel
+(writer on the producing stage's node, agent-relayed across nodes), and a
+stage runs a resident loop task (via the generic ``__rtpu_call__`` actor
+entry) instead of per-call task submission.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu.core.channel import Channel, ChannelClosedError
+
+_OUT_ATTR = "__rtpu_pipe_out__"
+
+
+def _stage_setup(inst, capacity: int):
+    """Runs ON the stage actor: create its output channel locally (a
+    channel's writer must live on the writing node) and hand back a
+    location-transparent reader for the next stage."""
+    ch = Channel(capacity=capacity, num_readers=1)
+    setattr(inst, _OUT_ATTR, ch)
+    return ch.remote_reader(0)
+
+
+def _stage_loop(inst, in_reader, method_name: str):
+    """Runs ON the stage actor for the pipeline's lifetime: read → method →
+    write. Ends (and closes the downstream edge, cascading teardown) when
+    the upstream channel closes."""
+    out: Channel = getattr(inst, _OUT_ATTR)
+    method = getattr(inst, method_name)
+    processed = 0
+    try:
+        while True:
+            try:
+                value = in_reader.read(timeout=None)
+            except ChannelClosedError:
+                return processed
+            out.write(method(value), timeout=None)
+            processed += 1
+    finally:
+        out.close()
+        if hasattr(in_reader, "close"):
+            in_reader.close()
+
+
+class PipelineRef:
+    """Result handle for one execute() (the compiled-DAG 'ref'): get()
+    blocks for that execution's output, delivered in submission order."""
+
+    def __init__(self, pipe: "CompiledPipeline", index: int):
+        self._pipe = pipe
+        self._index = index
+
+    def get(self, timeout: Optional[float] = 60.0):
+        return self._pipe._result(self._index, timeout)
+
+
+class CompiledPipeline:
+    """A linear actor pipeline compiled onto mutable channels.
+
+    >>> pipe = CompiledPipeline([(a, "prep"), (b, "infer")]).compile()
+    >>> ref = pipe.execute(batch)      # write-side, returns immediately
+    >>> out = ref.get()                # read-side, in submission order
+
+    The stage actors keep running their loop task until close(); while
+    compiled, calls submitted through the pipeline bypass task submission
+    entirely (one shm write per hop; agent relay across nodes).
+    """
+
+    def __init__(self, stages: list, capacity: int = 8 * 1024 * 1024):
+        if not stages:
+            raise ValueError("pipeline needs at least one stage")
+        self._stages = [(s if isinstance(s, tuple) else (s, "__call__"))
+                        for s in stages]
+        self._capacity = capacity
+        self._input: Optional[Channel] = None
+        self._out_reader = None
+        self._loop_refs: list = []
+        self._lock = threading.Lock()
+        # writers serialize on a SEPARATE lock: index assignment and the
+        # channel write must be atomic together (or two concurrent
+        # execute()s could write in the opposite order of their indices and
+        # cross-wire results), but the write may block on backpressure and
+        # the drain side (_result) needs _lock to make progress
+        self._write_lock = threading.Lock()
+        self._submitted = 0
+        self._delivered = 0
+        self._results: dict[int, Any] = {}
+        self._closed = False
+
+    def compile(self) -> "CompiledPipeline":
+        import ray_tpu
+
+        self._input = Channel(capacity=self._capacity, num_readers=1)
+        prev_reader = self._input.remote_reader(0)
+        for actor, method in self._stages:
+            out_reader = ray_tpu.get(
+                actor.__rtpu_call__.remote(_stage_setup, self._capacity),
+                timeout=60.0)
+            # resident stage loop: occupies one of the actor's concurrency
+            # slots until close()
+            self._loop_refs.append(
+                actor.__rtpu_call__.remote(_stage_loop, prev_reader, method))
+            prev_reader = out_reader
+        self._out_reader = prev_reader
+        return self
+
+    def execute(self, value) -> PipelineRef:
+        if self._input is None:
+            raise RuntimeError("pipeline not compiled (call .compile())")
+        if self._closed:
+            raise RuntimeError("pipeline closed")
+        with self._write_lock:
+            with self._lock:
+                # Bounded in-flight (reference: CompiledDAG
+                # max_buffered_results — dag/compiled_dag_node.py raises
+                # rather than deadlock): each hop buffers ONE value, so a
+                # single-threaded caller submitting past the chain's slot
+                # count would block in write() with the drain side never
+                # reached. stages+1 is a safe lower bound of the chain's
+                # capacity (input slot + one per stage output; relays and
+                # in-hand values only add slack).
+                limit = len(self._stages) + 1
+                if self._submitted - self._delivered >= limit:
+                    raise RuntimeError(
+                        f"{limit} executions already in flight; get() some "
+                        "results before submitting more (each pipeline hop "
+                        "buffers one value)")
+                idx = self._submitted
+                self._submitted += 1
+            self._input.write(value, timeout=None)
+        return PipelineRef(self, idx)
+
+    def _result(self, index: int, timeout: Optional[float]):
+        with self._lock:
+            while index not in self._results:
+                if self._delivered > index:
+                    raise RuntimeError(
+                        f"pipeline result {index} already consumed")
+                # single-threaded drain under the lock: deliver in order
+                value = self._out_reader.read(timeout=timeout)
+                self._results[self._delivered] = value
+                self._delivered += 1
+            return self._results.pop(index)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Tear down: close the input edge; closure cascades stage by stage
+        and each loop task returns its processed count."""
+        if self._closed or self._input is None:
+            return
+        self._closed = True
+        import ray_tpu
+
+        self._input.close()
+        try:
+            ray_tpu.get(self._loop_refs, timeout=timeout)
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+        if hasattr(self._out_reader, "close"):
+            self._out_reader.close()
+        self._input.unlink()

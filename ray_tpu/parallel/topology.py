@@ -60,6 +60,16 @@ def detect_local_topology() -> SliceTopology | None:
     # TPU VM runtime env vars (ref: tpu.py TPU_* env detection)
     accel = os.environ.get("TPU_ACCELERATOR_TYPE")
     if accel is None:
+        # tunneled dev chip (axon PJRT plugin): no TPU VM metadata env, but
+        # the plugin's generation var marks a single attached chip. Without
+        # this, whether the node advertises a TPU resource depends on which
+        # login-profile vars happened to materialize.
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+        if gen:
+            return SliceTopology(
+                slice_name=os.environ.get("HOSTNAME", "local-slice"),
+                pod_type=f"{gen}-tunnel", topology="1x1",
+                worker_id=0, num_hosts=1, chips_per_host=1)
         return None
     worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")

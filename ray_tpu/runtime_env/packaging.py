@@ -77,13 +77,117 @@ def prepare_runtime_env(rt, runtime_env: dict | None) -> dict | None:
     if mods:
         out["py_modules"] = [
             m if m.startswith("kv://") else _upload_dir(rt, m) for m in mods]
-    if out.get("pip"):
+    pip = out.get("pip")
+    if pip:
+        out["pip"] = _normalize_pip(pip)
+    return out
+
+
+def _is_local_req(req: str) -> bool:
+    """A requirement installs offline iff it is an EXPLICIT path (absolute,
+    ./relative, or file://). Bare names never count — probing the
+    filesystem for them would make 'requests' mean a same-named CWD
+    directory on one node and the PyPI package on another."""
+    return req.startswith(("/", "./", "file://"))
+
+
+def _normalize_pip(pip) -> dict:
+    """Accept the reference's shapes — a list of requirement strings or
+    {"packages": [...]} — normalized to {"packages": [...]}. Requirements
+    that are local paths (wheels / directories) install offline; anything
+    else needs the network and is gated by config, since index installs on
+    an air-gapped TPU pod would hang every lease that needs the env."""
+    if isinstance(pip, (list, tuple)):
+        pip = {"packages": list(pip)}
+    if not isinstance(pip, dict) or not isinstance(
+            pip.get("packages"), (list, tuple)):
+        raise RuntimeEnvError(
+            "runtime_env['pip'] must be a list of requirements or "
+            "{'packages': [...]}")
+    pkgs = [str(p) for p in pip["packages"]]
+    needs_net = [p for p in pkgs if not _is_local_req(p)]
+    if needs_net:
         from ray_tpu.core.config import get_config
         if not get_config().allow_runtime_env_pip:
             raise RuntimeEnvError(
-                "runtime_env['pip'] needs network access; set "
-                "RAY_TPU_ALLOW_RUNTIME_ENV_PIP=1 to enable")
-    return out
+                f"runtime_env pip requirements {needs_net} need network "
+                "access; set RAY_TPU_ALLOW_RUNTIME_ENV_PIP=1 to enable "
+                "(local wheel/dir paths install without it)")
+    return {"packages": pkgs}
+
+
+def _venv_python(spec: dict) -> str:
+    """Materialize an isolated virtualenv for a pip runtime_env; returns
+    its python executable. Cached under a spec-hash directory with a
+    .ready marker (reference: _private/runtime_env/uv.py / pip.py +
+    uri_cache.py). Prefers ``uv venv``/``uv pip`` when uv is on PATH
+    (reference uv plugin); falls back to stdlib venv + pip.
+
+    --system-site-packages: the env inherits the base interpreter's
+    packages (jax, numpy, the framework) and installed requirements
+    shadow them — per-job package ISOLATION with shared heavyweights,
+    the reference pip plugin's behavior."""
+    import subprocess
+    import sys
+
+    spec_key = hashlib.sha1(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+    dest = os.path.join(_ENV_ROOT, f"venv-{spec_key}")
+    py = os.path.join(dest, "bin", "python")
+    marker = os.path.join(dest, ".ready")
+    if os.path.exists(marker):
+        return py
+    os.makedirs(_ENV_ROOT, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=f"venv-{spec_key}.tmp.", dir=_ENV_ROOT)
+    tmp_py = os.path.join(tmp, "bin", "python")
+    try:
+        uv = shutil.which("uv")
+        if uv:
+            subprocess.run(
+                [uv, "venv", "--system-site-packages",
+                 "--python", sys.executable, tmp],
+                check=True, capture_output=True, timeout=300)
+            install = [uv, "pip", "install", "--python", tmp_py]
+        else:
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 tmp],
+                check=True, capture_output=True, timeout=300)
+            install = [tmp_py, "-m", "pip", "install", "--quiet"]
+        # --system-site-packages exposes the BASE interpreter's packages;
+        # when this process itself runs in a venv (the common dev install),
+        # that loses its site-packages (numpy, jax, ...). A .pth appends
+        # the parent's site dirs AFTER the new env's own, so installed
+        # requirements still shadow them.
+        parent_sites = [p for p in sys.path
+                        if p.rstrip("/").endswith("site-packages")]
+        if parent_sites:
+            import glob as _glob
+            for sp in _glob.glob(os.path.join(
+                    tmp, "lib", "python*", "site-packages")):
+                with open(os.path.join(sp, "_rtpu_parent_sites.pth"),
+                          "w") as f:
+                    f.write("\n".join(parent_sites) + "\n")
+        pkgs = list(spec.get("packages") or [])
+        local_only = all(_is_local_req(p) for p in pkgs)
+        if pkgs:
+            cmd = install + (["--no-index"] if local_only else []) + pkgs
+            r = subprocess.run(cmd, capture_output=True, timeout=600)
+            if r.returncode != 0:
+                raise RuntimeEnvError(
+                    f"pip install for runtime_env failed: "
+                    f"{r.stderr.decode()[-500:]}")
+        open(os.path.join(tmp, ".ready"), "w").close()
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            if not os.path.exists(marker):
+                raise
+            shutil.rmtree(tmp, ignore_errors=True)
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return py
 
 
 def env_hash(runtime_env: dict | None) -> str:
@@ -128,11 +232,14 @@ def _fetch_pkg(cp_client, uri: str) -> str:
 
 
 def materialize_runtime_env(cp_client, runtime_env: dict | None
-                            ) -> tuple[dict, str | None, list[str]]:
+                            ) -> tuple[dict, str | None, list[str],
+                                       str | None]:
     """Agent side (before worker spawn): returns (env_vars, cwd,
-    pythonpath_entries) for the worker process."""
+    pythonpath_entries, python_exe) for the worker process. python_exe is
+    non-None when the env carries a pip spec — the worker must run inside
+    that spec's virtualenv."""
     if not runtime_env:
-        return {}, None, []
+        return {}, None, [], None
     env_vars = dict(runtime_env.get("env_vars") or {})
     cwd = None
     pypath: list[str] = []
@@ -142,4 +249,8 @@ def materialize_runtime_env(cp_client, runtime_env: dict | None
         pypath.append(cwd)
     for uri in runtime_env.get("py_modules") or []:
         pypath.append(_fetch_pkg(cp_client, uri))
-    return env_vars, cwd, pypath
+    python_exe = None
+    pip = runtime_env.get("pip")
+    if pip:
+        python_exe = _venv_python(_normalize_pip(pip))
+    return env_vars, cwd, pypath, python_exe

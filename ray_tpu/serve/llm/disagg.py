@@ -90,10 +90,11 @@ def prefill_only(eng: LLMEngine, prompt, *, temperature: float | None = None,
                 eng.params, eng.kv, jnp.asarray(table), jnp.asarray(padded),
                 jnp.int32(plen), sub,
                 jnp.asarray([temperature], jnp.float32))
-            # extract this request's pages to host (the handoff payload)
+            # extract this request's pages to host (the handoff payload);
+            # pool layout [L, Hkv, P, page, D] — pages are axis 2
             pidx = jnp.asarray(table[:n_pages], jnp.int32)
-            kv_k = np.asarray(eng.kv["k"][:, pidx])
-            kv_v = np.asarray(eng.kv["v"][:, pidx])
+            kv_k = np.asarray(eng.kv["k"][:, :, pidx])
+            kv_v = np.asarray(eng.kv["v"][:, :, pidx])
             first = int(tok_dev)
         finally:
             eng.allocator.free(pages)
@@ -188,8 +189,8 @@ class DecodeEngine(LLMEngine):
         # pad the blob to max_pages_per_seq so ONE program shape covers
         # every prompt length (targets pad onto the trash page 0)
         mp = self.max_pages_per_seq
-        _l, _n, ps, h, d = state["kv_k"].shape
-        pad = ((0, 0), (0, mp - n_src), (0, 0), (0, 0), (0, 0))
+        # blob layout [L, Hkv, n_pages, page, D] — pad the page axis (2)
+        pad = ((0, 0), (0, 0), (0, mp - n_src), (0, 0), (0, 0))
         blob_k = jnp.asarray(np.pad(state["kv_k"], pad))
         blob_v = jnp.asarray(np.pad(state["kv_v"], pad))
         tgt = np.zeros((mp,), np.int32)
@@ -200,8 +201,8 @@ class DecodeEngine(LLMEngine):
             def impl(kv, bk, bv, pages):
                 # donated pool: injection rewrites the pages in place
                 # instead of copying the (GB-scale) pool per admission
-                return {"k": kv["k"].at[:, pages].set(bk),
-                        "v": kv["v"].at[:, pages].set(bv)}
+                return {"k": kv["k"].at[:, :, pages].set(bk),
+                        "v": kv["v"].at[:, :, pages].set(bv)}
 
             self._inject_fn = jax.jit(impl, donate_argnums=(0,))
         self.kv = self._inject_fn(self.kv, blob_k, blob_v,
@@ -236,20 +237,47 @@ class PrefillServer:
             temperature=sampling.get("temperature"),
             top_k=sampling.get("top_k"))
 
+    def prefill_one(self, req: dict) -> dict:
+        """Single-argument stage entry for the compiled pipeline (the KV
+        blob then rides the mutable-channel edge to the decode node instead
+        of the object plane)."""
+        return {"rid": req["rid"],
+                "state": self.prefill(req["prompt"],
+                                      req.get("sampling") or {})}
+
     def check_health(self) -> bool:
         return True
 
 
 class DisaggLLMServer:
-    """Decode-role ingress: completions run prefill on a prefill replica
-    (via its deployment handle), then decode locally from the handed-off
-    KV (reference: the "d" servers + PDProxyServer routing)."""
+    """Decode-role ingress: completions run prefill on a prefill replica,
+    then decode locally from the handed-off KV (reference: the "d" servers
+    + PDProxyServer routing).
 
-    def __init__(self, llm_config: LLMConfig | dict, prefill_handle):
+    Two prefill transports:
+    - ``prefill_handle``: a serve deployment handle; the KV blob travels as
+      a task return through the object plane.
+    - ``prefill_actors`` (compiled-pipeline path): raw prefill actors, each
+      compiled into a CompiledPipeline whose prompt→KV edge is a mutable
+      channel (agent-relayed across nodes) — the aDAG shape of the same
+      handoff (reference compiled_dag_node.py:805 over
+      experimental/channel)."""
+
+    def __init__(self, llm_config: LLMConfig | dict, prefill_handle=None,
+                 prefill_actors: list | None = None):
         if isinstance(llm_config, dict):
             llm_config = LLMConfig(**llm_config)
         self.cfg = llm_config
         self.prefill = prefill_handle
+        self._pipes = []
+        self._pipe_lock = threading.Lock()
+        self._pipe_rr = 0
+        self._rid = 0
+        if prefill_actors:
+            from ray_tpu.dag import CompiledPipeline
+            self._pipes = [
+                CompiledPipeline([(a, "prefill_one")]).compile()
+                for a in prefill_actors]
         self.engine = DecodeEngine(llm_config)
         self.engine.start()
 
@@ -265,14 +293,44 @@ class DisaggLLMServer:
         return self._run(_chat_prompt(payload.get("messages", [])),
                          payload, chat=True)
 
+    def _pipeline_prefill(self, prompt, sampling: dict) -> dict:
+        """Prefill through a compiled pipeline (round-robin over prefill
+        stages); execute() raising over-capacity just means that pipe has
+        its buffers full — try the next, else wait briefly."""
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            with self._pipe_lock:
+                pipe = self._pipes[self._pipe_rr % len(self._pipes)]
+                self._pipe_rr += 1
+                self._rid += 1
+                rid = self._rid
+            try:
+                ref = pipe.execute(
+                    {"rid": rid, "prompt": prompt, "sampling": sampling})
+            except RuntimeError:
+                time.sleep(0.05)  # all slots busy: prefill is chip-bound
+                continue
+            out = ref.get(timeout=600.0)
+            if out["rid"] != rid:
+                # belt over the pipeline's write-order lock: a cross-wired
+                # prefill would decode the WRONG prompt's KV silently
+                raise RuntimeError(
+                    f"prefill pipeline returned rid {out['rid']} for "
+                    f"request {rid}")
+            return out["state"]
+        raise TimeoutError("prefill pipeline saturated for 600s")
+
     def _run(self, prompt, payload: dict, chat: bool) -> Any:
         from ray_tpu.serve.llm.llm_server import LLMServer
         sampling = {k: payload[k] for k in ("temperature", "top_k")
                     if payload.get(k) is not None}
         t0 = time.monotonic()
-        state = self.prefill.options(
-            method_name="prefill", timeout_s=600.0).remote(
-            prompt, sampling).result(timeout_s=600.0)
+        if self._pipes:
+            state = self._pipeline_prefill(prompt, sampling)
+        else:
+            state = self.prefill.options(
+                method_name="prefill", timeout_s=600.0).remote(
+                prompt, sampling).result(timeout_s=600.0)
         rid = self.engine.submit_prefilled(
             state, max_tokens=payload.get("max_tokens"))
         out = self.engine.result(rid, timeout=600.0)
@@ -311,14 +369,33 @@ def build_disagg_openai_app(llm_config: LLMConfig | dict,
                             route_prefix: str = "/v1",
                             num_prefill: int = 1, num_decode: int = 1,
                             prefill_actor_options: dict | None = None,
-                            decode_actor_options: dict | None = None):
+                            decode_actor_options: dict | None = None,
+                            use_pipeline: bool = False):
     """Disaggregated OpenAI application: num_prefill prefill replicas feed
     num_decode decode ingress replicas (reference:
-    prefill_decode_disagg.build_pd_app)."""
+    prefill_decode_disagg.build_pd_app). With ``use_pipeline`` the
+    prefill→decode handoff rides compiled mutable-channel pipelines
+    (the aDAG path) instead of object-plane task returns."""
+    import ray_tpu
     from ray_tpu import serve
 
     if isinstance(llm_config, dict):
         llm_config = LLMConfig(**llm_config)
+    if use_pipeline:
+        # raw prefill actors, compiled into pipelines by each decode server
+        # (max_concurrency 2: the resident stage loop + health checks)
+        opts = dict(prefill_actor_options or {})
+        opts.setdefault("max_concurrency", 2)
+        actors = [ray_tpu.remote(PrefillServer).options(**opts).remote(
+            llm_config) for _ in range(num_prefill)]
+        decode_dep = serve.deployment(
+            DisaggLLMServer, name=f"{llm_config.name}-decode",
+            num_replicas=num_decode,
+            max_ongoing_requests=4 * llm_config.max_batch_size,
+            ray_actor_options=dict(decode_actor_options or {}),
+            health_check_timeout_s=600.0)
+        decode_dep.route_prefix = route_prefix
+        return decode_dep.bind(llm_config, None, actors)
     prefill_dep = serve.deployment(
         PrefillServer, name=f"{llm_config.name}-prefill",
         num_replicas=num_prefill,

@@ -145,6 +145,21 @@ class LLMEngine:
             self._decode_impl(params, kv, pt, sl, toks, rng, temp, idx, n),
             donate_argnums=(1, 3, 4), static_argnums=(8,))
         self._prefill_cache: dict[int, Any] = {}
+        # Slot-state patches run at ONE fixed shape (B+1 rows, trash-row
+        # padded) through these jitted fns. Eager .at[idx].set() with a
+        # dirty-count-sized idx compiled a fresh scatter per distinct count
+        # — ~0.6s per eager compile on a tunneled chip, observed as 8-14s
+        # TTFT stalls early in every serving run while counts 1,2,3,...
+        # were each seen for the first time.
+        self._patch_state = jax.jit(
+            lambda pt, sl, temps, idx, ptv, slv, tv: (
+                pt.at[idx].set(ptv), sl.at[idx].set(slv),
+                temps.at[idx].set(tv)),
+            donate_argnums=(0, 1, 2))
+        self._patch_toks = jax.jit(
+            lambda toks, idx, vals: toks.at[idx].set(vals),
+            donate_argnums=(0,))
+        self._zero_tok = None  # device int32(0), padding for override stacks
 
     # ---- compiled impls ------------------------------------------------
     def _decode_impl(self, params, kv, pt_full, sl_full, toks_full, rng,
@@ -271,6 +286,17 @@ class LLMEngine:
                 _all, toks, self.kv, self._sl_dev, self._rng = self._decode(
                     self.params, self.kv, self._pt_dev, self._sl_dev,
                     toks, self._rng, self._temps_dev, idx, k)
+        # the fixed-shape slot patches (all-trash write of zeros is a no-op)
+        didx = jnp.full((trash + 1,), trash, jnp.int32)
+        self._pt_dev, self._sl_dev, self._temps_dev = self._patch_state(
+            self._pt_dev, self._sl_dev, self._temps_dev, didx,
+            jnp.zeros((trash + 1, self.max_pages_per_seq), jnp.int32),
+            jnp.zeros((trash + 1,), jnp.int32),
+            jnp.zeros((trash + 1,), jnp.float32))
+        if self._zero_tok is None:
+            self._zero_tok = jnp.int32(0)
+        toks = self._patch_toks(
+            toks, didx, jnp.stack([self._zero_tok] * (trash + 1)))
         self._dev_tokens = toks
         self._jax.block_until_ready(toks)
 
@@ -415,6 +441,18 @@ class LLMEngine:
         except AttributeError:  # older jax: no readiness API
             return False
 
+    @staticmethod
+    def _start_fetch(dev_arr) -> None:
+        """Kick off the device->host copy at DISPATCH time so the later
+        harvest finds the bytes already local. Through a tunneled chip a
+        blocking fetch costs ~250ms of host latency per block — serialized
+        per harvest, it (not device execution) was the throughput and TTFT
+        bound."""
+        try:
+            dev_arr.copy_to_host_async()
+        except AttributeError:
+            pass
+
     def _bucket(self, n: int) -> int:
         b = 16
         while b < n:
@@ -492,6 +530,7 @@ class LLMEngine:
         state patch, first-token override (the on-device token carry knows
         nothing about fresh prefills), and a harvest entry for the sampled
         first token."""
+        self._start_fetch(tok_dev)
         with self._lock:
             req.dispatched = 1
             self.page_tables[req.slot] = table
@@ -593,25 +632,39 @@ class LLMEngine:
             overrides, self._overrides = self._overrides, {}
             for _col, _slot, req in snapshot:
                 req.dispatched += k
+        trash_row = self.cfg.max_batch_size
         if dirty:
+            # fixed-shape patch: pad to B+1 rows onto the trash row (whose
+            # state is all-zeros by invariant), so ONE compiled scatter
+            # covers every dirty-count
             order = sorted(dirty)
-            didx = jnp.asarray(order, jnp.int32)
-            self._pt_dev = self._pt_dev.at[didx].set(
-                jnp.asarray(self.page_tables[order]))
-            self._sl_dev = self._sl_dev.at[didx].set(
-                jnp.asarray([dirty[i][0] for i in order], jnp.int32))
-            self._temps_dev = self._temps_dev.at[didx].set(
-                jnp.asarray([dirty[i][1] for i in order], jnp.float32))
+            pad = (trash_row + 1) - len(order)
+            didx = jnp.asarray(order + [trash_row] * pad, jnp.int32)
+            ptv = np.zeros((trash_row + 1, self.max_pages_per_seq), np.int32)
+            ptv[: len(order)] = self.page_tables[order]
+            slv = np.zeros((trash_row + 1,), np.int32)
+            slv[: len(order)] = [dirty[i][0] for i in order]
+            tv = np.zeros((trash_row + 1,), np.float32)
+            tv[: len(order)] = [dirty[i][1] for i in order]
+            self._pt_dev, self._sl_dev, self._temps_dev = self._patch_state(
+                self._pt_dev, self._sl_dev, self._temps_dev, didx,
+                jnp.asarray(ptv), jnp.asarray(slv), jnp.asarray(tv))
         toks = self._dev_tokens
         if toks is None:
             toks = jnp.zeros((self.cfg.max_batch_size + 1,), jnp.int32)
         if overrides:
             # values are device scalars from async prefills: stacking and
-            # scattering them stays on device — no host sync
-            oidx = jnp.asarray(list(overrides.keys()), jnp.int32)
-            ovals = jnp.stack([jnp.asarray(v, jnp.int32)
-                               for v in overrides.values()])
-            toks = toks.at[oidx].set(ovals)
+            # scattering them stays on device — no host sync. Same
+            # fixed-shape padding (trash-row writes of 0) as the state patch.
+            if self._zero_tok is None:
+                self._zero_tok = jnp.int32(0)
+            pad = (trash_row + 1) - len(overrides)
+            oidx = jnp.asarray(
+                list(overrides.keys()) + [trash_row] * pad, jnp.int32)
+            ovals = jnp.stack(
+                [jnp.asarray(v, jnp.int32) for v in overrides.values()]
+                + [self._zero_tok] * pad)
+            toks = self._patch_toks(toks, oidx, ovals)
         # bucketed width: pack the active slots, pad with the trash row —
         # a lightly loaded engine runs a narrow program
         active_slots = [slot for _c, slot, _r in snapshot]
@@ -624,6 +677,7 @@ class LLMEngine:
         all_toks, self._dev_tokens, self.kv, self._sl_dev, self._rng = \
             self._decode(self.params, self.kv, self._pt_dev, self._sl_dev,
                          toks, self._rng, self._temps_dev, idx, k)
+        self._start_fetch(all_toks)
         self._pending.append((all_toks, snapshot, k))
         self.stats["steps"] += k
         if len(self._pending) > self.PIPELINE_DEPTH:

@@ -8,15 +8,18 @@ static (slot count, page count, pages-per-slot), so the decode step compiles
 ONCE and every iteration reuses the same XLA program — the crucial property
 on TPU, where recompilation would dwarf the step itself.
 
-Design choices for XLA (vs a CUDA kernel translation):
-- the per-slot KV view is materialized with a `jnp.take` gather over the
-  page axis — XLA fuses the gather into the attention matmul chain and never
-  round-trips HBM more than a dense cache would;
+Design choices:
+- decode attention runs the stock Pallas paged-attention kernel on TPU
+  (jax.experimental.pallas.ops.tpu.paged_attention — reads only each
+  sequence's live pages); the pool layout [L, Hkv, P, page, D] is the
+  kernel's native shape. A gather + dense-softmax fallback covers CPU and
+  kernel-incompatible shapes — it materializes the full per-slot view
+  (measured 84 ms/step vs the kernel's 25 ms for a 1.2B model at B=32);
 - writes are scatters at (page, offset) index pairs; inactive slots write to
   a reserved trash page (page 0), keeping the step free of dynamic shapes
   and `lax.cond`s;
-- a Pallas kernel can later replace the gather+matmul for decode without
-  touching the engine (same function signature).
+- prefill (full and chunked) stays gather-based: it runs at B=1 per
+  admission, where the materialized view is small.
 
 Page 0 is RESERVED as the trash page; the allocator never hands it out.
 """
@@ -38,8 +41,13 @@ from ray_tpu.models.llama import (
 
 
 def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int):
-    """KV pool: [n_layers, num_pages, page_size, n_kv_heads, head_dim]."""
-    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    """KV pool: [n_layers, n_kv_heads, num_pages, page_size, head_dim].
+
+    The head-major page layout is what the Pallas paged-attention decode
+    kernel consumes directly (jax.experimental.pallas.ops.tpu.paged_attention
+    — per layer [Hkv, P, page, D]), so decode on TPU runs the kernel with no
+    relayout; the CPU fallback gathers through the same pool."""
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_pages, page_size, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
@@ -81,13 +89,69 @@ class PageAllocator:
 def _write_token_kv(k_cache, v_cache, k_new, v_new, page_idx, offset):
     """Scatter one token's k/v per slot into the layer's page pool.
 
-    k_cache: [P, page, Hkv, D]; k_new: [B, Hkv, D]; page_idx/offset: [B].
+    k_cache: [Hkv, P, page, D]; k_new: [B, Hkv, D]; page_idx/offset: [B].
     Slots write distinct pages (or the shared trash page), so the scatter is
     conflict-free for real slots.
     """
-    k_cache = k_cache.at[page_idx, offset].set(k_new.astype(k_cache.dtype))
-    v_cache = v_cache.at[page_idx, offset].set(v_new.astype(v_cache.dtype))
+    k_cache = k_cache.at[:, page_idx, offset].set(
+        jnp.swapaxes(k_new, 0, 1).astype(k_cache.dtype))
+    v_cache = v_cache.at[:, page_idx, offset].set(
+        jnp.swapaxes(v_new, 0, 1).astype(v_cache.dtype))
     return k_cache, v_cache
+
+
+def _use_pallas_decode(cfg=None, page_size: int = 0) -> bool:
+    """Kernel path gate: TPU backend + shapes the Pallas paged-attention
+    kernel's tiling accepts (head_dim a multiple of 128, page a multiple of
+    8). Tiny test models (head_dim 16-64) fall back to the gather path."""
+    if jax.default_backend() != "tpu":
+        return False
+    if cfg is None:
+        return True
+    return cfg.head_dim % 128 == 0 and page_size % 8 == 0
+
+
+def _decode_attention(q, k_cache, v_cache, page_tables, pos, cfg, page_size):
+    """Single-token attention over the paged KV for all slots.
+
+    q: [B, H, D]; k_cache/v_cache: [Hkv, P, page, D]; pos: [B] (the new
+    token's position — attend over 0..pos inclusive). On TPU this is the
+    Pallas paged-attention kernel (reads only each sequence's live pages);
+    elsewhere a gather + dense softmax fallback. The gather path
+    materializes the full [B, max_len] view — measured 84 ms/step for a
+    1.2B model at B=32 on one v5e (~17 GB/step of HBM traffic), which is
+    why the kernel path exists."""
+    b = q.shape[0]
+    max_pages = page_tables.shape[1]
+    max_len = max_pages * page_size
+    if _use_pallas_decode(cfg, page_size):
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _pa)
+        blk = max_pages
+        while blk > 8 and max_pages % (blk // 2) == 0 and blk // 2 >= 8:
+            blk //= 2
+        # the kernel applies NO softmax scale (qk = q·k raw) — pre-scale q
+        return _pa(
+            (q * (cfg.head_dim ** -0.5)).astype(q.dtype),
+            k_cache, v_cache, pos + 1, page_tables,
+            pages_per_compute_block=blk)
+    n_rep = q.shape[1] // k_cache.shape[0]
+    sm = cfg.head_dim ** -0.5
+    # gather: [Hkv, B, MP, page, D] -> [B, MP, page, Hkv, D] -> [B, L, Hkv, D]
+    k_seq = jnp.moveaxis(
+        jnp.take(k_cache, page_tables, axis=1), 0, 3).reshape(
+        b, max_len, k_cache.shape[0], cfg.head_dim)
+    v_seq = jnp.moveaxis(
+        jnp.take(v_cache, page_tables, axis=1), 0, 3).reshape(
+        b, max_len, v_cache.shape[0], cfg.head_dim)
+    k_full = _gqa_expand(k_seq, n_rep)
+    v_full = _gqa_expand(v_seq, n_rep)
+    valid = jnp.arange(max_len)[None, :] <= pos[:, None]          # [B, L]
+    logits = jnp.einsum("bhd,bkhd->bhk", q, k_full).astype(
+        jnp.float32) * sm
+    logits = jnp.where(valid[:, None, :], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhk,bkhd->bhd", p, v_full)
 
 
 def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
@@ -101,20 +165,12 @@ def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
     carry seq_lens pointing at trash-page positions; their logits are junk
     and the engine ignores them.
     """
-    b = tokens.shape[0]
-    max_pages = page_tables.shape[1]
-    max_len = max_pages * page_size
-
     x = params["embed"][tokens[:, None]].astype(cfg.dtype)       # [B,1,D]
     cos, sin = rope_freqs(cfg, seq_lens[:, None])                # position = len
     pos = seq_lens
     page_idx = jnp.take_along_axis(
         page_tables, (pos // page_size)[:, None], axis=1)[:, 0]  # [B]
     offset = pos % page_size
-    # causal mask over the gathered view: positions 0..seq_len inclusive
-    valid = jnp.arange(max_len)[None, :] <= pos[:, None]          # [B, L]
-    sm = cfg.head_dim ** -0.5
-    n_rep = cfg.n_heads // cfg.n_kv_heads
 
     def body(carry, inputs):
         (x,) = carry
@@ -127,19 +183,10 @@ def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
         k = apply_rope(k, cos, sin)
         k_cache, v_cache = _write_token_kv(
             k_cache, v_cache, k[:, 0], v[:, 0], page_idx, offset)
-        # gather each slot's pages → [B, max_pages, page, Hkv, D] → [B, L, ...]
-        k_seq = jnp.take(k_cache, page_tables, axis=0).reshape(
-            b, max_len, cfg.n_kv_heads, cfg.head_dim)
-        v_seq = jnp.take(v_cache, page_tables, axis=0).reshape(
-            b, max_len, cfg.n_kv_heads, cfg.head_dim)
-        k_full = _gqa_expand(k_seq, n_rep)
-        v_full = _gqa_expand(v_seq, n_rep)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
-            jnp.float32) * sm
-        logits = jnp.where(valid[:, None, None, :], logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
-        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+        attn = _decode_attention(
+            q[:, 0], k_cache, v_cache, page_tables, pos, cfg,
+            page_size)                                            # [B,H,D]
+        x = x + jnp.einsum("bhk,hkd->bd", attn, layer["attn"]["wo"])[:, None]
         h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
         up = h2 @ layer["mlp"]["w_up"]
@@ -199,10 +246,10 @@ def paged_prefill(params, kv, page_table, tokens, true_len,
         up = h2 @ layer["mlp"]["w_up"]
         x = x + (gate * up) @ layer["mlp"]["w_down"]
         # scatter the prompt's k/v into this slot's pages
-        k_cache = k_cache.at[page_idx, offset].set(
-            k[0].astype(k_cache.dtype))
-        v_cache = v_cache.at[page_idx, offset].set(
-            v[0].astype(v_cache.dtype))
+        k_cache = k_cache.at[:, page_idx, offset].set(
+            jnp.swapaxes(k[0], 0, 1).astype(k_cache.dtype))
+        v_cache = v_cache.at[:, page_idx, offset].set(
+            jnp.swapaxes(v[0], 0, 1).astype(v_cache.dtype))
         return (x,), (k_cache, v_cache)
 
     (x,), (new_k, new_v) = jax.lax.scan(
@@ -255,14 +302,20 @@ def paged_prefill_chunk(params, kv, page_table, tokens, start, true_len,
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # write the chunk's k/v first, then attend through the paged view —
-        # the same write-then-gather shape as paged_decode_step, so the
-        # chunk sees earlier chunks AND itself causally
-        k_cache = k_cache.at[page_idx, offset].set(k[0].astype(k_cache.dtype))
-        v_cache = v_cache.at[page_idx, offset].set(v[0].astype(v_cache.dtype))
-        k_seq = jnp.take(k_cache, page_table, axis=0).reshape(
-            b, max_len, cfg.n_kv_heads, cfg.head_dim)
-        v_seq = jnp.take(v_cache, page_table, axis=0).reshape(
-            b, max_len, cfg.n_kv_heads, cfg.head_dim)
+        # the same write-then-gather shape as the decode fallback, so the
+        # chunk sees earlier chunks AND itself causally. B=1 here, so the
+        # gathered view is small (unlike batched decode, where the
+        # materialized gather is why the Pallas kernel exists).
+        k_cache = k_cache.at[:, page_idx, offset].set(
+            jnp.swapaxes(k[0], 0, 1).astype(k_cache.dtype))
+        v_cache = v_cache.at[:, page_idx, offset].set(
+            jnp.swapaxes(v[0], 0, 1).astype(v_cache.dtype))
+        k_seq = jnp.swapaxes(
+            jnp.take(k_cache, page_table, axis=1).reshape(
+                cfg.n_kv_heads, max_len, cfg.head_dim), 0, 1)[None]
+        v_seq = jnp.swapaxes(
+            jnp.take(v_cache, page_table, axis=1).reshape(
+                cfg.n_kv_heads, max_len, cfg.head_dim), 0, 1)[None]
         k_full = _gqa_expand(k_seq, n_rep)
         v_full = _gqa_expand(v_seq, n_rep)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(

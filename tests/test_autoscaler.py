@@ -51,6 +51,95 @@ def test_autoscaler_scale_up_and_down():
         cluster.shutdown()
 
 
+def test_autoscaler_e2e_real_loop():
+    """End-to-end through the STARTED reconciliation loop (not manual
+    update() calls): demand -> launches -> actors run on scaled nodes ->
+    idle -> terminations, with launch/terminate sequence assertions.
+    Scaled nodes host in-process workers (fake_multi_node-style harness)."""
+    from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, FakeNodeProvider
+    from ray_tpu.core.cluster import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    ray_tpu.init(address=cluster.address)
+    provider = FakeNodeProvider(cluster.control_plane.addr,
+                                inproc_workers=True)
+    scaler = Autoscaler(
+        cluster.control_plane.addr, provider,
+        AutoscalerConfig(min_workers=0, max_workers=3,
+                         node_resources={"CPU": 1, "accel": 1},
+                         idle_timeout_s=1.0, poll_interval_s=0.2))
+    scaler.start()
+    try:
+        @ray_tpu.remote(resources={"accel": 1})
+        class W:
+            def ping(self):
+                return "up"
+
+        actors = [W.remote() for _ in range(2)]
+        assert ray_tpu.get([a.ping.remote() for a in actors],
+                           timeout=120) == ["up", "up"]
+        assert scaler.num_launched == 2  # one launch per unplaceable actor
+        assert len(provider.non_terminated_nodes()) == 2
+
+        for a in actors:
+            ray_tpu.kill(a)
+        deadline = time.monotonic() + 60
+        while provider.non_terminated_nodes() and time.monotonic() < deadline:
+            time.sleep(0.3)
+        assert not provider.non_terminated_nodes(), "idle nodes not reclaimed"
+        assert scaler.num_terminated == 2
+    finally:
+        scaler.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_autoscaler_slice_scale_up_and_down():
+    """A slice-shaped (multi-host) PG request scales up ONE provider node
+    that registers as multiple CP hosts sharing a slice_name, the slice PG
+    places atomically on it, and removal scales the WHOLE slice down."""
+    from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, FakeNodeProvider
+    from ray_tpu.core.cluster import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)
+    ray_tpu.init(address=cluster.address)
+    provider = FakeNodeProvider(cluster.control_plane.addr,
+                                inproc_workers=True)
+    scaler = Autoscaler(
+        cluster.control_plane.addr, provider,
+        AutoscalerConfig(min_workers=0, max_workers=2,
+                         node_resources={"CPU": 2, "TPU": 4},
+                         node_labels={"pod_type": "v5p-8"},
+                         hosts_per_node=2,
+                         idle_timeout_s=1.0, poll_interval_s=0.2))
+    scaler.start()
+    try:
+        pg = ray_tpu.tpu_slice_placement_group("v5p-8")  # 2 hosts x 4 chips
+        assert pg.ready(timeout=120.0), "slice PG never placed"
+        assert len(provider.non_terminated_nodes()) == 1  # ONE slice launch
+        assert scaler.num_launched == 1
+        # the slice registered as 2 CP hosts sharing one slice_name
+        slice_nodes = [n for n in ray_tpu.nodes()
+                       if (n.get("labels") or {}).get("provider_node_name")]
+        assert len(slice_nodes) == 2
+        assert len({n["labels"]["slice_name"] for n in slice_nodes}) == 1
+
+        ray_tpu.remove_placement_group(pg)
+        deadline = time.monotonic() + 60
+        while provider.non_terminated_nodes() and time.monotonic() < deadline:
+            time.sleep(0.3)
+        assert not provider.non_terminated_nodes(), "idle slice not reclaimed"
+        assert scaler.num_terminated == 1
+    finally:
+        scaler.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def test_autoscaler_respects_max_workers():
     from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, FakeNodeProvider
     from ray_tpu.core.cluster import Cluster

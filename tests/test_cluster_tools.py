@@ -116,6 +116,55 @@ def test_log_streaming_survives_dropped_pushes(tmp_path, capfd):
         ray_tpu.shutdown()
 
 
+def _make_wheel(tmp_path, version: str) -> str:
+    """Build a minimal pure-python wheel (a wheel is just a zip) so pip
+    runtime_env tests install fully offline."""
+    import zipfile
+
+    path = tmp_path / f"rtpu_testpkg-{version}-py3-none-any.whl"
+    di = f"rtpu_testpkg-{version}.dist-info"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("rtpu_testpkg/__init__.py",
+                    f'__version__ = "{version}"\n')
+        zf.writestr(f"{di}/METADATA",
+                    "Metadata-Version: 2.1\nName: rtpu-testpkg\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{di}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\n"
+                    "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        zf.writestr(f"{di}/RECORD", "")
+    return str(path)
+
+
+def test_pip_runtime_env_conflicting_versions(ray_start_regular, tmp_path):
+    """Two jobs' tasks run against CONFLICTING package versions on one
+    cluster: each pip runtime_env materializes its own virtualenv (uv when
+    available, stdlib venv otherwise) and the worker pool is keyed per env
+    (reference: _private/runtime_env/uv.py, pip.py, uri_cache.py)."""
+    whl1 = _make_wheel(tmp_path, "1.0")
+    whl2 = _make_wheel(tmp_path, "2.0")
+
+    @ray_tpu.remote
+    def ver():
+        import rtpu_testpkg
+        return rtpu_testpkg.__version__
+
+    r1 = ver.options(runtime_env={"pip": [whl1]}).remote()
+    r2 = ver.options(runtime_env={"pip": [whl2]}).remote()
+    # generous timeout: each env creates a venv (~10s on a 1-core box)
+    assert sorted(ray_tpu.get([r1, r2], timeout=300)) == ["1.0", "2.0"]
+    # the base environment must NOT see the package (isolation)
+    @ray_tpu.remote
+    def base_has():
+        try:
+            import rtpu_testpkg  # noqa: F401
+            return True
+        except ImportError:
+            return False
+
+    assert ray_tpu.get(base_has.remote(), timeout=60) is False
+
+
 def test_runtime_env_validation(ray_start_regular):
     from ray_tpu.runtime_env import RuntimeEnvError
 

@@ -48,7 +48,7 @@ def test_prefill_handoff_matches_monolithic():
     try:
         state = prefill_only(pre, [7, 3, 9, 1, 4] * 4, temperature=0.0)
         assert state["plen"] == 20
-        assert state["kv_k"].shape[1] == state["n_pages"]
+        assert state["kv_k"].shape[2] == state["n_pages"]
         rid = dec.submit_prefilled(state, max_tokens=6)
         got = dec.result(rid, timeout=120.0)
         assert got["error"] is None
@@ -133,3 +133,36 @@ def test_disagg_openai_http_e2e(disagg_app):
     with urllib.request.urlopen(f"{disagg_app}/v1/models", timeout=30) as r:
         models = json.loads(r.read())
     assert models["data"][0]["mode"] == "disagg"
+
+
+@pytest.fixture
+def disagg_dag_app(ray_start_module):
+    from ray_tpu import serve
+    from ray_tpu.serve.llm.disagg import build_disagg_openai_app
+
+    app = build_disagg_openai_app(_tiny_cfg(), route_prefix="/v1",
+                                  num_prefill=2, num_decode=1,
+                                  use_pipeline=True)
+    serve.run(app, name="llm-disagg-dag", route_prefix="/v1")
+    proxy = serve.start_http_proxy(port=0)
+    yield f"http://{'127.0.0.1'}:{proxy.port}"
+    serve.shutdown()
+
+
+def test_disagg_dag_pipeline_e2e(disagg_dag_app):
+    """The prefill→decode handoff re-expressed on the compiled pipeline
+    (mutable-channel aDAG path, VERDICT r3 item 4): same OpenAI surface,
+    KV blobs ride channel edges instead of object-plane task returns."""
+    def post(payload):
+        req = urllib.request.Request(
+            f"{disagg_dag_app}/v1/completions",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    outs = [post({"prompt": f"hello {i}", "max_tokens": 4,
+                  "temperature": 0.0}) for i in range(4)]
+    for out in outs:
+        assert out["object"] == "text_completion"
+        assert out["usage"]["completion_tokens"] == 4

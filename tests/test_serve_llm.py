@@ -150,8 +150,8 @@ def test_chunked_prefill_matches_full_prefill():
     # the KV pages this slot owns must match too (pool dtype tolerance)
     for key in ("k", "v"):
         np.testing.assert_allclose(
-            np.asarray(kv_c[key][:, table]),
-            np.asarray(kv_full[key][:, table]), rtol=2e-3, atol=2e-3)
+            np.asarray(kv_c[key][:, :, table]),
+            np.asarray(kv_full[key][:, :, table]), rtol=2e-3, atol=2e-3)
 
 
 def test_engine_chunked_prefill_generates_same_tokens():
