@@ -22,7 +22,19 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
+
+# Many-agent single-host run: stretch periodic control-plane work BEFORE the
+# framework loads its config — 50 nodes heartbeating at 1 Hz (each scanning
+# /proc for gauges) would eat the single core the workload needs.
+os.environ.setdefault("RAY_TPU_AGENT_HEARTBEAT_INTERVAL_S", "10.0")
+os.environ.setdefault("RAY_TPU_HEALTH_CHECK_PERIOD_S", "10.0")
+os.environ.setdefault("RAY_TPU_HEALTH_CHECK_TIMEOUT_S", "60.0")
+
+
+def _p(msg: str) -> None:
+    print(msg, flush=True)
 
 
 def main():
@@ -43,10 +55,13 @@ def main():
     cpus_per_node = max(1, -(-args.actors // args.nodes))
     t0 = time.monotonic()
     cluster = Cluster()
-    for _ in range(args.nodes):
+    for i in range(args.nodes):
         cluster.add_node(num_cpus=cpus_per_node,
                          object_store_memory=8 * 1024 * 1024,
                          inproc_workers=True)
+        if (i + 1) % 10 == 0:
+            _p(f"... {i + 1}/{args.nodes} nodes up "
+               f"({time.monotonic() - t0:.1f}s)")
     ray_tpu.init(address=cluster.address)
     # the CP must see every node alive
     deadline = time.monotonic() + 120.0
@@ -60,7 +75,7 @@ def main():
     results["nodes"] = {"target": args.nodes, "alive": alive,
                         "bringup_s": round(dt, 2),
                         "nodes_per_s": round(args.nodes / dt, 1)}
-    print(json.dumps({"section": "nodes", **results["nodes"]}))
+    _p(json.dumps({"section": "nodes", **results["nodes"]}))
     assert alive >= args.nodes, f"only {alive}/{args.nodes} nodes alive"
 
     # ---- many queued tasks --------------------------------------------
@@ -78,7 +93,7 @@ def main():
         "submit_per_s": round(args.tasks / t_submit, 1),
         "throughput_per_s": round(args.tasks / t_total, 1),
         "wall_s": round(t_total, 2)}
-    print(json.dumps({"section": "tasks", **results["tasks"]}))
+    _p(json.dumps({"section": "tasks", **results["tasks"]}))
     del refs
 
     # ---- many actors ---------------------------------------------------
@@ -106,7 +121,7 @@ def main():
         "steady_ping_per_s": round(args.actors / t_ping, 1),
         "kill_per_s": round(args.actors / t_kill, 1),
         "bringup_s": round(t_up, 2)}
-    print(json.dumps({"section": "actors", **results["actors"]}))
+    _p(json.dumps({"section": "actors", **results["actors"]}))
     del actors
     time.sleep(2.0)  # let kill/reap churn drain before the PG section
 
@@ -126,12 +141,12 @@ def main():
         "count": args.pgs,
         "create_per_s": round(args.pgs / t_create, 1),
         "remove_per_s": round(args.pgs / t_remove, 1)}
-    print(json.dumps({"section": "pgs", **results["pgs"]}))
+    _p(json.dumps({"section": "pgs", **results["pgs"]}))
 
     results["ts"] = time.time()
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
-    print(json.dumps({"metric": "scale_envelope",
+    _p(json.dumps({"metric": "scale_envelope",
                       "value": args.actors, "unit": "actors",
                       "ok": True}))
 

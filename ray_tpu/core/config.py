@@ -85,6 +85,10 @@ class Config:
     health_check_period_s: float = 1.0
     health_check_timeout_s: float = 10.0
     health_check_failure_threshold: int = 5
+    # Agent resource-heartbeat period. Each beat scans /proc for system
+    # gauges; many-node single-host harnesses (scale tests: 50+ in-process
+    # agents) raise this so heartbeat CPU doesn't crowd out the workload.
+    agent_heartbeat_interval_s: float = 1.0
 
     # --- watchdog ---
     # get()/wait() called with no explicit timeout raise GetTimeoutError

@@ -938,6 +938,7 @@ class NodeAgent:
     # ---- worker monitoring ----------------------------------------------
     def _monitor_workers(self):
         cfg = get_config()
+        hb_interval = cfg.agent_heartbeat_interval_s
         last_report = 0.0
         while not self._stopped.is_set():
             time.sleep(0.1)
@@ -946,7 +947,7 @@ class NodeAgent:
             # report/subtract races, and re-registers after a CP restart
             # (NotifyGCSRestart analog)
             now = time.monotonic()
-            if now - last_report >= 1.0:
+            if now - last_report >= hb_interval:
                 last_report = now
                 try:
                     with self._lock:

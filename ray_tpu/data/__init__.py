@@ -25,9 +25,13 @@ from ray_tpu.data.read_api import (
     read_images,
     read_json,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
 )
+from ray_tpu.data.expressions import col, lit
+from ray_tpu.data import preprocessors
 
 __all__ = [
     "Block", "BlockAccessor", "BlockMetadata", "Count", "DataIterator",
@@ -35,5 +39,6 @@ __all__ = [
     "ReadTask", "Std", "Sum", "aggregate", "from_arrow", "from_huggingface",
     "from_items", "from_numpy", "from_pandas", "range", "range_tensor",
     "read_binary_files", "read_csv", "read_datasource", "read_images",
-    "read_json", "read_parquet", "read_text", "read_tfrecords",
+    "read_json", "read_parquet", "read_sql", "read_text",
+    "read_tfrecords", "read_webdataset", "col", "lit", "preprocessors",
 ]

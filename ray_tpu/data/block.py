@@ -173,8 +173,15 @@ class BlockAccessor:
 
     @staticmethod
     def concat(blocks: Iterable[Block]) -> Block:
-        blocks = [b for b in blocks if b is not None and b.num_rows > 0]
+        all_blocks = [b for b in blocks if b is not None]
+        blocks = [b for b in all_blocks if b.num_rows > 0]
         if not blocks:
+            # all inputs empty: the SCHEMA must still survive — outer joins
+            # materialize an all-filtered side's columns from it (a fused
+            # read+filter can legitimately produce only empty blocks)
+            for b in all_blocks:
+                if b.num_columns > 0:
+                    return b
             return pa.table({})
         # unify metadata (tensor shapes) from the first block
         out = pa.concat_tables(blocks, promote_options="default")

@@ -78,8 +78,49 @@ class Dataset:
                                   inputs=[self._terminal], fn=fn))
 
     def filter(self, fn, **kwargs) -> "Dataset":
+        from ray_tpu.data.expressions import Expr
+        if isinstance(fn, Expr):
+            return self.filter_expr(fn)
         return self._with(Filter(name=f"Filter({_fn_name(fn)})",
                                  inputs=[self._terminal], fn=fn))
+
+    def filter_expr(self, expr) -> "Dataset":
+        """Vectorized filter from a column expression (reference
+        expressions.py col/lit): evaluates per pyarrow batch — no per-row
+        python — and, being a stateless batch transform, fuses into the
+        read stage (logical.FusedRead pushdown)."""
+        def apply(batch):
+            import pyarrow as pa
+            mask = expr.eval_batch(batch)
+            if isinstance(batch, pa.RecordBatch):
+                batch = pa.Table.from_batches([batch])
+            return batch.filter(mask)
+        return self._with(MapBatches(
+            name=f"FilterExpr({expr!r})", inputs=[self._terminal],
+            fn=apply, batch_format="pyarrow"))
+
+    def with_column(self, name: str, expr) -> "Dataset":
+        """Add/replace a column from an expression (reference
+        Dataset.with_column), vectorized over pyarrow batches."""
+        from ray_tpu.data.expressions import Expr, lit
+        if not isinstance(expr, Expr):
+            if callable(expr):  # batch -> column fn: the add_column shape
+                return self.add_column(name, expr)
+            expr = lit(expr)  # plain value: implicit literal (reference)
+
+        def apply(batch):
+            import pyarrow as pa
+            value = expr.eval_batch(batch)
+            if isinstance(batch, pa.RecordBatch):
+                batch = pa.Table.from_batches([batch])
+            if isinstance(value, pa.Scalar):  # pure-literal expression
+                value = pa.array([value.as_py()] * batch.num_rows)
+            if name in batch.column_names:
+                batch = batch.drop_columns([name])
+            return batch.append_column(name, value)
+        return self._with(MapBatches(
+            name=f"WithColumn({name})", inputs=[self._terminal],
+            fn=apply, batch_format="pyarrow"))
 
     def add_column(self, name: str, fn) -> "Dataset":
         def add(batch: dict):
@@ -244,6 +285,16 @@ class Dataset:
             return None
         val = rows[0][agg_fn.out_name()]
         return val
+
+    def aggregate(self, *aggs) -> dict:
+        """Run several aggregations in ONE pass over the dataset
+        (reference Dataset.aggregate); returns {out_name: value}."""
+        ds = self._with(Aggregate(name="Aggregate", inputs=[self._terminal],
+                                  key=None, aggs=list(aggs)))
+        rows = ds.take_all()
+        if not rows:
+            return {}
+        return {a.out_name(): rows[0][a.out_name()] for a in aggs}
 
     def sum(self, on: str):
         return self._agg(agg_mod.Sum(on))

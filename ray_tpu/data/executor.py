@@ -102,24 +102,35 @@ def _map_task(block: Block, stages: list):
 
 
 @ray_tpu.remote(num_returns=2)
-def _read_task(task):
+def _read_task(task, stages: list = ()):
     """Non-streaming fallback (remote-client drivers: the client protocol
-    doesn't carry ObjectRefGenerators yet)."""
-    blocks = list(task())
+    doesn't carry ObjectRefGenerators yet). ``stages`` are read-fused
+    transforms applied in this same task (logical.FusedRead)."""
+    cache: dict = {}
+    blocks = []
+    for block in task():
+        for stage in stages:
+            block = _apply_stage(block, stage, _resolve_fn(stage, cache))
+        blocks.append(block)
     block = BlockAccessor.concat(blocks)
     return block, BlockAccessor.for_block(block).metadata(
         input_files=task.input_files)
 
 
 @ray_tpu.remote(num_returns="streaming")
-def _read_stream_task(task):
+def _read_stream_task(task, stages: list = ()):
     """Streaming read: each produced block reaches the executor AS SOON AS
     the datasource yields it (reference: read tasks return streaming
     generators consumed by the executor, core_worker.proto:513 +
     _internal/execution/operators/task_pool_map_operator.py). Items
     alternate (metadata, block): the small inline metadata lets the driver
-    schedule downstream work without ever fetching block data."""
+    schedule downstream work without ever fetching block data. ``stages``
+    are read-fused transforms applied here, in the producing task
+    (logical.FusedRead)."""
+    cache: dict = {}
     for block in task():
+        for stage in stages:
+            block = _apply_stage(block, stage, _resolve_fn(stage, cache))
         acc = BlockAccessor.for_block(block)
         yield acc.metadata(input_files=task.input_files)
         yield block
@@ -331,9 +342,9 @@ class ReadOp(TaskMapOp):
     a whole file list no longer has to finish before the first block flows
     downstream)."""
 
-    def __init__(self, name, read_tasks):
+    def __init__(self, name, read_tasks, stages: list | None = None):
         PhysicalOp.__init__(self, name, [])
-        self._stages = []
+        self._stages = list(stages or [])  # read-fused transforms
         self._resources = {}
         self._in_flight = []  # [(generator, pending_meta | None)]
         # in-flight READS are not byte-budgeted (block sizes are unknown
@@ -381,12 +392,12 @@ class ReadOp(TaskMapOp):
             task = self._pending.pop(0)
             if streaming_ok:
                 self._in_flight.append(
-                    [_read_stream_task.remote(task), None])
+                    [_read_stream_task.remote(task, self._stages), None])
             else:
                 # remote-client driver: the client protocol can't carry
                 # ObjectRefGenerators — fall back to whole-task reads
                 self._in_flight.append(
-                    ["fallback", _read_task.remote(task)])
+                    ["fallback", _read_task.remote(task, self._stages)])
         # Emit ONLY from the head stream so blocks keep submission order
         # (reference preserve_order; take() depends on it). Later streams
         # still produce concurrently up to their backpressure windows —
@@ -815,7 +826,8 @@ def build_physical(plan: LogicalPlan, parallelism: int) -> list[PhysicalOp]:
         if isinstance(lop, Read):
             tasks = lop.datasource.get_read_tasks(
                 lop.parallelism if lop.parallelism > 0 else parallelism)
-            op = ReadOp(lop.name, tasks)
+            op = ReadOp(lop.name, tasks,
+                        stages=getattr(lop, "stages", None))
         elif isinstance(lop, InputData):
             op = InputOp(lop.bundles)
         elif isinstance(lop, FusedMap):

@@ -1,0 +1,212 @@
+"""Column expressions: build vectorized transforms without lambdas.
+
+TPU-native analog of the reference's expression API
+(python/ray/data/expressions.py:418 — ``col``/``lit`` composing an AST that
+the planner can inspect and push down). Expressions evaluate VECTORIZED
+over pyarrow batches via pyarrow.compute, and because an expression-based
+filter/projection is a plain stateless batch transform, the optimizer fuses
+it into the read stage (logical.FusedRead) — the pushdown the lambda form
+can never get.
+
+>>> from ray_tpu.data.expressions import col, lit
+>>> ds.filter_expr((col("x") > 3) & (col("tag") == lit("a")))
+>>> ds.with_column("y", col("x") * 2 + 1)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_BIN_KERNELS = {
+    "+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+    ">": "greater", ">=": "greater_equal", "<": "less",
+    "<=": "less_equal", "==": "equal", "!=": "not_equal",
+    "&": "and_kleene", "|": "or_kleene",
+}
+
+
+class Expr:
+    """Base expression node. Combine with python operators; evaluate with
+    eval_batch(pyarrow_batch) -> pyarrow array."""
+
+    def _bin(self, op: str, other) -> "Expr":
+        return BinaryExpr(op, self, _wrap(other))
+
+    def _rbin(self, op: str, other) -> "Expr":
+        return BinaryExpr(op, _wrap(other), self)
+
+    def __add__(self, o):
+        return self._bin("+", o)
+
+    def __radd__(self, o):
+        return self._rbin("+", o)
+
+    def __sub__(self, o):
+        return self._bin("-", o)
+
+    def __rsub__(self, o):
+        return self._rbin("-", o)
+
+    def __mul__(self, o):
+        return self._bin("*", o)
+
+    def __rmul__(self, o):
+        return self._rbin("*", o)
+
+    def __truediv__(self, o):
+        return self._bin("/", o)
+
+    def __rtruediv__(self, o):
+        return self._rbin("/", o)
+
+    def __gt__(self, o):
+        return self._bin(">", o)
+
+    def __ge__(self, o):
+        return self._bin(">=", o)
+
+    def __lt__(self, o):
+        return self._bin("<", o)
+
+    def __le__(self, o):
+        return self._bin("<=", o)
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin("==", o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._bin("!=", o)
+
+    def __and__(self, o):
+        return self._bin("&", o)
+
+    def __or__(self, o):
+        return self._bin("|", o)
+
+    def __invert__(self):
+        return UnaryExpr("~", self)
+
+    def __bool__(self):
+        # `a and b` / `or` / `not` would silently DISCARD one side (python
+        # short-circuits on truthiness) — the classic expression-API trap;
+        # the reference raises the same way
+        raise TypeError(
+            "Expr cannot be used in a boolean context; use & | ~ instead "
+            "of and/or/not")
+
+    def __hash__(self):  # __eq__ is overloaded for AST building
+        return id(self)
+
+    def is_null(self) -> "Expr":
+        return UnaryExpr("is_null", self)
+
+    def alias(self, name: str) -> "Expr":
+        return Alias(self, name)
+
+    # -- evaluation ------------------------------------------------------
+    def eval_batch(self, batch):
+        """Evaluate over a pyarrow Table/RecordBatch; returns an arrow
+        array (or scalar for pure literals)."""
+        raise NotImplementedError
+
+    def columns(self) -> set[str]:
+        """Column names this expression reads (projection pushdown)."""
+        raise NotImplementedError
+
+
+class Col(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def eval_batch(self, batch):
+        return batch[self.name]
+
+    def columns(self) -> set[str]:
+        return {self.name}
+
+    def __repr__(self):
+        return f"col({self.name!r})"
+
+
+class Lit(Expr):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def eval_batch(self, batch):
+        import pyarrow as pa
+        return pa.scalar(self.value)
+
+    def columns(self) -> set[str]:
+        return set()
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+class BinaryExpr(Expr):
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def eval_batch(self, batch):
+        import pyarrow.compute as pc
+        kernel = getattr(pc, _BIN_KERNELS[self.op])
+        return kernel(self.left.eval_batch(batch),
+                      self.right.eval_batch(batch))
+
+    def columns(self) -> set[str]:
+        return self.left.columns() | self.right.columns()
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class UnaryExpr(Expr):
+    def __init__(self, op: str, operand: Expr):
+        self.op = op
+        self.operand = operand
+
+    def eval_batch(self, batch):
+        import pyarrow.compute as pc
+        v = self.operand.eval_batch(batch)
+        if self.op == "~":
+            return pc.invert(v)
+        if self.op == "is_null":
+            return pc.is_null(v)
+        raise ValueError(f"unknown unary op {self.op}")
+
+    def columns(self) -> set[str]:
+        return self.operand.columns()
+
+    def __repr__(self):
+        return f"{self.op}{self.operand!r}"
+
+
+class Alias(Expr):
+    def __init__(self, expr: Expr, name: str):
+        self.expr = expr
+        self.name = name
+
+    def eval_batch(self, batch):
+        return self.expr.eval_batch(batch)
+
+    def columns(self) -> set[str]:
+        return self.expr.columns()
+
+    def __repr__(self):
+        return f"{self.expr!r}.alias({self.name!r})"
+
+
+def _wrap(v) -> Expr:
+    return v if isinstance(v, Expr) else Lit(v)
+
+
+def col(name: str) -> Col:
+    """Reference a column (reference expressions.col)."""
+    return Col(name)
+
+
+def lit(value: Any) -> Lit:
+    """A literal constant (reference expressions.lit)."""
+    return Lit(value)

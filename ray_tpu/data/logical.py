@@ -174,6 +174,20 @@ class FusedMap(AbstractMap):
         self.name = "Fused(" + "+".join(s.name for s in self.stages) + ")"
 
 
+@dataclasses.dataclass
+class FusedRead(Read):
+    """Read with map/filter stages fused INTO the read tasks: each block is
+    transformed in the same remote task that produced it — no object-store
+    round trip between read and first transform (reference:
+    rules/operator_fusion.py fusing maps onto ReadOp)."""
+    stages: list[AbstractMap] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.name = (f"FusedRead"
+                     f"{self.datasource.name if self.datasource else ''}("
+                     + "+".join(s.name for s in self.stages) + ")")
+
+
 def optimize(plan: LogicalPlan) -> LogicalPlan:
     """Fuse adjacent map-ish ops along single-input chains.
 
@@ -195,6 +209,16 @@ def optimize(plan: LogicalPlan) -> LogicalPlan:
                 return FusedMap(name="", inputs=list(child.inputs),
                                 compute=op.compute, resources=op.resources,
                                 stages=[child, op])
+            # fuse stateless task maps INTO the read: the transform runs in
+            # the remote task that produced the block
+            if (isinstance(child, Read) and op.compute == "tasks"
+                    and not op.resources):
+                prior = child.stages if isinstance(child, FusedRead) else []
+                return FusedRead(
+                    name="", inputs=list(child.inputs),
+                    datasource=child.datasource,
+                    parallelism=child.parallelism,
+                    stages=[*prior, op])
         if any(n is not o for n, o in zip(new_inputs, op.inputs)):
             op = dataclasses.replace(op, inputs=new_inputs)
         return op

@@ -1,0 +1,185 @@
+"""Fit/transform preprocessors over Datasets.
+
+TPU-native analog of the reference's preprocessor library
+(python/ray/data/preprocessors/ — scalers, encoders, concatenator, chain;
+base class preprocessor.py). fit() computes dataset-level statistics with
+ONE aggregation pass; transform() is a stateless vectorized batch map that
+fuses into the read stage like any other map. The fitted state is plain
+python (dict of floats / category lists), so a fitted preprocessor pickles
+into train/serve workers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    """Base: fit(ds) -> self, transform(ds) -> ds, transform_batch(dict)."""
+
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit() first")
+        fn = self.transform_batch
+        return ds.map_batches(fn, batch_format="numpy")
+
+    # -- subclass hooks --------------------------------------------------
+    def _fit(self, ds) -> None:
+        pass
+
+    def _needs_fit(self) -> bool:
+        return True
+
+    def transform_batch(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference preprocessors/scaler.py)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.stats_: dict[str, tuple[float, float]] = {}
+
+    def _fit(self, ds) -> None:
+        from ray_tpu.data.aggregate import Mean, Std
+        aggs = [a for c in self.columns for a in (Mean(c), Std(c))]
+        out = ds.aggregate(*aggs)  # ONE pass for every column's stats
+        for c in self.columns:
+            self.stats_[c] = (float(out[f"mean({c})"]),
+                              float(out[f"std({c})"]) or 1.0)
+
+    def transform_batch(self, batch: dict) -> dict:
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            batch[c] = (np.asarray(batch[c], np.float64) - mean) / std
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column (reference scaler.py)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.stats_: dict[str, tuple[float, float]] = {}
+
+    def _fit(self, ds) -> None:
+        from ray_tpu.data.aggregate import Max, Min
+        aggs = [a for c in self.columns for a in (Min(c), Max(c))]
+        out = ds.aggregate(*aggs)  # ONE pass for every column's stats
+        for c in self.columns:
+            lo, hi = float(out[f"min({c})"]), float(out[f"max({c})"])
+            self.stats_[c] = (lo, (hi - lo) or 1.0)
+
+    def transform_batch(self, batch: dict) -> dict:
+        for c in self.columns:
+            lo, span = self.stats_[c]
+            batch[c] = (np.asarray(batch[c], np.float64) - lo) / span
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    """Map categories to dense int ids (reference preprocessors/encoder.py
+    LabelEncoder); unseen values encode as -1."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: list = []
+
+    def _fit(self, ds) -> None:
+        col = self.label_column
+        values = set()
+        for batch in ds.iter_batches(batch_format="numpy"):
+            values.update(np.asarray(batch[col]).tolist())
+        self.classes_ = sorted(values)
+
+    def transform_batch(self, batch: dict) -> dict:
+        idx = {v: i for i, v in enumerate(self.classes_)}
+        col = np.asarray(batch[self.label_column])
+        batch[self.label_column] = np.asarray(
+            [idx.get(v, -1) for v in col.tolist()], np.int64)
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    """Expand a categorical column into 0/1 indicator columns
+    (reference encoder.py OneHotEncoder): column -> column_<value>."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.categories_: dict[str, list] = {}
+
+    def _fit(self, ds) -> None:
+        values: dict[str, set] = {c: set() for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):  # ONE pass
+            for c in self.columns:
+                values[c].update(np.asarray(batch[c]).tolist())
+        self.categories_ = {c: sorted(v) for c, v in values.items()}
+
+    def transform_batch(self, batch: dict) -> dict:
+        for c in self.columns:
+            col = np.asarray(batch.pop(c))
+            for v in self.categories_[c]:
+                batch[f"{c}_{v}"] = (col == v).astype(np.int8)
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Concatenate numeric columns into one vector column (reference
+    preprocessors/concatenator.py) — the standard last step before
+    feeding a model a single feature matrix."""
+
+    def __init__(self, columns: list[str], output_column_name: str = "concat",
+                 dtype=np.float32):
+        self.columns = list(columns)
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def transform_batch(self, batch: dict) -> dict:
+        arrs = [np.asarray(batch.pop(c)) for c in self.columns]
+        n = len(arrs[0])
+        batch[self.output_column_name] = np.concatenate(
+            [a.reshape(n, -1) for a in arrs], axis=1).astype(self.dtype)
+        return batch
+
+
+class Chain(Preprocessor):
+    """Apply preprocessors in sequence (reference preprocessors/chain.py);
+    each stage fits on the PREVIOUS stages' transformed output."""
+
+    def __init__(self, *stages: Preprocessor):
+        self.stages = list(stages)
+
+    def _fit(self, ds) -> None:
+        for i, stage in enumerate(self.stages):
+            stage.fit(ds)
+            if i < len(self.stages) - 1:
+                # materialize between stages: each later fit would
+                # otherwise re-execute the WHOLE untransformed pipeline
+                # (including source reads) per statistic
+                ds = stage.transform(ds).materialize()
+
+    def transform(self, ds):
+        for stage in self.stages:
+            ds = stage.transform(ds)
+        return ds
+
+    def transform_batch(self, batch: dict) -> dict:
+        for stage in self.stages:
+            batch = stage.transform_batch(batch)
+        return batch

@@ -20,8 +20,10 @@ from ray_tpu.data.datasource import (
     NumpyDatasource,
     ParquetDatasource,
     RangeDatasource,
+    SQLDatasource,
     TextDatasource,
     TFRecordsDatasource,
+    WebDatasetDatasource,
 )
 from ray_tpu.data.logical import InputData, Read
 
@@ -68,6 +70,21 @@ def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
 def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
                 parallelism: int = -1) -> Dataset:
     return _read(ImageDatasource(paths, size=size, mode=mode), parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
+    """Read WebDataset tar shards: tar members group into one row per
+    sample key, columns keyed by extension (reference read_api.py:2101)."""
+    return _read(WebDatasetDatasource(paths), parallelism)
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism_column=None, parallelism: int = -1) -> Dataset:
+    """Read a SQL query through a DB-API connection factory; with
+    ``parallelism_column`` the query shards by hash-mod on that column
+    (reference read_api read_sql)."""
+    return _read(SQLDatasource(sql, connection_factory,
+                               parallelism_column), parallelism)
 
 
 def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:

@@ -363,6 +363,115 @@ def test_streaming_read_incremental(rt):
     finally:
         get_config().data_streaming_reads = False
 
+def test_expressions_filter_and_with_column(rt):
+    from ray_tpu.data import col, lit
+
+    ds = ray_tpu.data.from_items(
+        [{"x": i, "tag": "a" if i % 2 == 0 else "b"} for i in range(10)])
+    out = ds.filter((col("x") > 3) & (col("tag") == lit("a"))).take_all()
+    assert [r["x"] for r in out] == [4, 6, 8]
+
+    out = ds.with_column("y", col("x") * 2 + 1).take(3)
+    assert [r["y"] for r in out] == [1, 3, 5]
+
+    out = ds.with_column("z", lit(7)).take(2)
+    assert [r["z"] for r in out] == [7, 7]
+
+
+def test_expression_filter_fuses_into_read(rt):
+    """The pushdown bar (VERDICT r3 item 6): an expression filter on a
+    fresh read must fuse INTO the read stage in the optimized plan."""
+    from ray_tpu.data import col
+    from ray_tpu.data.logical import FusedRead, LogicalPlan, optimize
+
+    ds = ray_tpu.data.range(100).filter(col("id") >= 90)
+    plan = optimize(LogicalPlan(ds._terminal))
+    ops = plan.ops()
+    assert len(ops) == 1 and isinstance(ops[0], FusedRead), str(plan)
+    assert [r["id"] for r in ds.take_all()] == list(range(90, 100))
+
+
+def test_preprocessors_fit_transform(rt):
+    import numpy as np
+
+    from ray_tpu.data.preprocessors import (
+        Chain,
+        Concatenator,
+        MinMaxScaler,
+        OneHotEncoder,
+        StandardScaler,
+    )
+
+    items = [{"a": float(i), "b": float(10 - i), "cat": "xy"[i % 2]}
+             for i in range(10)]
+    ds = ray_tpu.data.from_items(items)
+
+    scaler = StandardScaler(["a"]).fit(ds)
+    out = scaler.transform(ds).take_all()
+    vals = np.array([r["a"] for r in out])
+    assert abs(vals.mean()) < 1e-9 and abs(vals.std(ddof=1) - 1.0) < 1e-9
+
+    chain = Chain(MinMaxScaler(["a", "b"]), OneHotEncoder(["cat"]),
+                  Concatenator(["a", "b", "cat_x", "cat_y"],
+                               output_column_name="f"))
+    out = chain.fit_transform(ds).take_all()
+    feats = [np.asarray(r["f"]) for r in out]
+    assert feats[0].shape == (4,)
+    assert feats[0][0] == 0.0 and feats[-1][0] == 1.0
+    # one-hot columns are exclusive
+    assert all((f[2] + f[3]) == 1.0 for f in feats)
+
+
+def test_read_webdataset(rt, tmp_path):
+    import io
+    import json as jsonlib
+    import tarfile
+
+    shard = tmp_path / "shard-000000.tar"
+    with tarfile.open(shard, "w") as tf:
+        for i in range(5):
+            payload = f"img-bytes-{i}".encode()
+            info = tarfile.TarInfo(f"{i:04d}.jpg")
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+            meta = jsonlib.dumps({"label": i}).encode()
+            info = tarfile.TarInfo(f"{i:04d}.json")
+            info.size = len(meta)
+            tf.addfile(info, io.BytesIO(meta))
+
+    rows = ray_tpu.data.read_webdataset(str(shard)).take_all()
+    assert len(rows) == 5
+    assert rows[0]["__key__"] == "0000"
+    assert rows[2]["jpg"] == b"img-bytes-2"
+    assert rows[3]["json"]["label"] == 3
+
+
+def test_read_sql(rt, tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, name TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(i, f"n{i}") for i in range(20)])
+    conn.commit()
+    conn.close()
+
+    ds = ray_tpu.data.read_sql(
+        "SELECT id, name FROM t", lambda: sqlite3.connect(db),
+        parallelism_column="id", parallelism=4)
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert len(rows) == 20
+    assert rows[7] == {"id": 7, "name": "n7"}
+
+
+def test_from_huggingface(rt):
+    datasets = pytest.importorskip("datasets")
+    hf = datasets.Dataset.from_dict({"x": list(range(8)), "y": ["a"] * 8})
+    rows = ray_tpu.data.from_huggingface(hf).take_all()
+    assert len(rows) == 8 and rows[3]["x"] == 3
+
+
 def test_distributed_hash_shuffle_1gb_two_nodes():
     """VERDICT r2 #7: shuffle >=1 GB across a 2-node cluster under per-node
     object-store caps. The shuffle moves shard REFS (map emits one ref per
@@ -409,5 +518,6 @@ def test_distributed_hash_shuffle_1gb_two_nodes():
         ray_tpu.shutdown()
         cluster.shutdown()
         cfg.health_check_timeout_s, cfg.health_check_failure_threshold = saved
+
 
 
