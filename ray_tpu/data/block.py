@@ -62,10 +62,14 @@ def block_from_dict(columns: dict[str, Any]) -> Block:
 def block_from_rows(rows: list[dict]) -> Block:
     if not rows:
         return pa.table({})
-    cols: dict[str, list] = {k: [] for k in rows[0]}
+    # union of ALL rows' keys (not just the first row's): ragged sources
+    # (e.g. webdataset samples with differing extensions) must not silently
+    # drop columns that first appear mid-block; missing values become null
+    keys: dict[str, None] = {}
     for r in rows:
-        for k in cols:
-            cols[k].append(r.get(k))
+        for k in r:
+            keys.setdefault(k)
+    cols: dict[str, list] = {k: [r.get(k) for r in rows] for k in keys}
     return block_from_dict(cols)
 
 

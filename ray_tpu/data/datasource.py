@@ -303,8 +303,12 @@ class SQLDatasource(Datasource):
         tasks = []
         for shard in range(parallelism):
             null_arm = f" OR ({col}) IS NULL" if shard == 0 else ""
-            q = (f"SELECT * FROM ({sql}) WHERE "
-                 f"(({col}) % {int(parallelism)}) = {int(shard)}{null_arm}")
+            # double-mod: SQL % preserves the dividend's sign, so negative
+            # keys would land in NO residue class. The derived table needs
+            # an alias (PostgreSQL/MySQL reject bare subqueries in FROM).
+            n = int(parallelism)
+            q = (f"SELECT * FROM ({sql}) AS _src WHERE "
+                 f"((({col}) % {n}) + {n}) % {n} = {int(shard)}{null_arm}")
 
             def make(query=q):
                 def read():

@@ -104,9 +104,10 @@ class LabelEncoder(Preprocessor):
         for batch in ds.iter_batches(batch_format="numpy"):
             values.update(np.asarray(batch[col]).tolist())
         self.classes_ = sorted(values)
+        self._index = {v: i for i, v in enumerate(self.classes_)}
 
     def transform_batch(self, batch: dict) -> dict:
-        idx = {v: i for i, v in enumerate(self.classes_)}
+        idx = self._index
         col = np.asarray(batch[self.label_column])
         batch[self.label_column] = np.asarray(
             [idx.get(v, -1) for v in col.tolist()], np.int64)

@@ -8,12 +8,19 @@ through the object store. Ships PPO and DQN on the new API stack surface
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.buffer import ReplayBuffer
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, Env, RandomWalk, make_env, register_env
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.models import RLModule
+from ray_tpu.rllib.multi_agent import (
+    MatchingGame,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+)
 from ray_tpu.rllib.offline import (
     BC,
     BCConfig,
@@ -26,7 +33,9 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "ReplayBuffer", "DQN", "DQNConfig",
+    "Algorithm", "AlgorithmConfig", "APPO", "APPOConfig", "ReplayBuffer",
+    "DQN", "DQNConfig", "MatchingGame", "MultiAgentEnv",
+    "MultiAgentEnvRunner", "MultiAgentPPO",
     "CartPole", "Env", "RandomWalk", "make_env", "register_env",
     "EnvRunner", "EnvRunnerGroup", "IMPALA", "IMPALAConfig", "RLModule",
     "PPO", "PPOConfig", "SAC", "SACConfig", "BC", "BCConfig", "CQL",

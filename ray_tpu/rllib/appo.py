@@ -1,0 +1,101 @@
+"""APPO: asynchronous PPO on the IMPALA machinery
+(ref: rllib/algorithms/appo/appo.py — IMPALA's decoupled actors + V-trace,
+PPO's clipped surrogate, and a periodically-synced TARGET network whose
+values anchor the V-trace targets).
+
+Shape here: EnvRunners sample with last-broadcast weights (behavior
+policy); the learner computes V-trace advantages against the TARGET
+network's values (stability under asynchrony — the reference's
+old_policy/target update), applies the PPO clip against the BEHAVIOR
+log-probs, and refreshes the target copy every ``target_update_freq``
+training steps. The whole update is one jitted program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.impala import _vtrace
+
+
+class APPO(Algorithm):
+    def setup(self) -> None:
+        kw = self.config.train_kwargs
+        self._clip = kw.get("clip_param", 0.2)
+        self._vf_coeff = kw.get("vf_loss_coeff", 0.5)
+        self._ent_coeff = kw.get("entropy_coeff", 0.01)
+        self._rho_clip = kw.get("rho_clip", 1.0)
+        self._target_update_freq = kw.get("target_update_freq", 4)
+        self._opt = optax.adam(self.config.lr)
+        self._opt_state = self._opt.init(self.params)
+        self._target_params = jax.tree.map(lambda x: x, self.params)
+
+        module, gamma = self.module, self.config.gamma
+        clip = self._clip
+        vf_c, ent_c, rho_clip = self._vf_coeff, self._ent_coeff, self._rho_clip
+
+        def loss_fn(params, target_params, batch):
+            logits, values = module.forward_train(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            # V-trace targets against the TARGET network's values: the
+            # anchor does not move every SGD step (reference APPO's
+            # old-policy value targets)
+            t_logits, t_values = module.forward_train(
+                target_params, batch["obs"])
+            t_logp = jnp.take_along_axis(
+                jax.nn.log_softmax(t_logits),
+                batch["actions"][:, None], axis=1)[:, 0]
+            _, t_last_v = module.forward_train(
+                target_params, batch["last_obs"][None])
+            vs, pg_adv = _vtrace(
+                batch["logp"], t_logp, batch["rewards"], batch["dones"],
+                t_values, t_last_v[0], gamma, rho_clip)
+            adv = jax.lax.stop_gradient(
+                (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8))
+            # PPO clip vs the BEHAVIOR policy (what actually sampled)
+            ratio = jnp.exp(logp - batch["logp"])
+            surrogate = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            pg_loss = -surrogate.mean()
+            vf_loss = ((values - jax.lax.stop_gradient(vs)) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg_loss + vf_c * vf_loss - ent_c * entropy
+            return total, (pg_loss, vf_loss, entropy)
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss, aux
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        samples = self.runners.sample(self.params, cfg.rollout_steps)
+        self._timesteps += cfg.rollout_steps * cfg.num_env_runners
+        last_loss, last_aux = 0.0, (0.0, 0.0, 0.0)
+        for s in samples:  # time-ordered trajectories (V-trace needs order)
+            self.params, self._opt_state, last_loss, last_aux = self._update(
+                self.params, self._target_params, self._opt_state, s)
+        if (self._iter + 1) % self._target_update_freq == 0:
+            self._target_params = jax.tree.map(lambda x: x, self.params)
+        pg_l, vf_l, ent = last_aux
+        return {"loss": float(last_loss), "policy_loss": float(pg_l),
+                "vf_loss": float(vf_l), "entropy": float(ent)}
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_cls=cls)
+        cfg.lr = 1e-3
+        return cfg
+
+
+def APPOConfig() -> AlgorithmConfig:
+    return APPO.get_default_config()

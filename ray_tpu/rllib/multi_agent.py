@@ -1,0 +1,259 @@
+"""Multi-agent environments, runners, and per-policy learning.
+
+TPU-native analog of the reference's multi-agent stack
+(rllib/env/multi_agent_env.py + multi_agent_env_runner.py + the
+policies_to_train / policy_mapping_fn machinery): a MultiAgentEnv steps a
+DICT of agent actions and returns per-agent observations/rewards; the
+MultiAgentEnvRunner collects per-POLICY sample batches (agents sharing a
+policy pool their transitions); MultiAgentPPO owns one module + one
+optimizer per policy and runs the jitted PPO update per policy per
+iteration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.models import RLModule
+
+
+class MultiAgentEnv:
+    """Minimal multi-agent env protocol (reference MultiAgentEnv):
+    reset/step speak dicts keyed by agent id."""
+
+    agent_ids: list[str]
+    observation_dim: int
+    num_actions: int
+
+    def reset(self, seed: Optional[int] = None) -> dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: dict[str, int]) -> tuple[
+            dict[str, np.ndarray], dict[str, float], bool, bool]:
+        """Returns (obs, rewards, terminated, truncated) — termination is
+        environment-global (the __all__ convention collapsed)."""
+        raise NotImplementedError
+
+
+class MatchingGame(MultiAgentEnv):
+    """Two-agent coordination game (test env): each agent sees a shared
+    random context bit and earns +1 when BOTH pick the action equal to the
+    bit, else 0. Optimal play is fully learnable from per-agent policies;
+    random play earns 0.25/step each."""
+
+    agent_ids = ["a0", "a1"]
+    observation_dim = 2
+    num_actions = 2
+
+    def __init__(self, episode_len: int = 16):
+        self._len = episode_len
+        self._rng = np.random.default_rng(0)
+        self._t = 0
+        self._bit = 0
+
+    def _obs(self) -> dict[str, np.ndarray]:
+        one_hot = np.zeros(2, np.float32)
+        one_hot[self._bit] = 1.0
+        return {a: one_hot.copy() for a in self.agent_ids}
+
+    def reset(self, seed: Optional[int] = None) -> dict[str, np.ndarray]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._bit = int(self._rng.integers(2))
+        return self._obs()
+
+    def step(self, actions: dict[str, int]):
+        both_right = all(actions[a] == self._bit for a in self.agent_ids)
+        rewards = {a: (1.0 if both_right else 0.0) for a in self.agent_ids}
+        self._t += 1
+        self._bit = int(self._rng.integers(2))
+        truncated = self._t >= self._len
+        return self._obs(), rewards, False, truncated
+
+
+@ray_tpu.remote
+class MultiAgentEnvRunner:
+    """Rollout actor for multi-agent envs (reference
+    multi_agent_env_runner.py): steps every agent each tick, routes each
+    agent's transition into its POLICY's batch via policy_mapping_fn."""
+
+    def __init__(self, env_creator, policy_ids: list[str],
+                 policy_mapping: Callable[[str], str], module: RLModule,
+                 seed: int = 0):
+        import jax
+
+        self._env = env_creator()
+        self._policy_ids = list(policy_ids)
+        self._map = policy_mapping
+        self._rng = np.random.default_rng(seed)
+        self._obs = self._env.reset(seed=seed)
+        self._logits_fn = jax.jit(module.forward_inference)
+        self._value_fn = jax.jit(lambda p, o: module.forward_train(p, o)[1])
+        self._ep_return = 0.0
+        self._done_returns: list[float] = []
+
+    def sample(self, params_per_policy: dict, num_steps: int) -> dict:
+        """Collect num_steps env ticks; returns {policy_id: column_batch}
+        (each batch in time order, one row per (tick, agent) transition)."""
+        env = self._env
+        cols: dict[str, dict[str, list]] = {
+            pid: {"obs": [], "actions": [], "rewards": [], "dones": [],
+                  "logp": [], "vf": []}
+            for pid in self._policy_ids}
+        for _ in range(num_steps):
+            actions: dict[str, int] = {}
+            staged = []
+            for agent in env.agent_ids:
+                pid = self._map(agent)
+                params = params_per_policy[pid]
+                ob = self._obs[agent]
+                logits = np.asarray(self._logits_fn(params, ob[None]))[0]
+                z = logits - logits.max()
+                p = np.exp(z) / np.exp(z).sum()
+                a = int(self._rng.choice(len(p), p=p))
+                actions[agent] = a
+                staged.append((pid, agent, ob, a,
+                               float(z[a] - np.log(np.exp(z).sum()))))
+            obs2, rewards, term, trunc = env.step(actions)
+            self._ep_return += sum(rewards.values())
+            for pid, agent, ob, a, logp in staged:
+                c = cols[pid]
+                c["obs"].append(ob)
+                c["actions"].append(a)
+                c["rewards"].append(rewards[agent])
+                c["dones"].append(float(term))
+                c["logp"].append(logp)
+            if term or trunc:
+                self._done_returns.append(self._ep_return)
+                self._ep_return = 0.0
+                obs2 = env.reset()
+            self._obs = obs2
+        out = {}
+        for pid, c in cols.items():
+            obs = np.asarray(c["obs"], np.float32)
+            any_agent = next(a for a in env.agent_ids if self._map(a) == pid)
+            out[pid] = {
+                "obs": obs,
+                "actions": np.asarray(c["actions"], np.int32),
+                "rewards": np.asarray(c["rewards"], np.float32),
+                "dones": np.asarray(c["dones"], np.float32),
+                "logp": np.asarray(c["logp"], np.float32),
+                "vf": np.asarray(self._value_fn(
+                    params_per_policy[pid], obs)) if len(obs) else
+                np.zeros((0,), np.float32),
+                "last_obs": self._obs[any_agent].copy(),
+                "last_done": 0.0,
+            }
+        return out
+
+    def episode_stats(self) -> dict:
+        rets, self._done_returns = self._done_returns, []
+        return {"episode_returns": rets}
+
+
+class MultiAgentPPO:
+    """Per-policy PPO over a multi-agent env (the reference's
+    policies={...} + policy_mapping_fn shape): one RLModule + optimizer +
+    jitted update per policy; each iteration samples once and updates
+    every policy on its own pooled batch."""
+
+    def __init__(self, env_creator, *, policies: list[str],
+                 policy_mapping: Callable[[str], str],
+                 num_env_runners: int = 2, rollout_steps: int = 64,
+                 lr: float = 3e-3, gamma: float = 0.95,
+                 hidden: tuple = (32, 32), seed: int = 0):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.ppo import _gae
+
+        probe = env_creator()
+        self.module = RLModule(probe.observation_dim, probe.num_actions,
+                               hidden=hidden)
+        self.policies = list(policies)
+        self.params = {
+            pid: self.module.init(jax.random.PRNGKey(seed + i))
+            for i, pid in enumerate(self.policies)}
+        self._opt = optax.adam(lr)
+        self._opt_state = {pid: self._opt.init(p)
+                           for pid, p in self.params.items()}
+        self._rollout_steps = rollout_steps
+        self._runners = [
+            MultiAgentEnvRunner.remote(env_creator, self.policies,
+                                       policy_mapping, self.module,
+                                       seed=seed + i)
+            for i in range(num_env_runners)]
+        self._iter = 0
+
+        module = self.module
+        clip, vf_c, ent_c, lam = 0.2, 0.5, 0.01, 0.95
+
+        def loss_fn(params, batch):
+            logits, values = module.forward_train(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jax.numpy.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            _, last_v = module.forward_train(params, batch["last_obs"][None])
+            adv, targets = _gae(batch["rewards"], batch["dones"],
+                                batch["vf"], last_v[0], gamma, lam)
+            adv = jax.lax.stop_gradient(
+                (adv - adv.mean()) / (adv.std() + 1e-8))
+            ratio = jax.numpy.exp(logp - batch["logp"])
+            surrogate = jax.numpy.minimum(
+                ratio * adv,
+                jax.numpy.clip(ratio, 1 - clip, 1 + clip) * adv)
+            pg_loss = -surrogate.mean()
+            vf_loss = ((values - jax.lax.stop_gradient(targets)) ** 2).mean()
+            entropy = -(jax.numpy.exp(logp_all) * logp_all).sum(-1).mean()
+            return pg_loss + vf_c * vf_loss - ent_c * entropy
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = update
+
+    def train(self) -> dict:
+        t0 = time.monotonic()
+        params_ref = ray_tpu.put(self.params)
+        samples = ray_tpu.get(
+            [r.sample.remote(params_ref, self._rollout_steps)
+             for r in self._runners], timeout=300.0)
+        losses = {}
+        for pid in self.policies:
+            for s in samples:
+                batch = s[pid]
+                if not len(batch["obs"]):
+                    continue
+                self.params[pid], self._opt_state[pid], loss = self._update(
+                    self.params[pid], self._opt_state[pid], batch)
+                losses[pid] = float(loss)
+        self._iter += 1
+        stats = ray_tpu.get([r.episode_stats.remote() for r in self._runners],
+                            timeout=60.0)
+        rets = [x for s in stats for x in s["episode_returns"]]
+        return {"training_iteration": self._iter,
+                "episode_return_mean": float(np.mean(rets)) if rets else None,
+                "policy_loss": losses, "time_this_iter_s":
+                time.monotonic() - t0}
+
+    def mean_step_reward(self, num_steps: int = 64) -> float:
+        """Average per-(tick, agent) reward under the CURRENT (stochastic)
+        policies — the learning-progress metric for cooperative envs."""
+        env_stats = ray_tpu.get(
+            [r.sample.remote(ray_tpu.put(self.params), num_steps)
+             for r in self._runners[:1]], timeout=300.0)[0]
+        total = sum(float(b["rewards"].sum()) for b in env_stats.values())
+        rows = sum(len(b["rewards"]) for b in env_stats.values())
+        return total / max(rows, 1)
+
+    def stop(self) -> None:
+        for r in self._runners:
+            ray_tpu.kill(r)

@@ -242,3 +242,56 @@ def test_default_optimizer_names():
     spmd.default_optimizer(name="adafactor")
     with pytest.raises(ValueError):
         spmd.default_optimizer(name="lion")
+
+
+def test_dryrun_collective_accounting(jax_cpu_mesh):
+    """Per-axis collective accounting (VERDICT r3 item 9): each parallelism
+    axis must insert its signature collective into the compiled HLO —
+    tp: all-reduce; sp(context ring) and pp: collective-permute — and the
+    accounting helper must see them."""
+    import os
+    import sys as _sys
+    sys_path_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if sys_path_root not in _sys.path:
+        _sys.path.insert(0, sys_path_root)
+    import importlib
+    graft = importlib.import_module("__graft_entry__")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.models import llama
+    from ray_tpu.train import spmd
+
+    # tp=2 x sp=2 x dp=2 llama train step
+    mesh = build_mesh(MeshSpec(data=2, tensor=2, context=2))
+    cfg = llama.llama_tiny(n_heads=4, n_kv_heads=2, attn_impl="ring")
+    opt = spmd.default_optimizer(warmup_steps=1, decay_steps=10)
+    state, sh = spmd.sharded_create_state(
+        lambda: llama.init_params(jax.random.PRNGKey(0), cfg), opt, mesh,
+        params_logical_axes=llama.logical_axes(cfg))
+    step = spmd.make_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg, mesh), opt, mesh, sh)
+    tokens = jnp.asarray(np.zeros((2, 33), np.int32))
+    batch = spmd.shard_batch({"tokens": tokens}, mesh)
+    hlo = step.lower(state, batch).compile().as_text()
+    counts = graft.collective_counts(hlo)
+    assert counts.get("all-reduce", 0) > 0, counts          # tp + dp grads
+    assert counts.get("collective-permute", 0) > 0, counts  # sp ring
+
+    # pp=2 pipeline: ppermute ring between stages
+    from ray_tpu.parallel.pipeline import pipeline_apply
+    mesh_p = build_mesh(MeshSpec(data=4, pipeline=2))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    params = jax.device_put(
+        {"w": jnp.zeros((2, 8, 8)), "b": jnp.zeros((2, 8))},
+        NamedSharding(mesh_p, P("pipeline")))
+    x = jnp.zeros((8, 8))
+
+    def pp_fn(params, x):
+        return pipeline_apply(lambda p, h: jnp.tanh(h @ p["w"] + p["b"]),
+                              params, x, mesh_p, num_microbatches=4).sum()
+
+    hlo_p = jax.jit(pp_fn).lower(params, x).compile().as_text()
+    counts_p = graft.collective_counts(hlo_p)
+    assert counts_p.get("collective-permute", 0) > 0, counts_p

@@ -99,3 +99,46 @@ def test_offline_data_from_ray_dataset(tmp_path):
     batch = od.sample(16)
     assert batch["obs"].shape == (16, 9)
     assert batch["actions"].dtype == np.int32
+
+
+def test_appo_learns_randomwalk(rt):
+    """APPO (IMPALA machinery + PPO clip + target network, reference
+    rllib/algorithms/appo/) must solve RandomWalk."""
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("RandomWalk")
+            .env_runners(num_env_runners=2, rollout_steps=256)
+            .training(lr=2e-3, gamma=0.95, entropy_coeff=0.003,
+                      target_update_freq=2)
+            .build())
+    try:
+        for _ in range(12):
+            r = algo.train()
+        assert r["training_iteration"] == 12
+        ev = algo.evaluate(num_episodes=10, max_steps=50)
+        assert ev["episode_return_mean"] >= 0.9
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_ppo_learns_coordination(rt):
+    """Per-policy learners over a multi-agent env (reference
+    multi_agent_env_runner.py + policy_mapping_fn): two independent
+    policies must learn the coordination game far beyond random play."""
+    from ray_tpu.rllib import MatchingGame, MultiAgentPPO
+
+    trainer = MultiAgentPPO(
+        MatchingGame,
+        policies=["p0", "p1"],
+        policy_mapping=lambda agent: "p0" if agent == "a0" else "p1",
+        num_env_runners=2, rollout_steps=128, lr=5e-3, seed=3)
+    try:
+        for _ in range(15):
+            r = trainer.train()
+        assert r["training_iteration"] == 15
+        assert set(r["policy_loss"]) == {"p0", "p1"}  # both policies trained
+        # random play earns 0.25/tick per agent; coordinated >= ~0.8
+        assert trainer.mean_step_reward(num_steps=128) >= 0.7
+    finally:
+        trainer.stop()
