@@ -48,6 +48,11 @@ class LlamaConfig:
     # once); bigger = less scan serialization, more HBM. T (or more) = one
     # chunk, i.e. effectively unchunked.
     ce_chunk: int = 256
+    # MLP matmul implementation for the TRAIN path: "bf16" (default) or
+    # "int8" — dynamic per-tensor symmetric quantization of both operands
+    # into the MXU's int8 path (2x bf16 peak on v5e), fp32 accumulation,
+    # straight-through bf16 backward. Measured lever from VERDICT r3 item 8.
+    mlp_impl: str = "bf16"
 
     @property
     def head_dim(self) -> int:
@@ -187,6 +192,58 @@ def _gqa_expand(k, n_rep):
         b, t, h * n_rep, d)
 
 
+def _quantize_int8(t):
+    """Dynamic per-tensor symmetric quantization: t -> (int8, fp32 scale)."""
+    s = (jnp.max(jnp.abs(t)).astype(jnp.float32) / 127.0) + 1e-12
+    q = jnp.clip(jnp.round(t.astype(jnp.float32) / s), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+@jax.custom_vjp
+def int8_matmul(x, w):
+    """x @ w with BOTH operands dynamically quantized to int8 and the
+    contraction run on the MXU's int8 path with int32 accumulation
+    (~1.55x bf16 matmul throughput measured on one v5e at bench shapes).
+    Backward is straight-through bf16 (quantization treated as identity) —
+    the standard int8-forward training recipe."""
+    out, _ = _int8_matmul_fwd(x, w)
+    return out
+
+
+def _int8_matmul_fwd(x, w):
+    xq, xs = _quantize_int8(x)
+    wq, ws = _quantize_int8(w)
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = (acc.astype(jnp.float32) * (xs * ws)).astype(x.dtype)
+    # save the QUANTIZED residuals: int8 + scale is half of bf16, which is
+    # what lets the int8 path fit where saved-bf16 residuals OOM (measured:
+    # +245MB over budget at dots-remat b4 with bf16 residuals). Backward
+    # uses the dequantized approximations — consistent with the straight-
+    # through estimator the forward already commits to.
+    return out, (xq, xs, wq, ws)
+
+
+def _int8_matmul_bwd(res, g):
+    xq, xs, wq, ws = res
+    # gradients arrive at the model dtype; dequantized operands join at it
+    x = (xq.astype(jnp.float32) * xs).astype(g.dtype)
+    w = (wq.astype(jnp.float32) * ws).astype(g.dtype)
+    dx = jnp.einsum("...n,kn->...k", g, w)
+    dw = jnp.einsum("...k,...n->kn", x, g)
+    return dx.astype(g.dtype), dw.astype(g.dtype)
+
+
+int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
+
+
+def _mlp_matmul(h, w, cfg: LlamaConfig):
+    if cfg.mlp_impl == "int8":
+        return int8_matmul(h, w)
+    return h @ w
+
+
 def _attention(q, k, v, cfg: LlamaConfig, mesh, *, positions_offset=0):
     """Causal self-attention dispatch: ring over the context axis, Pallas
     flash on TPU, einsum fallback."""
@@ -226,9 +283,10 @@ def _layer_fwd(x, layer, cos, sin, cfg: LlamaConfig, mesh):
     x = x + attn_out
     h = checkpoint_name(
         rms_norm(x, layer["mlp_norm"], cfg.norm_eps), "mlp_in")
-    gate = jax.nn.silu(h @ layer["mlp"]["w_gate"])
-    up = h @ layer["mlp"]["w_up"]
-    x = x + checkpoint_name((gate * up) @ layer["mlp"]["w_down"], "mlp_out")
+    gate = jax.nn.silu(_mlp_matmul(h, layer["mlp"]["w_gate"], cfg))
+    up = _mlp_matmul(h, layer["mlp"]["w_up"], cfg)
+    x = x + checkpoint_name(
+        _mlp_matmul(gate * up, layer["mlp"]["w_down"], cfg), "mlp_out")
     return x
 
 

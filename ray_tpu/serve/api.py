@@ -130,7 +130,13 @@ def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking: bool = False,
         _local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy an application; returns a handle to the ingress deployment
-    (reference serve.run api.py:685)."""
+    (reference serve.run api.py:685). With ``_local_testing_mode`` the
+    whole application runs IN-PROCESS — no cluster, no controller, no
+    replica actors (reference _private/local_testing_mode.py) — for unit
+    tests and notebooks."""
+    if _local_testing_mode:
+        from ray_tpu.serve.local_testing import run_local
+        return run_local(app, name)
     if not ray_tpu.is_initialized():
         ray_tpu.init()
     controller = get_or_create_controller()

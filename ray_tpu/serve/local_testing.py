@@ -1,0 +1,90 @@
+"""Local testing mode: run a serve application IN-PROCESS, no cluster.
+
+TPU-native analog of the reference's local testing mode
+(python/ray/serve/_private/local_testing_mode.py): `serve.run(app,
+_local_testing_mode=True)` constructs every deployment instance directly
+in the caller's process and returns handles whose `.remote()` runs the
+method on a thread pool — the full handle surface (options/method
+attributes/response futures/composition) with zero cluster, for unit
+tests and notebooks.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Optional
+
+
+class LocalDeploymentResponse:
+    """Future-shaped response matching DeploymentResponse.result()."""
+
+    def __init__(self, fut: Future):
+        self._fut = fut
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return self._fut.result(timeout=timeout_s)
+
+    @property
+    def ref(self):
+        return self._fut
+
+
+class LocalDeploymentHandle:
+    """In-process DeploymentHandle: same call surface, direct dispatch."""
+
+    def __init__(self, instance, pool: ThreadPoolExecutor,
+                 method_name: str = "__call__"):
+        self._instance = instance
+        self._pool = pool
+        self._method = method_name
+
+    def options(self, *, method_name: Optional[str] = None,
+                **_ignored) -> "LocalDeploymentHandle":
+        return LocalDeploymentHandle(
+            self._instance, self._pool,
+            method_name if method_name is not None else self._method)
+
+    def __getattr__(self, name: str) -> "LocalDeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
+        def resolve(v):
+            if isinstance(v, LocalDeploymentResponse):
+                return v.result()
+            return v
+
+        def call():
+            a = tuple(resolve(x) for x in args)
+            kw = {k: resolve(v) for k, v in kwargs.items()}
+            target = self._instance
+            if self._method != "__call__" or not callable(target):
+                target = getattr(target, self._method)
+            return target(*a, **kw)
+
+        return LocalDeploymentResponse(self._pool.submit(call))
+
+
+def run_local(app, app_name: str = "default") -> LocalDeploymentHandle:
+    """Build every deployment of the application in-process (topological
+    order, bound sub-apps become LocalDeploymentHandles) and return the
+    ingress handle."""
+    ordered: list = []
+    app._collect(ordered, set())
+    ingress = ordered[-1]
+    pool = ThreadPoolExecutor(max_workers=8,
+                              thread_name_prefix="serve-local")
+    built: dict[int, LocalDeploymentHandle] = {}
+    for node in ordered:
+        def conv(v):
+            if id(v) in built:
+                return built[id(v)]
+            return v
+        args = tuple(conv(a) for a in node.init_args)
+        kwargs = {k: conv(v) for k, v in node.init_kwargs.items()}
+        obj = node.deployment.func_or_class
+        instance = obj(*args, **kwargs) if isinstance(obj, type) else obj
+        built[id(node)] = LocalDeploymentHandle(instance, pool)
+    return built[id(ingress)]

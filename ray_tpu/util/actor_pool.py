@@ -12,62 +12,62 @@ import ray_tpu
 class ActorPool:
     def __init__(self, actors: list):
         self._idle = list(actors)
-        self._future_to_actor: dict = {}
-        self._index_to_future: dict = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: list = []
+        self._inflight_owner: dict = {}
+        self._result_futures: dict = {}
+        self._submit_seq = 0
+        self._drain_seq = 0
+        self._backlog: list = []
 
     def submit(self, fn: Callable, value: Any):
         """fn(actor, value) -> ObjectRef; queues if all actors busy."""
         if self._idle:
             actor = self._idle.pop(0)
             future = fn(actor, value)
-            self._future_to_actor[future] = actor
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+            self._inflight_owner[future] = actor
+            self._result_futures[self._submit_seq] = future
+            self._submit_seq += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def _return_actor(self, actor):
         self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+        if self._backlog:
+            self.submit(*self._backlog.pop(0))
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future)
+        return bool(self._result_futures)
 
     def get_next(self, timeout: float | None = None) -> Any:
         if not self.has_next():
             raise StopIteration("no pending results")
         # Wait with the timeout BEFORE mutating pool state so a TimeoutError
         # leaves the pool intact (reference actor_pool.py does ray.wait first).
-        future = self._index_to_future[self._next_return_index]
+        future = self._result_futures[self._drain_seq]
         ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("timed out waiting for result")
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
+        del self._result_futures[self._drain_seq]
+        self._drain_seq += 1
         try:
             result = ray_tpu.get(future)
         finally:
-            self._return_actor(self._future_to_actor.pop(future))
+            self._return_actor(self._inflight_owner.pop(future))
         return result
 
     def get_next_unordered(self, timeout: float | None = None) -> Any:
         if not self.has_next():
             raise StopIteration("no pending results")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+        ready, _ = ray_tpu.wait(list(self._inflight_owner),
                                 num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("timed out waiting for result")
         future = ready[0]
-        for idx, f in list(self._index_to_future.items()):
+        for idx, f in list(self._result_futures.items()):
             if f == future:
-                del self._index_to_future[idx]
+                del self._result_futures[idx]
                 break
         result = ray_tpu.get(future)
-        self._return_actor(self._future_to_actor.pop(future))
+        self._return_actor(self._inflight_owner.pop(future))
         return result
 
     def map(self, fn: Callable, values: list) -> Iterator[Any]:
