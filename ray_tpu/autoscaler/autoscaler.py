@@ -173,10 +173,18 @@ class Autoscaler:
                 # count at decision time (same as num_launched): a provider
                 # terminate may take seconds tearing the node down, and
                 # observers polling non_terminated_nodes() would see the
-                # node gone before a post-call increment landed
+                # node gone before a post-call increment landed — but only
+                # once the provider call is actually in flight; a failed
+                # call (gcloud flake) must not inflate the counter or drop
+                # the idle clock, so the node is retried next reconcile
+                try:
+                    self._provider.terminate_node(name)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "terminate_node(%s) failed; will retry", name)
+                    continue
                 self.num_terminated += 1
                 self._idle_since.pop(name, None)
-                self._provider.terminate_node(name)
 
     def _loop(self) -> None:
         while not self._stopped.is_set():

@@ -203,7 +203,7 @@ def _jax_array_types() -> tuple:
 
 def _reduce_jax_array(arr):
     """jax.Array → host numpy + sharding tag. On deserialize we return numpy;
-    consumers that want device placement use ray_tpu.utils.device_get semantics
+    consumers that want device placement use ray_tpu.util device_get semantics
     or the train/data iterators, which device_put with the recorded sharding."""
     import numpy as np
     host = np.asarray(arr)

@@ -11,6 +11,7 @@ entry) instead of per-call task submission.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional
 
 from ray_tpu.core.channel import Channel, ChannelClosedError
@@ -46,6 +47,18 @@ def _stage_loop(inst, in_reader, method_name: str):
         out.close()
         if hasattr(in_reader, "close"):
             in_reader.close()
+
+
+def _stage_unlink(inst):
+    """Runs ON the stage actor after its loop task has exited (queued
+    behind it on the actor's slots): drop the out channel's /dev/shm name.
+    Deferred to close() rather than the loop's finally because a
+    downstream reader attaches lazily on first read — unlinking at loop
+    exit could delete the segment before a late-starting consumer (or the
+    driver's result reader) ever opened it."""
+    ch = getattr(inst, _OUT_ATTR, None)
+    if ch is not None:
+        ch.unlink()
 
 
 class PipelineRef:
@@ -137,13 +150,19 @@ class CompiledPipeline:
         return PipelineRef(self, idx)
 
     def _result(self, index: int, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while index not in self._results:
                 if self._delivered > index:
                     raise RuntimeError(
                         f"pipeline result {index} already consumed")
-                # single-threaded drain under the lock: deliver in order
-                value = self._out_reader.read(timeout=timeout)
+                # single-threaded drain under the lock: deliver in order.
+                # The whole drain shares ONE deadline — without it, get()
+                # for index N could block (N-delivered+1)*timeout while
+                # holding _lock against concurrent execute() callers.
+                remaining = None if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                value = self._out_reader.read(timeout=remaining)
                 self._results[self._delivered] = value
                 self._delivered += 1
             return self._results.pop(index)
@@ -160,6 +179,20 @@ class CompiledPipeline:
         try:
             ray_tpu.get(self._loop_refs, timeout=timeout)
         except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+        # attach the result reader BEFORE any unlink so values still
+        # buffered in the final channel stay readable after close()
+        try:
+            if hasattr(self._out_reader, "_ensure"):
+                self._out_reader._ensure()
+        except Exception:  # noqa: BLE001
+            pass
+        # reclaim every stage's out segment (ordered behind the loop task
+        # on each actor's slots, so a hung stage just skips its unlink)
+        try:
+            ray_tpu.get([a.__rtpu_call__.remote(_stage_unlink)
+                         for a, _ in self._stages], timeout=10.0)
+        except Exception:  # noqa: BLE001
             pass
         if hasattr(self._out_reader, "close"):
             self._out_reader.close()

@@ -98,12 +98,20 @@ class MultiAgentEnvRunner:
         self._done_returns: list[float] = []
 
     def sample(self, params_per_policy: dict, num_steps: int) -> dict:
-        """Collect num_steps env ticks; returns {policy_id: column_batch}
-        (each batch in time order, one row per (tick, agent) transition)."""
+        """Collect num_steps env ticks; returns {policy_id: column_batch}.
+
+        Batches are AGENT-MAJOR: each agent mapped to the policy contributes
+        its own time-ordered trajectory of num_steps rows, concatenated
+        (agent0's rows, then agent1's, ...). Episode boundaries (termination
+        OR truncation/reset) are marked in ``dones`` so GAE never bootstraps
+        across a reset, and ``last_obs`` carries one bootstrap observation
+        PER AGENT ([n_agents, obs_dim])."""
         env = self._env
-        cols: dict[str, dict[str, list]] = {
-            pid: {"obs": [], "actions": [], "rewards": [], "dones": [],
-                  "logp": [], "vf": []}
+        # per (pid, agent) trajectory columns — pooled agents stay separate
+        traj: dict[str, dict[str, dict[str, list]]] = {
+            pid: {a: {"obs": [], "actions": [], "rewards": [], "dones": [],
+                      "logp": []}
+                  for a in env.agent_ids if self._map(a) == pid}
             for pid in self._policy_ids}
         for _ in range(num_steps):
             actions: dict[str, int] = {}
@@ -121,33 +129,39 @@ class MultiAgentEnvRunner:
                                float(z[a] - np.log(np.exp(z).sum()))))
             obs2, rewards, term, trunc = env.step(actions)
             self._ep_return += sum(rewards.values())
+            done = term or trunc
             for pid, agent, ob, a, logp in staged:
-                c = cols[pid]
+                c = traj[pid][agent]
                 c["obs"].append(ob)
                 c["actions"].append(a)
                 c["rewards"].append(rewards[agent])
-                c["dones"].append(float(term))
+                c["dones"].append(float(done))
                 c["logp"].append(logp)
-            if term or trunc:
+            if done:
                 self._done_returns.append(self._ep_return)
                 self._ep_return = 0.0
                 obs2 = env.reset()
             self._obs = obs2
         out = {}
-        for pid, c in cols.items():
-            obs = np.asarray(c["obs"], np.float32)
-            any_agent = next(a for a in env.agent_ids if self._map(a) == pid)
+        for pid, agents in traj.items():
+            ids = sorted(agents)
+            obs = np.concatenate(
+                [np.asarray(agents[a]["obs"], np.float32) for a in ids]) \
+                if ids else np.zeros((0, env.observation_dim), np.float32)
+            cat = lambda k, dt: np.concatenate(  # noqa: E731
+                [np.asarray(agents[a][k], dt) for a in ids]) if ids else \
+                np.zeros((0,), dt)
             out[pid] = {
                 "obs": obs,
-                "actions": np.asarray(c["actions"], np.int32),
-                "rewards": np.asarray(c["rewards"], np.float32),
-                "dones": np.asarray(c["dones"], np.float32),
-                "logp": np.asarray(c["logp"], np.float32),
+                "actions": cat("actions", np.int32),
+                "rewards": cat("rewards", np.float32),
+                "dones": cat("dones", np.float32),
+                "logp": cat("logp", np.float32),
                 "vf": np.asarray(self._value_fn(
                     params_per_policy[pid], obs)) if len(obs) else
                 np.zeros((0,), np.float32),
-                "last_obs": self._obs[any_agent].copy(),
-                "last_done": 0.0,
+                "last_obs": np.stack([self._obs[a] for a in ids]) if ids
+                else np.zeros((0, env.observation_dim), np.float32),
             }
         return out
 
@@ -198,9 +212,17 @@ class MultiAgentPPO:
             logp_all = jax.nn.log_softmax(logits)
             logp = jax.numpy.take_along_axis(
                 logp_all, batch["actions"][:, None], axis=1)[:, 0]
-            _, last_v = module.forward_train(params, batch["last_obs"][None])
-            adv, targets = _gae(batch["rewards"], batch["dones"],
-                                batch["vf"], last_v[0], gamma, lam)
+            # batch is agent-major ([n_agents * T] rows): GAE runs per agent
+            # trajectory (vmapped over the agent axis), never across agents,
+            # bootstrapping each from its own last_obs value
+            n_agents = batch["last_obs"].shape[0]
+            _, last_v = module.forward_train(params, batch["last_obs"])
+            per = lambda x: x.reshape(n_agents, -1)  # noqa: E731
+            adv, targets = jax.vmap(
+                _gae, in_axes=(0, 0, 0, 0, None, None))(
+                per(batch["rewards"]), per(batch["dones"]),
+                per(batch["vf"]), last_v, gamma, lam)
+            adv, targets = adv.reshape(-1), targets.reshape(-1)
             adv = jax.lax.stop_gradient(
                 (adv - adv.mean()) / (adv.std() + 1e-8))
             ratio = jax.numpy.exp(logp - batch["logp"])

@@ -249,6 +249,21 @@ class PrefillServer:
         return True
 
 
+def _handoff_channel_capacity(cfg: LLMConfig) -> int:
+    """Channel capacity sized for the largest KV handoff blob this config
+    can produce (a max_prompt_len prompt's pages), not the default 8 MiB:
+    k+v arrays are [L, Hkv, n_pages, page, D] in the model dtype, and
+    Channel.write hard-fails on overflow — an undersized pipe would poison
+    every later request on it."""
+    mc = cfg.llama()
+    pages = -(-cfg.max_prompt_len // cfg.page_size)
+    itemsize = np.dtype(getattr(mc, "dtype", np.float32)).itemsize
+    kv_bytes = 2 * mc.n_layers * mc.n_kv_heads * pages * cfg.page_size \
+        * mc.head_dim * itemsize  # k+v in the model dtype
+    # prompt tokens + pickle/ndarray framing + slack
+    return int(kv_bytes * 1.25) + (1 << 20)
+
+
 class DisaggLLMServer:
     """Decode-role ingress: completions run prefill on a prefill replica,
     then decode locally from the handed-off KV (reference: the "d" servers
@@ -275,8 +290,9 @@ class DisaggLLMServer:
         self._rid = 0
         if prefill_actors:
             from ray_tpu.dag import CompiledPipeline
+            cap = _handoff_channel_capacity(llm_config)
             self._pipes = [
-                CompiledPipeline([(a, "prefill_one")]).compile()
+                CompiledPipeline([(a, "prefill_one")], capacity=cap).compile()
                 for a in prefill_actors]
         self.engine = DecodeEngine(llm_config)
         self.engine.start()
