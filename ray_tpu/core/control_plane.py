@@ -106,11 +106,15 @@ class ControlPlane:
         self._pool = ClientPool("cp")
         self._pending_actors: list[ActorID] = []
         self._pending_pgs: list[PlacementGroupID] = []
-        # snapshot of work the scheduling loop has taken out of the pending
-        # lists for an in-flight placement pass — without it, an autoscaler
-        # demand poll during the pass reads zero demand and scales down
-        self._placing_actors: list[ActorID] = []
+        # placements with an in-flight async lease RPC: aid -> (node_id,
+        # dispatch ts). Also feeds the autoscaler demand poll — without it,
+        # a poll during a placement pass reads zero demand and scales down
+        self._placing_actors: dict[ActorID, tuple] = {}
+        self._scheduling_pass: list[ActorID] = []  # mid-pass demand snapshot
         self._placing_pgs: list[PlacementGroupID] = []
+        # lease fan-out bound: how many actor placements may be in flight
+        # at once (ref: worker_pool.h maximum_startup_concurrency spirit)
+        self._max_inflight_leases = 100
         self._wake = threading.Condition()
         self._stopped = threading.Event()
         self._task_events: list[dict] = []  # GcsTaskManager-style sink (bounded)
@@ -277,7 +281,8 @@ class ControlPlane:
         actors and pending placement-group bundles."""
         with self._lock:
             actor_ids = dict.fromkeys(
-                list(self._pending_actors) + list(self._placing_actors))
+                list(self._pending_actors) + list(self._placing_actors)
+                + list(self._scheduling_pass))
             actor_shapes = [dict(self._actors[a].spec.resources)
                             for a in actor_ids if a in self._actors]
             bundle_shapes = []
@@ -666,12 +671,24 @@ class ControlPlane:
             return [n.view for n in self._nodes.values() if n.view.alive]
 
     def _schedule_pending_actors(self) -> bool:
-        """(ref: GcsActorManager::SchedulePendingActors gcs_actor_manager.h:198)"""
+        """Async fan-out actor placement (ref:
+        GcsActorManager::SchedulePendingActors gcs_actor_manager.h:198 with
+        the scheduler's async LeaseWorkerFromNode gcs_actor_scheduler.h:256):
+        pick a node per pending actor, optimistically reserve against the
+        cached view, and fire the lease RPC WITHOUT blocking the scheduling
+        loop — the grant/rejection completes on the RPC callback. The old
+        serial synchronous lease capped actor bringup at one lease RTT per
+        actor (~2/s at 1,000-actor scale)."""
+        self._expire_stale_leases()
         with self._lock:
             if not self._pending_actors:
                 return False
             pending, self._pending_actors = self._pending_actors, []
-            self._placing_actors = list(pending)
+            # keep the in-pass snapshot visible to the autoscaler demand
+            # poll (an infeasible actor is neither pending nor placing
+            # mid-pass; without this the poll reads zero demand and the
+            # autoscaler scales down)
+            self._scheduling_pass = list(pending)
         progressed = False
         try:
             for aid in pending:
@@ -679,20 +696,38 @@ class ControlPlane:
                     info = self._actors.get(aid)
                     if info is None or info.state not in (ActorState.PENDING, ActorState.RESTARTING):
                         continue
-                if self._try_schedule_actor(info):
-                    progressed = True
-                else:
+                    if len(self._placing_actors) >= self._max_inflight_leases:
+                        self._pending_actors.append(aid)
+                        continue
+                if not self._begin_actor_lease(info):
                     with self._lock:
                         self._pending_actors.append(aid)
+                else:
+                    progressed = True
         finally:
             with self._lock:
-                self._placing_actors = []
+                self._scheduling_pass = []
         return progressed
 
-    def _try_schedule_actor(self, info: ActorInfo) -> bool:
-        """Lease a worker and push the creation task
-        (ref: GcsActorScheduler::LeaseWorkerFromNode gcs_actor_scheduler.h:256,
-        CreateActorOnWorker :316)."""
+    def _expire_stale_leases(self):
+        """Re-queue placements whose lease RPC never completed (hung agent
+        whose TCP stays open); a late grant is detected as stale in the
+        reply callback and its lease returned."""
+        cfg = get_config()
+        ttl = cfg.lease_timeout_s * (cfg.rpc_retries + 1) + 10.0
+        now = time.monotonic()
+        with self._lock:
+            expired = [aid for aid, (_nid, ts) in self._placing_actors.items()
+                       if now - ts > ttl]
+            for aid in expired:
+                del self._placing_actors[aid]
+                self._pending_actors.append(aid)
+        if expired:
+            logger.warning("%d actor lease(s) expired; re-queued", len(expired))
+
+    def _begin_actor_lease(self, info: ActorInfo) -> bool:
+        """Dispatch one async lease for a pending actor; returns True when
+        the RPC is in flight (completion in _on_actor_lease_reply)."""
         spec = info.spec
         views = self._alive_views()
         strategy = spec.strategy
@@ -717,21 +752,85 @@ class ControlPlane:
         node = pick_node(views, resources, strategy)
         if node is None:
             return False
-        cp_node = self._nodes.get(node.node_id)
+        with self._lock:
+            cp_node = self._nodes.get(node.node_id)
+            if cp_node is None or not cp_node.view.alive:
+                return False
+            # optimistic reservation: concurrent placements must spread
+            # instead of stampeding the node the stale view liked best; the
+            # grant's authoritative snapshot (or any fresher agent report)
+            # supersedes it, and a rejection re-adds it version-gated
+            subtract(cp_node.view.available, resources)
+            reserved_version = cp_node.res_version
+            # the tuple object doubles as the attempt token: a late reply
+            # from an EXPIRED attempt (TTL requeue, node death) must not pop
+            # a newer re-dispatched attempt's entry
+            token = (node.node_id, time.monotonic())
+            self._placing_actors[info.actor_id] = token
+        if spec.runtime_env:
+            lease_body["runtime_env"] = spec.runtime_env
+        lease_body.update({"for_actor": info.actor_id,
+                           "job_id": spec.job_id.hex(),
+                           "timeout": get_config().lease_timeout_s})
+        node_id, node_addr = node.node_id, node.addr
+
+        def on_reply(ok, reply):
+            try:
+                self._on_actor_lease_reply(
+                    info, node_id, node_addr, resources, reserved_version,
+                    token, ok, reply)
+            except Exception:
+                logger.exception("actor lease reply handling failed")
+
         try:
-            if spec.runtime_env:
-                lease_body["runtime_env"] = spec.runtime_env
-            reply = self._pool.get(node.addr).call_with_retry(
-                "lease_worker", {**lease_body, "for_actor": info.actor_id,
-                                 "job_id": spec.job_id.hex()},
-                timeout=get_config().lease_timeout_s)
+            self._pool.get(node_addr).call_async(
+                "lease_worker", lease_body, callback=on_reply)
         except Exception as e:
-            logger.warning("lease for actor %s on node %s failed: %s",
-                           info.actor_id.hex()[:8], node.node_id.hex()[:8], e)
-            return False
-        if not reply.get("granted"):
-            return False
+            on_reply(False, e)
+        return True
+
+    def _release_stale_grant(self, node_addr, reply):
+        try:
+            self._pool.get(node_addr).call_async(
+                "return_lease", {"lease_id": reply.get("lease_id")})
+        except Exception:  # noqa: BLE001 — agent may be gone
+            pass
+
+    def _on_actor_lease_reply(self, info: ActorInfo, node_id, node_addr,
+                              resources, reserved_version, token, ok, reply):
+        granted = ok and isinstance(reply, dict) and reply.get("granted")
+        with self._lock:
+            cp_node = self._nodes.get(node_id)
+            if self._placing_actors.get(info.actor_id) is not token \
+                    or info.state not in (ActorState.PENDING,
+                                          ActorState.RESTARTING):
+                # expired/requeued attempt (or actor no longer schedulable):
+                # leave any NEWER attempt's entry alone
+                stale = True
+            else:
+                del self._placing_actors[info.actor_id]
+                stale = False
+            if (not granted or stale) and cp_node is not None \
+                    and cp_node.res_version == reserved_version:
+                # lease didn't land (or landed too late): roll back the
+                # optimistic reservation unless a fresher authoritative
+                # snapshot already replaced the view
+                add(cp_node.view.available, resources)
+        if stale:
+            if granted:
+                self._release_stale_grant(node_addr, reply)
+            return
+        if not granted:
+            if not ok:
+                logger.warning("lease for actor %s on node %s failed: %s",
+                               info.actor_id.hex()[:8], node_id.hex()[:8],
+                               reply)
+            with self._lock:
+                self._pending_actors.append(info.actor_id)
+            self._wake_scheduler()
+            return
         worker_addr = tuple(reply["worker_addr"])
+        spec = info.spec
         with self._lock:
             if reply.get("available") is not None:
                 # agent's authoritative post-grant snapshot; subtracting here
@@ -740,9 +839,7 @@ class ControlPlane:
                 # newer than this grant must not be regressed.
                 if self._fresher(cp_node, reply):
                     cp_node.view.available = dict(reply["available"])
-            else:
-                subtract(cp_node.view.available, resources)
-            info.node_id = node.node_id
+            info.node_id = node_id
             info.worker_id = reply["worker_id"]
         spec.attempt_number = info.num_restarts
 
@@ -767,8 +864,6 @@ class ControlPlane:
                 "push_task", {"spec": spec}, callback=on_created)
         except Exception as e:
             self._on_actor_down(info.actor_id, f"push failed: {e}", clean=False)
-            return False
-        return True
 
     def _schedule_pending_pgs(self) -> bool:
         with self._lock:
@@ -859,8 +954,13 @@ class ControlPlane:
                 if not node.view.alive:
                     continue
                 try:
+                    # short connect window: a refused connect means the
+                    # agent's port is gone — burning the full RPC connect
+                    # retry budget per miss would stretch detection to
+                    # threshold * connect_timeout (50s+)
                     self._pool.get(node.view.addr).call(
-                        "ping", None, timeout=cfg.health_check_timeout_s)
+                        "ping", None, timeout=cfg.health_check_timeout_s,
+                        connect_timeout=min(1.0, cfg.health_check_timeout_s))
                     node.missed_health_checks = 0
                 except Exception:
                     node.missed_health_checks += 1
@@ -875,6 +975,14 @@ class ControlPlane:
             node.view.alive = False
             victims = [i.actor_id for i in self._actors.values()
                        if i.node_id == node_id and i.state == ActorState.ALIVE]
+            # placements whose lease RPC targeted the dead node will never
+            # complete: re-queue them now (a late grant from a zombie agent
+            # is handled as stale in the reply callback)
+            placing = [aid for aid, (nid, _ts) in self._placing_actors.items()
+                       if nid == node_id]
+            for aid in placing:
+                del self._placing_actors[aid]
+                self._pending_actors.append(aid)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("node", {"event": "dead", "node_id": node_id})
         for aid in victims:

@@ -574,11 +574,17 @@ class NodeAgent:
                     # not "in dead": a CONCURRENT lease loop may have reaped
                     # our corpse in its own iteration — absence from the
                     # pool is the durable signal (a healthy registered spawn
-                    # stays in the dict)
-                    if spawned and spawned_wid is not None \
-                            and spawned_wid not in self._workers:
-                        spawned = False
-                        spawned_wid = None
+                    # stays in the dict). Same for THEFT: another concurrent
+                    # lease may legally pop OUR spawn the moment it
+                    # registers (the pool is fungible); if our spawn is
+                    # gone, dead, or taken, we must become spawn-eligible
+                    # again or we'd wait out the full lease timeout with
+                    # `spawned` set on a worker we'll never get.
+                    if spawned and spawned_wid is not None:
+                        w = self._workers.get(spawned_wid)
+                        if w is None or w.busy or w.actor_id is not None:
+                            spawned = False
+                            spawned_wid = None
                     if not reserved:
                         reserved = self._try_reserve(resources, pg_id, bundle_index)
                     if reserved:

@@ -25,6 +25,16 @@ from ray_tpu.core.config import get_config
 
 _REQ, _RESP, _ONEWAY = 0, 1, 2
 
+# Process-local server registry for the loopback fast path: when the caller
+# and the target server share a process (driver->in-proc CP/agent; the
+# whole in-proc multi-node Cluster harness), requests dispatch straight to
+# the server's handler pool — no sockets, no per-connection reader threads,
+# no syscall round trip. Bodies still take a pickle round trip so loopback
+# keeps wire copy semantics (handlers own their body; replies don't alias
+# caller state), and chaos fault injection still applies.
+_LOCAL_SERVERS: dict[tuple, "RpcServer"] = {}
+_LOCAL_LOCK = threading.Lock()
+
 
 class RpcError(Exception):
     pass
@@ -94,6 +104,49 @@ def _recv_frame(sock: socket.socket) -> tuple[int, bytes]:
     return kind, _recv_exact(sock, ln - 1)
 
 
+class _GrowPool:
+    """Unbounded-but-reusing executor for loopback dispatch of blocking
+    handlers: never queues behind a busy thread (parity with the socket
+    path's thread-per-call, so long-poll pileups cannot deadlock), but idle
+    threads linger to serve the next call instead of paying a thread spawn
+    per RPC, and die after a quiet period."""
+
+    _IDLE_TTL_S = 5.0
+
+    def __init__(self, name: str):
+        self._name = name
+        self._lock = threading.Lock()
+        self._tasks: list = []
+        self._cv = threading.Condition(self._lock)
+        self._idle = 0
+        self._seq = 0
+
+    def submit(self, fn) -> None:
+        with self._lock:
+            self._tasks.append(fn)
+            if self._idle > 0:
+                self._cv.notify()
+                return
+            self._seq += 1
+            name = f"{self._name}-{self._seq}"
+        threading.Thread(target=self._run, name=name, daemon=True).start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while not self._tasks:
+                    self._idle += 1
+                    signaled = self._cv.wait(self._IDLE_TTL_S)
+                    self._idle -= 1
+                    if not signaled and not self._tasks:
+                        return  # quiet: let the thread die
+                fn = self._tasks.pop()
+            try:
+                fn()
+            except Exception:
+                pass
+
+
 class DeferredReply:
     """Returned by a handler to decouple the RPC reply from the handler
     thread (ref: the reference's reply-later ServerCall — server_call.h —
@@ -149,6 +202,7 @@ class RpcServer:
         # cannot starve the pool (ref: server_call.h io-service separation).
         self._blocking = blocking_methods or set()
         self._pool = ThreadPoolExecutor(max_workers=pool_size, thread_name_prefix=f"{name}-h")
+        self._grow_pool = _GrowPool(f"{name}-hb")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -160,6 +214,50 @@ class RpcServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"{name}-accept", daemon=True)
         self._accept_thread.start()
+        with _LOCAL_LOCK:
+            _LOCAL_SERVERS[self.addr] = self
+
+    def _dispatch_local(self, kind: int, method: str, body_pickled: bytes,
+                        reply_cb) -> None:
+        """Loopback entry: run the handler exactly as a socket request would
+        (bounded pool, or a dedicated thread for blocking methods), then
+        hand (ok, pickled_reply) to ``reply_cb`` — or drop per chaos."""
+        def run():
+            try:
+                body = pickle.loads(body_pickled)
+                result, ok = self._handler(method, body, ("loopback", 0)), True
+            except BaseException as e:  # noqa: BLE001 — propagate to caller
+                result, ok = e, False
+            if ok and isinstance(result, DeferredReply):
+                if kind == _ONEWAY:
+                    result._bind(lambda *_: None)
+                else:
+                    result._bind(lambda ok2, res2: self._finish_local(
+                        method, ok2, res2, reply_cb))
+                return
+            if kind == _ONEWAY:
+                return
+            self._finish_local(method, ok, result, reply_cb)
+
+        try:
+            if method in self._blocking:
+                self._grow_pool.submit(run)
+            else:
+                self._pool.submit(run)
+        except RuntimeError as e:
+            # server stopped between the registry check and the dispatch:
+            # surface the same failure shape the socket path produces
+            raise ConnectionLost(f"server {self.addr} stopped: {e}") from e
+
+    def _finish_local(self, method, ok, result, reply_cb):
+        if _chaos().drop_response(method):
+            return
+        try:
+            payload = pickle.dumps(result)
+        except Exception as e:
+            ok, payload = False, pickle.dumps(
+                RpcError(f"unpicklable response: {e}"))
+        reply_cb(ok, payload)
 
     def _accept_loop(self):
         while not self._stopped.is_set():
@@ -182,10 +280,13 @@ class RpcServer:
                 if _chaos().drop_request(method):
                     continue
                 if method in self._blocking:
-                    threading.Thread(
-                        target=self._dispatch,
-                        args=(conn, wlock, kind, msg_id, method, body, peer),
-                        name=f"{self._name}-h-{method}", daemon=True).start()
+                    # grow-pool: thread-per-call semantics (a blocked
+                    # handler never queues behind another) with idle-thread
+                    # reuse instead of a spawn per RPC
+                    self._grow_pool.submit(
+                        lambda c=conn, w=wlock, k=kind, m=msg_id,
+                        me=method, b=body, p=peer:
+                        self._dispatch(c, w, k, m, me, b, p))
                 else:
                     self._pool.submit(
                         self._dispatch, conn, wlock, kind, msg_id, method, body, peer)
@@ -231,6 +332,9 @@ class RpcServer:
 
     def stop(self):
         self._stopped.set()
+        with _LOCAL_LOCK:
+            if _LOCAL_SERVERS.get(self.addr) is self:
+                del _LOCAL_SERVERS[self.addr]
         try:
             self._sock.close()
         except OSError:
@@ -321,8 +425,42 @@ class RpcClient:
                     ent[1], ent[2] = False, err
                     ent[0].set()
 
+    def _local_server(self) -> "RpcServer | None":
+        srv = _LOCAL_SERVERS.get(self.addr)
+        if srv is None or srv._stopped.is_set():
+            return None
+        return srv
+
     def call(self, method: str, body: Any = None, timeout: float | None = None,
              connect_timeout: float | None = None) -> Any:
+        srv = self._local_server()
+        if srv is not None:
+            if self._closed:
+                raise ConnectionLost("client closed")
+            payload = pickle.dumps(body)
+            if _chaos().drop_request(method):
+                # dropped on the (virtual) wire: caller waits out its timeout
+                # exactly like the socket path
+                if timeout is None:
+                    raise ConnectionLost(f"rpc {method} dropped by chaos")
+                time.sleep(timeout)
+                raise TimeoutError(
+                    f"rpc {method} to {self.addr} timed out after {timeout}s")
+            ev = threading.Event()
+            ent = [None, None]
+
+            def reply_cb(ok, res_payload):
+                ent[0], ent[1] = ok, res_payload
+                ev.set()
+
+            srv._dispatch_local(_REQ, method, payload, reply_cb)
+            if not ev.wait(timeout):
+                raise TimeoutError(
+                    f"rpc {method} to {self.addr} timed out after {timeout}s")
+            result = pickle.loads(ent[1])
+            if not ent[0]:
+                raise result
+            return result
         ev = threading.Event()
         with self._lock:
             self._next_id += 1
@@ -349,6 +487,33 @@ class RpcClient:
         """Fire a request; ``callback(ok, body)`` runs on the reader thread when
         the response arrives (ref: client_call.h async ClientCall). Keep
         callbacks short — heavy work must hop to another thread."""
+        srv = self._local_server()
+        if srv is not None:
+            try:
+                if self._closed:
+                    raise ConnectionLost("client closed")
+                payload = pickle.dumps(body)
+            except Exception as e:
+                if callback is not None:
+                    callback(False, e)
+                return
+            if _chaos().drop_request(method):
+                return  # dropped: no reply ever arrives (socket-path parity)
+
+            def reply_cb(ok, res_payload):
+                if callback is not None:
+                    try:
+                        callback(ok, pickle.loads(res_payload))
+                    except Exception:
+                        pass
+
+            try:
+                srv._dispatch_local(_REQ if callback else _ONEWAY, method,
+                                    payload, reply_cb)
+            except ConnectionLost as e:
+                if callback is not None:
+                    callback(False, e)
+            return
         with self._lock:
             self._next_id += 1
             msg_id = self._next_id
@@ -378,6 +543,14 @@ class RpcClient:
 
     def notify(self, method: str, body: Any = None,
                connect_timeout: float | None = None):
+        srv = self._local_server()
+        if srv is not None:
+            if self._closed:
+                raise ConnectionLost("client closed")
+            payload = pickle.dumps(body)
+            if not _chaos().drop_request(method):
+                srv._dispatch_local(_ONEWAY, method, payload, lambda *_: None)
+            return
         with self._lock:
             self._next_id += 1
             msg_id = self._next_id

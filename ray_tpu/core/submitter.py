@@ -39,6 +39,7 @@ class _ShapeState:
     strategy: object = None
     runtime_env: dict | None = None
     last_busy: float = 0.0  # ts of last busy (saturated) lease reply
+    last_submit: float = 0.0  # ts of last submit() into this shape's queue
 
 
 class _Flusher:
@@ -52,16 +53,24 @@ class _Flusher:
     non-blocking the same way via the asio io-service)."""
 
     def __init__(self, name: str, drain):
+        self._name = name
         self._drain = drain
         self._lock = threading.Lock()
         self._dirty: set = set()
         self._event = threading.Event()
         self._stopped = False
-        threading.Thread(target=self._loop, name=name, daemon=True).start()
+        self._thread: threading.Thread | None = None
 
     def mark(self, key):
         with self._lock:
             self._dirty.add(key)
+            # lazy pump start: a worker that never submits (the common case
+            # for plain actors — thousands of them in the in-proc scale
+            # harness) must not pay a resident thread for each submitter
+            if self._thread is None and not self._stopped:
+                self._thread = threading.Thread(
+                    target=self._loop, name=self._name, daemon=True)
+                self._thread.start()
         self._event.set()
 
     def stop(self):
@@ -159,19 +168,43 @@ class NormalTaskSubmitter:
         self._lock = threading.Lock()
         self._shapes: dict[object, _ShapeState] = {}
         self._lease_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="lease")
-        self._reaper = threading.Thread(
-            target=self._reap_idle_leases, name="lease-reaper", daemon=True)
+        # reaper starts lazily with the first submission: a worker that
+        # never submits tasks (most actors) holds no leases to reap, and a
+        # resident 0.25s-tick thread per worker is real GIL churn when
+        # thousands of in-proc workers share one interpreter
+        self._reaper: threading.Thread | None = None
         self._stopped = threading.Event()
-        self._reaper.start()
         self._flusher = _Flusher("task-flush", self._pump)
+
+    def _ensure_reaper(self):
+        if self._reaper is None and not self._stopped.is_set():
+            self._reaper = threading.Thread(
+                target=self._reap_idle_leases, name="lease-reaper", daemon=True)
+            self._reaper.start()
+
+    def _depth(self, st: _ShapeState) -> int:
+        """Pipelining depth per held lease. With lease breadth still in
+        flight, don't sink the whole queue into the first worker(s) — split
+        it over EXPECTED breadth (held leases + in-flight requests), so an
+        incoming grant still finds queued work. But never collapse to a
+        hard 1: under saturation (busy cluster, many submitters) a request
+        is ~always in flight and depth-1 serializes every pipeline on its
+        reply RTT."""
+        if st.requests_in_flight == 0:
+            return self.MAX_INFLIGHT_PER_WORKER
+        breadth = len(st.leases) + st.requests_in_flight
+        return max(1, min(self.MAX_INFLIGHT_PER_WORKER,
+                          -(-len(st.queue) // max(1, breadth))))
 
     def submit(self, spec: TaskSpec):
         key = _shape_key(spec)
         push = None
         with self._lock:
+            self._ensure_reaper()
             st = self._shapes.setdefault(key, _ShapeState())
             st.strategy = spec.strategy
             st.runtime_env = spec.runtime_env
+            st.last_submit = time.monotonic()
             # Fast path for interactive (sync call-loop) traffic: with
             # nothing queued or in flight for this shape, skip the flusher
             # handoff and push the singleton frame inline. Any concurrency
@@ -197,8 +230,7 @@ class NormalTaskSubmitter:
             st = self._shapes.get(key)
             if st is None:
                 return
-            depth = (self.MAX_INFLIGHT_PER_WORKER
-                     if st.requests_in_flight == 0 else 1)
+            depth = self._depth(st)
             while st.queue and st.leases:
                 open_leases = [l for l in st.leases
                                if l.frames < self.MAX_FRAMES_PER_WORKER
@@ -390,6 +422,7 @@ class NormalTaskSubmitter:
         thread) so sync call-loops reuse the warm worker."""
         next_batch = None
         repump = False
+        surplus = None
         with self._lock:
             st = self._shapes.get(key)
             if st is None:
@@ -403,18 +436,29 @@ class NormalTaskSubmitter:
                 # it again (it would burn a retry on a known-dead address)
                 repump = bool(st.queue)
             elif st.queue:
-                # same depth gate as _pump: while lease requests are still
-                # outstanding, continuations must not drain the queue onto
-                # this one worker — breadth is what the scheduler promised
-                depth = (self.MAX_INFLIGHT_PER_WORKER
-                         if st.requests_in_flight == 0 else 1)
-                limit = min(depth - lease.inflight, self.MAX_BATCH)
+                # same adaptive depth gate as _pump (see _depth)
+                limit = min(self._depth(st) - lease.inflight, self.MAX_BATCH)
                 if limit > 0:
                     next_batch = _take_batch(st.queue, limit)
                     lease.inflight += len(next_batch)
                     lease.frames += 1
             elif lease.inflight == 0:
-                lease.idle_since = time.monotonic()
+                now = time.monotonic()
+                lease.idle_since = now
+                # eager surplus return: the queue is drained, so surplus
+                # breadth is pure hoarding — a CONTENDED cluster redistributes
+                # it to whoever is starving right now instead of after the
+                # reaper's idle TTL (the straggler tail in many-client
+                # fan-outs: 3 clients done at 0.45s, the 4th at 1.0s waiting
+                # on TTL handoffs). One lease stays warm for sync call-loops,
+                # and an ACTIVE burst (a submit landed within 100ms — the
+                # queue just happens to be momentarily drained into flight)
+                # keeps its breadth.
+                if len(st.leases) > 1 and now - st.last_submit > 0.1:
+                    st.leases.remove(lease)
+                    surplus = lease
+        if surplus is not None:
+            self._return_lease(surplus)
         if next_batch is not None:
             self._push(key, lease, next_batch)
         elif repump:
