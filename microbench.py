@@ -108,14 +108,21 @@ def run(quick: bool = False) -> dict:
 
     big = np.zeros(1 << 25, np.uint8)  # 32 MiB > inline threshold → shm
     n_big = N(40)
-    t0 = time.perf_counter()
-    refs = [ray_tpu.put(big) for _ in range(n_big)]
-    dt = time.perf_counter() - t0
-    results["single_client_put_gbps"] = (n_big * big.nbytes / dt) / 1e9
-    del refs
-    # let refcount-driven deletions/evictions drain so the freed-object
-    # cleanup storm doesn't contaminate the latency sections that follow
-    time.sleep(1.0)
+    # 3 passes, report the MEDIAN (r4 recorded a 4x run-to-run swing in
+    # this row; the dominant noise was page-fault state of the arena —
+    # now pre-touched by the native store — plus host load). Pass 0 also
+    # covers the cold path; spread lands in the JSON for the record.
+    passes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        refs = [ray_tpu.put(big) for _ in range(n_big)]
+        passes.append((n_big * big.nbytes / (time.perf_counter() - t0)) / 1e9)
+        del refs
+        # let refcount-driven deletions/evictions drain so the freed-object
+        # cleanup storm doesn't contaminate the next pass / section
+        time.sleep(1.0)
+    results["single_client_put_gbps"] = sorted(passes)[1]
+    results["single_client_put_gbps_passes"] = [round(p, 2) for p in passes]
 
     # ---- task plane ----------------------------------------------------
     @ray_tpu.remote
@@ -268,6 +275,10 @@ def main():
 
     rows = []
     for key, val in results.items():
+        if isinstance(val, list):  # per-pass detail (e.g. put_gbps spread)
+            rows.append({"metric": key, "value": val, "reference": None,
+                         "ratio_vs_reference": None})
+            continue
         ref = _REFERENCE.get(key)
         ratio = (val / ref) if ref else None
         rows.append({"metric": key, "value": round(val, 1),
@@ -283,6 +294,9 @@ def main():
     w = max(len(r["metric"]) for r in rows)
     print(f"{'metric'.ljust(w)}  {'ours':>10}  {'reference':>10}  ratio")
     for r in rows:
+        if isinstance(r["value"], list):
+            print(f"{r['metric'].ljust(w)}  {r['value']}")
+            continue
         ref = f"{r['reference']:>10.1f}" if r["reference"] else " " * 10
         ratio = f"{r['ratio_vs_reference']:.2f}x" \
             if r["ratio_vs_reference"] else ""

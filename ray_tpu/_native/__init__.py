@@ -88,6 +88,8 @@ def load_library():
         ctypes.POINTER(ctypes.c_uint64)]
     lib.rtpu_store_base.restype = ctypes.c_void_p
     lib.rtpu_store_base.argtypes = [ctypes.c_void_p]
+    lib.rtpu_store_leak_mapping.restype = None
+    lib.rtpu_store_leak_mapping.argtypes = [ctypes.c_void_p]
     with _lock:
         _lib = lib
     return lib

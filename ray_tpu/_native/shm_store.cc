@@ -13,6 +13,7 @@
 // Exposed as a C ABI consumed via ctypes (ray_tpu/_native/__init__.py); the
 // store object itself lives in the node-agent process only.
 
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -23,6 +24,7 @@
 #include <string>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <thread>
 #include <unistd.h>
 #include <unordered_map>
 #include <vector>
@@ -69,15 +71,31 @@ class ShmArenaStore {
       return;
     }
     free_list_.push_back({0, capacity_});
+    // Background page pre-toucher: writing each arena page once makes the
+    // kernel allocate+zero it off the critical path. A cold put otherwise
+    // pays a page fault + zeroing per 4 KiB inside its memcpy — measured
+    // 1.1 GB/s cold vs 5.6 GB/s over pre-touched pages on the dev box
+    // (tmpfs THP is 'never', so huge pages can't amortize the faults).
+    // Chunks are memset under the allocator mutex and skip ranges already
+    // handed out, so the toucher can never scribble over live object data.
+    toucher_ = std::thread([this] { TouchLoop(); });
   }
 
   ~ShmArenaStore() {
-    if (base_ != nullptr) munmap(base_, capacity_);
+    stop_.store(true);
+    if (toucher_.joinable()) toucher_.join();
+    // leak_mapping: in-process writers may still hold views into the
+    // arena (a put mid-memcpy when another thread shuts down); the OS
+    // reclaims at process exit — same lifetime model as the Python
+    // client's _MappedSegment.close on still-exported views
+    if (base_ != nullptr && !leak_mapping_.load()) munmap(base_, capacity_);
     if (fd_ >= 0) {
       close(fd_);
       shm_unlink(name_.c_str());
     }
   }
+
+  void LeakMapping() { leak_mapping_.store(true); }
 
   bool ok() const { return base_ != nullptr; }
 
@@ -243,6 +261,35 @@ class ShmArenaStore {
     objects_.erase(it);
   }
 
+  void TouchLoop() {
+    constexpr uint64_t kChunk = 4ull << 20;  // ~0.7 ms memset per lock hold
+    uint64_t frontier = 0;
+    while (!stop_.load(std::memory_order_relaxed) && frontier < capacity_) {
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        uint64_t end = std::min(frontier + kChunk, capacity_);
+        // clip against live extents: an allocated range is the owner's to
+        // fault (its writer touches it anyway); only free space is memset
+        uint64_t cur = frontier;
+        while (cur < end) {
+          uint64_t next_alloc = end, alloc_end = 0;
+          for (const auto& kv : extents_) {
+            if (kv.first + kv.second > cur && kv.first < next_alloc) {
+              next_alloc = std::max(kv.first, cur);
+              alloc_end = kv.first + kv.second;
+            }
+          }
+          if (next_alloc > cur) {
+            memset(static_cast<char*>(base_) + cur, 0, next_alloc - cur);
+          }
+          cur = next_alloc < end ? std::max(alloc_end, next_alloc) : end;
+        }
+        frontier = end;
+      }
+      std::this_thread::yield();
+    }
+  }
+
   std::string name_;
   uint64_t capacity_;
   int fd_ = -1;
@@ -254,6 +301,9 @@ class ShmArenaStore {
   uint64_t used_ = 0;
   uint64_t tick_ = 0;
   uint64_t num_evicted_ = 0;
+  std::thread toucher_;
+  std::atomic<bool> stop_{false};
+  std::atomic<bool> leak_mapping_{false};
 };
 
 }  // namespace
@@ -306,6 +356,12 @@ void rtpu_store_stats(void* store, uint64_t* used, uint64_t* num_objects,
 // Direct write/read helpers for the agent process (tests + local fast path).
 void* rtpu_store_base(void* store) {
   return static_cast<ShmArenaStore*>(store)->base();
+}
+
+// Keep the arena mapped after destroy (in-process views may outlive the
+// store object; pages are reclaimed at process exit).
+void rtpu_store_leak_mapping(void* store) {
+  static_cast<ShmArenaStore*>(store)->LeakMapping();
 }
 
 }  // extern "C"

@@ -801,15 +801,17 @@ class ControlPlane:
         granted = ok and isinstance(reply, dict) and reply.get("granted")
         with self._lock:
             cp_node = self._nodes.get(node_id)
-            if self._placing_actors.get(info.actor_id) is not token \
-                    or info.state not in (ActorState.PENDING,
-                                          ActorState.RESTARTING):
-                # expired/requeued attempt (or actor no longer schedulable):
-                # leave any NEWER attempt's entry alone
-                stale = True
-            else:
+            current = self._placing_actors.get(info.actor_id) is token
+            if current:
+                # this attempt owns the entry: always release the in-flight
+                # slot, even when the actor was killed mid-placement (a
+                # leaked entry would wedge one of _max_inflight_leases
+                # slots until the TTL sweep)
                 del self._placing_actors[info.actor_id]
-                stale = False
+            # a reply from an expired/requeued attempt must leave any newer
+            # attempt alone; a dead/killed actor's grant is returned below
+            stale = not current or info.state not in (ActorState.PENDING,
+                                                      ActorState.RESTARTING)
             if (not granted or stale) and cp_node is not None \
                     and cp_node.res_version == reserved_version:
                 # lease didn't land (or landed too late): roll back the

@@ -28,6 +28,18 @@ from multiprocessing import shared_memory
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError
 
+# Arenas living in THIS process (agent-side native stores), keyed by shm
+# name: same-process clients write through the agent's warm mapping (pages
+# materialized by the C++ pre-toucher) instead of faulting in their own.
+_LOCAL_ARENAS: dict[str, "NativeObjectStore"] = {}
+_ARENA_LOCK = threading.Lock()
+
+
+def local_arena(shm_name: str) -> "NativeObjectStore | None":
+    """The in-process native store owning ``shm_name``, if any."""
+    with _ARENA_LOCK:
+        return _LOCAL_ARENAS.get(shm_name)
+
 
 @dataclass
 class _ObjMeta:
@@ -201,6 +213,26 @@ class _MappedSegment:
         self._f = open(self.path, "r+b")
         self.mm = mmap.mmap(self._f.fileno(), 0)
         self._f.close()
+        # Populate this process's page table in the background: the agent's
+        # pre-toucher materialized the pages, but OUR mapping still pays a
+        # minor fault per 4 KiB on first touch (~1.6 GB/s inside a cold
+        # copy vs ~3.2 with populated read PTEs). Reads only — this client
+        # does not own the data.
+        if len(self.mm) >= (64 << 20):
+            threading.Thread(target=self._prefault, name="shm-prefault",
+                             daemon=True).start()
+
+    def _prefault(self):
+        try:
+            mv = memoryview(self.mm)
+            # one C-level strided copy touches every page (bytes() of a
+            # step-4096 view); chunked so the transient buffer stays small
+            # and a racing close fails at a chunk boundary
+            chunk = 256 << 20
+            for start in range(0, len(mv), chunk):
+                bytes(mv[start:start + chunk:4096])
+        except (ValueError, IndexError, BufferError):
+            pass  # mapping closed mid-walk: nothing to do
 
     def buf(self) -> memoryview:
         return memoryview(self.mm)
@@ -278,6 +310,14 @@ class NativeShmStore:
             raise RuntimeError("native store arena creation failed")
         self._base = lib.rtpu_store_base(ctypes.c_void_p(self._handle))
         self._lock = threading.Lock()
+        # same-process writers (driver in head mode, in-proc workers) write
+        # through THIS mapping instead of creating their own: the arena's
+        # pages are materialized here by the C++ pre-toucher, while a fresh
+        # per-client mmap pays a minor fault per 4 KiB (measured 1.6 vs
+        # 5.6+ GB/s on the dev box)
+        self._views_handed = False
+        with _ARENA_LOCK:
+            _LOCAL_ARENAS[self.arena_name] = self
         self._hints: dict[ObjectID, str] = {}
         # reused under self._lock: avoids a 64KB alloc+memset per put
         self._evicted_buf = ctypes.create_string_buffer(1 << 16)
@@ -416,7 +456,26 @@ class NativeShmStore:
             "backend": "native",
         }
 
+    def local_write_view(self, offset: int, size: int):
+        """Writable memoryview over [offset, offset+size) of the in-process
+        arena mapping, or None once shut down. Handing out a view switches
+        the arena to leak-the-mapping-at-destroy (a racing shutdown must
+        not munmap under a writer mid-memcpy; pages go back at process
+        exit — the same lifetime model as _MappedSegment.close)."""
+        with self._lock:
+            if not self._handle:
+                return None
+            if not self._views_handed:
+                self._views_handed = True
+                self._lib.rtpu_store_leak_mapping(
+                    self._ctypes.c_void_p(self._handle))
+            buf = (self._ctypes.c_char * size).from_address(self._base + offset)
+        return memoryview(buf).cast("B")
+
     def shutdown(self):
+        with _ARENA_LOCK:
+            if _LOCAL_ARENAS.get(self.arena_name) is self:
+                del _LOCAL_ARENAS[self.arena_name]
         with self._lock:
             if self._handle:
                 self._lib.rtpu_store_destroy(self._ctypes.c_void_p(self._handle))

@@ -114,10 +114,15 @@ class _GrowPool:
     _IDLE_TTL_S = 5.0
 
     def __init__(self, name: str):
+        from collections import deque
         self._name = name
         self._lock = threading.Lock()
-        self._tasks: list = []
+        self._tasks: "deque" = deque()
         self._cv = threading.Condition(self._lock)
+        # threads waiting AND unclaimed: a submitter that hands work to an
+        # idle thread decrements this under the lock at claim time, so two
+        # near-simultaneous submits can never both count the same waiter
+        # (the second would see 0 and spawn)
         self._idle = 0
         self._seq = 0
 
@@ -125,6 +130,7 @@ class _GrowPool:
         with self._lock:
             self._tasks.append(fn)
             if self._idle > 0:
+                self._idle -= 1  # claim one waiter for this task
                 self._cv.notify()
                 return
             self._seq += 1
@@ -133,14 +139,27 @@ class _GrowPool:
 
     def _run(self):
         while True:
+            fn = None
             with self._lock:
-                while not self._tasks:
+                if self._tasks:
+                    fn = self._tasks.popleft()
+                else:
                     self._idle += 1
                     signaled = self._cv.wait(self._IDLE_TTL_S)
-                    self._idle -= 1
-                    if not signaled and not self._tasks:
-                        return  # quiet: let the thread die
-                fn = self._tasks.pop()
+                    if self._tasks:
+                        # claimed (claimer decremented _idle), or timed out
+                        # in the same instant a claim landed — either way
+                        # the claim-side accounting already happened
+                        fn = self._tasks.popleft()
+                    else:
+                        # no work: un-register. Clamped because a freshly
+                        # spawned thread may have taken the task of the
+                        # claim that woke us (then our slot was already
+                        # decremented by that claimer).
+                        self._idle = max(0, self._idle - 1)
+                        if not signaled:
+                            return  # quiet: let the thread die
+                        continue
             try:
                 fn()
             except Exception:

@@ -405,10 +405,26 @@ class WorkerRuntime:
             "store_create",
             {"object_id": oid, "size": size, "device_hint": device_hint,
              "owner_addr": self.addr}, timeout=30.0)
-        mv = self.shm_client.map(reply["shm_name"], size, reply.get("offset", 0))
+        mv = self._writable_extent(reply["shm_name"], size,
+                                   reply.get("offset", 0))
         _write_serialized(mv, sobj)
         agent.call_with_retry("store_seal", {"object_id": oid}, timeout=30.0)
         self.memory_store.put_location(oid, self.node_id)
+
+    def _writable_extent(self, shm_name: str, size: int, offset: int):
+        """Writable view of an arena extent. Same-process arenas (head-mode
+        driver, in-proc workers) write through the agent's mapping — its
+        pages are pre-materialized by the native store's background
+        toucher, while a fresh client mmap pays a minor fault per 4 KiB of
+        every cold extent (the difference between ~1.6 and ~6 GB/s put
+        bandwidth on one core)."""
+        from ray_tpu.core.object_store import local_arena
+        arena = local_arena(shm_name)
+        if arena is not None:
+            mv = arena.local_write_view(offset, size)
+            if mv is not None:
+                return mv
+        return self.shm_client.map(shm_name, size, offset)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
         self.drain_releases()
@@ -1611,7 +1627,8 @@ class WorkerRuntime:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.5)
-        mv = self.shm_client.map(reply["shm_name"], size, reply.get("offset", 0))
+        mv = self._writable_extent(reply["shm_name"], size,
+                                   reply.get("offset", 0))
         _write_serialized(mv, sobj)
         agent.call_with_retry("store_seal", {"object_id": oid}, timeout=30.0)
 
