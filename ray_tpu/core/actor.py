@@ -48,6 +48,13 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs)
 
+    def bind(self, *args):
+        """Bind this method into a compiled DAG (ref: dag node binding on
+        actor methods, python/ray/dag/__init__.py): args may mix
+        constants, InputNode, and other DAG nodes."""
+        from ray_tpu.dag.compiled import DAGNode
+        return DAGNode(self._handle, self._method_name, args)
+
     def options(self, **opts) -> "ActorMethod":
         return ActorMethod(
             self._handle, self._method_name,

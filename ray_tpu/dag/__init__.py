@@ -16,6 +16,16 @@ serve/llm/disagg.py). Cross-node edges ride the agent channel relay
 (channel.RemoteChannelReader).
 """
 
-from ray_tpu.dag.compiled import CompiledPipeline, PipelineRef
+from ray_tpu.dag.compiled import (
+    CompiledDAG,
+    CompiledPipeline,
+    DAGNode,
+    DagRef,
+    InputNode,
+    MultiOutputNode,
+    PipelineRef,
+    allreduce_bind,
+)
 
-__all__ = ["CompiledPipeline", "PipelineRef"]
+__all__ = ["CompiledDAG", "CompiledPipeline", "DAGNode", "DagRef",
+           "InputNode", "MultiOutputNode", "PipelineRef", "allreduce_bind"]

@@ -1,199 +1,598 @@
-"""CompiledPipeline: bind actors -> compile to a channel chain -> execute.
+"""Compiled DAGs: bind actor methods into a graph -> compile onto mutable
+channels -> execute with pipelined in-flight executions.
 
 Reference parity: python/ray/dag/compiled_dag_node.py:805 (CompiledDAG —
-bind, experimental_compile, execute returning a ref) re-shaped for this
-runtime: stages are existing actors, each edge is one mutable channel
-(writer on the producing stage's node, agent-relayed across nodes), and a
-stage runs a resident loop task (via the generic ``__rtpu_call__`` actor
-entry) instead of per-call task submission.
+bind/experimental_compile/execute returning a ref, max_buffered_results,
+multi-arg bind, MultiOutputNode) and python/ray/dag/collective_node.py
+(allreduce nodes between the bound actors), re-shaped for this runtime:
+
+- every edge is ONE mutable shm channel (writer on the producing actor's
+  node, agent-relayed across nodes — core/channel.py), fan-out uses the
+  channel's multi-reader acks;
+- each bound node runs a resident loop task on its actor (via the generic
+  ``__rtpu_call__`` entry): read its input channels, apply the method,
+  write its output channel — no per-call task submission anywhere on the
+  compiled path;
+- collective nodes run host-plane allreduce across the stage actors
+  through ``ray_tpu.util.collective`` (the reference's NCCL groups are the
+  CUDA analog; device-plane collectives belong to XLA inside a jitted
+  step, not to the DAG runtime);
+- a driver-side drain thread buffers completed results past the chain's
+  channel-slot count (the reference's max_buffered_results), so in-flight
+  executions are bounded by buffer + pipeline depth, not depth alone.
+
+Errors raised by a stage method wrap into a _DagError value that flows
+through downstream stages untouched and re-raises at ``ref.get()``.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Any, Optional
 
 from ray_tpu.core.channel import Channel, ChannelClosedError
 
-_OUT_ATTR = "__rtpu_pipe_out__"
+_OUT_CHANNELS_ATTR = "__rtpu_dag_out__"
 
 
-def _stage_setup(inst, capacity: int):
-    """Runs ON the stage actor: create its output channel locally (a
-    channel's writer must live on the writing node) and hand back a
-    location-transparent reader for the next stage."""
-    ch = Channel(capacity=capacity, num_readers=1)
-    setattr(inst, _OUT_ATTR, ch)
-    return ch.remote_reader(0)
+class _DagError:
+    """A stage failure in transit: passes through downstream stages and
+    re-raises at the driver (ref: compiled DAG exception propagation).
+    Sanitized at creation: an unpicklable exception (open socket, lock)
+    must not kill the channel write that carries it."""
+
+    def __init__(self, exc: BaseException, where: str):
+        import pickle
+        self.where = where
+        try:
+            pickle.dumps(exc)
+            self.exc = exc
+        except Exception:  # noqa: BLE001 — keep the message, drop the object
+            self.exc = RuntimeError(f"{type(exc).__name__}: {exc}")
 
 
-def _stage_loop(inst, in_reader, method_name: str):
-    """Runs ON the stage actor for the pipeline's lifetime: read → method →
-    write. Ends (and closes the downstream edge, cascading teardown) when
-    the upstream channel closes."""
-    out: Channel = getattr(inst, _OUT_ATTR)
-    method = getattr(inst, method_name)
+class DAGNode:
+    """An actor method bound into a DAG. ``args`` may mix constants,
+    InputNode, other DAGNodes, and CollectiveOutput nodes."""
+
+    def __init__(self, actor, method_name: str, args: tuple):
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args
+
+    def experimental_compile(self, **kw) -> "CompiledDAG":
+        return CompiledDAG(self, **kw).compile()
+
+
+class InputNode:
+    """The DAG's input placeholder (ref: dag/input_node.py). Usable as a
+    context manager for reference-API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class MultiOutputNode:
+    """Bundle several terminal nodes; ``ref.get()`` returns their values
+    as a list (ref: dag/output_node.py)."""
+
+    def __init__(self, outputs: list):
+        self.outputs = list(outputs)
+
+
+class CollectiveOutput:
+    """One branch's output of a collective op: the value produced on this
+    branch's actor after the cross-actor reduction (ref:
+    dag/collective_node.py)."""
+
+    def __init__(self, group: "_CollectiveGroup", index: int):
+        self.group = group
+        self.index = index
+
+
+class _CollectiveGroup:
+    def __init__(self, inputs: list, op: str):
+        self.inputs = list(inputs)   # DAGNodes, one per participating actor
+        self.op = op
+        self.name = f"dag_cc_{uuid.uuid4().hex[:12]}"
+
+
+def allreduce_bind(nodes: list, op: str = "sum") -> list:
+    """Insert a host-plane allreduce across the given nodes' actors; returns
+    one CollectiveOutput per input node, consumable by downstream binds
+    (ref: dag/collective_node.py AllReduceWrapper.bind)."""
+    group = _CollectiveGroup(nodes, op)
+    return [CollectiveOutput(group, i) for i in range(len(nodes))]
+
+
+# ---------------------------------------------------------------------------
+# stage-side helpers (run ON the stage actors via __rtpu_call__)
+# ---------------------------------------------------------------------------
+
+def _dag_stage_setup(inst, node_key: str, num_readers: int, capacity: int):
+    """Create this node's output channel locally (a channel's writer must
+    live on the writing node) and return location-transparent readers."""
+    ch = Channel(capacity=capacity, num_readers=num_readers)
+    chans = getattr(inst, _OUT_CHANNELS_ATTR, None)
+    if chans is None:
+        chans = {}
+        setattr(inst, _OUT_CHANNELS_ATTR, chans)
+    chans[node_key] = ch
+    return [ch.remote_reader(i) for i in range(num_readers)]
+
+
+def _dag_collective_join(inst, group_name: str, world: int, rank: int):
+    from ray_tpu.util import collective
+    collective.init_collective_group(world, rank, group_name=group_name)
+    return True
+
+
+def _dag_stage_loop(inst, node_key: str, method_name: Optional[str],
+                    arg_spec: list, readers: list, collective: Optional[tuple]):
+    """Resident loop: read input channels in arg order, apply the method
+    (or the collective op), publish the result. Runs until any upstream
+    edge closes; closure cascades downstream.
+
+    ``arg_spec``: one of ("const", value) | ("chan", reader_index) per arg.
+    ``collective``: (group_name, op) when this node is a collective stage —
+    then the single input value is allreduced instead of method-applied.
+    """
+    out: Channel = getattr(inst, _OUT_CHANNELS_ATTR)[node_key]
+    method = getattr(inst, method_name) if method_name else None
     processed = 0
     try:
         while True:
             try:
-                value = in_reader.read(timeout=None)
+                values = [r.read(timeout=None) for r in readers]
             except ChannelClosedError:
                 return processed
-            out.write(method(value), timeout=None)
+            err = next((v for v in values if isinstance(v, _DagError)), None)
+            if collective is not None:
+                # a collective stage MUST participate every tick, error or
+                # not: a skipped rank would strand its peers at the
+                # rendezvous for the full timeout and desync the group's
+                # seq counters for every later execution
+                result = _collective_tick(collective, err, values[0]
+                                          if err is None else None)
+            elif err is not None:
+                out.write(err, timeout=None)
+                processed += 1
+                continue
+            else:
+                args = [values[s[1]] if s[0] == "chan" else s[1]
+                        for s in arg_spec]
+                try:
+                    result = method(*args)
+                except BaseException as e:  # noqa: BLE001 — propagate via value
+                    result = _DagError(
+                        e, f"{type(inst).__name__}.{method_name}")
+            try:
+                out.write(result, timeout=None)
+            except ChannelClosedError:
+                return processed
             processed += 1
     finally:
         out.close()
-        if hasattr(in_reader, "close"):
-            in_reader.close()
+        for r in readers:
+            if hasattr(r, "close"):
+                r.close()
 
 
-def _stage_unlink(inst):
-    """Runs ON the stage actor after its loop task has exited (queued
-    behind it on the actor's slots): drop the out channel's /dev/shm name.
-    Deferred to close() rather than the loop's finally because a
-    downstream reader attaches lazily on first read — unlinking at loop
-    exit could delete the segment before a late-starting consumer (or the
-    driver's result reader) ever opened it."""
-    ch = getattr(inst, _OUT_ATTR, None)
-    if ch is not None:
+def _collective_tick(collective: tuple, err: Optional[_DagError], value):
+    """One lockstep round of a DAG collective: every rank allgathers an
+    (ok|err, payload) envelope through the rendezvous actor — keeping seq
+    counters aligned even on failure — then reduces locally."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.util import collective as cc
+
+    group_name, op = collective
+    st = cc._state(group_name)
+    payload = ("err", err) if err is not None else ("ok", np.asarray(value))
+    gathered = ray_tpu.get(st.actor.collect.remote(
+        st.next_seq(), st.rank, payload, "gather"))
+    first_err = next((p[1] for p in gathered if p[0] == "err"), None)
+    if first_err is not None:
+        return first_err
+    return cc._REDUCE_OPS[op]([np.asarray(p[1]) for p in gathered])
+
+
+def _dag_stage_unlink(inst):
+    """After the loop exits (queued behind it on the actor's slots): drop
+    every output channel's /dev/shm name. Deferred to close() because
+    downstream readers attach lazily on first read."""
+    chans = getattr(inst, _OUT_CHANNELS_ATTR, None) or {}
+    for ch in chans.values():
         ch.unlink()
+    chans.clear()
 
 
-class PipelineRef:
-    """Result handle for one execute() (the compiled-DAG 'ref'): get()
-    blocks for that execution's output, delivered in submission order."""
+# ---------------------------------------------------------------------------
+# driver side
+# ---------------------------------------------------------------------------
 
-    def __init__(self, pipe: "CompiledPipeline", index: int):
-        self._pipe = pipe
+class DagRef:
+    """Result handle for one execute() (the compiled-DAG 'ref')."""
+
+    def __init__(self, dag: "CompiledDAG", index: int):
+        self._dag = dag
         self._index = index
 
     def get(self, timeout: Optional[float] = 60.0):
-        return self._pipe._result(self._index, timeout)
+        return self._dag._result(self._index, timeout)
 
 
-class CompiledPipeline:
-    """A linear actor pipeline compiled onto mutable channels.
+class _Plan:
+    """Per-node compile info."""
 
-    >>> pipe = CompiledPipeline([(a, "prep"), (b, "infer")]).compile()
-    >>> ref = pipe.execute(batch)      # write-side, returns immediately
-    >>> out = ref.get()                # read-side, in submission order
+    def __init__(self, key, actor, method_name, args, collective=None):
+        self.key = key
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args            # raw bind args
+        self.collective = collective  # (group_name, op) | None
+        self.consumers: list = []   # (consumer_plan_key | "driver")
+        self.readers: list = []     # remote readers of this node's channel
 
-    The stage actors keep running their loop task until close(); while
-    compiled, calls submitted through the pipeline bypass task submission
-    entirely (one shm write per hop; agent relay across nodes).
+
+class CompiledDAG:
+    """A DAG of actor-method nodes compiled onto mutable channels.
+
+    >>> with InputNode() as inp:
+    ...     a = prep.bind(inp)          # ActorMethod.bind -> DAGNode
+    ...     l, r = fan1.bind(a), fan2.bind(a)
+    ...     out = merge.bind(l, r)      # fan-in (multi-arg)
+    >>> dag = out.experimental_compile()
+    >>> ref = dag.execute(x)
+    >>> ref.get()
     """
 
-    def __init__(self, stages: list, capacity: int = 8 * 1024 * 1024):
-        if not stages:
-            raise ValueError("pipeline needs at least one stage")
-        self._stages = [(s if isinstance(s, tuple) else (s, "__call__"))
-                        for s in stages]
+    def __init__(self, output, capacity: int = 8 * 1024 * 1024,
+                 max_buffered_results: int = 64):
+        self._output = output
         self._capacity = capacity
+        self._max_buffered = max_buffered_results
+        self._plans: dict[int, _Plan] = {}   # id(node) -> plan
+        self._order: list[_Plan] = []        # topological
+        self._input_consumers: list[_Plan] = []
+        self._out_plans: list[_Plan] = []
         self._input: Optional[Channel] = None
-        self._out_reader = None
+        self._out_readers: list = []
         self._loop_refs: list = []
         self._lock = threading.Lock()
-        # writers serialize on a SEPARATE lock: index assignment and the
-        # channel write must be atomic together (or two concurrent
-        # execute()s could write in the opposite order of their indices and
-        # cross-wire results), but the write may block on backpressure and
-        # the drain side (_result) needs _lock to make progress
+        self._cv = threading.Condition(self._lock)
         self._write_lock = threading.Lock()
         self._submitted = 0
-        self._delivered = 0
+        self._drained = 0   # moved off the output channels into _results
+        self._consumed = 0  # handed to the user via ref.get()
         self._results: dict[int, Any] = {}
         self._closed = False
+        self._drain_exc: Optional[BaseException] = None
+        self._group_names: list[str] = []
+        self._plans_raw_collectives: list[CollectiveOutput] = []
 
-    def compile(self) -> "CompiledPipeline":
+    # ---- graph walk ---------------------------------------------------
+    def _visit(self, node) -> _Plan:
+        if isinstance(node, CollectiveOutput):
+            return self._visit_collective(node)
+        if not isinstance(node, DAGNode):
+            raise TypeError(f"not a DAG node: {node!r}")
+        plan = self._plans.get(id(node))
+        if plan is not None:
+            return plan
+        plan = _Plan(f"n{len(self._plans)}", node.actor, node.method_name,
+                     node.args)
+        self._plans[id(node)] = plan
+        for arg in node.args:
+            if isinstance(arg, (DAGNode, CollectiveOutput)):
+                self._visit(arg).consumers.append(plan)
+            elif isinstance(arg, InputNode):
+                if plan not in self._input_consumers:
+                    self._input_consumers.append(plan)
+        self._order.append(plan)
+        return plan
+
+    def _visit_collective(self, node: CollectiveOutput) -> _Plan:
+        plan = self._plans.get(id(node))
+        if plan is not None:
+            return plan
+        group = node.group
+        src = group.inputs[node.index]
+        src_plan = self._visit(src)
+        plan = _Plan(f"cc{len(self._plans)}", src_plan.actor, None,
+                     (src,), collective=(group.name, group.op))
+        self._plans[id(node)] = plan
+        self._plans_raw_collectives.append(node)
+        src_plan.consumers.append(plan)
+        self._order.append(plan)
+        return plan
+
+    # ---- compile ------------------------------------------------------
+    def compile(self) -> "CompiledDAG":
         import ray_tpu
 
-        self._input = Channel(capacity=self._capacity, num_readers=1)
-        prev_reader = self._input.remote_reader(0)
-        for actor, method in self._stages:
-            out_reader = ray_tpu.get(
-                actor.__rtpu_call__.remote(_stage_setup, self._capacity),
+        outputs = self._output.outputs \
+            if isinstance(self._output, MultiOutputNode) else [self._output]
+        out_plans = [self._visit(o) for o in outputs]
+        for p in out_plans:
+            p.consumers.append("driver")
+        self._out_plans = out_plans
+        if not self._input_consumers:
+            raise ValueError("DAG consumes no InputNode; nothing to execute")
+
+        # every node needs an upstream edge: a const-only node's loop could
+        # never observe closure and would wedge its actor slot forever
+        for p in self._order:
+            if not any(isinstance(a, (DAGNode, CollectiveOutput, InputNode))
+                       for a in p.args):
+                raise ValueError(
+                    f"node {p.method_name!r} is bound to constants only; "
+                    "every DAG node needs an InputNode or upstream node arg")
+
+        # collective groups join BEFORE loops start (rank 0 creates the
+        # rendezvous actor; the rest block on the named-actor lookup)
+        groups: dict[str, list[_Plan]] = {}
+        group_defs: dict[str, _CollectiveGroup] = {}
+        for p in self._order:
+            if p.collective is not None:
+                groups.setdefault(p.collective[0], []).append(p)
+        for node in self._plans_raw_collectives:
+            group_defs[node.group.name] = node.group
+        for gname, members in groups.items():
+            expected = len(group_defs[gname].inputs)
+            if len(members) != expected:
+                raise ValueError(
+                    f"collective group consumes {len(members)} of "
+                    f"{expected} branches; every output of allreduce_bind "
+                    "must be bound into the DAG (a missing rank would "
+                    "reduce over a partial world)")
+        self._group_names = list(groups)
+        for gname, members in groups.items():
+            ray_tpu.get(members[0].actor.__rtpu_call__.remote(
+                _dag_collective_join, gname, len(members), 0), timeout=60.0)
+            if len(members) > 1:
+                ray_tpu.get(
+                    [m.actor.__rtpu_call__.remote(
+                        _dag_collective_join, gname, len(members), rank)
+                     for rank, m in enumerate(members) if rank > 0],
+                    timeout=60.0)
+
+        # output channels (one per node; fan-out = multi-reader acks)
+        node_readers: dict[str, list] = {}
+        for p in self._order:
+            rs = ray_tpu.get(p.actor.__rtpu_call__.remote(
+                _dag_stage_setup, p.key, len(p.consumers), self._capacity),
                 timeout=60.0)
-            # resident stage loop: occupies one of the actor's concurrency
-            # slots until close()
-            self._loop_refs.append(
-                actor.__rtpu_call__.remote(_stage_loop, prev_reader, method))
-            prev_reader = out_reader
-        self._out_reader = prev_reader
+            node_readers[p.key] = list(rs)
+
+        # the driver's input channel feeds every InputNode consumer
+        self._input = Channel(capacity=self._capacity,
+                              num_readers=len(self._input_consumers))
+
+        # wire readers: each consumer takes the next reader index of each
+        # producer it consumes (order is deterministic: topological)
+        taken: dict[str, int] = {}
+
+        def _take(key: str):
+            i = taken.get(key, 0)
+            taken[key] = i + 1
+            return node_readers[key][i]
+
+        input_taken = [0]
+
+        def _take_input():
+            i = input_taken[0]
+            input_taken[0] += 1
+            return self._input.remote_reader(i)
+
+        for p in self._order:
+            readers = []
+            arg_spec = []
+            input_reader_idx: Optional[int] = None
+            for arg in p.args:
+                if isinstance(arg, (DAGNode, CollectiveOutput)):
+                    src = self._plans[id(arg)]
+                    readers.append(_take(src.key))
+                    arg_spec.append(("chan", len(readers) - 1))
+                elif isinstance(arg, InputNode):
+                    if input_reader_idx is None:
+                        readers.append(_take_input())
+                        input_reader_idx = len(readers) - 1
+                    arg_spec.append(("chan", input_reader_idx))
+                else:
+                    arg_spec.append(("const", arg))
+            self._loop_refs.append(p.actor.__rtpu_call__.remote(
+                _dag_stage_loop, p.key, p.method_name, arg_spec, readers,
+                p.collective))
+        self._out_readers = [_take(p.key) for p in out_plans]
+
+        threading.Thread(target=self._drain_loop, name="dag-drain",
+                         daemon=True).start()
         return self
 
-    def execute(self, value) -> PipelineRef:
+    # ---- execute / results --------------------------------------------
+    def _capacity_slots(self) -> int:
+        # one buffered value per channel hop plus the driver-side buffer
+        return len(self._order) + 1 + self._max_buffered
+
+    def execute(self, value) -> DagRef:
         if self._input is None:
-            raise RuntimeError("pipeline not compiled (call .compile())")
+            raise RuntimeError("DAG not compiled (call .compile())")
         if self._closed:
-            raise RuntimeError("pipeline closed")
+            raise RuntimeError("DAG closed")
         with self._write_lock:
             with self._lock:
-                # Bounded in-flight (reference: CompiledDAG
-                # max_buffered_results — dag/compiled_dag_node.py raises
-                # rather than deadlock): each hop buffers ONE value, so a
-                # single-threaded caller submitting past the chain's slot
-                # count would block in write() with the drain side never
-                # reached. stages+1 is a safe lower bound of the chain's
-                # capacity (input slot + one per stage output; relays and
-                # in-hand values only add slack).
-                limit = len(self._stages) + 1
-                if self._submitted - self._delivered >= limit:
+                if self._submitted - self._consumed >= self._capacity_slots():
                     raise RuntimeError(
-                        f"{limit} executions already in flight; get() some "
-                        "results before submitting more (each pipeline hop "
-                        "buffers one value)")
+                        f"{self._capacity_slots()} executions already in "
+                        "flight; get() some results first (channel slots + "
+                        f"max_buffered_results={self._max_buffered})")
                 idx = self._submitted
                 self._submitted += 1
             self._input.write(value, timeout=None)
-        return PipelineRef(self, idx)
+        return DagRef(self, idx)
+
+    def _drain_loop(self):
+        """Eagerly move completed executions off the output channels into
+        the driver-side buffer (bounded; pausing propagates backpressure
+        through the channels)."""
+        multi = isinstance(self._output, MultiOutputNode)
+        while True:
+            with self._cv:
+                while len(self._results) >= self._max_buffered \
+                        and not self._closed:
+                    self._cv.wait(0.5)
+                if self._closed and self._drained >= self._submitted:
+                    return
+            try:
+                values = [r.read(timeout=None) for r in self._out_readers]
+            except Exception as e:  # noqa: BLE001
+                with self._cv:
+                    if not (isinstance(e, ChannelClosedError) and self._closed):
+                        # closure WITHOUT close() = a stage died (actor
+                        # crash, relay failure): surface it at every get()
+                        # instead of a silent hang
+                        self._drain_exc = e
+                    self._cv.notify_all()
+                return
+            result = values if multi else values[0]
+            with self._cv:
+                self._results[self._drained] = result
+                self._drained += 1
+                self._cv.notify_all()
 
     def _result(self, index: int, timeout: Optional[float]):
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._lock:
+        with self._cv:
             while index not in self._results:
-                if self._delivered > index:
+                if self._drain_exc is not None:
                     raise RuntimeError(
-                        f"pipeline result {index} already consumed")
-                # single-threaded drain under the lock: deliver in order.
-                # The whole drain shares ONE deadline — without it, get()
-                # for index N could block (N-delivered+1)*timeout while
-                # holding _lock against concurrent execute() callers.
+                        f"DAG drain failed: {self._drain_exc!r}")
+                if index < self._drained:
+                    raise RuntimeError(f"result {index} already consumed")
                 remaining = None if deadline is None else \
-                    max(0.0, deadline - time.monotonic())
-                value = self._out_reader.read(timeout=remaining)
-                self._results[self._delivered] = value
-                self._delivered += 1
-            return self._results.pop(index)
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(f"result {index} not ready")
+                self._cv.wait(remaining if remaining is not None else 1.0)
+            value = self._results.pop(index)
+            self._consumed += 1
+            self._cv.notify_all()
+        if isinstance(value, _DagError):
+            raise RuntimeError(
+                f"DAG stage {value.where} failed: {value.exc!r}") \
+                from value.exc
+        if isinstance(self._output, MultiOutputNode):
+            out = []
+            for v in value:
+                if isinstance(v, _DagError):
+                    raise RuntimeError(
+                        f"DAG stage {v.where} failed: {v.exc!r}") from v.exc
+                out.append(v)
+            return out
+        return value
 
     def close(self, timeout: float = 30.0) -> None:
-        """Tear down: close the input edge; closure cascades stage by stage
-        and each loop task returns its processed count."""
+        """Tear down: close the input edge; closure cascades stage by
+        stage; every stage's channels are unlinked behind its loop task."""
         if self._closed or self._input is None:
             return
-        self._closed = True
         import ray_tpu
 
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
         self._input.close()
         try:
             ray_tpu.get(self._loop_refs, timeout=timeout)
         except Exception:  # noqa: BLE001 - teardown is best-effort
             pass
-        # attach the result reader BEFORE any unlink so values still
-        # buffered in the final channel stay readable after close()
+        # attach result readers BEFORE any unlink so buffered values stay
+        # readable after close()
+        for r in self._out_readers:
+            try:
+                if hasattr(r, "_ensure"):
+                    r._ensure()
+            except Exception:  # noqa: BLE001
+                pass
+        seen = set()
+        unlinks = []
+        for p in self._order:
+            actor_id = getattr(p.actor, "_actor_id", id(p.actor))
+            if actor_id in seen:
+                continue
+            seen.add(actor_id)
+            unlinks.append(p.actor.__rtpu_call__.remote(_dag_stage_unlink))
         try:
-            if hasattr(self._out_reader, "_ensure"):
-                self._out_reader._ensure()
+            ray_tpu.get(unlinks, timeout=10.0)
         except Exception:  # noqa: BLE001
             pass
-        # reclaim every stage's out segment (ordered behind the loop task
-        # on each actor's slots, so a hung stage just skips its unlink)
-        try:
-            ray_tpu.get([a.__rtpu_call__.remote(_stage_unlink)
-                         for a, _ in self._stages], timeout=10.0)
-        except Exception:  # noqa: BLE001
-            pass
-        if hasattr(self._out_reader, "close"):
-            self._out_reader.close()
+        for r in self._out_readers:
+            if hasattr(r, "close"):
+                r.close()
+        # reap collective rendezvous actors (detached: they would outlive
+        # every compile/close cycle otherwise)
+        for gname in self._group_names:
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(f"_collective_{gname}",
+                                               timeout=1.0))
+            except Exception:  # noqa: BLE001 — already gone
+                pass
         self._input.unlink()
+
+
+# ---------------------------------------------------------------------------
+# linear-pipeline sugar (the r4 API, now running on the DAG engine)
+# ---------------------------------------------------------------------------
+
+class PipelineRef:
+    """Result handle for one CompiledPipeline.execute()."""
+
+    def __init__(self, ref: DagRef):
+        self._ref = ref
+
+    def get(self, timeout: Optional[float] = 60.0):
+        return self._ref.get(timeout)
+
+
+class CompiledPipeline:
+    """A linear actor pipeline compiled onto mutable channels — sugar over
+    CompiledDAG (ref: the linear subset of compiled_dag_node.py).
+
+    >>> pipe = CompiledPipeline([(a, "prep"), (b, "infer")]).compile()
+    >>> out = pipe.execute(batch).get()
+    """
+
+    def __init__(self, stages: list, capacity: int = 8 * 1024 * 1024,
+                 max_buffered_results: int = 64):
+        if not stages:
+            raise ValueError("pipeline needs at least one stage")
+        self._stages = [(s if isinstance(s, tuple) else (s, "__call__"))
+                        for s in stages]
+        self._capacity = capacity
+        self._max_buffered = max_buffered_results
+        self._dag: Optional[CompiledDAG] = None
+
+    def compile(self) -> "CompiledPipeline":
+        node: Any = InputNode()
+        for actor, method in self._stages:
+            node = DAGNode(actor, method, (node,))
+        self._dag = CompiledDAG(node, capacity=self._capacity,
+                                max_buffered_results=self._max_buffered)
+        self._dag.compile()
+        return self
+
+    def execute(self, value) -> PipelineRef:
+        if self._dag is None:
+            raise RuntimeError("pipeline not compiled (call .compile())")
+        return PipelineRef(self._dag.execute(value))
+
+    def close(self, timeout: float = 30.0) -> None:
+        if self._dag is not None:
+            self._dag.close(timeout=timeout)
