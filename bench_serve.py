@@ -33,6 +33,38 @@ def _post(url: str, payload: dict, timeout: float = 600.0) -> dict:
         return json.loads(r.read())
 
 
+def _post_stream(url: str, payload: dict, timeout: float = 600.0) -> dict:
+    """SSE request; returns CLIENT-observed timings: ttft_s is the wall
+    time to the first data: byte on this socket (the north-star metric —
+    engine-side ttft excludes proxy/router/transport), plus the final
+    chunk's usage/engine accounting."""
+    req = urllib.request.Request(
+        url, data=json.dumps({**payload, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    t0 = time.monotonic()
+    ttft = None
+    last = {}
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        for raw in r:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data:"):
+                continue
+            if ttft is None:
+                ttft = time.monotonic() - t0
+            body = line[5:].strip()
+            if body == "[DONE]":
+                break
+            try:
+                chunk = json.loads(body)
+            except ValueError:
+                continue
+            if chunk.get("usage") is not None:
+                last = chunk
+    return {"client_ttft_s": ttft, "client_latency_s": time.monotonic() - t0,
+            "usage": last.get("usage") or {},
+            "engine": last.get("ray_tpu") or {}}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=64)
@@ -89,45 +121,74 @@ def main():
     prompt = "the quick brown fox jumps over the lazy dog " * (
         max(1, args.prompt_tokens // 9))
 
-    # warmup: compile prefill buckets + decode program
+    # warmup: compile prefill buckets + decode program (incl. the widest
+    # bucket for the long-prompt point) and the SSE path
     _post(base, {"prompt": prompt, "max_tokens": 4})
-    _post(base, {"prompt": prompt, "max_tokens": 4})
+    _post_stream(base, {"prompt": prompt, "max_tokens": 4})
+    if args.curve:
+        _post_stream(base, {"prompt": "dog " * 1024, "max_tokens": 4})
 
-    def run_point(concurrency: int, requests: int) -> dict:
-        """Drive one operating point; returns its TTFT/throughput row."""
+    import os
+
+    def _proc_cpu_s() -> float:
+        parts = open(f"/proc/{os.getpid()}/stat").read().rsplit(") ", 1)[1]
+        f = parts.split()
+        return (int(f[11]) + int(f[12])) / os.sysconf("SC_CLK_TCK")
+
+    def run_point(concurrency: int, requests: int,
+                  point_prompt: str | None = None,
+                  label: str | None = None) -> dict:
+        """Drive one operating point over SSE; TTFT is CLIENT-observed
+        (first data: byte), engine-side ttft recorded alongside so the
+        proxy/router/transport share is visible per point."""
+        p = point_prompt if point_prompt is not None else prompt
         ttfts: list[float] = []
+        engine_ttfts: list[float] = []
         latencies: list[float] = []
         tokens = 0
 
         def one(_i: int):
-            out = _post(base,
-                        {"prompt": prompt, "max_tokens": args.max_tokens})
-            meta = out.get("ray_tpu") or {}
-            return (meta.get("ttft_s"), meta.get("latency_s"),
-                    out["usage"]["completion_tokens"])
+            out = _post_stream(
+                base, {"prompt": p, "max_tokens": args.max_tokens})
+            return (out["client_ttft_s"], out["client_latency_s"],
+                    out["engine"].get("ttft_s"),
+                    out["usage"].get("completion_tokens", 0))
 
+        cpu0 = _proc_cpu_s()
         t0 = time.monotonic()
         with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
-            for ttft, lat, ntok in pool.map(one, range(requests)):
+            for ttft, lat, engine_ttft, ntok in pool.map(one, range(requests)):
                 if ttft is not None:
                     ttfts.append(ttft)
+                if engine_ttft is not None:
+                    engine_ttfts.append(engine_ttft)
                 if lat is not None:
                     latencies.append(lat)
                 tokens += ntok
         wall = time.monotonic() - t0
+        proxy_cpu = _proc_cpu_s() - cpu0
         p50 = statistics.median(ttfts) * 1e3 if ttfts else float("nan")
         p90 = (statistics.quantiles(ttfts, n=10)[-1] * 1e3
                if len(ttfts) >= 10 else p50)
-        return {
+        row = {
             "concurrency": concurrency,
             "requests": requests,
             "req_per_s": round(requests / wall, 3),
             "p50_ttft_ms": round(p50, 2),
             "p90_ttft_ms": round(p90, 2),
+            "p50_engine_ttft_ms": round(
+                statistics.median(engine_ttfts) * 1e3, 2)
+            if engine_ttfts else None,
             "p50_latency_ms": round(
                 statistics.median(latencies) * 1e3, 2) if latencies else None,
             "gen_tokens_per_s": round(tokens / wall, 1),
+            # driver-process (proxy+router+client threads) CPU share of the
+            # point's wall time: the "is the proxy eating the core?" number
+            "proxy_cpu_share": round(proxy_cpu / wall, 3),
         }
+        if label:
+            row["label"] = label
+        return row
 
     # TTFT-vs-throughput curve: light load -> saturation. The headline row
     # is the point the driver tracks (args.concurrency); the curve shows
@@ -140,9 +201,15 @@ def main():
                          args.concurrency})
         points = [run_point(c, max(8, min(args.requests, c * 8)))
                   for c in levels]
+        # long-prompt operating point: >=1024 prompt tokens exercises
+        # chunked prefill + pressure decode blocks under measurement
+        long_prompt = "the quick brown fox jumps over the lazy dog " * 128
+        points.append(run_point(
+            max(2, args.concurrency // 4), max(8, args.requests // 4),
+            point_prompt=long_prompt, label="long_prompt_1024"))
     else:
         points = [run_point(args.concurrency, args.requests)]
-    head = points[-1]
+    head = points[-2] if args.curve else points[-1]
 
     serve.shutdown()
 

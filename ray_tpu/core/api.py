@@ -293,6 +293,19 @@ def get_runtime_context() -> RuntimeContext:
     return RuntimeContext(_get_runtime())
 
 
+def get_actor_event_loop():
+    """The asyncio event loop of the CURRENT async actor, or None when the
+    calling code is not hosted on an async actor. Lets sync actor methods
+    drive the actor's coroutines/async generators
+    (asyncio.run_coroutine_threadsafe) without reaching into runtime
+    internals."""
+    rt = _try_get_runtime()
+    if rt is None:
+        return None
+    state = getattr(rt, "_actor_state", None)
+    return getattr(state, "loop", None)
+
+
 def cluster_resources() -> dict:
     rt = _get_runtime()
     nodes = rt.cp_client.call_with_retry("get_nodes", None, timeout=10.0)

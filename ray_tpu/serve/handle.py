@@ -60,7 +60,12 @@ class DeploymentResponseGenerator:
         self._ref = ref
 
     def __iter__(self):
-        chunks = ray_tpu.get(self._ref)
+        if hasattr(self._ref, "__next__"):
+            # streaming-generator call: chunks land as the replica yields
+            for item_ref in self._ref:
+                yield ray_tpu.get(item_ref)
+            return
+        chunks = ray_tpu.get(self._ref)  # legacy list-returning replicas
         yield from chunks
 
 

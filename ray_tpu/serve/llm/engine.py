@@ -356,6 +356,31 @@ class LLMEngine:
         self._wake.set()
         return req.request_id
 
+    def cancel(self, request_id: str) -> None:
+        """Abandon a request (client disconnected mid-stream): a waiting
+        request is dropped immediately; a slotted one finishes at its next
+        recorded token (the loop then frees its slot/pages on the normal
+        completion path). The entry is removed so nothing leaks when no
+        one drains it again."""
+        with self._lock:
+            req = self._requests.pop(request_id, None)
+            if req is None:
+                return
+            if req in self._waiting:
+                self._waiting.remove(req)
+                req.done = True
+                return
+            if not req.done:
+                # finish at next token; keep a tracking entry so the loop's
+                # completion path still finds consistent state, and flag it
+                # abandoned so completion also reaps the entry (no drain
+                # will ever come to do it)
+                req.max_tokens = max(1, len(req.generated))
+                req.abandoned = True
+                self._requests[request_id] = req
+                req.drained_upto = len(req.generated)
+        self._wake.set()
+
     def drain(self, request_id: str) -> dict:
         """New tokens since the last drain + done flag (streaming poll)."""
         with self._lock:
@@ -721,3 +746,6 @@ class LLMEngine:
             req.pages = []
         for req in finished:
             req.done_event.set()
+            if getattr(req, "abandoned", False):
+                with self._lock:
+                    self._requests.pop(req.request_id, None)

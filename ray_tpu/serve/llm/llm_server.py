@@ -103,28 +103,62 @@ class LLMServer:
         N streaming requests drain concurrently instead of serializing."""
         import asyncio
 
+        import time as _time
+
+        t0 = _time.monotonic()
+        n_prompt = len(self.engine.tokenizer.encode(prompt)) \
+            if isinstance(prompt, str) else len(prompt)
         rid = self.engine.submit(prompt, **params)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
         obj = "chat.completion.chunk" if chat else "text_completion"
-        while True:
-            d = self.engine.drain(rid)
-            if d["text"]:
-                if chat:
-                    delta = {"delta": {"content": d["text"]}, "index": 0,
-                             "finish_reason": None}
-                else:
-                    delta = {"text": d["text"], "index": 0,
-                             "finish_reason": None}
-                yield {"id": oid, "object": obj,
-                       "model": self.cfg.model_id, "choices": [delta]}
-            if d["done"]:
-                fin = ({"delta": {}, "index": 0, "finish_reason": "stop"}
-                       if chat else
-                       {"text": "", "index": 0, "finish_reason": "stop"})
-                yield {"id": oid, "object": obj,
-                       "model": self.cfg.model_id, "choices": [fin]}
-                return
-            await asyncio.sleep(0.01)
+        ntok = 0
+        ttft = None
+        try:
+            while True:
+                d = self.engine.drain(rid)
+                # gate on TOKENS, not decoded text: a tokenizer can decode
+                # a batch to "" (byte tokenizer on unprintable ids) and the
+                # stream must still emit the chunk — TTFT is first-token
+                # time
+                if d.get("tokens"):
+                    if ttft is None:
+                        ttft = _time.monotonic() - t0
+                    ntok += len(d.get("tokens") or ())
+                    if chat:
+                        delta = {"delta": {"content": d["text"]}, "index": 0,
+                                 "finish_reason": None}
+                    else:
+                        delta = {"text": d["text"], "index": 0,
+                                 "finish_reason": None}
+                    yield {"id": oid, "object": obj,
+                           "model": self.cfg.model_id, "choices": [delta]}
+                if d["done"]:
+                    err = d.get("error")
+                    reason = "error" if err else "stop"
+                    fin = ({"delta": {}, "index": 0, "finish_reason": reason}
+                           if chat else
+                           {"text": "", "index": 0, "finish_reason": reason})
+                    # final chunk carries usage + engine-side timing so
+                    # streaming clients (and the bench) get the same
+                    # accounting as the non-streaming path
+                    final = {"id": oid, "object": obj,
+                             "model": self.cfg.model_id, "choices": [fin],
+                             "usage": {"prompt_tokens": n_prompt,
+                                       "completion_tokens": ntok,
+                                       "total_tokens": n_prompt + ntok},
+                             "ray_tpu": {"ttft_s": ttft,
+                                         "latency_s":
+                                         _time.monotonic() - t0}}
+                    if err:
+                        final["error"] = {"message": str(err)}
+                    yield final
+                    return
+                await asyncio.sleep(0.01)
+        finally:
+            # abandoned stream (client disconnect -> generator close): stop
+            # burning batch slots and reap the engine entry — nothing will
+            # drain it again
+            self.engine.cancel(rid)
 
     # raw engine access (bench, composition)
     def generate(self, prompt: str, **kw) -> dict:

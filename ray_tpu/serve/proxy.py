@@ -19,6 +19,8 @@ from typing import Optional
 import ray_tpu
 from ray_tpu.serve.router import Router
 
+_SSE_DONE = object()  # sentinel: streaming generator exhausted
+
 
 class HTTPProxy:
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
@@ -146,6 +148,45 @@ class HTTPProxy:
             ref = await loop.run_in_executor(
                 None, lambda: router.assign(
                     call[0], call[1], call[2], {}, streaming=streaming))
+            if streaming and hasattr(ref, "__next__"):
+                # ObjectRefGenerator: stream each chunk to the client the
+                # moment the replica yields it (SSE framing; reference:
+                # proxy ASGI streaming). First byte goes out at first
+                # token, not at completion. Once the response is prepared,
+                # errors must be delivered IN-STREAM (an SSE error event +
+                # [DONE]) — aiohttp cannot start a second response.
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream",
+                             "Cache-Control": "no-cache"})
+                await resp.prepare(request)
+                gen = iter(ref)
+
+                def _next_chunk():
+                    try:
+                        # bounded: a hung replica must not pin an executor
+                        # thread (and this connection) forever
+                        return ray_tpu.get(next(gen), timeout=120.0)
+                    except StopIteration:
+                        return _SSE_DONE
+
+                try:
+                    while True:
+                        chunk = await loop.run_in_executor(None, _next_chunk)
+                        if chunk is _SSE_DONE:
+                            break
+                        data = json.dumps(chunk) \
+                            if not isinstance(chunk, str) else chunk
+                        await resp.write(f"data: {data}\n\n".encode())
+                except (ConnectionResetError, asyncio.CancelledError):
+                    raise  # client went away: nothing left to tell it
+                except Exception as e:  # noqa: BLE001 — replica/stream error
+                    await resp.write(
+                        b"data: " + json.dumps(
+                            {"error": {"message": repr(e)}}).encode()
+                        + b"\n\n")
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
             result = await _aget(ref)
         except TimeoutError as e:
             return web.Response(status=503, text=str(e))
@@ -153,7 +194,7 @@ class HTTPProxy:
             return web.Response(status=500, text=repr(e))
 
         if streaming and isinstance(result, list):
-            # server-sent events framing (reference: proxy ASGI streaming)
+            # server-sent events framing (legacy list-returning replicas)
             resp = web.StreamResponse(
                 headers={"Content-Type": "text/event-stream",
                          "Cache-Control": "no-cache"})

@@ -88,10 +88,18 @@ class ServeReplica:
         finally:
             self._ongoing -= 1
 
-    async def handle_request_streaming(self, method_name: str, args: tuple,
-                                       kwargs: dict) -> list:
-        """Generator endpoints: collect and return chunks (the handle
-        re-streams them; reference streams over gRPC/ASGI incrementally)."""
+    def handle_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict):
+        """Generator endpoints, streamed INCREMENTALLY: a sync generator
+        invoked with num_returns="streaming", so each chunk reaches the
+        caller (proxy/handle) the moment the user code yields it — the
+        ASGI-streaming behavior of the reference proxy, carried by the
+        core's streaming-generator item reports
+        (core_worker.proto:513 ReportGeneratorItemReturns analog).
+
+        Async-generator user code is pumped from this (pool) thread via the
+        actor's event loop; sync generators and plain results pass through.
+        """
         self._ongoing += 1
         self._total += 1
         model_id = (kwargs or {}).pop("_multiplexed_model_id", "")
@@ -102,23 +110,35 @@ class ServeReplica:
             target = (self._callable if self._is_fn or method_name == "__call__"
                       else getattr(self._callable, method_name))
             result = target(*args, **kwargs)
-            chunks = []
             if inspect.isasyncgen(result):
-                async for chunk in result:
-                    chunks.append(chunk)
+                loop = self._actor_loop()
+                while True:
+                    try:
+                        yield asyncio.run_coroutine_threadsafe(
+                            result.__anext__(), loop).result()
+                    except StopAsyncIteration:
+                        return
             elif inspect.isgenerator(result):
-                # drain sync generators on a thread (same loop-starvation
-                # concern as handle_request)
-                chunks.extend(await asyncio.get_running_loop()
-                              .run_in_executor(self._exec,
-                                               lambda: list(result)))
+                yield from result
             else:
                 if inspect.iscoroutine(result):
-                    result = await result
-                chunks.append(result)
-            return chunks
+                    yield asyncio.run_coroutine_threadsafe(
+                        result, self._actor_loop()).result()
+                else:
+                    yield result
         finally:
             self._ongoing -= 1
+
+    @staticmethod
+    def _actor_loop():
+        """The hosting async actor's event loop (async user generators are
+        driven from the sync streaming method's pool thread)."""
+        from ray_tpu.core.api import get_actor_event_loop
+        loop = get_actor_event_loop()
+        if loop is None:
+            raise RuntimeError("async generator endpoint on a non-async "
+                               "replica actor")
+        return loop
 
     async def get_queue_len(self) -> int:
         return self._ongoing

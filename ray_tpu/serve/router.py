@@ -131,8 +131,10 @@ class Router:
             replica = rs.choose(multiplexed_model_id)
             if replica is not None:
                 if streaming:
-                    return replica.handle_request_streaming.remote(
-                        method, args, kwargs)
+                    # streaming-generator call: returns an ObjectRefGenerator
+                    # whose items land as the replica yields them
+                    return replica.handle_request_streaming.options(
+                        num_returns="streaming").remote(method, args, kwargs)
                 return replica.handle_request.remote(method, args, kwargs)
             if time.monotonic() > deadline:
                 raise TimeoutError(
