@@ -227,6 +227,17 @@ class ControlPlane:
         self._wake_scheduler()
         return {"known": True}
 
+    def _h_get_node_metrics(self, body):
+        """Raw per-node heartbeat gauges for the dashboard's drill-down and
+        timeseries sampler (the Prometheus endpoint renders these same
+        gauges as text; this is the JSON view)."""
+        with self._lock:
+            return [{"node_id": n.view.node_id, "alive": n.view.alive,
+                     "resources": dict(n.view.total),
+                     "available": dict(n.view.available),
+                     "metrics": dict(getattr(n, "metrics", None) or {})}
+                    for n in self._nodes.values()]
+
     def _h_get_metrics(self, body):
         """Prometheus exposition of cluster system metrics: CP-derived
         gauges + per-node agent gauges (TPU-native analog of the reference's

@@ -20,16 +20,49 @@ _INDEX = """<!doctype html>
 </style></head>
 <body>
 <h1>ray_tpu dashboard</h1>
+<div>
+ <button onclick="profile()">profile cluster (3s)</button>
+ <span id="profstatus"></span>
+</div>
+<pre id="profout" style="max-height:300px;overflow:auto;background:#f7f7f7"></pre>
+<div id="charts"></div>
 <div id="content">loading…</div>
 <script>
 function esc(s) {
   // user-controlled strings (actor names, entrypoints) must never reach
   // innerHTML unescaped
-  return s.replace(/&/g, "&amp;").replace(/</g, "&lt;").replace(/>/g, "&gt;")
-          .replace(/"/g, "&quot;");
+  return String(s).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+          .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+}
+function sparkline(samples, key, label) {
+  const vals = samples.map(s => s[key]).filter(v => v !== null && v !== undefined);
+  if (!vals.length) return "";
+  const w = 360, h = 60, max = Math.max(...vals, 1e-9);
+  const pts = vals.map((v, i) =>
+    (i * w / Math.max(1, vals.length - 1)).toFixed(1) + "," +
+    (h - v * h / max).toFixed(1)).join(" ");
+  return "<div><b>" + esc(label) + "</b> (now " + esc(vals[vals.length-1]) +
+    ", max " + esc(max.toFixed(1)) + ")<br>" +
+    "<svg width='" + w + "' height='" + h + "' style='border:1px solid #ccc'>" +
+    "<polyline fill='none' stroke='#36c' stroke-width='1.5' points='" +
+    pts + "'/></svg></div>";
+}
+async function profile() {
+  document.getElementById("profstatus").textContent = "sampling…";
+  const out = await (await fetch("/api/profile?duration=3")).json();
+  document.getElementById("profout").textContent =
+    out.collapsed.slice(0, 80).join("\\n");
+  document.getElementById("profstatus").textContent =
+    out.rounds + " rounds";
 }
 async function refresh() {
-  const sections = ["nodes", "actors", "pgs", "jobs", "tasks"];
+  const ts = await (await fetch("/api/timeseries")).json();
+  document.getElementById("charts").innerHTML =
+    sparkline(ts, "cpu_percent_avg", "cluster cpu %") +
+    sparkline(ts, "memory_percent_avg", "cluster mem %") +
+    sparkline(ts, "logical_cpus_in_use", "logical CPUs in use") +
+    sparkline(ts, "object_store_used_bytes", "object store bytes");
+  const sections = ["nodes", "train", "serve", "actors", "pgs", "jobs", "tasks"];
   let html = "";
   for (const s of sections) {
     const rows = await (await fetch("/api/" + s)).json();
@@ -38,7 +71,14 @@ async function refresh() {
       const cols = Object.keys(rows[0]);
       html += "<table><tr>" + cols.map(c => "<th>" + esc(c) + "</th>").join("") + "</tr>";
       for (const r of rows.slice(0, 200)) {
-        html += "<tr>" + cols.map(c => "<td>" + esc(JSON.stringify(r[c])) + "</td>").join("") + "</tr>";
+        html += "<tr>" + cols.map(c => {
+          let cell = esc(JSON.stringify(r[c]));
+          if (s === "nodes" && c === "node_id" && typeof r[c] === "string") {
+            cell = "<a href='/api/node/" + encodeURIComponent(r[c]) + "'>" +
+                   cell + "</a>";
+          }
+          return "<td>" + cell + "</td>";
+        }).join("") + "</tr>";
       }
       html += "</table>";
     }
@@ -47,6 +87,68 @@ async function refresh() {
 }
 refresh(); setInterval(refresh, 3000);
 </script></body></html>"""
+
+
+def _train_runs() -> list[dict]:
+    """Train runs published by TrainController to the CP KV
+    (train_run:* keys; reference: dashboard/modules/train/)."""
+    from ray_tpu.core import api
+    rt = api._get_runtime()
+    keys = rt.cp_client.call_with_retry(
+        "kv_keys", {"prefix": "train_run:"}, timeout=10.0) or []
+    out = []
+    for key in sorted(keys):
+        raw = rt.cp_client.call_with_retry("kv_get", {"key": key},
+                                           timeout=10.0)
+        if raw is None:
+            continue
+        try:
+            out.append(json.loads(raw.decode()
+                                  if isinstance(raw, bytes) else raw))
+        except ValueError:
+            continue
+    return out
+
+
+def _serve_apps() -> list[dict]:
+    """Serve deployment/replica status with live queue lengths via the
+    controller (reference: dashboard/modules/serve/). Empty when serve is
+    down."""
+    try:
+        controller = ray_tpu.get_actor("_serve_controller", timeout=1.0)
+    except Exception:  # noqa: BLE001 — serve not running
+        return []
+    try:
+        status = ray_tpu.get(controller.detailed_status.remote(),
+                             timeout=15.0)
+    except Exception:  # noqa: BLE001
+        return []
+    return [{"deployment": name, **info} for name, info in status.items()]
+
+
+def _collapse_stacks(proc: str, text: str) -> list[str]:
+    """Parse dump_thread_stacks text into collapsed flamegraph lines:
+    'proc;thread;frame;frame;...' (root first)."""
+    out = []
+    for block in text.split("--- thread "):
+        block = block.strip()
+        if not block:
+            continue
+        lines = block.splitlines()
+        header = lines[0].rsplit(" (", 1)[0].strip()
+        frames = []
+        for line in lines[1:]:
+            line = line.strip()
+            if line.startswith("File \""):
+                try:
+                    path, _, rest = line[6:].partition("\", line ")
+                    _lineno, _, func = rest.partition(", in ")
+                    frames.append(f"{path.rsplit('/', 1)[-1]}:{func.strip()}")
+                except ValueError:
+                    continue
+        if frames:
+            out.append(";".join([proc, header] + frames))
+    return out
 
 
 def _hexify(obj):
@@ -67,15 +169,83 @@ def _hexify(obj):
     return obj
 
 
+class _Timeseries:
+    """In-process ring buffer of cluster gauges, sampled by a background
+    thread (reference: dashboard/modules/metrics keeps timeseries in
+    Prometheus; here the dashboard itself retains a window so the UI has
+    history without external infra)."""
+
+    def __init__(self, period_s: float = 5.0, window: int = 720):
+        self.period_s = period_s
+        self.window = window
+        self.samples: list[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="dash-timeseries")
+            self._thread.start()
+
+    def _loop(self):
+        import time as _time
+        while not self._stop.wait(self.period_s):
+            try:
+                from ray_tpu.core import api
+                rt = api._try_get_runtime()
+                if rt is None:
+                    continue
+                nodes = rt.cp_client.call_with_retry(
+                    "get_node_metrics", None, timeout=10.0)
+                alive = [n for n in nodes if n.get("alive")]
+                cpu = [n["metrics"].get("cpu_percent") for n in alive
+                       if n["metrics"].get("cpu_percent") is not None]
+                mem = [n["metrics"].get("memory_percent") for n in alive
+                       if n["metrics"].get("memory_percent") is not None]
+                store = sum(n["metrics"].get("object_store_used_bytes", 0)
+                            for n in alive)
+                used_cpu = sum(
+                    n["resources"].get("CPU", 0)
+                    - n["available"].get("CPU", 0) for n in alive)
+                sample = {
+                    "ts": _time.time(),
+                    "nodes_alive": len(alive),
+                    "cpu_percent_avg": round(sum(cpu) / len(cpu), 2)
+                    if cpu else None,
+                    "memory_percent_avg": round(sum(mem) / len(mem), 2)
+                    if mem else None,
+                    "object_store_used_bytes": store,
+                    "logical_cpus_in_use": round(used_cpu, 2),
+                }
+                with self._lock:
+                    self.samples.append(sample)
+                    if len(self.samples) > self.window:
+                        del self.samples[: len(self.samples) - self.window]
+            except Exception:  # noqa: BLE001 — sampling is best-effort
+                pass
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self.samples)
+
+    def stop(self):
+        self._stop.set()
+
+
 class Dashboard:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265,
+                 timeseries_period_s: float = 5.0):
         self.host = host
         self.port = port
         self._thread: Optional[threading.Thread] = None
         self._loop = None
         self._started = threading.Event()
+        self._timeseries = _Timeseries(period_s=timeseries_period_s)
 
     def start(self):
+        self._timeseries.start()
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name="dashboard")
         self._thread.start()
@@ -84,6 +254,7 @@ class Dashboard:
         return self
 
     def stop(self):
+        self._timeseries.stop()
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
 
@@ -96,6 +267,8 @@ class Dashboard:
         app = web.Application()
         app.router.add_get("/", self._index)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/api/node/{node_id}", self._node_detail)
+        app.router.add_get("/api/profile", self._profile)
         app.router.add_get("/api/{section}", self._api)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
@@ -151,6 +324,12 @@ class Dashboard:
             if section == "jobs":
                 from ray_tpu.job import JobSubmissionClient
                 return JobSubmissionClient().list_jobs()
+            if section == "train":
+                return _train_runs()
+            if section == "serve":
+                return _serve_apps()
+            if section == "timeseries":
+                return self._timeseries.snapshot()
             if section == "logs":
                 wid = request.query.get("worker_id")
                 tail = int(request.query.get("tail", "100"))
@@ -167,6 +346,77 @@ class Dashboard:
         if data is None:
             return web.Response(status=404, text=f"unknown section {section}")
         return web.json_response(_hexify(data))
+
+    async def _node_detail(self, request):
+        """Per-node drill-down: identity, resources, live gauges, and the
+        node's actors (reference: dashboard node detail page)."""
+        from aiohttp import web
+
+        node_id = request.match_info["node_id"]
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.core import api
+            from ray_tpu.util import state
+            rt = api._get_runtime()
+            nodes = rt.cp_client.call_with_retry(
+                "get_node_metrics", None, timeout=10.0)
+            me = next((n for n in nodes
+                       if n["node_id"].hex().startswith(node_id)), None)
+            if me is None:
+                return None
+            actors = [a for a in state.list_actors()
+                      if str(a.get("node_id", ""))
+                      .startswith(node_id[:8])]
+            return {**me, "actors": actors}
+
+        data = await loop.run_in_executor(None, fetch)
+        if data is None:
+            return web.Response(status=404, text=f"unknown node {node_id}")
+        return web.json_response(_hexify(data))
+
+    async def _profile(self, request):
+        """On-demand sampling profile (reference: dashboard/modules/
+        reporter/profile_manager.py py-spy endpoints): repeatedly snapshot
+        cluster (or one worker's) stacks for ``duration`` seconds and
+        return collapsed flamegraph lines ('frame;frame;frame count')."""
+        from aiohttp import web
+
+        try:
+            duration = min(30.0, max(0.2,
+                                     float(request.query.get("duration",
+                                                             "3"))))
+        except ValueError:
+            return web.Response(status=400, text="bad duration")
+        process = request.query.get("process")  # substring filter
+        loop = asyncio.get_event_loop()
+
+        def sample():
+            import time as _time
+
+            from ray_tpu.util import state
+            counts: dict[str, int] = {}
+            deadline = _time.monotonic() + duration
+            rounds = 0
+            while _time.monotonic() < deadline:
+                try:
+                    dump = state.dump_cluster_stacks()
+                except Exception:  # noqa: BLE001
+                    break
+                rounds += 1
+                for proc, text in dump.items():
+                    if process and process not in proc:
+                        continue
+                    for stack in _collapse_stacks(proc, text):
+                        counts[stack] = counts.get(stack, 0) + 1
+                _time.sleep(0.2)
+            lines = [f"{stack} {n}" for stack, n in
+                     sorted(counts.items(), key=lambda kv: -kv[1])]
+            return {"duration_s": duration, "rounds": rounds,
+                    "collapsed": lines[:500]}
+
+        data = await loop.run_in_executor(None, sample)
+        return web.json_response(data)
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:

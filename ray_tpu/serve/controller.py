@@ -208,6 +208,33 @@ class ServeController:
             for state in self._deployments.values()
         }
 
+    async def detailed_status(self) -> dict:
+        """status() plus live per-replica queue lengths (dashboard serve
+        view; reference: dashboard/modules/serve/ deployment details)."""
+        self._ensure_started()
+
+        async def probe(replica):
+            try:
+                return int(await asyncio.wait_for(
+                    replica.get_queue_len.remote(), timeout=2.0))
+            except Exception:  # noqa: BLE001 — replica busy/dead
+                return None
+
+        out = {}
+        for state in self._deployments.values():
+            # concurrent probes: a deployment of N hung replicas must cost
+            # one 2s timeout, not N of them (the dashboard polls this)
+            qlens = list(await asyncio.gather(
+                *(probe(r) for r in state.replicas)))
+            out[state.full_name()] = {
+                "app": state.app,
+                "replicas": len(state.replicas),
+                "target": state.target,
+                "version": state.version,
+                "queue_lens": qlens,
+            }
+        return out
+
     async def shutdown(self) -> bool:
         self._stopped = True
         for state in self._deployments.values():

@@ -197,18 +197,68 @@ class TrainController:
 
     # -- main loop ---------------------------------------------------------
     def run(self) -> Result:
+        last_pub = 0.0
         while self.state not in (RunState.FINISHED, RunState.ERRORED):
             self._step()
+            now = time.monotonic()
+            if now - last_pub >= 1.0:
+                self._publish_run_state()
+                last_pub = now
         latest = self._ckpt_manager.latest
         best = self._ckpt_manager.best_checkpoints()
         err = None
         if self.state == RunState.ERRORED:
             err = TrainingFailedError(self._error or "training failed")
+        self._publish_run_state()
         return Result(
             metrics=self._latest_metrics,
             checkpoint=latest.checkpoint if latest else None,
             error=err, path=self._storage.run_path,
             best_checkpoints=best)
+
+    def _publish_run_state(self) -> None:
+        """Export the run's controller state to the CP KV for the dashboard
+        (reference: train/v2/_internal/state + dashboard/modules/train/ —
+        run/attempt state visible in the UI). Best-effort: a dashboardless
+        cluster must not pay for failures here."""
+        try:
+            import json as _json
+
+            from ray_tpu.core import api as _api
+            rt = _api._try_get_runtime()
+            if rt is None:
+                return
+            wg = self._worker_group
+            workers = []
+            if wg is not None:
+                for w in getattr(wg, "workers", []) or []:
+                    aid = getattr(w.actor, "_actor_id", None)
+                    workers.append({
+                        "rank": w.world_rank,
+                        "node_id": w.node_id,
+                        "actor_id": aid.hex()[:16] if aid is not None
+                        else None,
+                    })
+            latest = self._ckpt_manager.latest
+            payload = {
+                "name": self._run_name,
+                "state": self.state.value,
+                "num_workers": self._num_workers,
+                "workers": workers,
+                "latest_metrics": self._latest_metrics,
+                "error": self._error,
+                "checkpoints": len(self._ckpt_manager.best_checkpoints()),
+                "latest_checkpoint":
+                    getattr(latest.checkpoint, "path", None)
+                    if latest else None,
+                "path": self._storage.run_path,
+                "updated_at": time.time(),
+            }
+            rt.cp_client.notify("kv_put", {
+                "key": f"train_run:{self._run_name}",
+                "value": _json.dumps(payload, default=str).encode()})
+        except Exception:  # noqa: BLE001 — observability must not fail runs
+            pass
 
     def _step(self):
         if self.state in (RunState.INITIALIZING, RunState.RESTARTING,
