@@ -170,20 +170,20 @@ class Autoscaler:
                             {"node_id": node["node_id"]}, timeout=10.0)
                     except Exception:  # noqa: BLE001
                         pass
-                # count at decision time (same as num_launched): a provider
-                # terminate may take seconds tearing the node down, and
-                # observers polling non_terminated_nodes() would see the
-                # node gone before a post-call increment landed — but only
-                # once the provider call is actually in flight; a failed
-                # call (gcloud flake) must not inflate the counter or drop
-                # the idle clock, so the node is retried next reconcile
+                # count at decision time (same as num_launched): providers
+                # drop the node from non_terminated_nodes() DURING the
+                # call, so a post-call increment lets an observer see the
+                # node gone with the counter still short. A failed call
+                # (gcloud flake) must not inflate the counter or drop the
+                # idle clock — roll both back and retry next reconcile.
+                self.num_terminated += 1
                 try:
                     self._provider.terminate_node(name)
                 except Exception:  # noqa: BLE001
+                    self.num_terminated -= 1
                     logger.exception(
                         "terminate_node(%s) failed; will retry", name)
                     continue
-                self.num_terminated += 1
                 self._idle_since.pop(name, None)
 
     def _loop(self) -> None:

@@ -134,6 +134,11 @@ class Config:
     # runtime_env["pip"] needs network access; opt in explicitly
     # (RAY_TPU_ALLOW_RUNTIME_ENV_PIP=1).
     allow_runtime_env_pip: bool = False
+    # Cached runtime-env eviction (ref: _private/runtime_env/uri_cache.py):
+    # LRU over /tmp/ray_tpu_envs, keeping at most max_envs entries; entries
+    # used within min_age_s are never evicted (a live worker may hold one).
+    runtime_env_cache_max_envs: int = 16
+    runtime_env_cache_min_age_s: float = 600.0
     log_dir: str = ""
     # Stream worker stdout/stderr to the driver (ref: _private/log_monitor.py
     # + worker.py log_to_driver).

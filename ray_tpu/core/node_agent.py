@@ -381,8 +381,9 @@ class NodeAgent:
         if runtime_env:
             # materialize BEFORE spawn (reference: runtime_env agent creates
             # the env, then the worker starts inside it)
-            env_vars, env_cwd, pypath, venv_py = materialize_runtime_env(
-                self._pool.get(self.cp_addr), runtime_env)
+            env_vars, env_cwd, pypath, venv_py, container = \
+                materialize_runtime_env(
+                    self._pool.get(self.cp_addr), runtime_env)
             env.update(env_vars)
             if env_cwd:
                 cwd = env_cwd
@@ -420,10 +421,22 @@ class NodeAgent:
         os.makedirs(log_dir, exist_ok=True)
         out_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.out")
         err_path = os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.err")
+        argv = [python_exe, "-m", "ray_tpu.core.worker_main"]
+        if runtime_env and container:
+            # image_uri envs: the worker runs inside the container (shm +
+            # host network shared — the object plane and RPC addresses keep
+            # working; reference image_uri.py worker-in-container). The
+            # container list ends with the image; worker identity env vars
+            # are forwarded explicitly.
+            env_flags: list[str] = []
+            for k, v in env.items():
+                if k.startswith(("RAY_TPU_", "PYTHONPATH", "ARROW_")):
+                    env_flags += ["-e", f"{k}={v}"]
+            argv = container[:-1] + env_flags + [
+                container[-1], "python", "-m", "ray_tpu.core.worker_main"]
         with open(out_path, "ab") as fout, open(err_path, "ab") as ferr:
             proc = subprocess.Popen(
-                [python_exe, "-m", "ray_tpu.core.worker_main"],
-                env=env, cwd=cwd, stdout=fout, stderr=ferr)
+                argv, env=env, cwd=cwd, stdout=fout, stderr=ferr)
         info.proc, info.pid = proc, proc.pid
         info.log_paths = (out_path, err_path)
         with self._lock:

@@ -63,9 +63,15 @@ def prepare_runtime_env(rt, runtime_env: dict | None) -> dict | None:
     if not runtime_env:
         return None
     out = dict(runtime_env)
-    unknown = set(out) - {"env_vars", "working_dir", "py_modules", "pip"}
+    unknown = set(out) - {"env_vars", "working_dir", "py_modules", "pip",
+                          "conda", "image_uri", "container"}
     if unknown:
         raise RuntimeEnvError(f"unsupported runtime_env keys: {unknown}")
+    if out.get("conda") and out.get("pip"):
+        raise RuntimeEnvError("runtime_env cannot combine 'pip' and 'conda'")
+    if out.get("container") and not isinstance(out.get("container"), dict):
+        raise RuntimeEnvError("runtime_env['container'] must be a dict "
+                              "with an 'image' key")
     if out.get("env_vars"):
         if not all(isinstance(k, str) and isinstance(v, str)
                    for k, v in out["env_vars"].items()):
@@ -136,6 +142,7 @@ def _venv_python(spec: dict) -> str:
     py = os.path.join(dest, "bin", "python")
     marker = os.path.join(dest, ".ready")
     if os.path.exists(marker):
+        _touch_entry(marker)
         return py
     os.makedirs(_ENV_ROOT, exist_ok=True)
     tmp = tempfile.mkdtemp(prefix=f"venv-{spec_key}.tmp.", dir=_ENV_ROOT)
@@ -190,6 +197,56 @@ def _venv_python(spec: dict) -> str:
     return py
 
 
+def _touch_entry(path: str) -> None:
+    """Record use of a cached env/package (LRU clock for gc_env_cache)."""
+    try:
+        os.utime(path, None)
+    except OSError:
+        pass
+
+
+def gc_env_cache(root: str = _ENV_ROOT) -> list[str]:
+    """LRU eviction over the cached-env root (reference:
+    _private/runtime_env/uri_cache.py): keep at most
+    runtime_env_cache_max_envs entries; entries whose last use (mtime of
+    the entry's .ready marker, touched on every use) is within
+    runtime_env_cache_min_age_s are never evicted — a live worker may be
+    running out of one. Returns the evicted paths."""
+    import time as _time
+
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    entries = []
+    for name in names:
+        if ".tmp." in name:
+            continue  # mid-materialization
+        path = os.path.join(root, name)
+        if not os.path.isdir(path):
+            continue  # stray file: not a cache entry
+        marker = os.path.join(path, ".ready")
+        clock = marker if os.path.exists(marker) else path
+        try:
+            entries.append((os.path.getmtime(clock), path))
+        except OSError:
+            continue
+    excess = len(entries) - max(0, cfg.runtime_env_cache_max_envs)
+    if excess <= 0:
+        return []
+    now = _time.time()
+    evicted = []
+    for mtime, path in sorted(entries)[:excess]:
+        if now - mtime < cfg.runtime_env_cache_min_age_s:
+            break  # everything after this is younger still
+        shutil.rmtree(path, ignore_errors=True)
+        evicted.append(path)
+    return evicted
+
+
 def env_hash(runtime_env: dict | None) -> str:
     """Stable identity for worker pooling (reference worker_pool env hash)."""
     if not runtime_env:
@@ -204,6 +261,7 @@ def _fetch_pkg(cp_client, uri: str) -> str:
     dest = os.path.join(_ENV_ROOT, key.replace(":", "_"))
     marker = os.path.join(dest, ".ready")
     if os.path.exists(marker):
+        _touch_entry(marker)
         return dest
     data = cp_client.call_with_retry("kv_get", {"key": key}, timeout=60.0)
     if data is None:
@@ -233,13 +291,16 @@ def _fetch_pkg(cp_client, uri: str) -> str:
 
 def materialize_runtime_env(cp_client, runtime_env: dict | None
                             ) -> tuple[dict, str | None, list[str],
-                                       str | None]:
+                                       str | None, list[str] | None]:
     """Agent side (before worker spawn): returns (env_vars, cwd,
-    pythonpath_entries, python_exe) for the worker process. python_exe is
-    non-None when the env carries a pip spec — the worker must run inside
-    that spec's virtualenv."""
+    pythonpath_entries, python_exe, container_prefix) for the worker
+    process. python_exe is non-None when the env carries a pip/conda spec
+    — the worker must run under that interpreter; container_prefix is the
+    docker/podman argv prefix to wrap the worker command with (image_uri
+    envs), raising here — on the node that would run it — when no
+    container runtime exists."""
     if not runtime_env:
-        return {}, None, [], None
+        return {}, None, [], None, None
     env_vars = dict(runtime_env.get("env_vars") or {})
     cwd = None
     pypath: list[str] = []
@@ -253,4 +314,119 @@ def materialize_runtime_env(cp_client, runtime_env: dict | None
     pip = runtime_env.get("pip")
     if pip:
         python_exe = _venv_python(_normalize_pip(pip))
-    return env_vars, cwd, pypath, python_exe
+    conda = runtime_env.get("conda")
+    if conda:
+        if pip:
+            raise RuntimeEnvError(
+                "runtime_env cannot combine 'pip' and 'conda'")
+        prefix = _conda_prefix(conda)
+        python_exe = os.path.join(prefix, "bin", "python")
+        env_vars.setdefault("CONDA_PREFIX", prefix)
+        base_path = env_vars.get("PATH") or os.environ.get("PATH", "")
+        env_vars["PATH"] = os.path.join(prefix, "bin") + os.pathsep + base_path
+    container = _container_command(runtime_env)
+    gc_env_cache()
+    return env_vars, cwd, pypath, python_exe, container
+
+
+def _conda_prefix(conda) -> str:
+    """Resolve a conda runtime_env to an env PREFIX (reference:
+    _private/runtime_env/conda.py). Three forms:
+
+    - ``{"prefix": "/path"}``: use an existing env in place (the
+      reference's named/existing-env reuse — no conda binary needed);
+    - ``"envname"``: resolve against $CONDA_ROOT/envs or ``conda env
+      list`` when the binary exists;
+    - ``{"dependencies": [...]}``: create (spec-hash cached under the
+      LRU-GC'd env root) via the conda binary.
+    """
+    import subprocess
+
+    if isinstance(conda, dict) and conda.get("prefix"):
+        prefix = conda["prefix"]
+        if not os.path.exists(os.path.join(prefix, "bin", "python")):
+            raise RuntimeEnvError(
+                f"conda prefix {prefix!r} has no bin/python")
+        return prefix
+    conda_bin = shutil.which("conda")
+    if isinstance(conda, str):
+        root = os.environ.get("CONDA_ROOT") or os.environ.get("CONDA_PREFIX")
+        if root:
+            cand = os.path.join(root, "envs", conda)
+            if os.path.exists(os.path.join(cand, "bin", "python")):
+                return cand
+        if conda_bin is None:
+            raise RuntimeEnvError(
+                f"conda env {conda!r} not found and no conda binary on "
+                "PATH; use conda={'prefix': '/path/to/env'} for an "
+                "existing env")
+        out = subprocess.run([conda_bin, "env", "list", "--json"],
+                             capture_output=True, timeout=60)
+        for prefix in json.loads(out.stdout or b"{}").get("envs", []):
+            if os.path.basename(prefix) == conda:
+                return prefix
+        raise RuntimeEnvError(f"conda env {conda!r} not found")
+    if not isinstance(conda, dict) or "dependencies" not in conda:
+        raise RuntimeEnvError(
+            "conda runtime_env must be an env name, {'prefix': path}, or "
+            "a spec dict with 'dependencies'")
+    if conda_bin is None:
+        raise RuntimeEnvError(
+            "conda spec runtime_env needs the conda binary on PATH "
+            "(not present in this image); use pip or an existing prefix")
+    spec_key = hashlib.sha1(
+        json.dumps(conda, sort_keys=True).encode()).hexdigest()[:16]
+    dest = os.path.join(_ENV_ROOT, f"conda-{spec_key}")
+    marker = os.path.join(dest, ".ready")
+    if os.path.exists(marker):
+        _touch_entry(marker)
+        return dest
+    os.makedirs(_ENV_ROOT, exist_ok=True)
+    # private tmp dir + atomic rename, same as _venv_python/_fetch_pkg:
+    # concurrent materializations of one spec must never rmtree a racer's
+    # completed env. The spec yml lives OUTSIDE the env root so the LRU gc
+    # never mistakes it for a cache entry.
+    tmp = tempfile.mkdtemp(prefix=f"conda-{spec_key}.tmp.", dir=_ENV_ROOT)
+    env_dir = os.path.join(tmp, "env")
+    try:
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".yml", delete=False) as f:
+            json.dump({"dependencies": conda["dependencies"]}, f)
+            spec_file = f.name
+        try:
+            r = subprocess.run(
+                [conda_bin, "env", "create", "-p", env_dir, "-f", spec_file],
+                capture_output=True, timeout=1800)
+        finally:
+            os.unlink(spec_file)
+        if r.returncode != 0:
+            raise RuntimeEnvError(
+                f"conda env create failed: {r.stderr.decode()[-500:]}")
+        open(os.path.join(env_dir, ".ready"), "w").close()
+        try:
+            os.rename(env_dir, dest)
+        except OSError:
+            if not os.path.exists(marker):
+                raise
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def _container_command(runtime_env: dict) -> list[str] | None:
+    """image_uri/container runtime_env (reference:
+    _private/runtime_env/image_uri.py): returns the docker/podman prefix
+    the worker command should be wrapped with, or raises when no
+    container runtime exists. Gated — this image ships neither."""
+    image = runtime_env.get("image_uri") or (
+        (runtime_env.get("container") or {}).get("image"))
+    if not image:
+        return None
+    for rt_bin in ("podman", "docker"):
+        path = shutil.which(rt_bin)
+        if path:
+            return [path, "run", "--rm", "--network=host",
+                    "-v", "/tmp:/tmp", "-v", "/dev/shm:/dev/shm", image]
+    raise RuntimeEnvError(
+        "runtime_env image_uri/container requires docker or podman on "
+        "PATH (neither is present in this image)")

@@ -309,3 +309,90 @@ class ConcurrencyLimiter(Searcher):
                           error: bool = False) -> None:
         self._live.discard(trial_id)
         self._searcher.on_trial_complete(trial_id, result, error)
+
+
+class RandomSearcher(Searcher):
+    """Independent random sampling under the Searcher protocol (the
+    baseline TPE must beat; reference basic_variant random sampling)."""
+
+    def __init__(self, space: dict, seed: Optional[int] = None):
+        self._flat_space = _flatten(space)
+        for k, v in self._flat_space.items():
+            if isinstance(v, GridSearch):
+                raise ValueError(
+                    f"{k}: grid_search is not a samplable domain; use "
+                    f"choice() with RandomSearcher")
+        self._rng = _random.Random(seed)
+
+    def suggest(self, trial_id: str) -> dict:
+        return _unflatten({
+            k: (v.sample(self._rng) if isinstance(v, Domain) else v)
+            for k, v in self._flat_space.items()})
+
+
+class OptunaSearch(Searcher):
+    """External-searcher adapter backed by optuna (reference:
+    tune/search/optuna/optuna_search.py). The tune Domain space is mapped
+    onto an optuna study's ask/tell interface; any sampler optuna offers
+    (TPE, CMA-ES, ...) drives suggestions.
+
+    optuna is an OPTIONAL dependency: constructing this searcher without
+    it raises ImportError with the install hint (this image ships without
+    optuna — the in-tree TPESearcher covers the same role natively).
+    """
+
+    def __init__(self, space: dict, *, metric: str, mode: str = "max",
+                 sampler=None, seed: Optional[int] = None):
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the 'optuna' package (pip install "
+                "optuna); the in-tree TPESearcher needs no extra "
+                "dependency") from e
+        assert mode in ("max", "min")
+        self._optuna = optuna
+        self._flat_space = _flatten(space)
+        self._metric = metric
+        self._mode = mode
+        if sampler is None:
+            sampler = optuna.samplers.TPESampler(seed=seed)
+        optuna.logging.set_verbosity(optuna.logging.WARNING)
+        self._study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=sampler)
+        self._trials: dict[str, object] = {}
+
+    def _suggest_dim(self, trial, key: str, domain):
+        if isinstance(domain, Choice):
+            return trial.suggest_categorical(key, list(domain.values))
+        if isinstance(domain, LogUniform):
+            return trial.suggest_float(key, domain.low, domain.high, log=True)
+        if isinstance(domain, Uniform):
+            return trial.suggest_float(key, domain.low, domain.high)
+        if isinstance(domain, RandInt):
+            return trial.suggest_int(key, domain.low, domain.high - 1)
+        if isinstance(domain, SampleFrom):
+            raise ValueError(f"{key}: sample_from is not translatable to "
+                             "optuna distributions")
+        if isinstance(domain, GridSearch):
+            raise ValueError(f"{key}: use choice() instead of grid_search "
+                             "with OptunaSearch")
+        return domain  # constant
+
+    def suggest(self, trial_id: str) -> dict:
+        t = self._study.ask()
+        self._trials[trial_id] = t
+        return _unflatten({k: self._suggest_dim(t, k, v)
+                           for k, v in self._flat_space.items()})
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False) -> None:
+        t = self._trials.pop(trial_id, None)
+        if t is None:
+            return
+        value = (result or {}).get(self._metric)
+        if error or value is None:
+            self._study.tell(t, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            self._study.tell(t, float(value))

@@ -239,3 +239,100 @@ def test_cli_status_and_head(tmp_path):
     finally:
         subprocess.run([sys.executable, "-m", "ray_tpu", "stop"],
                        env=env, timeout=60, cwd="/root/repo")
+
+
+def test_env_cache_gc_lru(tmp_path, monkeypatch):
+    """LRU eviction over the cached-env root (reference uri_cache.py):
+    oldest entries beyond the budget go; recently-used entries survive
+    even when over budget (a live worker may hold them)."""
+    import os
+    import time
+
+    from ray_tpu.core import config as cfgmod
+    from ray_tpu.runtime_env.packaging import gc_env_cache
+
+    root = str(tmp_path / "envs")
+    os.makedirs(root)
+    # 5 entries, oldest first; entry 4 has no .ready marker (dir mtime)
+    for i in range(5):
+        d = os.path.join(root, f"venv-{i:02d}")
+        os.makedirs(d)
+        if i != 4:
+            open(os.path.join(d, ".ready"), "w").close()
+        age = (10 - i) * 1000  # older for smaller i
+        ts = time.time() - age
+        os.utime(os.path.join(d, ".ready") if i != 4 else d, (ts, ts))
+
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE_MAX_ENVS", "2")
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE_MIN_AGE_S", "600")
+    cfgmod.reset_config()
+    try:
+        evicted = gc_env_cache(root)
+        left = sorted(os.listdir(root))
+        # budget 2: the 3 oldest evicted
+        assert len(evicted) == 3
+        assert left == ["venv-03", "venv-04"]
+        # min-age shield: make everything recent, over budget -> no eviction
+        now = time.time()
+        for name in left:
+            d = os.path.join(root, name)
+            clock = os.path.join(d, ".ready")
+            os.utime(clock if os.path.exists(clock) else d, (now, now))
+        monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE_MAX_ENVS", "1")
+        cfgmod.reset_config()
+        assert gc_env_cache(root) == []
+        assert sorted(os.listdir(root)) == left
+    finally:
+        monkeypatch.delenv("RAY_TPU_RUNTIME_ENV_CACHE_MAX_ENVS")
+        monkeypatch.delenv("RAY_TPU_RUNTIME_ENV_CACHE_MIN_AGE_S")
+        cfgmod.reset_config()
+
+
+def test_conda_prefix_runtime_env_e2e(ray_start_regular, tmp_path):
+    """Second isolation plugin (reference conda.py): an existing env
+    prefix runs the worker under THAT interpreter — verified end to end by
+    a task reporting its sys.prefix and CONDA_PREFIX."""
+    import subprocess
+    import sys
+
+    prefix = str(tmp_path / "condaenv")
+    subprocess.run([sys.executable, "-m", "venv",
+                    "--system-site-packages", prefix],
+                   check=True, capture_output=True, timeout=300)
+    # the framework must be importable inside the env (same mechanism as
+    # the pip plugin's parent-site .pth)
+    import glob as _glob
+    parent_sites = [p for p in sys.path
+                    if p.rstrip("/").endswith("site-packages")]
+    for sp in _glob.glob(os.path.join(prefix, "lib", "python*",
+                                      "site-packages")):
+        with open(os.path.join(sp, "_rtpu_parent_sites.pth"), "w") as f:
+            f.write("\n".join(parent_sites + [os.getcwd()]) + "\n")
+
+    @ray_tpu.remote(runtime_env={"conda": {"prefix": prefix}})
+    def where():
+        import os as _os
+        import sys as _sys
+        return _sys.prefix, _os.environ.get("CONDA_PREFIX")
+
+    sys_prefix, conda_prefix = ray_tpu.get(where.remote(), timeout=120)
+    assert sys_prefix == prefix
+    assert conda_prefix == prefix
+
+
+def test_container_runtime_env_gates():
+    """image_uri requires a container runtime ON THE EXECUTING NODE; this
+    image has none, so agent-side materialization must fail with a clear
+    error (a docker-ful node would instead get the podman/docker argv
+    prefix the worker command is wrapped with)."""
+    import shutil as _shutil
+
+    from ray_tpu.runtime_env.packaging import (
+        RuntimeEnvError, _container_command, materialize_runtime_env)
+
+    if _shutil.which("docker") or _shutil.which("podman"):
+        cmd = _container_command({"image_uri": "ubuntu:22.04"})
+        assert cmd[-1] == "ubuntu:22.04"
+        return
+    with pytest.raises(RuntimeEnvError, match="docker or podman"):
+        materialize_runtime_env(None, {"image_uri": "ubuntu:22.04"})

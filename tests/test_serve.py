@@ -256,3 +256,54 @@ def test_grpc_ingress(serve_shutdown):
         assert health(b"", timeout=30) == b"\x08\x01"
     finally:
         serve.shutdown()
+
+
+def test_local_testing_mode_composition():
+    """serve.run(_local_testing_mode=True): the whole app runs in-process
+    with no cluster — composed deployments, method routing, and
+    response-as-argument resolution all behave like the real handle
+    surface (reference: serve/_private/local_testing_mode.py)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Embedder:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def embed(self, x):
+            return [v * self.scale for v in x]
+
+    @serve.deployment
+    class Ranker:
+        def __init__(self, embedder):
+            self.embedder = embedder
+
+        def __call__(self, x):
+            emb = self.embedder.options(method_name="embed").remote(x)
+            return sum(emb.result())
+
+        def top(self, x):
+            return max(self.embedder.embed.remote(x).result())
+
+    handle = serve.run(Ranker.bind(Embedder.bind(10)),
+                       _local_testing_mode=True)
+    assert handle.remote([1, 2, 3]).result(timeout_s=30) == 60
+    assert handle.options(method_name="top").remote([1, 5, 2]).result(
+        timeout_s=30) == 50
+    assert handle.top.remote([2, 4]).result(timeout_s=30) == 40
+
+    # a response passed as an argument resolves before the call
+    emb_handle = handle._instance.embedder
+    pre = emb_handle.embed.remote([1, 1])
+    assert handle.remote(pre).result(timeout_s=30) == 200
+
+
+def test_local_testing_mode_function_deployment():
+    from ray_tpu import serve
+
+    @serve.deployment
+    def double(x):
+        return 2 * x
+
+    handle = serve.run(double.bind(), _local_testing_mode=True)
+    assert handle.remote(21).result(timeout_s=30) == 42

@@ -211,3 +211,57 @@ def test_tpe_searcher_unit():
         s.on_trial_complete(f"g{i}", None, error=True)
     a_frac = sum(1 for p in picks if p["opt"] == "a") / len(picks)
     assert a_frac >= 0.6
+
+
+def test_tpe_beats_random_on_fixed_budget():
+    """Validation for the in-tree TPE (VERDICT r3/r4): on a smooth
+    2-D objective with a fixed trial budget, TPE's best-found must beat
+    random search's across seed-paired runs (reference: the optuna/
+    hyperopt integrations are validated the same way)."""
+    from ray_tpu.tune.search import RandomSearcher, TPESearcher, uniform
+
+    def objective(cfg):
+        # unimodal bowl with optimum at (0.3, -0.7); best value 0
+        return -((cfg["x"] - 0.3) ** 2 + (cfg["y"] + 0.7) ** 2)
+
+    space = {"x": uniform(-2, 2), "y": uniform(-2, 2)}
+    budget = 40
+    tpe_wins = 0
+    for seed in range(5):
+        best = {}
+        for name, searcher in (
+                ("tpe", TPESearcher(space, metric="score", mode="max",
+                                    n_initial=8, seed=seed)),
+                ("rnd", RandomSearcher(space, seed=seed))):
+            vals = []
+            for i in range(budget):
+                cfg = searcher.suggest(f"t{i}")
+                score = objective(cfg)
+                searcher.on_trial_complete(f"t{i}", {"score": score})
+                vals.append(score)
+            best[name] = max(vals)
+        if best["tpe"] >= best["rnd"]:
+            tpe_wins += 1
+    assert tpe_wins >= 4, f"TPE won only {tpe_wins}/5 paired runs"
+
+
+def test_optuna_adapter_gates_cleanly():
+    """optuna is optional; without it the adapter must raise a clear
+    ImportError (and with it, drive a short study end-to-end)."""
+    from ray_tpu.tune.search import OptunaSearch, uniform
+
+    space = {"x": uniform(0, 1)}
+    try:
+        import optuna  # noqa: F401
+        have_optuna = True
+    except ImportError:
+        have_optuna = False
+
+    if not have_optuna:
+        with pytest.raises(ImportError, match="optuna"):
+            OptunaSearch(space, metric="score")
+        return
+    s = OptunaSearch(space, metric="score", mode="max", seed=0)
+    for i in range(10):
+        cfg = s.suggest(f"t{i}")
+        s.on_trial_complete(f"t{i}", {"score": -(cfg["x"] - 0.5) ** 2})
