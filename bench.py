@@ -40,8 +40,9 @@ def _peak_tflops(device) -> float:
     return _PEAK_TFLOPS["v5e"]  # conservative default
 
 
-def _run_config(cfg, batch: int, seq: int, steps: int, warmup: int, dev,
-                optimizer: str = "adafactor"):
+def _make_step(cfg, dev, optimizer: str):
+    """Shared recipe for BOTH the static-batch and data-plane runs — one
+    copy so the A/B always compares identical training setups."""
     from ray_tpu.models import llama
     from ray_tpu.train import spmd
 
@@ -57,7 +58,14 @@ def _run_config(cfg, batch: int, seq: int, steps: int, warmup: int, dev,
         params_logical_axes=llama.logical_axes(cfg))
     step = spmd.make_train_step(
         lambda p, b: llama.loss_fn(p, b, cfg, mesh), opt, mesh, sh)
+    return mesh, state, step
 
+
+def _run_config(cfg, batch: int, seq: int, steps: int, warmup: int, dev,
+                optimizer: str = "adafactor"):
+    from ray_tpu.train import spmd
+
+    mesh, state, step = _make_step(cfg, dev, optimizer)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(
         rng.integers(0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)
@@ -75,6 +83,46 @@ def _run_config(cfg, batch: int, seq: int, steps: int, warmup: int, dev,
     float(metrics["loss"])
     dt = time.perf_counter() - t0
     return batch * seq * steps / dt
+
+
+def _run_data_pipeline(cfg, batch: int, seq: int, steps: int, warmup: int,
+                       dev, optimizer: str = "adafactor") -> float:
+    """Same train step, but batches arrive through the REAL Data plane:
+    synthetic tokens generated in Data tasks -> streaming_split ->
+    iter_jax_batches HBM double-buffering (reference:
+    release/train_tests/benchmark/train_benchmark.py drives training
+    through ray.data the same way). Returns tokens/s; the delta vs the
+    static-batch path is the input-pipeline cost."""
+    from ray_tpu import data as rdata
+    from ray_tpu.train import spmd
+
+    mesh, state, step = _make_step(cfg, dev, optimizer)
+    n_rows = (steps + warmup) * batch
+    vocab = cfg.vocab_size
+    seqlen = seq
+
+    def gen_tokens(b: dict) -> dict:
+        rng = np.random.default_rng(int(b["id"][0]))
+        return {"tokens": rng.integers(
+            0, vocab, (len(b["id"]), seqlen + 1)).astype(np.int32)}
+
+    ds = rdata.range(n_rows).map_batches(gen_tokens, batch_size=batch)
+    (it,) = ds.streaming_split(1)
+    sharding = spmd.batch_sharding(mesh, extra_dims=1)
+    batches = it.iter_jax_batches(batch_size=batch, sharding=sharding,
+                                  prefetch_batches=2)
+
+    for _ in range(warmup):
+        state, metrics = step(state, next(batches))
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    n = 0
+    for batch_data in batches:
+        state, metrics = step(state, batch_data)
+        n += 1
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return batch * seq * n / dt
 
 
 def main() -> None:
@@ -116,6 +164,37 @@ def main() -> None:
     best_impl = max(ok, key=ok.get) if ok else "none"
     tok_per_s = ok.get(best_impl, float("nan"))
 
+    # Data-plane A/B: the same step fed through streaming_split ->
+    # iter_jax_batches (tokens generated in Data tasks). Reported as the
+    # input-pipeline cost vs the static-batch headline.
+    data_tps = None
+    if ok:
+        import os as _os
+
+        import ray_tpu
+        from ray_tpu.core import config as _cfgmod
+        try:
+            # Honest overlap: cap the executor's output buffering so block
+            # generation CANNOT pre-complete during warmup (13 tiny blocks
+            # would otherwise all materialize before t0 and the "pipeline
+            # cost" would measure queue pulls only), and run 3x the steps
+            # so most generation lands inside the timed region.
+            _os.environ.setdefault("RAY_TPU_DATA_OP_OUTPUT_BUFFER_BYTES",
+                                   str(64 * 1024))
+            _cfgmod.reset_config()
+            ray_tpu.init(num_cpus=4)
+            cfg = dataclasses.replace(base, attn_impl=best_impl)
+            data_tps = round(_run_data_pipeline(
+                cfg, batch, seq, steps * 3, warmup, dev,
+                optimizer=optimizer), 1)
+        except Exception as e:  # noqa: BLE001 — A/B must not sink the bench
+            print(f"# data pipeline A/B failed: {e!r}", file=sys.stderr)
+        finally:
+            try:
+                ray_tpu.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+
     n_params = llama.num_params(base)
     peak = _peak_tflops(dev)
 
@@ -137,6 +216,10 @@ def main() -> None:
             "params": n_params,
             "batch": batch, "seq": seq,
             "device": getattr(dev, "device_kind", str(dev)),
+            "data_pipeline_tokens_per_s": data_tps,
+            "data_pipeline_cost_pct": round(
+                100.0 * (1.0 - data_tps / tok_per_s), 2)
+            if data_tps and tok_per_s == tok_per_s else None,
         },
     }))
 
