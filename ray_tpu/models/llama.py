@@ -48,6 +48,14 @@ class LlamaConfig:
     # once); bigger = less scan serialization, more HBM. T (or more) = one
     # chunk, i.e. effectively unchunked.
     ce_chunk: int = 256
+    # Rematerialize CE logits in the backward (checkpoint on the CE chunk
+    # body). True = recompute the lm_head matmul in bwd, smallest peak HBM.
+    # False = keep each chunk's fp32 logits as residuals — one extra
+    # B*T*V fp32 tensor live across the backward, but the recompute matmul
+    # disappears: measured 33 ms/step (0.572 -> 0.60 MFU) at 1.5B/b4/
+    # seq2048 on one v5e where the 4.2 GB residual fits. Keep True for
+    # HBM-tight configs (bigger batch/model per chip).
+    ce_remat: bool = True
     # MLP matmul implementation for the TRAIN path: "bf16" (default) or
     # "int8" — dynamic per-tensor symmetric quantization of both operands
     # into the MXU's int8 path (2x bf16 peak on v5e), fp32 accumulation,
@@ -347,13 +355,19 @@ def hidden_states(params, tokens, cfg: LlamaConfig, mesh=None):
     return rms_norm(x, params["final_norm"], cfg.norm_eps)
 
 
-def chunked_cross_entropy(lm_head, hidden, targets, chunk: int = 256):
+def chunked_cross_entropy(lm_head, hidden, targets, chunk: int = 256,
+                          remat: bool = True):
     """Next-token CE without ever materializing fp32 [B, T, vocab].
 
     The naive log_softmax over the full sequence allocates B·T·V fp32 —
     7.8 GiB at B=8, T=2048, V=128k, more than half a v5e's HBM. Scanning
     sequence chunks keeps the live logits at B·chunk·V and lets XLA overlap
     the lm_head matmul of one chunk with the reduction of the previous.
+
+    ``remat=False`` drops the checkpoint: each chunk's fp32 logits persist
+    as backward residuals (full B·T·V again, but live only across the CE
+    backward region) in exchange for skipping the lm_head recompute matmul
+    — measured 33 ms/step at 1.5B/b4/seq2048 (see LlamaConfig.ce_remat).
     """
     b, t, d = hidden.shape
     chunk = min(chunk, t)
@@ -367,9 +381,6 @@ def chunked_cross_entropy(lm_head, hidden, targets, chunk: int = 256):
     hid = hidden.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
     tgt = targets.reshape(b, n, chunk).transpose(1, 0, 2)
 
-    # checkpoint: without it the scan's backward saves EVERY chunk's fp32
-    # logits as residuals — the full B·T·V tensor again
-    @jax.checkpoint
     def body(acc, xs):
         h, y = xs
         logits = (h @ lm_head).astype(jnp.float32)       # [B, chunk, V]
@@ -379,6 +390,10 @@ def chunked_cross_entropy(lm_head, hidden, targets, chunk: int = 256):
         ll = jnp.where(y >= 0, ll, 0.0)  # padded positions contribute 0
         return acc + jnp.sum(ll), None
 
+    if remat:
+        # checkpoint: without it the scan's backward saves EVERY chunk's
+        # fp32 logits as residuals — the full B·T·V tensor again
+        body = jax.checkpoint(body)
     total, _ = jax.lax.scan(body, jnp.float32(0.0), (hid, tgt))
     return -total / (b * t)
 
@@ -389,7 +404,7 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     hidden = hidden_states(params, inputs, cfg, mesh)
     return chunked_cross_entropy(params["lm_head"], hidden, targets,
-                                 chunk=cfg.ce_chunk)
+                                 chunk=cfg.ce_chunk, remat=cfg.ce_remat)
 
 
 # ---------------------------------------------------------------------------

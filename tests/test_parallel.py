@@ -229,10 +229,21 @@ def test_chunked_cross_entropy_matches_full():
     logp = jax.nn.log_softmax(logits, axis=-1)
     full = -jnp.mean(
         jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0])
+    grad_ref = None
     for chunk in (16, 63, 200):
-        c = llama.chunked_cross_entropy(
-            params["lm_head"], hidden, targets, chunk=chunk)
-        assert abs(float(c - full)) < 1e-4, chunk
+        for remat in (True, False):
+            c = llama.chunked_cross_entropy(
+                params["lm_head"], hidden, targets, chunk=chunk, remat=remat)
+            assert abs(float(c - full)) < 1e-4, (chunk, remat)
+            # both remat modes must produce identical lm_head gradients
+            # (remat only changes WHEN logits exist, never the math)
+            g = jax.grad(lambda w: llama.chunked_cross_entropy(
+                w, hidden, targets, chunk=chunk, remat=remat))(
+                params["lm_head"])
+            if grad_ref is None:
+                grad_ref = g
+            else:
+                assert jnp.allclose(g, grad_ref, atol=1e-5), (chunk, remat)
 
 
 def test_default_optimizer_names():
