@@ -33,6 +33,10 @@ class AlgorithmConfig:
     gamma: float = 0.99
     seed: int = 0
     train_kwargs: dict = field(default_factory=dict)
+    # connector pipeline FACTORIES (rllib/connectors/ analog): callables
+    # returning a Connector/ConnectorPipeline; one instance per runner
+    env_to_module_connector: Any = None
+    learner_connector: Any = None
 
     # builder-style setters (ref: algorithm_config.py fluent API)
     def environment(self, env) -> "AlgorithmConfig":
@@ -44,6 +48,14 @@ class AlgorithmConfig:
         self.num_env_runners = num_env_runners
         if rollout_steps is not None:
             self.rollout_steps = rollout_steps
+        return self
+
+    def connectors(self, *, env_to_module=None,
+                   learner=None) -> "AlgorithmConfig":
+        if env_to_module is not None:
+            self.env_to_module_connector = env_to_module
+        if learner is not None:
+            self.learner_connector = learner
         return self
 
     def training(self, **kw) -> "AlgorithmConfig":
@@ -69,10 +81,22 @@ class Algorithm:
 
         self.config = config
         probe = make_env(config.env_spec)
-        self.module = RLModule(probe.observation_dim, probe.num_actions,
+        # the driver keeps its OWN env-to-module pipeline instance: it
+        # sizes the module from the FILTERED observation (shape-changing
+        # connectors like FrameStack widen it), filters evaluation
+        # observations identically to training, and merges/broadcasts the
+        # per-runner filter states each iteration
+        self._env_to_module = (config.env_to_module_connector()
+                               if config.env_to_module_connector else None)
+        probe_obs = np.asarray(probe.reset(seed=0), np.float32)
+        if self._env_to_module is not None:
+            probe_obs = np.asarray(self._env_to_module(probe_obs))
+        self.module = RLModule(int(probe_obs.shape[-1]), probe.num_actions,
                                hidden=config.hidden)
         self.params = self.module.init(jax.random.PRNGKey(config.seed))
         self.runners = EnvRunnerGroup(config.env_spec, self.module,
+                                      env_to_module_fn=config.env_to_module_connector,
+                                      learner_connector_fn=config.learner_connector,
                                       num_runners=config.num_env_runners,
                                       seed=config.seed)
         self._iter = 0
@@ -91,6 +115,17 @@ class Algorithm:
         t0 = time.monotonic()
         metrics = self.training_step()
         self._iter += 1
+        if self._env_to_module is not None:
+            # merge per-runner stateful-connector states (Welford combine
+            # for the mean/std filter) and broadcast back, so every runner
+            # and the driver's eval pipeline normalize identically
+            # (reference: connector state synced through the learner group)
+            states = [st for st in self.runners.connector_states()
+                      if st is not None]
+            if states:
+                merged = self._env_to_module.merge_states(states)
+                self.runners.set_connector_states(merged)
+                self._env_to_module.set_state(merged)
         stats = self.runners.episode_stats()
         rets = stats["episode_returns"]
         return {
@@ -103,6 +138,12 @@ class Algorithm:
         }
 
     def compute_single_action(self, obs, explore: bool = False) -> int:
+        if self._env_to_module is not None:
+            # same preprocessing the policy trained on, without polluting
+            # the running statistics from evaluation streams
+            frozen = getattr(self._env_to_module, "frozen", None)
+            obs = frozen(obs) if frozen is not None \
+                else self._env_to_module(obs)
         logits = np.asarray(
             self.module.forward_inference(self.params, np.asarray(obs)[None]))[0]
         if explore:

@@ -41,3 +41,86 @@ class ReplayBuffer:
         return {"obs": self._obs[idx], "next_obs": self._next_obs[idx],
                 "actions": self._actions[idx], "rewards": self._rewards[idx],
                 "dones": self._dones[idx]}
+
+
+class _SumTree:
+    """Binary sum-tree over leaf priorities: O(log n) update and
+    prefix-sum sampling (ref: rllib/utils/replay_buffers/segment_tree)."""
+
+    def __init__(self, capacity: int):
+        self._cap = 1
+        while self._cap < capacity:
+            self._cap *= 2
+        self._tree = np.zeros(2 * self._cap, np.float64)
+
+    def set(self, idx: np.ndarray, value: np.ndarray) -> None:
+        i = np.asarray(idx) + self._cap
+        self._tree[i] = value
+        # all leaves share one depth, so every index walks to the root in
+        # lockstep; one vectorized parent recompute per level
+        i //= 2
+        while i[0] >= 1 if np.ndim(i) else i >= 1:
+            uj = np.unique(i)
+            uj = uj[uj >= 1]
+            if not len(uj):
+                break
+            self._tree[uj] = self._tree[2 * uj] + self._tree[2 * uj + 1]
+            i = uj // 2
+
+    def total(self) -> float:
+        return float(self._tree[1])
+
+    def prefix_index(self, mass: np.ndarray) -> np.ndarray:
+        """Leaf index whose cumulative-priority interval contains mass."""
+        mass = np.asarray(mass, np.float64).copy()
+        idx = np.ones(len(mass), np.int64)
+        while idx[0] < self._cap:
+            left = 2 * idx
+            left_sum = self._tree[left]
+            go_right = mass > left_sum
+            mass = np.where(go_right, mass - left_sum, mass)
+            idx = np.where(go_right, left + 1, left)
+        return idx - self._cap
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (ref:
+    rllib/utils/replay_buffers/prioritized_replay_buffer.py — the PER
+    scheme): sample probability ~ priority^alpha via a sum-tree,
+    importance-sampling weights (1/(N*P))^beta returned per sample, and
+    update_priorities(idx, td_error) after each learner step."""
+
+    def __init__(self, capacity: int, observation_dim: int, seed: int = 0,
+                 alpha: float = 0.6, beta: float = 0.4):
+        super().__init__(capacity, observation_dim, seed=seed)
+        self._alpha = alpha
+        self.beta = beta
+        self._tree = _SumTree(capacity)
+        self._max_prio = 1.0
+
+    def add_batch(self, batch: dict) -> None:
+        n = len(batch["actions"])
+        idx = (self._head + np.arange(n)) % self._cap
+        super().add_batch(batch)
+        # new experience enters at max priority so it is seen at least once
+        self._tree.set(idx, np.full(n, self._max_prio ** self._alpha))
+
+    def sample(self, batch_size: int) -> dict:
+        total = self._tree.total()
+        mass = self._rng.uniform(0.0, total, batch_size)
+        idx = self._tree.prefix_index(mass)
+        idx = np.minimum(idx, self._size - 1)
+        prios = self._tree._tree[idx + self._tree._cap]
+        probs = np.maximum(prios, 1e-12) / max(total, 1e-12)
+        weights = (self._size * probs) ** (-self.beta)
+        weights = weights / weights.max()
+        return {"obs": self._obs[idx], "next_obs": self._next_obs[idx],
+                "actions": self._actions[idx], "rewards": self._rewards[idx],
+                "dones": self._dones[idx],
+                "weights": weights.astype(np.float32),
+                "idx": idx.astype(np.int64)}
+
+    def update_priorities(self, idx: np.ndarray, td_errors: np.ndarray) -> None:
+        prio = np.abs(np.asarray(td_errors, np.float64)) + 1e-6
+        self._max_prio = max(self._max_prio, float(prio.max()))
+        self._tree.set(np.asarray(idx), prio ** self._alpha)

@@ -1,0 +1,246 @@
+"""Connector pipelines — composable batch transforms between env, module,
+and learner.
+
+TPU-native analog of the reference connector stack (rllib/connectors/ —
+ConnectorV2 with env-to-module, module-to-env, and learner pipelines):
+each connector is a pure callable over the COLUMN BATCH dicts this
+runtime's env runners and algorithms already speak, so custom
+preprocessing/postprocessing composes into any algorithm without
+subclassing it. Stateful connectors (the running obs filter) expose
+get_state/set_state so runner-side copies can be synced from the learner
+(reference: connector state in the learner group).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+class Connector:
+    """One transform stage (reference ConnectorV2). ``__call__`` receives a
+    dict batch (or a single observation array for env-to-module use) and
+    returns the transformed value."""
+
+    def __call__(self, batch: Any) -> Any:
+        raise NotImplementedError
+
+    # stateful connectors override; stateless return None/ignore
+    def get_state(self) -> Optional[dict]:
+        return None
+
+    def set_state(self, state: Optional[dict]) -> None:
+        pass
+
+    def merge_states(self, states: list):
+        """Combine per-runner states into one (driver-side sync each
+        iteration). Default: first non-None wins (stateless/unmergeable)."""
+        return next((s for s in states if s is not None), None)
+
+    def frozen(self, batch: Any) -> Any:
+        """Apply WITHOUT mutating running statistics (evaluation path)."""
+        return self(batch)
+
+    def reset(self) -> None:
+        """Episode boundary (e.g. FrameStack clears its window)."""
+
+
+class ConnectorPipeline(Connector):
+    """Ordered composition of connectors (reference pipeline semantics)."""
+
+    def __init__(self, connectors: list):
+        self.connectors = list(connectors)
+
+    def __call__(self, batch: Any) -> Any:
+        for c in self.connectors:
+            batch = c(batch)
+        return batch
+
+    def get_state(self) -> dict:
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: Optional[dict]) -> None:
+        for i, c in enumerate(self.connectors):
+            if state and state.get(i) is not None:
+                c.set_state(state[i])
+
+    def merge_states(self, states: list) -> dict:
+        return {i: c.merge_states([st.get(i) if st else None
+                                   for st in states])
+                for i, c in enumerate(self.connectors)}
+
+    def frozen(self, batch: Any) -> Any:
+        for c in self.connectors:
+            batch = c.frozen(batch)
+        return batch
+
+    def reset(self) -> None:
+        for c in self.connectors:
+            c.reset()
+
+    def append(self, connector: Connector) -> "ConnectorPipeline":
+        self.connectors.append(connector)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# env-to-module (observation preprocessing)
+# ---------------------------------------------------------------------------
+
+class MeanStdFilter(Connector):
+    """Running mean/std observation normalization (reference
+    connectors/env_to_module/mean_std_filter.py). Welford accumulation;
+    the update can be frozen (evaluation) and state synced across
+    runners."""
+
+    def __init__(self, shape: tuple, clip: float = 10.0,
+                 update: bool = True):
+        self._mean = np.zeros(shape, np.float64)
+        self._m2 = np.zeros(shape, np.float64)
+        self._count = 1e-4
+        # DELTA accumulator: samples seen since the last get_state harvest.
+        # Sync merges deltas into the global filter and broadcasts totals —
+        # re-merging absolute states would double-count the shared base
+        # every iteration (the reference filter keeps the same split).
+        self._d_mean = np.zeros(shape, np.float64)
+        self._d_m2 = np.zeros(shape, np.float64)
+        self._d_count = 0.0
+        self._clip = clip
+        self.update_enabled = update
+
+    def __setstate__(self, state):
+        # unpickling via the object plane hands back READ-ONLY zero-copy
+        # array views; the Welford accumulators mutate in place
+        self.__dict__.update(state)
+        for name in ("_mean", "_m2", "_d_mean", "_d_m2"):
+            setattr(self, name, np.array(getattr(self, name)))
+
+    def __call__(self, obs):
+        arr = np.asarray(obs, np.float64)
+        rows = arr if arr.ndim > self._mean.ndim else arr[None]
+        if self.update_enabled:
+            for row in rows:
+                self._count += 1.0
+                d = row - self._mean
+                self._mean += d / self._count
+                self._m2 += d * (row - self._mean)
+                self._d_count += 1.0
+                dd = row - self._d_mean
+                self._d_mean += dd / self._d_count
+                self._d_m2 += dd * (row - self._d_mean)
+        std = np.sqrt(self._m2 / self._count) + 1e-8
+        out = np.clip((arr - self._mean) / std, -self._clip, self._clip)
+        return out.astype(np.float32)
+
+    def get_state(self) -> dict:
+        """Snapshot totals AND harvest the since-last-sync delta (the
+        delta accumulator clears — sync consumes it exactly once)."""
+        state = {"mean": self._mean.copy(), "m2": self._m2.copy(),
+                 "count": self._count,
+                 "delta": {"mean": self._d_mean.copy(),
+                           "m2": self._d_m2.copy(),
+                           "count": self._d_count}}
+        self._d_mean = np.zeros_like(self._d_mean)
+        self._d_m2 = np.zeros_like(self._d_m2)
+        self._d_count = 0.0
+        return state
+
+    def set_state(self, state: Optional[dict]) -> None:
+        if state:
+            self._mean = np.asarray(state["mean"], np.float64).copy()
+            self._m2 = np.asarray(state["m2"], np.float64).copy()
+            self._count = float(state["count"])
+
+    def merge_states(self, states: list):
+        """Combine harvested runner DELTAS into this (driver) filter's
+        totals via parallel Welford; returns the new totals to broadcast."""
+        count, mean, m2 = self._count, self._mean.copy(), self._m2.copy()
+        for s in states:
+            if not s:
+                continue
+            d_state = s.get("delta") or s
+            c2 = float(d_state["count"])
+            if c2 <= 0:
+                continue
+            mu2 = np.asarray(d_state["mean"], np.float64)
+            m22 = np.asarray(d_state["m2"], np.float64)
+            d = mu2 - mean
+            tot = count + c2
+            m2 = m2 + m22 + d * d * count * c2 / tot
+            mean = mean + d * c2 / tot
+            count = tot
+        return {"mean": mean, "m2": m2, "count": count}
+
+    def frozen(self, obs):
+        prev = self.update_enabled
+        self.update_enabled = False
+        try:
+            return self(obs)
+        finally:
+            self.update_enabled = prev
+
+
+class FrameStack(Connector):
+    """Stack the last N observations along the feature axis (reference
+    frame-stacking env-to-module connector). Call reset() at episode
+    boundaries."""
+
+    def __init__(self, shape: tuple, n: int = 4):
+        self._n = n
+        self._shape = tuple(shape)
+        self._frames = [np.zeros(self._shape, np.float32)
+                        for _ in range(n)]
+
+    def reset(self) -> None:
+        self._frames = [np.zeros(self._shape, np.float32)
+                        for _ in range(self._n)]
+
+    def __call__(self, obs):
+        self._frames.pop(0)
+        self._frames.append(np.asarray(obs, np.float32))
+        return np.concatenate(self._frames, axis=-1)
+
+
+class FlattenObs(Connector):
+    """Flatten structured observations to one vector (reference
+    flatten_observations connector)."""
+
+    def __call__(self, obs):
+        if isinstance(obs, dict):
+            return np.concatenate(
+                [np.asarray(obs[k], np.float32).ravel()
+                 for k in sorted(obs)])
+        return np.asarray(obs, np.float32).ravel()
+
+
+# ---------------------------------------------------------------------------
+# learner pipeline (batch transforms before the update)
+# ---------------------------------------------------------------------------
+
+class ClipRewards(Connector):
+    """Clip batch rewards into [-limit, limit] (reference learner-side
+    reward clipping)."""
+
+    def __init__(self, limit: float = 1.0):
+        self._limit = limit
+
+    def __call__(self, batch: dict) -> dict:
+        out = dict(batch)
+        out["rewards"] = np.clip(batch["rewards"], -self._limit, self._limit)
+        return out
+
+
+class StandardizeFields(Connector):
+    """Zero-mean/unit-std selected batch columns (the reference's
+    advantage standardization as a connector)."""
+
+    def __init__(self, fields: list):
+        self._fields = list(fields)
+
+    def __call__(self, batch: dict) -> dict:
+        out = dict(batch)
+        for f in self._fields:
+            v = np.asarray(batch[f], np.float32)
+            out[f] = (v - v.mean()) / (v.std() + 1e-8)
+        return out

@@ -12,17 +12,26 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.buffer import ReplayBuffer
+from ray_tpu.rllib.buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.env import make_env
 
 
 class DQN(Algorithm):
     def setup(self) -> None:
         kw = self.config.train_kwargs
-        self._buffer = ReplayBuffer(
-            kw.get("buffer_size", 50_000),
-            make_env(self.config.env_spec).observation_dim,
-            seed=self.config.seed)
+        obs_dim = make_env(self.config.env_spec).observation_dim
+        # "prioritized" -> proportional PER with IS weights + TD-error
+        # priority updates (ref: dqn.py replay_buffer_config)
+        self._prioritized = kw.get("replay_buffer", "uniform") == "prioritized"
+        if self._prioritized:
+            self._buffer = PrioritizedReplayBuffer(
+                kw.get("buffer_size", 50_000), obs_dim,
+                seed=self.config.seed, alpha=kw.get("per_alpha", 0.6),
+                beta=kw.get("per_beta", 0.4))
+        else:
+            self._buffer = ReplayBuffer(
+                kw.get("buffer_size", 50_000), obs_dim,
+                seed=self.config.seed)
         self._batch_size = kw.get("train_batch_size", 128)
         self._updates_per_iter = kw.get("updates_per_iter", 128)
         # hard target copy once per iteration by default: near-online targets
@@ -49,13 +58,17 @@ class DQN(Algorithm):
             next_q = jnp.take_along_axis(next_target, next_a[:, None], axis=1)[:, 0]
             target = b["rewards"] + gamma * (1.0 - b["dones"]) * \
                 jax.lax.stop_gradient(next_q)
-            return ((q_sa - target) ** 2).mean()
+            td = q_sa - target
+            # importance weights correct the prioritized sampling bias
+            # (uniform replay passes ones)
+            return (b["weights"] * td ** 2).mean(), td
 
         @jax.jit
         def update(params, target_params, opt_state, b):
-            loss, grads = jax.value_and_grad(loss_fn)(params, target_params, b)
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, b)
             updates, opt_state = self._opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+            return optax.apply_updates(params, updates), opt_state, loss, td
 
         self._update = update
 
@@ -79,8 +92,12 @@ class DQN(Algorithm):
         loss = 0.0
         for i in range(self._updates_per_iter):
             b = self._buffer.sample(self._batch_size)
-            self.params, self._opt_state, loss = self._update(
+            idx = b.pop("idx", None)
+            b.setdefault("weights", np.ones(self._batch_size, np.float32))
+            self.params, self._opt_state, loss, td = self._update(
                 self.params, self._target, self._opt_state, b)
+            if self._prioritized and idx is not None:
+                self._buffer.update_priorities(idx, np.asarray(td))
             if (i + 1) % self._target_update_freq == 0:
                 self._target = jax.tree.map(jnp.copy, self.params)
         return {"loss": float(loss), "epsilon": self._epsilon(),

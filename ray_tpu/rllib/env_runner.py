@@ -22,13 +22,20 @@ from ray_tpu.rllib.models import RLModule
 
 @ray_tpu.remote
 class EnvRunner:
-    def __init__(self, env_spec, module: RLModule, seed: int = 0):
+    def __init__(self, env_spec, module: RLModule, seed: int = 0,
+                 env_to_module=None, learner_connector=None):
+        """``env_to_module``: Connector(Pipeline) applied to every raw
+        observation before inference (and recorded into the batch);
+        ``learner_connector``: applied to the finished column batch
+        (reference: rllib/connectors/ env-to-module + learner pipelines)."""
         import jax
 
         self._env = make_env(env_spec)
         self._module = module
         self._rng = np.random.default_rng(seed)
-        self._obs = self._env.reset(seed=seed)
+        self._env_to_module = env_to_module
+        self._learner_connector = learner_connector
+        self._obs = self._filter(self._env.reset(seed=seed))
         self._ep_return = 0.0
         self._ep_len = 0
         self._done_returns: list[float] = []
@@ -37,6 +44,20 @@ class EnvRunner:
         self._value_fn = jax.jit(
             lambda p, o: module.forward_train(p, o)[1])
 
+    def _filter(self, obs):
+        return self._env_to_module(obs) if self._env_to_module is not None \
+            else obs
+
+    def connector_state(self) -> dict | None:
+        """Stateful env-to-module connector state (e.g. the running
+        mean/std filter) for learner-side syncing."""
+        return self._env_to_module.get_state() \
+            if self._env_to_module is not None else None
+
+    def set_connector_state(self, state) -> None:
+        if self._env_to_module is not None and state is not None:
+            self._env_to_module.set_state(state)
+
     def sample(self, params: dict, num_steps: int, *,
                explore: bool = True, epsilon: float = 0.0) -> dict:
         """Collect num_steps transitions with the given policy params.
@@ -44,7 +65,8 @@ class EnvRunner:
         Returns a column batch: obs, actions, rewards, dones, next_obs,
         logp (behavior log-prob, for PPO), vf (bootstrap values).
         """
-        obs = np.empty((num_steps, self._env.observation_dim), np.float32)
+        obs_dim = int(np.asarray(self._obs).shape[-1])  # FILTERED width
+        obs = np.empty((num_steps, obs_dim), np.float32)
         next_obs = np.empty_like(obs)
         actions = np.empty((num_steps,), np.int32)
         rewards = np.empty((num_steps,), np.float32)
@@ -65,6 +87,7 @@ class EnvRunner:
             z = logits - logits.max()
             logps[t] = z[a] - np.log(np.exp(z).sum())
             o2, r, term, trunc = self._env.step(a)
+            o2 = self._filter(o2)
             actions[t], rewards[t] = a, r
             dones[t] = float(term)  # truncation is not a terminal for GAE
             next_obs[t] = o2
@@ -74,14 +97,19 @@ class EnvRunner:
                 self._done_returns.append(self._ep_return)
                 self._done_lens.append(self._ep_len)
                 self._ep_return, self._ep_len = 0.0, 0
-                o2 = self._env.reset()
+                if self._env_to_module is not None:
+                    self._env_to_module.reset()  # e.g. FrameStack window
+                o2 = self._filter(self._env.reset())
             self._obs = o2
 
-        return {"obs": obs, "actions": actions, "rewards": rewards,
-                "dones": dones, "next_obs": next_obs, "logp": logps,
-                "vf": np.asarray(self._value_fn(params, obs)),
-                "last_obs": self._obs.copy(),
-                "last_done": 0.0}
+        batch = {"obs": obs, "actions": actions, "rewards": rewards,
+                 "dones": dones, "next_obs": next_obs, "logp": logps,
+                 "vf": np.asarray(self._value_fn(params, obs)),
+                 "last_obs": self._obs.copy(),
+                 "last_done": 0.0}
+        if self._learner_connector is not None:
+            batch = self._learner_connector(batch)
+        return batch
 
     def episode_stats(self) -> dict:
         """Drain completed-episode stats since the last call."""
@@ -94,10 +122,26 @@ class EnvRunnerGroup:
     """Fan-out over n EnvRunner actors (ref: env_runner_group.py)."""
 
     def __init__(self, env_spec, module: RLModule, num_runners: int = 2,
-                 seed: int = 0):
+                 seed: int = 0, env_to_module_fn=None, learner_connector_fn=None):
+        """Connector FACTORIES (not instances): each runner builds its own
+        stateful pipeline; sync via connector_states()/set_connector_states
+        (reference: per-runner connector state synced by the learner)."""
         env_spec = resolve_env_spec(env_spec)
-        self._runners = [EnvRunner.remote(env_spec, module, seed=seed + i)
-                         for i in range(num_runners)]
+        self._runners = [
+            EnvRunner.remote(
+                env_spec, module, seed=seed + i,
+                env_to_module=env_to_module_fn() if env_to_module_fn else None,
+                learner_connector=learner_connector_fn()
+                if learner_connector_fn else None)
+            for i in range(num_runners)]
+
+    def connector_states(self) -> list:
+        return ray_tpu.get([r.connector_state.remote()
+                            for r in self._runners], timeout=60.0)
+
+    def set_connector_states(self, state) -> None:
+        ray_tpu.get([r.set_connector_state.remote(state)
+                     for r in self._runners], timeout=60.0)
 
     def sample(self, params, steps_per_runner: int, **kw) -> list[dict]:
         params_ref = ray_tpu.put(params)  # one broadcast, n consumers

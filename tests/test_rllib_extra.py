@@ -142,3 +142,132 @@ def test_multi_agent_ppo_learns_coordination(rt):
         assert trainer.mean_step_reward(num_steps=128) >= 0.7
     finally:
         trainer.stop()
+
+
+def test_connector_pipeline_and_mean_std_filter():
+    """Connector composition + the stateful running filter incl. state
+    sync (reference: rllib/connectors/ ConnectorV2 pipelines)."""
+    import numpy as np
+
+    from ray_tpu.rllib.connectors import (ClipRewards, ConnectorPipeline,
+                                          MeanStdFilter, StandardizeFields)
+
+    f = MeanStdFilter(shape=(3,))
+    rng = np.random.default_rng(0)
+    data = rng.normal(5.0, 2.0, (500, 3))
+    out = np.stack([f(row) for row in data])
+    # after enough samples, normalized stream is ~zero-mean unit-std
+    assert abs(out[-100:].mean()) < 0.3
+    assert 0.5 < out[-100:].std() < 1.5
+    # state sync: a fresh filter with copied state normalizes identically
+    g = MeanStdFilter(shape=(3,), update=False)
+    g.set_state(f.get_state())
+    probe = rng.normal(5.0, 2.0, (3,))
+    f.update_enabled = False
+    assert np.allclose(f(probe), g(probe))
+
+    pipe = ConnectorPipeline([ClipRewards(1.0),
+                              StandardizeFields(["advantages"])])
+    batch = {"rewards": np.array([-5.0, 0.5, 7.0]),
+             "advantages": np.array([1.0, 2.0, 3.0])}
+    out = pipe(batch)
+    assert np.allclose(out["rewards"], [-1.0, 0.5, 1.0])
+    assert abs(out["advantages"].mean()) < 1e-6
+    # original batch untouched (connectors copy)
+    assert batch["rewards"][0] == -5.0
+
+
+def test_prioritized_replay_buffer_sampling():
+    import numpy as np
+
+    from ray_tpu.rllib.buffer import PrioritizedReplayBuffer
+
+    b = PrioritizedReplayBuffer(128, 2, seed=0, alpha=1.0, beta=1.0)
+    for i in range(8):
+        b.add_batch({"obs": np.ones((16, 2)) * i,
+                     "next_obs": np.zeros((16, 2)),
+                     "actions": np.full(16, i, np.int32),
+                     "rewards": np.ones(16), "dones": np.zeros(16)})
+    s = b.sample(64)
+    assert set(s) >= {"obs", "actions", "weights", "idx"}
+    # after spiking one index's priority it dominates sampling
+    prios = np.full(128, 1e-3)
+    prios[42] = 50.0
+    b.update_priorities(np.arange(128), prios)
+    s2 = b.sample(512)
+    assert (s2["idx"] == 42).mean() > 0.5
+    # IS weights are <= 1 and smallest for the over-sampled index
+    assert s2["weights"].max() <= 1.0 + 1e-6
+    w42 = s2["weights"][s2["idx"] == 42]
+    assert w42.mean() < np.median(s2["weights"]) + 1e-6
+
+
+def test_dqn_prioritized_learns(ray_start_regular):
+    """DQN with the PER buffer still learns the chain env (the composable
+    extension point exercised through a full algorithm)."""
+    from ray_tpu import rllib
+
+    algo = (rllib.DQNConfig()
+            .environment("RandomWalk")
+            .env_runners(1, rollout_steps=128)
+            .training(lr=1e-3, gamma=0.95, seed=3,
+                      replay_buffer="prioritized",
+                      buffer_size=10_000, learning_starts=200,
+                      epsilon_anneal_iters=5)
+            .build())
+    try:
+        for _ in range(10):
+            res = algo.train()
+        assert res["loss"] is not None
+        ev = algo.evaluate(num_episodes=10, max_steps=50)
+        assert ev["episode_return_mean"] >= 0.9, ev
+    finally:
+        algo.stop()
+
+
+def test_env_to_module_connector_in_runner(ray_start_regular):
+    """A MeanStdFilter env-to-module pipeline threads through config ->
+    runner group -> sample batches, with state retrievable for sync."""
+    import numpy as np
+
+    from ray_tpu import rllib
+    from ray_tpu.rllib.connectors import ConnectorPipeline, MeanStdFilter
+
+    algo = (rllib.PPOConfig()
+            .environment("CartPole")
+            .env_runners(1, rollout_steps=128)
+            .connectors(env_to_module=lambda: ConnectorPipeline(
+                [MeanStdFilter(shape=(4,))]))
+            .training(seed=0)
+            .build())
+    try:
+        algo.train()
+        states = algo.runners.connector_states()
+        assert states and states[0] is not None
+        count = states[0][0]["count"]
+        assert count > 100  # the filter saw the rollout stream
+    finally:
+        algo.stop()
+
+
+def test_frame_stack_connector_resizes_module(ray_start_regular):
+    """A shape-changing env-to-module connector (FrameStack) widens the
+    module input and runs end to end, with the stack window cleared at
+    episode boundaries."""
+    from ray_tpu import rllib
+    from ray_tpu.rllib.connectors import FrameStack
+
+    algo = (rllib.PPOConfig()
+            .environment("CartPole")
+            .env_runners(1, rollout_steps=64)
+            .connectors(env_to_module=lambda: FrameStack(shape=(4,), n=3))
+            .training(seed=0)
+            .build())
+    try:
+        assert algo.module.observation_dim == 12  # 3 stacked frames
+        res = algo.train()
+        assert res["training_iteration"] == 1
+        # evaluation path uses the driver's pipeline: must not crash on dim
+        algo.evaluate(num_episodes=1, max_steps=20)
+    finally:
+        algo.stop()
