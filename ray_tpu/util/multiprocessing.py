@@ -1,0 +1,250 @@
+"""multiprocessing.Pool API over the cluster.
+
+TPU-native analog of the reference shim (python/ray/util/multiprocessing/
+pool.py): drop-in ``Pool`` whose workers are cluster actors, so existing
+multiprocessing code scales past one machine by changing an import. The
+surface covered: map/starmap/apply (+ _async variants returning
+AsyncResult), imap/imap_unordered, chunking, context manager,
+close/terminate/join.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+
+def _unwrap(exc: BaseException) -> BaseException:
+    """mp.Pool re-raises the ORIGINAL exception type; the runtime delivers
+    a TaskError wrapper — unwrap so `except ValueError:` keeps working."""
+    cause = getattr(exc, "cause", None)
+    return cause if isinstance(exc, TaskError) and cause is not None else exc
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    """One pool process (reference pool worker actor): runs pickled
+    callables; keeps the initializer's side effects for its lifetime."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_chunk(self, func, chunk, star: bool) -> list:
+        if star:
+            return [func(*args) for args in chunk]
+        return [func(item) for item in chunk]
+
+    def run_call(self, func, args, kwargs):
+        return func(*args, **(kwargs or {}))
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult surface over object refs."""
+
+    def __init__(self, refs: list, reassemble: Callable[[list], Any],
+                 single: bool = False):
+        self._refs = refs
+        self._reassemble = reassemble
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        try:
+            out = self._reassemble(ray_tpu.get(self._refs, timeout=timeout))
+        except TaskError as e:
+            raise _unwrap(e) from None
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        try:
+            ray_tpu.get(self._refs, timeout=0)
+            return True
+        except Exception:  # noqa: BLE001 — mp.Pool semantics
+            return False
+
+
+class Pool:
+    """Drop-in multiprocessing.Pool running on cluster actors."""
+
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs: tuple = (), maxtasksperchild: Optional[int] = None,
+                 *, ray_remote_args: Optional[dict] = None):
+        # maxtasksperchild accepted for signature parity and ignored —
+        # actor workers do not accumulate per-process state the way forked
+        # mp workers do (the reference shim ignores it too)
+        del maxtasksperchild
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            total = ray_tpu.cluster_resources().get("CPU", 1)
+            processes = max(1, int(total))
+        self._n = processes
+        cls = _PoolWorker
+        if ray_remote_args:
+            cls = _PoolWorker.options(**ray_remote_args)
+        self._workers = [cls.remote(initializer, tuple(initargs))
+                         for _ in range(processes)]
+        self._rr = 0
+        self._closed = False
+        self._inflight: list = []  # refs close()/join() must wait out
+
+    # -- internals ------------------------------------------------------
+    def _next_worker(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+        w = self._workers[self._rr % self._n]
+        self._rr += 1
+        return w
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            # multiprocessing's heuristic: ~4 chunks per worker
+            chunksize = max(1, len(items) // (self._n * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)], chunksize
+
+    def _track(self, refs: list) -> list:
+        # prune settled refs opportunistically so the list stays bounded
+        if len(self._inflight) > 4 * self._n:
+            done, pending = ray_tpu.wait(
+                self._inflight, num_returns=len(self._inflight), timeout=0)
+            self._inflight = list(pending)
+        self._inflight.extend(refs)
+        return refs
+
+    def _map_refs(self, func, iterable, chunksize, star: bool):
+        chunks, _ = self._chunks(iterable, chunksize)
+        return self._track(
+            [self._next_worker().run_chunk.remote(func, c, star)
+             for c in chunks])
+
+    # -- the mp.Pool surface --------------------------------------------
+    def map(self, func, iterable, chunksize: Optional[int] = None) -> list:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func, iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        refs = self._map_refs(func, iterable, chunksize, star=False)
+        return AsyncResult(refs, lambda outs: list(
+            itertools.chain.from_iterable(outs)))
+
+    def starmap(self, func, iterable,
+                chunksize: Optional[int] = None) -> list:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func, iterable,
+                      chunksize: Optional[int] = None) -> AsyncResult:
+        refs = self._map_refs(func, iterable, chunksize, star=True)
+        return AsyncResult(refs, lambda outs: list(
+            itertools.chain.from_iterable(outs)))
+
+    def apply(self, func, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args: tuple = (),
+                    kwds: Optional[dict] = None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        (ref,) = self._track(
+            [self._next_worker().run_call.remote(func, tuple(args), kwds)])
+        if callback is not None or error_callback is not None:
+            # one blocking-get thread per in-flight callback (ref.future());
+            # bounded in practice by the caller's dispatch window (joblib
+            # pre-dispatches ~2*n_jobs batches)
+            def on_done(fut):
+                try:
+                    value = fut.result()
+                except Exception as e:  # noqa: BLE001 — mp semantics
+                    if error_callback is not None:
+                        error_callback(_unwrap(e))
+                    return
+                if callback is not None:
+                    callback(value)
+            ref.future().add_done_callback(on_done)
+        return AsyncResult([ref], lambda outs: outs, single=True)
+
+    def _lazy_chunks(self, iterable: Iterable, chunksize: int):
+        it = iter(iterable)
+        while True:
+            chunk = list(itertools.islice(it, chunksize))
+            if not chunk:
+                return
+            yield chunk
+
+    def imap(self, func, iterable, chunksize: int = 1):
+        """Ordered lazy iteration: at most ~2 chunks per worker in flight
+        (mp.Pool's incremental submission; an infinite iterable works)."""
+        window = max(2, 2 * self._n)
+        chunks = self._lazy_chunks(iterable, chunksize)
+        refs = [self._track(
+            [self._next_worker().run_chunk.remote(func, c, False)])[0]
+            for c in itertools.islice(chunks, window)]
+        while refs:
+            ref = refs.pop(0)
+            for c in itertools.islice(chunks, 1):
+                refs.append(self._track(
+                    [self._next_worker().run_chunk.remote(func, c, False)])[0])
+            try:
+                yield from ray_tpu.get(ref)
+            except TaskError as e:
+                raise _unwrap(e) from None
+
+    def imap_unordered(self, func, iterable, chunksize: int = 1):
+        window = max(2, 2 * self._n)
+        chunks = self._lazy_chunks(iterable, chunksize)
+        pending = [self._track(
+            [self._next_worker().run_chunk.remote(func, c, False)])[0]
+            for c in itertools.islice(chunks, window)]
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            pending = list(pending)
+            for c in itertools.islice(chunks, 1):
+                pending.append(self._track(
+                    [self._next_worker().run_chunk.remote(func, c, False)])[0])
+            try:
+                yield from ray_tpu.get(done[0])
+            except TaskError as e:
+                raise _unwrap(e) from None
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self._workers = []
+
+    def join(self) -> None:
+        """Wait for submitted work to finish, then release the workers
+        (mp.Pool's close()+join() contract: in-flight tasks complete)."""
+        if not self._closed:
+            raise ValueError("Pool is still running")
+        if self._inflight:
+            ray_tpu.wait(self._inflight, num_returns=len(self._inflight),
+                         timeout=300.0)
+        self.terminate()
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

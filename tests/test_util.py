@@ -174,3 +174,75 @@ def test_profiling_trace_and_annotation(tmp_path):
     for root, _dirs, files in os.walk(logdir):
         dumped += [f for f in files if f.endswith(".xplane.pb")]
     assert dumped, "no xplane trace written"
+
+
+def test_multiprocessing_pool_shim(ray_start_regular):
+    """Drop-in multiprocessing.Pool over cluster actors (reference:
+    python/ray/util/multiprocessing/pool.py surface)."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    with Pool(processes=2) as pool:
+        assert pool.map(square, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(add, (5, 6)) == 11
+        ar = pool.map_async(square, range(5))
+        ar.wait(timeout=60)
+        assert ar.ready() and ar.successful()
+        assert ar.get(timeout=60) == [0, 1, 4, 9, 16]
+        assert list(pool.imap(square, range(6), chunksize=2)) == \
+            [0, 1, 4, 9, 16, 25]
+        assert sorted(pool.imap_unordered(square, range(6), chunksize=2)) \
+            == [0, 1, 4, 9, 16, 25]
+
+
+def test_joblib_backend(ray_start_regular):
+    """joblib parallel loops run as cluster tasks (reference:
+    python/ray/util/joblib/)."""
+    import math
+
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib_backend import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(math.sqrt)(i ** 2) for i in range(10))
+    assert out == [float(i) for i in range(10)]
+
+
+def test_multiprocessing_pool_semantics(ray_start_regular):
+    """mp.Pool parity details: original exception types re-raise, lazy
+    imap over generators, close()+join() completes in-flight work."""
+    import itertools
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    def boom(x):
+        raise ValueError(f"bad {x}")
+
+    def slow_square(x):
+        import time
+        time.sleep(0.05)
+        return x * x
+
+    pool = Pool(processes=2)
+    try:
+        with pytest.raises(ValueError, match="bad 3"):
+            pool.apply(boom, (3,))
+        # lazy imap: an infinite generator yields incrementally
+        it = pool.imap(slow_square, itertools.count(), chunksize=1)
+        assert [next(it) for _ in range(5)] == [0, 1, 4, 9, 16]
+    finally:
+        pool.terminate()
+
+    pool = Pool(processes=2)
+    ar = pool.map_async(slow_square, range(8))
+    pool.close()
+    pool.join()  # must wait for the map, not kill it
+    assert ar.get(timeout=60) == [x * x for x in range(8)]
