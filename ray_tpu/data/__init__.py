@@ -19,10 +19,16 @@ from ray_tpu.data.read_api import (
     from_pandas,
     range,
     range_tensor,
+    read_bigquery,
     read_binary_files,
+    read_clickhouse,
     read_csv,
     read_datasource,
+    read_delta,
+    read_iceberg,
     read_images,
+    read_lance,
+    read_mongo,
     read_json,
     read_parquet,
     read_sql,
@@ -38,7 +44,8 @@ __all__ = [
     "Dataset", "Datasource", "MaterializedDataset", "Max", "Mean", "Min",
     "ReadTask", "Std", "Sum", "aggregate", "from_arrow", "from_huggingface",
     "from_items", "from_numpy", "from_pandas", "range", "range_tensor",
-    "read_binary_files", "read_csv", "read_datasource", "read_images",
-    "read_json", "read_parquet", "read_sql", "read_text",
-    "read_tfrecords", "read_webdataset", "col", "lit", "preprocessors",
+    "read_bigquery", "read_binary_files", "read_clickhouse", "read_csv",
+    "read_datasource", "read_delta", "read_iceberg", "read_images",
+    "read_json", "read_lance", "read_mongo", "read_parquet", "read_sql",
+    "read_text", "read_tfrecords", "read_webdataset", "col", "lit", "preprocessors",
 ]

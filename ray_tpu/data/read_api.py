@@ -91,6 +91,59 @@ def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
     return _read(TFRecordsDatasource(paths), parallelism)
 
 
+def read_delta(table_path: str, *, columns=None,
+               parallelism: int = -1) -> Dataset:
+    """Delta Lake table (native: parquet + _delta_log JSON fold; no
+    deltalake dependency). Reference: the delta/hudi table-format
+    readers under data/_internal/datasource/."""
+    from ray_tpu.data.datasource_ext import DeltaLakeDatasource
+    return _read(DeltaLakeDatasource(table_path, columns), parallelism)
+
+
+def read_lance(uri: str, *, columns=None, parallelism: int = -1) -> Dataset:
+    """Lance dataset (requires `lance`; reference lance_datasource.py)."""
+    from ray_tpu.data.datasource_ext import LanceDatasource
+    return _read(LanceDatasource(uri, columns), parallelism)
+
+
+def read_iceberg(table_identifier: str, *, catalog_kwargs=None,
+                 row_filter=None, selected_fields: tuple = ("*",),
+                 parallelism: int = -1) -> Dataset:
+    """Iceberg table (requires `pyiceberg`; reference
+    iceberg_datasource.py)."""
+    from ray_tpu.data.datasource_ext import IcebergDatasource
+    return _read(IcebergDatasource(
+        table_identifier, catalog_kwargs=catalog_kwargs,
+        row_filter=row_filter, selected_fields=selected_fields), parallelism)
+
+
+def read_bigquery(project_id: str, *, dataset=None, query=None,
+                  parallelism: int = -1) -> Dataset:
+    """BigQuery table or query (requires `google-cloud-bigquery`;
+    reference bigquery_datasource.py)."""
+    from ray_tpu.data.datasource_ext import BigQueryDatasource
+    return _read(BigQueryDatasource(project_id, dataset, query), parallelism)
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None, parallelism: int = -1) -> Dataset:
+    """MongoDB collection (requires `pymongo`; reference
+    mongo_datasource.py)."""
+    from ray_tpu.data.datasource_ext import MongoDatasource
+    return _read(MongoDatasource(uri, database, collection, pipeline),
+                 parallelism)
+
+
+def read_clickhouse(query: str, *, url: str = "http://localhost:8123",
+                    user=None, password=None,
+                    parallelism: int = -1) -> Dataset:
+    """ClickHouse query over the HTTP interface (library-free ArrowStream;
+    reference clickhouse_datasource.py)."""
+    from ray_tpu.data.datasource_ext import ClickHouseDatasource
+    return _read(ClickHouseDatasource(query, url=url, user=user,
+                                      password=password), parallelism)
+
+
 def from_items(items: list, *, parallelism: int = -1) -> Dataset:
     return _read(ItemsDatasource(items), parallelism)
 

@@ -56,6 +56,11 @@ class AsyncResult:
             out = self._reassemble(ray_tpu.get(self._refs, timeout=timeout))
         except TaskError as e:
             raise _unwrap(e) from None
+        except TimeoutError:
+            # mp.Pool parity: its TimeoutError subclasses ProcessError,
+            # NOT the builtin — migrated except-clauses must still match
+            import multiprocessing
+            raise multiprocessing.TimeoutError() from None
         return out[0] if self._single else out
 
     def wait(self, timeout: Optional[float] = None) -> None:
@@ -92,6 +97,8 @@ class Pool:
         if processes is None:
             total = ray_tpu.cluster_resources().get("CPU", 1)
             processes = max(1, int(total))
+        if processes < 1:
+            raise ValueError("Number of processes must be at least 1")
         self._n = processes
         cls = _PoolWorker
         if ray_remote_args:
@@ -238,9 +245,14 @@ class Pool:
         (mp.Pool's close()+join() contract: in-flight tasks complete)."""
         if not self._closed:
             raise ValueError("Pool is still running")
-        if self._inflight:
-            ray_tpu.wait(self._inflight, num_returns=len(self._inflight),
-                         timeout=300.0)
+        while self._inflight:
+            # unbounded by contract (mp.Pool.join blocks until done);
+            # bounded waits in a loop so a wedged cluster still leaves
+            # the thread interruptible
+            done, pending = ray_tpu.wait(
+                self._inflight, num_returns=len(self._inflight),
+                timeout=60.0)
+            self._inflight = list(pending)
         self.terminate()
 
     def __enter__(self) -> "Pool":
