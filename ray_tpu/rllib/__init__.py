@@ -26,6 +26,10 @@ from ray_tpu.rllib.offline import (
     BCConfig,
     CQL,
     CQLConfig,
+    IQL,
+    IQLConfig,
+    MARWIL,
+    MARWILConfig,
     OfflineData,
     record_episodes,
 )
@@ -39,5 +43,6 @@ __all__ = [
     "CartPole", "Env", "RandomWalk", "make_env", "register_env",
     "EnvRunner", "EnvRunnerGroup", "IMPALA", "IMPALAConfig", "RLModule",
     "PPO", "PPOConfig", "SAC", "SACConfig", "BC", "BCConfig", "CQL",
-    "CQLConfig", "OfflineData", "record_episodes",
+    "CQLConfig", "IQL", "IQLConfig", "MARWIL", "MARWILConfig",
+    "OfflineData", "record_episodes",
 ]

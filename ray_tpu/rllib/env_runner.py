@@ -149,6 +149,8 @@ class EnvRunnerGroup:
                             for r in self._runners], timeout=300.0)
 
     def episode_stats(self) -> dict:
+        if not self._runners:  # offline algos: no env sampling at all
+            return {"episode_returns": [], "episode_lens": []}
         stats = ray_tpu.get(
             [r.episode_stats.remote() for r in self._runners], timeout=60.0)
         return {

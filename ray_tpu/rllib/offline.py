@@ -91,14 +91,23 @@ class _OfflineAlgorithm(Algorithm):
     """Base for offline algos: no env runners are sampled during training
     (the dataset IS the experience); evaluate() still uses the env."""
 
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_cls=cls)
+        cfg.lr = 1e-3
+        cfg.num_env_runners = 0
+        return cfg
+
     def __init__(self, config: AlgorithmConfig):
-        super().__init__(config)
         src = config.train_kwargs.get("input_")
         if src is None:
             raise ValueError(
                 "offline algorithms need config.training(input_=<npz path "
                 "or ray_tpu.data Dataset>)")
+        # BEFORE super().__init__: setup() runs inside it and advantage-
+        # style algos (MARWIL) precompute over the dataset there
         self.data = OfflineData(src, seed=config.seed)
+        super().__init__(config)
 
 
 class BC(_OfflineAlgorithm):
@@ -135,12 +144,6 @@ class BC(_OfflineAlgorithm):
         self._timesteps += self._updates_per_iter * self._batch_size
         return {"bc_loss": float(loss), "dataset_size": len(self.data)}
 
-    @classmethod
-    def get_default_config(cls) -> AlgorithmConfig:
-        cfg = AlgorithmConfig(algo_cls=cls)
-        cfg.lr = 1e-3
-        cfg.num_env_runners = 0
-        return cfg
 
 
 class CQL(_OfflineAlgorithm):
@@ -203,13 +206,6 @@ class CQL(_OfflineAlgorithm):
         return {"td_loss": float(td), "cql_loss": float(cql),
                 "dataset_size": len(self.data)}
 
-    @classmethod
-    def get_default_config(cls) -> AlgorithmConfig:
-        cfg = AlgorithmConfig(algo_cls=cls)
-        cfg.lr = 1e-3
-        cfg.num_env_runners = 0
-        return cfg
-
 
 def BCConfig() -> AlgorithmConfig:
     return BC.get_default_config()
@@ -217,3 +213,159 @@ def BCConfig() -> AlgorithmConfig:
 
 def CQLConfig() -> AlgorithmConfig:
     return CQL.get_default_config()
+
+
+class MARWIL(_OfflineAlgorithm):
+    """Monotonic advantage re-weighted imitation learning (ref:
+    rllib/algorithms/marwil/): behavior cloning whose log-likelihood is
+    weighted by exp(beta * advantage) — transitions that beat the value
+    baseline imitate harder, so mixed-quality data distills toward its
+    good trajectories. beta=0 degenerates to BC (the reference notes the
+    same). Advantages use return-to-go computed over the dataset's done
+    boundaries."""
+
+    def setup(self) -> None:
+        kw = self.config.train_kwargs
+        self._batch_size = kw.get("train_batch_size", 256)
+        self._updates_per_iter = kw.get("updates_per_iter", 100)
+        beta = kw.get("beta", 1.0)
+        vf_c = kw.get("vf_coeff", 1.0)
+        env = make_env(self.config.env_spec)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(self.config.seed + 3))
+        sizes = [env.observation_dim, *self.config.hidden]
+        self.params = {"pi": mlp_init(k1, sizes + [env.num_actions]),
+                       "v": mlp_init(k2, sizes + [1])}
+        self._opt = optax.adam(self.config.lr)
+        self._opt_state = self._opt.init(self.params)
+        # return-to-go with gamma over the recorded stream; a done resets
+        # the accumulator (truncation without a done mark leaks the next
+        # episode's head into the tail — the recorder marks term only,
+        # matching the reference's offline json semantics)
+        gamma = self.config.gamma
+        rew = jnp.asarray(self.data._data["rewards"], jnp.float32)
+        dones = jnp.asarray(self.data._data["dones"], jnp.float32)
+
+        def rtg_step(acc, x):
+            r, d = x
+            acc = r + gamma * (1.0 - d) * acc
+            return acc, acc
+
+        # jitted reverse scan (the _gae idiom): O(n) on-device, not a
+        # per-element Python loop over a potentially huge dataset
+        _, rtg = jax.jit(lambda r, d: jax.lax.scan(
+            rtg_step, jnp.float32(0.0), (r, d), reverse=True))(rew, dones)
+        self.data._data["rtg"] = np.asarray(rtg, np.float32)
+
+        def loss_fn(params, b):
+            logits = mlp_apply(params["pi"], b["obs"])
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), b["actions"][:, None], axis=1)[:, 0]
+            v = mlp_apply(params["v"], b["obs"])[:, 0]
+            adv = b["rtg"] - v
+            # stop-grad on the weight: the policy term must not push V
+            w = jnp.exp(jnp.clip(
+                beta * jax.lax.stop_gradient(adv), -5.0, 5.0))
+            pi_loss = -(w * logp).mean()
+            v_loss = (adv ** 2).mean()
+            return pi_loss + vf_c * v_loss, (pi_loss, v_loss)
+
+        @jax.jit
+        def update(params, opt_state, b):
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, b)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, aux
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        pi_l = v_l = 0.0
+        for _ in range(self._updates_per_iter):
+            b = self.data.sample(self._batch_size)
+            self.params, self._opt_state, (pi_l, v_l) = self._update(
+                self.params, self._opt_state, b)
+        self._timesteps += self._updates_per_iter * self._batch_size
+        return {"policy_loss": float(pi_l), "vf_loss": float(v_l),
+                "dataset_size": len(self.data)}
+
+
+
+class IQL(_OfflineAlgorithm):
+    """Discrete implicit Q-learning (ref: rllib/algorithms/iql/): never
+    queries Q on out-of-distribution actions. V is fit to Q by EXPECTILE
+    regression (tau > 0.5 biases toward the dataset's better actions), Q
+    bootstraps from V, and the policy is advantage-weighted behavior
+    cloning exp((Q - V)/temperature) over dataset actions only."""
+
+    def setup(self) -> None:
+        kw = self.config.train_kwargs
+        self._batch_size = kw.get("train_batch_size", 256)
+        self._updates_per_iter = kw.get("updates_per_iter", 100)
+        self._target_update_freq = kw.get("target_update_freq", 100)
+        tau = kw.get("expectile", 0.8)
+        # exp((Q-V)/temperature): LOWER temperature sharpens toward the
+        # best dataset actions (IQL paper convention)
+        inv_temp = 1.0 / max(1e-6, kw.get("temperature", 0.33))
+        env = make_env(self.config.env_spec)
+        keys = jax.random.split(jax.random.PRNGKey(self.config.seed + 4), 3)
+        sizes = [env.observation_dim, *self.config.hidden]
+        self.params = {"pi": mlp_init(keys[0], sizes + [env.num_actions]),
+                       "q": mlp_init(keys[1], sizes + [env.num_actions]),
+                       "v": mlp_init(keys[2], sizes + [1])}
+        self._target_q = jax.tree.map(jnp.copy, self.params["q"])
+        self._opt = optax.adam(self.config.lr)
+        self._opt_state = self._opt.init(self.params)
+        gamma = self.config.gamma
+
+        def loss_fn(params, target_q, b):
+            a = b["actions"][:, None]
+            # V <- expectile of target-Q at DATASET actions
+            q_t = jnp.take_along_axis(
+                mlp_apply(target_q, b["obs"]), a, axis=1)[:, 0]
+            v = mlp_apply(params["v"], b["obs"])[:, 0]
+            u = jax.lax.stop_gradient(q_t) - v
+            v_loss = (jnp.abs(tau - (u < 0)) * u ** 2).mean()
+            # Q <- r + gamma V(s') (no max over OOD actions)
+            v_next = jax.lax.stop_gradient(
+                mlp_apply(params["v"], b["next_obs"])[:, 0])
+            q = jnp.take_along_axis(mlp_apply(params["q"], b["obs"]),
+                                    a, axis=1)[:, 0]
+            q_loss = ((b["rewards"] + gamma * (1.0 - b["dones"]) * v_next
+                       - q) ** 2).mean()
+            # policy <- advantage-weighted BC on dataset actions
+            adv = jax.lax.stop_gradient(q_t - v)
+            w = jnp.exp(jnp.clip(adv * inv_temp, -5.0, 5.0))
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(mlp_apply(params["pi"], b["obs"])),
+                a, axis=1)[:, 0]
+            pi_loss = -(w * logp).mean()
+            return v_loss + q_loss + pi_loss, (v_loss, q_loss, pi_loss)
+
+        @jax.jit
+        def update(params, target_q, opt_state, b):
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_q, b)
+            updates, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, aux
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        v_l = q_l = pi_l = 0.0
+        for i in range(self._updates_per_iter):
+            b = self.data.sample(self._batch_size)
+            self.params, self._opt_state, (v_l, q_l, pi_l) = self._update(
+                self.params, self._target_q, self._opt_state, b)
+            if (i + 1) % self._target_update_freq == 0:
+                self._target_q = jax.tree.map(jnp.copy, self.params["q"])
+        self._timesteps += self._updates_per_iter * self._batch_size
+        return {"v_loss": float(v_l), "q_loss": float(q_l),
+                "policy_loss": float(pi_l), "dataset_size": len(self.data)}
+
+
+def MARWILConfig() -> AlgorithmConfig:
+    return MARWIL.get_default_config()
+
+
+def IQLConfig() -> AlgorithmConfig:
+    return IQL.get_default_config()
