@@ -5,14 +5,20 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    BOHBSearcher,
     ConcurrencyLimiter,
+    OptunaSearch,
+    RandomSearcher,
     Searcher,
     TPESearcher,
+    create_bohb,
     choice,
     grid_search,
     loguniform,
@@ -30,8 +36,10 @@ from ray_tpu.tune.tuner import (
 )
 
 __all__ = [
-    "ASHAScheduler", "AsyncHyperBandScheduler", "ConcurrencyLimiter",
-    "FIFOScheduler", "Searcher", "TPESearcher",
+    "ASHAScheduler", "AsyncHyperBandScheduler", "BOHBSearcher",
+    "ConcurrencyLimiter", "FIFOScheduler", "HyperBandForBOHB",
+    "OptunaSearch", "PB2", "RandomSearcher", "Searcher", "TPESearcher",
+    "create_bohb",
     "MedianStoppingRule", "PopulationBasedTraining", "ResultGrid", "Trial",
     "TrialResult", "TrialScheduler", "TuneConfig", "TuneController", "Tuner",
     "choice", "get_context", "grid_search", "loguniform", "randint", "report",

@@ -396,3 +396,52 @@ class OptunaSearch(Searcher):
             self._study.tell(t, state=self._optuna.trial.TrialState.FAIL)
         else:
             self._study.tell(t, float(value))
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model half (reference: TuneBOHB pairs with HyperBandForBOHB;
+    here the TPE model is in-tree): keeps observations PER RUNG and fits
+    the split on the deepest rung with >= n_min results, so cheap
+    low-fidelity evaluations guide early sampling and high-fidelity ones
+    take over as they accumulate (the BOHB fidelity schedule)."""
+
+    def __init__(self, space: dict, *, metric: str, mode: str = "max",
+                 n_min: int = 6, **kw):
+        # the TPE model gate must match the rung rule, or a qualifying
+        # rung with n_min..n_initial-1 points would leave suggestions
+        # uniform-random despite usable data
+        kw.setdefault("n_initial", n_min)
+        super().__init__(space, metric=metric, mode=mode, **kw)
+        self._n_min = n_min
+        self._rungs: dict[float, list[tuple[dict, float]]] = {}
+
+    def observe_rung(self, config: dict, value: float, rung: float) -> None:
+        score = float(value) if self._mode == "max" else -float(value)
+        flat = {k: v for k, v in _flatten(config).items()
+                if k in self._flat_space}
+        self._rungs.setdefault(rung, []).append((flat, score))
+        # the TPE split reads self._observed: point it at the deepest
+        # rung that has enough data (BOHB's model-selection rule)
+        deep = [r for r in sorted(self._rungs, reverse=True)
+                if len(self._rungs[r]) >= self._n_min]
+        if deep:
+            self._observed = list(self._rungs[deep[0]])
+        else:
+            # no rung qualifies yet: fall back to the data-richest rung
+            # (low fidelity beats no model — the BOHB fallback)
+            richest = max(self._rungs, key=lambda r: len(self._rungs[r]))
+            self._observed = list(self._rungs[richest])
+
+
+def create_bohb(space: dict, *, metric: str, mode: str = "max",
+                max_t: int = 100, grace_period: int = 1,
+                reduction_factor: float = 3.0, seed=None):
+    """Wire the BOHB pair: returns (searcher, scheduler) to pass as
+    TuneConfig(search_alg=..., scheduler=...)."""
+    from ray_tpu.tune.schedulers import HyperBandForBOHB
+
+    searcher = BOHBSearcher(space, metric=metric, mode=mode, seed=seed)
+    scheduler = HyperBandForBOHB(
+        searcher=searcher, metric=metric, mode=mode, max_t=max_t,
+        grace_period=grace_period, reduction_factor=reduction_factor)
+    return searcher, scheduler

@@ -220,6 +220,7 @@ class TuneController:
                 continue
             self._stop_trial(trial, "PENDING")
             trial.config = sched.mutate_config(dict(donor.config))
+            sched.on_exploit(trial_id)
             self._start_trial(trial, resume_from=donor.checkpoint)
             sched.exploit_requests.pop(trial_id, None)
 
