@@ -28,6 +28,7 @@ def test_spill_and_restore(ray_start_regular):
     assert agent.store.stats()["num_restored"] > 0
 
 
+@pytest.mark.slow
 def test_large_object_broadcast_multinode():
     """A 1 GiB object produced on one node is pulled (chunked, admission-
     controlled) by consumers on three other nodes (BASELINE's

@@ -472,6 +472,7 @@ def test_from_huggingface(rt):
     assert len(rows) == 8 and rows[3]["x"] == 3
 
 
+@pytest.mark.slow
 def test_distributed_hash_shuffle_1gb_two_nodes():
     """VERDICT r2 #7: shuffle >=1 GB across a 2-node cluster under per-node
     object-store caps. The shuffle moves shard REFS (map emits one ref per
