@@ -15,6 +15,7 @@ import logging
 import threading
 import time
 
+from ray_tpu.autoscaler.instance_manager import InstanceManager, InstanceState
 from ray_tpu.core.rpc import RpcClient
 from ray_tpu.core.scheduler import fits
 
@@ -50,6 +51,11 @@ class Autoscaler:
         self._launching: dict[str, float] = {}
         self.launch_grace_s = 600.0
         self._thread: threading.Thread | None = None
+        # v2 instance lifecycle tracking (reference instance_manager):
+        # every provider node walks QUEUED -> ... -> TERMINATED with a
+        # recorded transition history
+        self.instance_manager = InstanceManager(
+            provider, allocate_grace_s=self.launch_grace_s)
         self.num_launched = 0
         self.num_terminated = 0
 
@@ -111,6 +117,8 @@ class Autoscaler:
             return [n for n in alive if tuple(n["addr"]) in addrs]
 
         hosts = max(1, self._cfg.hosts_per_node)
+        self.instance_manager.reconcile(
+            lambda n: len(cp_nodes_for(n)) >= hosts)
         cur = self._provider.non_terminated_nodes()
         # registration (all hosts) drains the launching set; boots past the
         # grace period stop counting (the node may have failed — allow a
@@ -134,14 +142,18 @@ class Autoscaler:
                 self._cfg.max_workers - len(cur))
         want_new = max(want_new, self._cfg.min_workers - len(cur))
         for _ in range(max(0, want_new)):
-            name = self._provider.create_node(
+            inst = self.instance_manager.launch(
                 {"resources": dict(self._cfg.node_resources),
                  "labels": dict(self._cfg.node_labels),
                  "hosts": hosts})
-            self._launching[name] = now
+            if inst.state == InstanceState.ALLOCATION_FAILED:
+                logger.warning("instance %s allocation failed: %s",
+                               inst.instance_id[:8], inst.history[-1][3])
+                continue
+            self._launching[inst.name] = now
             self.num_launched += 1
             logger.info("autoscaler launched node %s (unplaceable=%d)",
-                        name, unplaceable)
+                        inst.name, unplaceable)
 
         # scale down: provider nodes whose EVERY host is idle (full
         # availability) past the timeout — a slice terminates whole or not
@@ -177,11 +189,10 @@ class Autoscaler:
                 # (gcloud flake) must not inflate the counter or drop the
                 # idle clock — roll both back and retry next reconcile.
                 self.num_terminated += 1
-                try:
-                    self._provider.terminate_node(name)
-                except Exception:  # noqa: BLE001
+                if not self.instance_manager.begin_terminate(
+                        name, "idle past timeout"):
                     self.num_terminated -= 1
-                    logger.exception(
+                    logger.warning(
                         "terminate_node(%s) failed; will retry", name)
                     continue
                 self._idle_since.pop(name, None)

@@ -1,0 +1,199 @@
+"""Instance lifecycle manager — the autoscaler v2 reconciliation model.
+
+TPU-native analog of the reference's v2 instance manager
+(python/ray/autoscaler/v2/instance_manager/ + instance_manager.proto:243):
+every provider node is tracked as an Instance walking an explicit state
+machine, with a recorded transition history the dashboard/operators can
+audit:
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+                   |            |            |
+                   v            v            v
+          ALLOCATION_FAILED  TERMINATING -> TERMINATED
+
+The autoscaling loop makes decisions (launch N, terminate X); the
+instance manager owns the provider calls and the truth about where each
+instance is in its lifecycle, reconciling desired state against what the
+provider and the control plane actually report each tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import time
+import uuid
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class InstanceState(enum.Enum):
+    QUEUED = "QUEUED"                  # decision made, provider not called
+    REQUESTED = "REQUESTED"            # provider.create_node in flight
+    ALLOCATED = "ALLOCATED"            # provider created; agents booting
+    RAY_RUNNING = "RAY_RUNNING"        # every host registered with the CP
+    ALLOCATION_FAILED = "ALLOCATION_FAILED"
+    TERMINATING = "TERMINATING"        # provider.terminate_node issued
+    TERMINATED = "TERMINATED"
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    node_config: dict
+    state: InstanceState = InstanceState.QUEUED
+    name: Optional[str] = None         # provider node name once allocated
+    created_at: float = dataclasses.field(default_factory=time.time)
+    updated_at: float = dataclasses.field(default_factory=time.time)
+    history: list = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"instance_id": self.instance_id, "state": self.state.value,
+                "name": self.name, "created_at": self.created_at,
+                "updated_at": self.updated_at,
+                "history": [(t, a, b, why) for t, a, b, why in self.history]}
+
+
+class InstanceManager:
+    """Owns provider calls + per-instance state transitions. Not
+    thread-safe by itself — the autoscaling loop is the single driver
+    (matching the reference's single reconciler)."""
+
+    _MAX_TERMINAL = 64  # retained terminal records (audit window)
+
+    def __init__(self, provider, *, allocate_grace_s: float = 600.0):
+        self._provider = provider
+        self._grace = allocate_grace_s  # stuck-boot flag threshold (no kill)
+        self._instances: dict[str, Instance] = {}
+
+    # ---- queries -------------------------------------------------------
+    def instances(self, states: Optional[set] = None) -> list[Instance]:
+        out = [i for i in self._instances.values()
+               if states is None or i.state in states]
+        return sorted(out, key=lambda i: i.created_at)
+
+    def active(self) -> list[Instance]:
+        return self.instances({InstanceState.QUEUED, InstanceState.REQUESTED,
+                               InstanceState.ALLOCATED,
+                               InstanceState.RAY_RUNNING})
+
+    def by_name(self, name: str) -> Optional[Instance]:
+        return next((i for i in self._instances.values()
+                     if i.name == name), None)
+
+    def summary(self) -> dict:
+        out: dict[str, int] = {}
+        for i in self._instances.values():
+            out[i.state.value] = out.get(i.state.value, 0) + 1
+        return out
+
+    # ---- transitions ---------------------------------------------------
+    def _transition(self, inst: Instance, to: InstanceState,
+                    reason: str) -> None:
+        inst.history.append((time.time(), inst.state.value, to.value, reason))
+        logger.info("instance %s: %s -> %s (%s)", inst.instance_id[:8],
+                    inst.state.value, to.value, reason)
+        inst.state = to
+        inst.updated_at = time.time()
+
+    def queue_launch(self, node_config: dict) -> Instance:
+        inst = Instance(instance_id=uuid.uuid4().hex,
+                        node_config=dict(node_config))
+        inst.history.append((time.time(), None, "QUEUED", "launch decision"))
+        self._instances[inst.instance_id] = inst
+        return inst
+
+    def launch(self, node_config: dict) -> Instance:
+        """Queue + immediately drive the provider create (the common
+        launch path; a full reconcile per launch would re-walk every
+        tracked instance for nothing)."""
+        inst = self.queue_launch(node_config)
+        self._request(inst)
+        return inst
+
+    def _request(self, inst: Instance) -> None:
+        self._transition(inst, InstanceState.REQUESTED, "provider create")
+        try:
+            inst.name = self._provider.create_node(inst.node_config)
+            self._transition(inst, InstanceState.ALLOCATED,
+                             f"provider node {inst.name}")
+        except Exception as e:  # noqa: BLE001
+            self._transition(inst, InstanceState.ALLOCATION_FAILED, repr(e))
+
+    def begin_terminate(self, name: str, reason: str) -> bool:
+        """Issue the provider terminate for a named instance; returns False
+        when the provider call fails (the caller retries next tick)."""
+        inst = self.by_name(name)
+        if inst is None:
+            inst = self._adopt(name)
+        prior = inst.state
+        self._transition(inst, InstanceState.TERMINATING, reason)
+        try:
+            self._provider.terminate_node(name)
+        except Exception as e:  # noqa: BLE001 — provider flake: retry later
+            # roll back to the ACTUAL prior state so the audit log never
+            # fabricates a lifecycle stage the node didn't reach
+            self._transition(inst, prior, f"terminate failed: {e!r}")
+            return False
+        return True
+
+    def _adopt(self, name: str) -> Instance:
+        """Track a provider node launched outside this manager (process
+        restart, pre-manager launches)."""
+        inst = Instance(instance_id=uuid.uuid4().hex, node_config={},
+                        state=InstanceState.ALLOCATED, name=name)
+        inst.history.append((time.time(), None, "ALLOCATED", "adopted"))
+        self._instances[inst.instance_id] = inst
+        return inst
+
+    # ---- reconciliation ------------------------------------------------
+    def reconcile(self, ray_running: Callable[[str], bool]) -> None:
+        """One tick: push QUEUED into the provider, observe ALLOCATED →
+        RAY_RUNNING via the CP view, TERMINATING → TERMINATED via the
+        provider view, and fail instances stuck past the grace window."""
+        provider_nodes = set(self._provider.non_terminated_nodes())
+        # adopt provider nodes this manager doesn't know (process restart):
+        # "every provider node is tracked" must hold from the first tick
+        known = {i.name for i in self._instances.values()
+                 if i.name is not None and i.state not in
+                 (InstanceState.TERMINATED, InstanceState.ALLOCATION_FAILED)}
+        for name in provider_nodes - known:
+            self._adopt(name)
+        for inst in list(self._instances.values()):
+            if inst.state == InstanceState.QUEUED:
+                self._request(inst)
+            elif inst.state == InstanceState.ALLOCATED:
+                if inst.name not in provider_nodes:
+                    self._transition(inst, InstanceState.ALLOCATION_FAILED,
+                                     "vanished from provider while booting")
+                elif ray_running(inst.name):
+                    self._transition(inst, InstanceState.RAY_RUNNING,
+                                     "all hosts registered")
+                # NOTE deliberately no boot-grace kill here: slow multi-host
+                # slice boots are the AUTOSCALER's policy call (it merely
+                # stops counting them against demand); killing would churn
+                # launch->partial-register->kill forever on slow slices
+            elif inst.state == InstanceState.RAY_RUNNING:
+                if inst.name not in provider_nodes:
+                    self._transition(inst, InstanceState.TERMINATED,
+                                     "gone from provider")
+            elif inst.state == InstanceState.TERMINATING:
+                if inst.name not in provider_nodes:
+                    self._transition(inst, InstanceState.TERMINATED,
+                                     "provider confirmed")
+                else:
+                    # the terminate call may have flaked mid-flight
+                    # earlier: re-issue (idempotent on real providers)
+                    try:
+                        self._provider.terminate_node(inst.name)
+                    except Exception:  # noqa: BLE001 — retry next tick
+                        pass
+        self._prune_terminal()
+
+    def _prune_terminal(self) -> None:
+        terminal = [i for i in self.instances(
+            {InstanceState.TERMINATED, InstanceState.ALLOCATION_FAILED})]
+        for inst in terminal[:-self._MAX_TERMINAL]:
+            self._instances.pop(inst.instance_id, None)

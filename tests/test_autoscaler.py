@@ -168,3 +168,132 @@ def test_autoscaler_respects_max_workers():
         scaler.stop()
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_instance_manager_state_machine():
+    """The v2 lifecycle: QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING ->
+    TERMINATING -> TERMINATED, with transition history recorded; failed
+    creates land in ALLOCATION_FAILED (reference: autoscaler/v2
+    instance_manager reconciliation)."""
+    from ray_tpu.autoscaler.instance_manager import (InstanceManager,
+                                                     InstanceState)
+
+    class Prov:
+        def __init__(self):
+            self.nodes = []
+            self.fail_next = False
+            self.n = 0
+
+        def create_node(self, cfg):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("quota exceeded")
+            self.n += 1
+            name = f"node-{self.n}"
+            self.nodes.append(name)
+            return name
+
+        def terminate_node(self, name):
+            self.nodes.remove(name)
+
+        def non_terminated_nodes(self):
+            return list(self.nodes)
+
+    prov = Prov()
+    im = InstanceManager(prov, allocate_grace_s=600)
+    registered: set = set()
+
+    inst = im.queue_launch({"resources": {"CPU": 1}})
+    assert inst.state == InstanceState.QUEUED
+    im.reconcile(lambda n: n in registered)
+    assert inst.state == InstanceState.ALLOCATED and inst.name == "node-1"
+    # still booting
+    im.reconcile(lambda n: n in registered)
+    assert inst.state == InstanceState.ALLOCATED
+    registered.add("node-1")
+    im.reconcile(lambda n: n in registered)
+    assert inst.state == InstanceState.RAY_RUNNING
+
+    # terminate path
+    assert im.begin_terminate("node-1", "idle")
+    assert inst.state == InstanceState.TERMINATING
+    im.reconcile(lambda n: n in registered)
+    assert inst.state == InstanceState.TERMINATED
+    # full audit trail
+    states = [b for _, _, b, _ in inst.history]
+    assert states == ["QUEUED", "REQUESTED", "ALLOCATED", "RAY_RUNNING",
+                      "TERMINATING", "TERMINATED"]
+
+    # allocation failure
+    prov.fail_next = True
+    bad = im.queue_launch({})
+    im.reconcile(lambda n: False)
+    assert bad.state == InstanceState.ALLOCATION_FAILED
+    assert "quota" in bad.history[-1][3]
+    assert im.summary()["ALLOCATION_FAILED"] == 1
+
+
+def test_instance_manager_terminate_retry_and_adoption():
+    from ray_tpu.autoscaler.instance_manager import (InstanceManager,
+                                                     InstanceState)
+
+    class FlakyProv:
+        def __init__(self):
+            self.nodes = ["adopted-1"]
+            self.fails = 1
+
+        def create_node(self, cfg):
+            raise AssertionError("not used")
+
+        def terminate_node(self, name):
+            if self.fails:
+                self.fails -= 1
+                raise RuntimeError("gcloud 503")
+            self.nodes.remove(name)
+
+        def non_terminated_nodes(self):
+            return list(self.nodes)
+
+    im = InstanceManager(FlakyProv())
+    # node launched before the manager existed: reconcile adopts it, and a
+    # flaked terminate rolls back to the ACTUAL prior state (retryable)
+    im.reconcile(lambda n: True)
+    inst = im.by_name("adopted-1")
+    assert inst.state == InstanceState.RAY_RUNNING  # adopted + registered
+    assert not im.begin_terminate("adopted-1", "idle")  # first call flakes
+    assert inst.state == InstanceState.RAY_RUNNING  # rolled back to prior
+    assert im.begin_terminate("adopted-1", "idle retry")
+    im.reconcile(lambda n: True)
+    assert inst.state == InstanceState.TERMINATED
+
+
+def test_autoscaler_tracks_instances(ray_start_regular):
+    """The live autoscaler records every provider node as an instance with
+    lifecycle history (dashboard/audit surface)."""
+    import ray_tpu
+    from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+    from ray_tpu.autoscaler.instance_manager import InstanceState
+    from ray_tpu.autoscaler.node_provider import FakeNodeProvider
+    from ray_tpu.core import api
+
+    rt = api._get_runtime()
+    provider = FakeNodeProvider(rt.cp_addr, inproc_workers=True)
+    scaler = Autoscaler(
+        rt.cp_addr, provider,
+        AutoscalerConfig(min_workers=1, max_workers=2,
+                         node_resources={"CPU": 1},
+                         idle_timeout_s=300.0))
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            scaler.update()
+            insts = scaler.instance_manager.instances()
+            if insts and insts[0].state == InstanceState.RAY_RUNNING:
+                break
+            time.sleep(0.5)
+        assert insts and insts[0].state == InstanceState.RAY_RUNNING
+        assert [b for _, _, b, _ in insts[0].history][:3] == \
+            ["QUEUED", "REQUESTED", "ALLOCATED"]
+    finally:
+        for name in provider.non_terminated_nodes():
+            provider.terminate_node(name)
