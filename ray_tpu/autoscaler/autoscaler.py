@@ -45,17 +45,19 @@ class Autoscaler:
         self._cfg = config
         self._stopped = threading.Event()
         self._idle_since: dict[str, float] = {}
-        # nodes launched but not yet registered with the CP: name -> t0.
-        # Counted against new demand so a slow boot doesn't re-trigger a
-        # launch every poll (ref: instance_manager pending-instance set).
-        self._launching: dict[str, float] = {}
+        # boots older than this stop counting against demand (the node may
+        # have failed — allow a replacement); the instance manager is the
+        # single source of what is booting (ALLOCATED instances)
         self.launch_grace_s = 600.0
         self._thread: threading.Thread | None = None
         # v2 instance lifecycle tracking (reference instance_manager):
         # every provider node walks QUEUED -> ... -> TERMINATED with a
         # recorded transition history
-        self.instance_manager = InstanceManager(
-            provider, allocate_grace_s=self.launch_grace_s)
+        self.instance_manager = InstanceManager(provider)
+        import uuid as _uuid
+        # stacked autoscalers (layered node types) each publish under
+        # their own key; the dashboard merges the prefix like train_run:*
+        self.scaler_id = _uuid.uuid4().hex[:8]
         self.num_launched = 0
         self.num_terminated = 0
 
@@ -120,14 +122,14 @@ class Autoscaler:
         self.instance_manager.reconcile(
             lambda n: len(cp_nodes_for(n)) >= hosts)
         cur = self._provider.non_terminated_nodes()
-        # registration (all hosts) drains the launching set; boots past the
-        # grace period stop counting (the node may have failed — allow a
-        # replacement)
-        for name in list(self._launching):
-            if (len(cp_nodes_for(name)) >= hosts
-                    or name not in cur
-                    or now - self._launching[name] > self.launch_grace_s):
-                self._launching.pop(name, None)
+        # booting = ALLOCATED instances inside the grace window: counted
+        # against demand (no double-launch while a node boots) and immune
+        # to idle scale-down. The manager moved registered ones to
+        # RAY_RUNNING in the reconcile above — one source of truth.
+        wall_now = time.time()
+        booting = {i.name for i in self.instance_manager.instances(
+                       {InstanceState.ALLOCATED})
+                   if wall_now - i.updated_at <= self.launch_grace_s}
 
         want_new = 0
         if unplaceable > 0 and self._cfg.node_resources:
@@ -138,7 +140,7 @@ class Autoscaler:
                            if v > 0) or 1))
             per_node_cap = per_host_cap * hosts
             want_new = min(
-                math.ceil(unplaceable / per_node_cap) - len(self._launching),
+                math.ceil(unplaceable / per_node_cap) - len(booting),
                 self._cfg.max_workers - len(cur))
         want_new = max(want_new, self._cfg.min_workers - len(cur))
         for _ in range(max(0, want_new)):
@@ -150,7 +152,7 @@ class Autoscaler:
                 logger.warning("instance %s allocation failed: %s",
                                inst.instance_id[:8], inst.history[-1][3])
                 continue
-            self._launching[inst.name] = now
+            booting.add(inst.name)
             self.num_launched += 1
             logger.info("autoscaler launched node %s (unplaceable=%d)",
                         inst.name, unplaceable)
@@ -164,7 +166,7 @@ class Autoscaler:
             # register minutes before host N on real TPU slices, and
             # draining it would churn launch/terminate forever while the
             # slice PG never places
-            idle = (name not in self._launching
+            idle = (name not in booting
                     and len(nodes) >= hosts
                     and all(n["available"] == n["resources"] for n in nodes))
             if not idle:
@@ -201,6 +203,27 @@ class Autoscaler:
         while not self._stopped.is_set():
             try:
                 self.update()
+                self._publish_state()
             except Exception:  # noqa: BLE001
                 logger.exception("autoscaler update failed")
             self._stopped.wait(self._cfg.poll_interval_s)
+
+    def _publish_state(self) -> None:
+        """Export instance lifecycle state to the CP KV for the dashboard
+        (the train-run publishing pattern; reference: autoscaler state in
+        the dashboard's cluster view). Best-effort."""
+        import json as _json
+        try:
+            payload = {
+                "summary": self.instance_manager.summary(),
+                "num_launched": self.num_launched,
+                "num_terminated": self.num_terminated,
+                "instances": [i.to_dict() for i in
+                              self.instance_manager.instances()][-100:],
+                "updated_at": time.time(),
+            }
+            self._cp.notify("kv_put", {
+                "key": f"autoscaler:instances:{self.scaler_id}",
+                "value": _json.dumps(payload, default=str).encode()})
+        except Exception:  # noqa: BLE001 — observability must not kill scaling
+            pass

@@ -63,9 +63,8 @@ class InstanceManager:
 
     _MAX_TERMINAL = 64  # retained terminal records (audit window)
 
-    def __init__(self, provider, *, allocate_grace_s: float = 600.0):
+    def __init__(self, provider):
         self._provider = provider
-        self._grace = allocate_grace_s  # stuck-boot flag threshold (no kill)
         self._instances: dict[str, Instance] = {}
 
     # ---- queries -------------------------------------------------------
@@ -80,8 +79,13 @@ class InstanceManager:
                                InstanceState.RAY_RUNNING})
 
     def by_name(self, name: str) -> Optional[Instance]:
-        return next((i for i in self._instances.values()
-                     if i.name == name), None)
+        """Newest NON-TERMINAL instance with this provider name: providers
+        reuse names, and a retained TERMINATED audit record must never
+        shadow the live instance."""
+        matches = [i for i in self._instances.values() if i.name == name
+                   and i.state not in (InstanceState.TERMINATED,
+                                       InstanceState.ALLOCATION_FAILED)]
+        return max(matches, key=lambda i: i.created_at, default=None)
 
     def summary(self) -> dict:
         out: dict[str, int] = {}
@@ -90,9 +94,16 @@ class InstanceManager:
         return out
 
     # ---- transitions ---------------------------------------------------
+    _MAX_HISTORY = 50  # per-instance transition records (retry loops cap)
+
     def _transition(self, inst: Instance, to: InstanceState,
                     reason: str) -> None:
         inst.history.append((time.time(), inst.state.value, to.value, reason))
+        if len(inst.history) > self._MAX_HISTORY:
+            # keep creation + the most recent window (a provider outage
+            # retrying every tick must not grow this unboundedly)
+            inst.history = inst.history[:1] + \
+                inst.history[-(self._MAX_HISTORY - 1):]
         logger.info("instance %s: %s -> %s (%s)", inst.instance_id[:8],
                     inst.state.value, to.value, reason)
         inst.state = to
@@ -152,7 +163,8 @@ class InstanceManager:
     def reconcile(self, ray_running: Callable[[str], bool]) -> None:
         """One tick: push QUEUED into the provider, observe ALLOCATED →
         RAY_RUNNING via the CP view, TERMINATING → TERMINATED via the
-        provider view, and fail instances stuck past the grace window."""
+        provider view. Boot-time policy (grace windows) stays with the
+        autoscaler — the manager only records truth."""
         provider_nodes = set(self._provider.non_terminated_nodes())
         # adopt provider nodes this manager doesn't know (process restart):
         # "every provider node is tracked" must hold from the first tick

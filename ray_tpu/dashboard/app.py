@@ -62,7 +62,7 @@ async function refresh() {
     sparkline(ts, "memory_percent_avg", "cluster mem %") +
     sparkline(ts, "logical_cpus_in_use", "logical CPUs in use") +
     sparkline(ts, "object_store_used_bytes", "object store bytes");
-  const sections = ["nodes", "train", "serve", "actors", "pgs", "jobs", "tasks"];
+  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks"];
   let html = "";
   for (const s of sections) {
     const rows = await (await fetch("/api/" + s)).json();
@@ -108,6 +108,31 @@ def _train_runs() -> list[dict]:
         except ValueError:
             continue
     return out
+
+
+def _autoscaler_state() -> list[dict]:
+    """Instance lifecycle rows published by autoscalers to the CP KV
+    (one key per scaler — stacked autoscalers merge here; reference:
+    dashboard cluster view's autoscaler status)."""
+    from ray_tpu.core import api
+    rt = api._get_runtime()
+    keys = rt.cp_client.call_with_retry(
+        "kv_keys", {"prefix": "autoscaler:instances"}, timeout=10.0) or []
+    rows: list[dict] = []
+    for key in sorted(keys):
+        raw = rt.cp_client.call_with_retry("kv_get", {"key": key},
+                                           timeout=10.0)
+        if raw is None:
+            continue
+        try:
+            state = json.loads(raw.decode()
+                               if isinstance(raw, bytes) else raw)
+        except ValueError:
+            continue
+        scaler = key.rsplit(":", 1)[-1]
+        rows.extend({"scaler": scaler, **i}
+                    for i in state.get("instances") or [])
+    return rows
 
 
 def _serve_apps() -> list[dict]:
@@ -326,6 +351,8 @@ class Dashboard:
                 return JobSubmissionClient().list_jobs()
             if section == "train":
                 return _train_runs()
+            if section == "autoscaler":
+                return _autoscaler_state()
             if section == "serve":
                 return _serve_apps()
             if section == "timeseries":

@@ -200,7 +200,7 @@ def test_instance_manager_state_machine():
             return list(self.nodes)
 
     prov = Prov()
-    im = InstanceManager(prov, allocate_grace_s=600)
+    im = InstanceManager(prov)
     registered: set = set()
 
     inst = im.queue_launch({"resources": {"CPU": 1}})

@@ -129,3 +129,33 @@ def test_dashboard_serve_section(dash):
         assert "queue_lens" in row
     finally:
         serve.shutdown()
+
+
+def test_dashboard_autoscaler_section(dash):
+    """Instance lifecycle rows published by a live autoscaler appear in
+    the dashboard's autoscaler section."""
+    from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+    from ray_tpu.autoscaler.node_provider import FakeNodeProvider
+    from ray_tpu.core import api
+
+    rt = api._get_runtime()
+    provider = FakeNodeProvider(rt.cp_addr, inproc_workers=True)
+    scaler = Autoscaler(rt.cp_addr, provider,
+                        AutoscalerConfig(min_workers=1, max_workers=1,
+                                         node_resources={"CPU": 1},
+                                         idle_timeout_s=300.0))
+    try:
+        deadline = time.monotonic() + 60
+        rows = []
+        while time.monotonic() < deadline:
+            scaler.update()
+            scaler._publish_state()
+            rows = _get(dash, "/api/autoscaler")
+            if rows and rows[0]["state"] == "RAY_RUNNING":
+                break
+            time.sleep(0.5)
+        assert rows and rows[0]["state"] == "RAY_RUNNING"
+        assert rows[0]["history"], "no lifecycle history recorded"
+    finally:
+        for name in provider.non_terminated_nodes():
+            provider.terminate_node(name)
