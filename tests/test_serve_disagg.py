@@ -166,3 +166,35 @@ def test_disagg_dag_pipeline_e2e(disagg_dag_app):
     for out in outs:
         assert out["object"] == "text_completion"
         assert out["usage"]["completion_tokens"] == 4
+
+
+def test_handoff_channel_capacity_sizing():
+    """ADVICE r4: the compiled-pipeline channel must fit the LARGEST KV
+    handoff blob the config can produce (>1 page, model dtype), not the
+    8 MiB default that only fit the tiny test config."""
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm.config import LLMConfig
+    from ray_tpu.serve.llm.disagg import _handoff_channel_capacity
+
+    mc = llama.llama3_1b(max_seq_len=2048)
+    cfg = LLMConfig(model_id="x", model_config=mc, page_size=128,
+                    max_prompt_len=1024, max_seq_len=2048)
+    cap = _handoff_channel_capacity(cfg)
+    pages = -(-cfg.max_prompt_len // cfg.page_size)
+    assert pages == 8  # a real multi-page prompt
+    kv_bytes = 2 * mc.n_layers * mc.n_kv_heads * pages * cfg.page_size \
+        * mc.head_dim * np.dtype(mc.dtype).itemsize
+    assert cap > kv_bytes          # blob + framing headroom fits
+    assert cap > 8 * 1024 * 1024   # and exceeds the old default
+    # picklable envelope of that worst-case blob actually fits
+    import pickle
+    blob = {"kv_k": np.zeros((mc.n_layers, mc.n_kv_heads, pages,
+                              cfg.page_size, mc.head_dim),
+                             np.dtype(mc.dtype)),
+            "kv_v": np.zeros((mc.n_layers, mc.n_kv_heads, pages,
+                              cfg.page_size, mc.head_dim),
+                             np.dtype(mc.dtype)),
+            "prompt_tokens": list(range(cfg.max_prompt_len))}
+    assert len(pickle.dumps(blob, protocol=5)) <= cap
