@@ -10,11 +10,14 @@ not single VMs).
 """
 
 from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.instance_manager import InstanceManager, InstanceState
 from ray_tpu.autoscaler.node_provider import (
     FakeNodeProvider,
     GCETPUNodeProvider,
+    KubernetesNodeProvider,
     NodeProvider,
 )
 
 __all__ = ["Autoscaler", "AutoscalerConfig", "FakeNodeProvider",
-           "GCETPUNodeProvider", "NodeProvider"]
+           "GCETPUNodeProvider", "InstanceManager", "InstanceState",
+           "KubernetesNodeProvider", "NodeProvider"]

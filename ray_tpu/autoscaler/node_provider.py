@@ -134,3 +134,108 @@ class GCETPUNodeProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> list[str]:
         return sorted(self._nodes)
+
+
+class KubernetesNodeProvider(NodeProvider):
+    """KubeRay-style provider: each node is a pod running a node agent
+    (reference: autoscaler/kuberay/ + the KubeRay operator's worker
+    groups, collapsed to the provider interface — this framework's
+    controller/agent processes ARE the pod entrypoint, so the operator's
+    CRD layer reduces to pod create/delete/list).
+
+    Shells out to `kubectl` (no kubernetes SDK dependency; gated with a
+    clear error when absent). Pods run `python -m ray_tpu start --address
+    <head>` with resources from the node_config; a `ray-tpu-node` label
+    keys listing and the provider-name label lets the autoscaler match CP
+    nodes back to pods for idle scale-down.
+    """
+
+    _LABEL = "ray-tpu-node"
+
+    def __init__(self, cluster_address: str, *, namespace: str = "default",
+                 image: str = "ray-tpu:latest",
+                 pod_template: Optional[dict] = None):
+        import shutil as _shutil
+        if _shutil.which("kubectl") is None:
+            raise RuntimeError(
+                "KubernetesNodeProvider requires kubectl on PATH "
+                "(not present in this image)")
+        self.cluster_address = cluster_address
+        self.namespace = namespace
+        self.image = image
+        self.pod_template = pod_template or {}
+        self._counter = 0
+
+    def _kubectl(self, *args: str, stdin: Optional[str] = None) -> str:
+        import subprocess
+        out = subprocess.run(
+            ["kubectl", "-n", self.namespace, *args],
+            input=stdin, capture_output=True, text=True, timeout=300)
+        if out.returncode != 0:
+            raise RuntimeError(f"kubectl failed: {out.stderr[-500:]}")
+        return out.stdout
+
+    def create_node(self, node_config: dict) -> str:
+        import json as _json
+        self._counter += 1
+        name = node_config.get("name") or f"ray-tpu-worker-{self._counter}"
+        resources = dict(node_config.get("resources") or {})
+        cpu = float(resources.get("CPU", 1))
+        # millicores: fractional CPUs are normal in Ray-style dicts and a
+        # truncated "0" request hard-throttles the pod
+        requests = {"cpu": f"{int(cpu * 1000)}m"}
+        if resources.get("TPU"):
+            requests["google.com/tpu"] = str(int(resources["TPU"]))
+        pod = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name,
+                         "labels": {self._LABEL: "true",
+                                    "provider-node-name": name}},
+            "spec": {
+                **{k: v for k, v in self.pod_template.items()
+                   if k not in ("containers",)},
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "node",
+                    "image": node_config.get("image", self.image),
+                    # --labels speaks the CLI's k=v[,k2=v2] format — the
+                    # provider_node_name label is how the autoscaler maps
+                    # CP nodes back to pods for idle scale-down
+                    "command": ["python", "-m", "ray_tpu", "start",
+                                "--address", self.cluster_address,
+                                "--labels", ",".join(
+                                    f"{k}={v}" for k, v in
+                                    {"provider_node_name": name,
+                                     **(node_config.get("labels") or {})}
+                                    .items())],
+                    "resources": {"requests": requests,
+                                  "limits": dict(requests)},
+                }],
+            },
+        }
+        if "containers" in self.pod_template:
+            raise ValueError(
+                "pod_template must not define 'containers' (the provider "
+                "owns the node-agent container); use sidecar-free "
+                "templates for tolerations/nodeSelector/etc.")
+        self._kubectl("apply", "-f", "-", stdin=_json.dumps(pod))
+        return name
+
+    def terminate_node(self, name: str) -> None:
+        self._kubectl("delete", "pod", name, "--ignore-not-found=true",
+                      "--wait=false")
+
+    def non_terminated_nodes(self) -> list[str]:
+        import json as _json
+        out = self._kubectl("get", "pods", "-l", f"{self._LABEL}=true",
+                            "-o", "json")
+        items = _json.loads(out or "{}").get("items", [])
+        alive = []
+        for pod in items:
+            phase = (pod.get("status") or {}).get("phase", "")
+            deleting = (pod.get("metadata") or {}).get("deletionTimestamp")
+            # a gracefully-terminating pod keeps phase Running with only
+            # deletionTimestamp set — it is NOT live capacity
+            if phase in ("Pending", "Running") and not deleting:
+                alive.append(pod["metadata"]["name"])
+        return alive
