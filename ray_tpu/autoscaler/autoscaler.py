@@ -73,6 +73,15 @@ class Autoscaler:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        # retract our published state: the per-scaler KV key would
+        # otherwise outlive this scaler forever and the dashboard would
+        # keep showing its dead instances (best-effort — the dashboard
+        # also filters rows with stale updated_at)
+        try:
+            self._cp.notify(
+                "kv_del", {"key": f"autoscaler:instances:{self.scaler_id}"})
+        except Exception:  # noqa: BLE001 — CP may already be gone
+            pass
 
     # ---- one reconciliation pass (public for tests) --------------------
     def update(self) -> None:

@@ -177,8 +177,13 @@ class KubernetesNodeProvider(NodeProvider):
 
     def create_node(self, node_config: dict) -> str:
         import json as _json
+        import uuid as _uuid
         self._counter += 1
-        name = node_config.get("name") or f"ray-tpu-worker-{self._counter}"
+        # unique suffix: the counter resets on autoscaler restart, and a
+        # bare counter name would collide with a pod the previous
+        # incarnation left behind
+        name = node_config.get("name") or \
+            f"ray-tpu-worker-{self._counter}-{_uuid.uuid4().hex[:6]}"
         resources = dict(node_config.get("resources") or {})
         cpu = float(resources.get("CPU", 1))
         # millicores: fractional CPUs are normal in Ray-style dicts and a
@@ -218,7 +223,11 @@ class KubernetesNodeProvider(NodeProvider):
                 "pod_template must not define 'containers' (the provider "
                 "owns the node-agent container); use sidecar-free "
                 "templates for tolerations/nodeSelector/etc.")
-        self._kubectl("apply", "-f", "-", stdin=_json.dumps(pod))
+        # `create`, NOT `apply`: apply is idempotent, so a name collision
+        # with a leftover pod "succeeds" without starting anything and the
+        # instance manager counts phantom capacity. create fails loudly
+        # (_kubectl raises) and the launch lands in ALLOCATION_FAILED.
+        self._kubectl("create", "-f", "-", stdin=_json.dumps(pod))
         return name
 
     def terminate_node(self, name: str) -> None:

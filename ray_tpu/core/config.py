@@ -128,6 +128,18 @@ class Config:
     # --- task events / observability ---
     task_events_buffer_size: int = 10000
     task_events_flush_interval_s: float = 1.0
+    # Distributed tracing (observability/tracing.py). Head-based sampling:
+    # the root caller rolls tracing_sample_rate once; the decision
+    # propagates by carrier presence, so rate 0 / disabled leaves the hot
+    # path span-free everywhere.
+    tracing_enabled: bool = False
+    tracing_sample_rate: float = 1.0
+    # finished spans per report_spans RPC (also flushed when the local
+    # span stack unwinds and on shutdown)
+    trace_flush_batch: int = 256
+    # control-plane trace store: evict whole oldest traces past this
+    # total span count (bounded ring, ref: GcsTaskManager's bounded sink)
+    trace_store_max_spans: int = 50000
 
     # --- misc ---
     worker_register_timeout_s: float = 30.0

@@ -119,6 +119,12 @@ class ControlPlane:
         self._stopped = threading.Event()
         self._task_events: list[dict] = []  # GcsTaskManager-style sink (bounded)
         self._task_event_counts: dict[str, int] = {}  # running totals
+        # trace store (observability/tracing.py sink): spans grouped per
+        # trace, whole oldest traces evicted past trace_store_max_spans
+        self._trace_index: dict[str, list[dict]] = {}  # trace_id -> spans
+        self._trace_meta: dict[str, dict] = {}         # trace_id -> summary
+        self._trace_order: list[str] = []              # insertion order
+        self._trace_span_count = 0
         self._store = make_meta_store(
             store_path if store_path is not None
             else (get_config().cp_store_path or None))
@@ -475,6 +481,80 @@ class ControlPlane:
         limit = body.get("limit", 1000) if body else 1000
         with self._lock:
             return list(self._task_events[-limit:])
+
+    # ---- trace store (observability/tracing.py sink) -------------------
+    def _h_report_spans(self, body):
+        import json as _json
+        spans = (body or {}).get("spans") or []
+        touched: set[str] = set()
+        with self._lock:
+            for s in spans:
+                tid = s.get("trace_id")
+                if not tid:
+                    continue
+                if tid not in self._trace_index:
+                    self._trace_index[tid] = []
+                    self._trace_order.append(tid)
+                    self._trace_meta[tid] = {
+                        "trace_id": tid, "name": s.get("name", ""),
+                        "start": s.get("start"), "end": s.get("end"),
+                        "num_spans": 0, "root_seen": False}
+                self._trace_index[tid].append(s)
+                self._trace_span_count += 1
+                touched.add(tid)
+                meta = self._trace_meta[tid]
+                meta["num_spans"] += 1
+                st, en = s.get("start"), s.get("end")
+                if st is not None and (meta["start"] is None
+                                       or st < meta["start"]):
+                    meta["start"] = st
+                if en is not None and (meta["end"] is None
+                                       or en > meta["end"]):
+                    meta["end"] = en
+                if not s.get("parent_id"):
+                    # the root span names the trace
+                    meta["name"] = s.get("name", meta["name"])
+                    meta["root_seen"] = True
+            # whole-trace eviction, oldest first (bounded ring)
+            max_spans = max(1, get_config().trace_store_max_spans)
+            while (self._trace_span_count > max_spans
+                   and len(self._trace_order) > 1):
+                old = self._trace_order.pop(0)
+                gone = self._trace_index.pop(old, [])
+                self._trace_span_count -= len(gone)
+                self._trace_meta.pop(old, None)
+                touched.discard(old)
+                self._h_kv_del({"key": f"trace:{old}"})
+            # KV index: one summary key per trace, queryable via kv_keys
+            # (RLock: _h_kv_put re-enters safely)
+            for tid in touched:
+                meta = self._trace_meta.get(tid)
+                if meta is not None:
+                    self._h_kv_put({
+                        "key": f"trace:{tid}",
+                        "value": _json.dumps(meta).encode()})
+        return {"ok": True}
+
+    def _h_get_trace(self, body):
+        tid = (body or {}).get("trace_id") or ""
+        with self._lock:
+            full = tid if tid in self._trace_index else next(
+                (t for t in self._trace_order if t.startswith(tid)), None)
+            if full is None:
+                return None
+            spans = sorted(self._trace_index[full],
+                           key=lambda s: s.get("start") or 0.0)
+            return {"trace_id": full,
+                    "meta": dict(self._trace_meta.get(full) or {}),
+                    "spans": spans}
+
+    def _h_list_traces(self, body):
+        limit = (body or {}).get("limit", 100)
+        with self._lock:
+            metas = [dict(self._trace_meta[t])
+                     for t in reversed(self._trace_order)
+                     if t in self._trace_meta]
+        return metas[:limit]
 
     # ---- actors -------------------------------------------------------
     def _h_create_actor(self, body):

@@ -395,6 +395,18 @@ class NodeAgent:
                 # interpreter, so its installed packages shadow the base
                 # environment's (reference pip/uv plugin semantics)
                 python_exe = venv_py
+            # pin every cache entry this worker will run out of: the env
+            # GC must never rmtree a live worker's cwd/py_modules/venv
+            # (unpinned when the agent reaps the worker)
+            from ray_tpu.runtime_env.packaging import pin_env_paths
+            pin_paths = list(pypath)
+            if env_cwd:
+                pin_paths.append(env_cwd)
+            if venv_py:
+                # <env_root>/venv-<key>/bin/python -> the venv entry dir
+                pin_paths.append(
+                    os.path.dirname(os.path.dirname(venv_py)))
+            pin_env_paths(worker_id.hex(), pin_paths)
         # see ray_tpu/__init__.py: arrow's mimalloc pool is unsafe under the
         # worker's thread profile; pin the system pool unless the user set one
         env.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
@@ -584,6 +596,7 @@ class NodeAgent:
                             and i.proc.poll() is not None]
                     for wid in dead:
                         del self._workers[wid]
+                        self._unpin_worker_envs(wid)
                     # not "in dead": a CONCURRENT lease loop may have reaped
                     # our corpse in its own iteration — absence from the
                     # pool is the durable signal (a healthy registered spawn
@@ -638,6 +651,7 @@ class NodeAgent:
                             if victim is not None:
                                 victim.busy = True  # unleaseable while dying
                                 del self._workers[victim.worker_id]
+                                self._unpin_worker_envs(victim.worker_id)
                                 evict_proc = victim.proc
                                 spawned = need_spawn = True
                     elif pg_id is None:
@@ -1015,6 +1029,16 @@ class NodeAgent:
         except Exception:  # noqa: BLE001
             pass
 
+    def _unpin_worker_envs(self, worker_id) -> None:
+        """Release a reaped worker's runtime-env cache pins so the LRU GC
+        may evict its entries again."""
+        try:
+            from ray_tpu.runtime_env.packaging import unpin_env_paths
+            unpin_env_paths(worker_id.hex() if hasattr(worker_id, "hex")
+                            else str(worker_id))
+        except Exception:  # noqa: BLE001 — cleanup must not break reaping
+            pass
+
     def _on_worker_dead(self, info: _WorkerInfo):
         code = info.proc.returncode if info.proc else None
         logger.info("worker %s (pid %s, actor=%s) died, exit code %s",
@@ -1039,7 +1063,9 @@ class NodeAgent:
                         # monitor reaps + a fresh worker spawns clean)
                         to_kill.append(w.proc)
                         del self._workers[w.worker_id]
+                        self._unpin_worker_envs(w.worker_id)
             self._lease_cv.notify_all()
+        self._unpin_worker_envs(info.worker_id)
         for proc in to_kill:
             try:
                 if proc is not None:

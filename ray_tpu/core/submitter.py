@@ -295,6 +295,11 @@ class NormalTaskSubmitter:
             st0 = self._shapes.get(key)
             strategy = st0.strategy if st0 else None
             runtime_env = st0.runtime_env if st0 else None
+            # lease pool threads have no ambient span context; the head of
+            # the queue is a representative parent for this lease round
+            trace_parent = (getattr(st0.queue[0], "trace_ctx", None)
+                            if st0 and st0.queue else None)
+        lease_t0 = time.time()
         max_hops = 4
         try:
             if pg_id is not None:
@@ -346,6 +351,13 @@ class NormalTaskSubmitter:
                 break
         except Exception as e:
             logger.debug("lease request failed: %s", e)
+        if trace_parent:
+            from ray_tpu.observability import tracing
+            tracing.record_span(
+                "lease.acquire", lease_t0, time.time(),
+                parent=trace_parent, kind="scheduler",
+                attrs={"granted": granted is not None,
+                       "resources": repr(resources)})
         with self._lock:
             st = self._shapes.get(key)
             if st is None:

@@ -119,6 +119,12 @@ class TaskSpec:
     depth: int = 0
     # attempt bookkeeping (set on retries)
     attempt_number: int = 0
+    # distributed tracing carrier ({"trace_id","span_id"}; ref:
+    # util/tracing/tracing_helper.py _DictPropagator — span context rides
+    # the spec so the executor parents its span under the caller's). Last
+    # field on purpose: older 25-tuple pickles keep loading (shorter
+    # tuples leave later fields at their defaults).
+    trace_ctx: dict | None = None
 
     # Tuple-based pickling: specs cross the wire once per task (batched into
     # frames, but still serialized per spec) — the default dataclass

@@ -43,6 +43,7 @@ from ray_tpu.core.task_spec import (
     TaskType,
 )
 from ray_tpu.core.refcount import ReferenceCounter
+from ray_tpu.observability import tracing
 from ray_tpu.exceptions import (
     GetTimeoutError,
     ObjectLostError,
@@ -325,6 +326,11 @@ class WorkerRuntime:
         self._early_send_failures: dict[tuple, float] = {}  # addr -> ts
         self._driver_task_id = TaskID.for_driver(job_id)
         self.task_events: list[dict] = []  # flushed to CP (TaskEventBuffer)
+        # span sink: finished spans batch to the CP trace store over the
+        # same notify path as task events (observability/tracing.py)
+        tracing.register_flusher(
+            lambda spans: self.cp_client.notify(
+                "report_spans", {"spans": spans}))
         self._server = RpcServer(
             self._handle, host=host, name=f"{mode}-rpc",
             blocking_methods={"push_task", "get_object_status", "wait_object"},
@@ -381,22 +387,24 @@ class WorkerRuntime:
         self.drain_releases()
         self._ctx.put_counter += 1
         oid = ObjectID.for_put(self.current_task_id(), self._ctx.put_counter)
-        if _is_device_array(value):
-            # device-resident object (ref: experimental/gpu_object_manager):
-            # the array stays in THIS process's HBM; same-process gets return
-            # the live handle with no device↔host round-trip. The serialized
-            # host copy below is the durable/cross-process representation
-            # (chips admit one process, so crossing processes crosses the
-            # host anyway — SURVEY.md §7 hard-part 7).
-            self._device_objects[oid] = value
-            device_hint = device_hint or "jax"
-        sobj = self.serialization.serialize(value)
-        self.reference_counter.add_owned(oid, contained_refs=sobj.contained_refs)
-        if sobj.serialized_size() <= get_config().max_inline_object_size or self.agent_addr is None:
-            self.memory_store.put_inline(oid, sobj)
-        else:
-            self._put_shm(oid, sobj, device_hint)
-        return ObjectRef(oid, self.worker_id, self.addr)
+        with tracing.span("object.put", kind="object", child_only=True,
+                          attrs={"object_id": oid.hex()[:16]}):
+            if _is_device_array(value):
+                # device-resident object (ref: experimental/gpu_object_manager):
+                # the array stays in THIS process's HBM; same-process gets return
+                # the live handle with no device↔host round-trip. The serialized
+                # host copy below is the durable/cross-process representation
+                # (chips admit one process, so crossing processes crosses the
+                # host anyway — SURVEY.md §7 hard-part 7).
+                self._device_objects[oid] = value
+                device_hint = device_hint or "jax"
+            sobj = self.serialization.serialize(value)
+            self.reference_counter.add_owned(oid, contained_refs=sobj.contained_refs)
+            if sobj.serialized_size() <= get_config().max_inline_object_size or self.agent_addr is None:
+                self.memory_store.put_inline(oid, sobj)
+            else:
+                self._put_shm(oid, sobj, device_hint)
+            return ObjectRef(oid, self.worker_id, self.addr)
 
     def _put_shm(self, oid: ObjectID, sobj: SerializedObject, device_hint: str = ""):
         size = sobj.serialized_size()
@@ -433,18 +441,20 @@ class WorkerRuntime:
             timeout = get_config().blocking_watchdog_s
         deadline = None if timeout is None else time.monotonic() + timeout
         out: list[Any] = []
-        for ref in refs:
-            try:
-                out.append(self._get_one(ref, deadline))
-            except GetTimeoutError:
-                if not watchdog:
-                    raise
-                raise GetTimeoutError(
-                    f"get() watchdog: no result after {timeout:.0f}s on "
-                    f"{ref.id().hex()[:12]} — a lost reply or dead owner "
-                    "would otherwise hang forever. For legitimately longer "
-                    "work pass an explicit timeout or raise/disable "
-                    "RAY_TPU_BLOCKING_WATCHDOG_S (0 disables).") from None
+        with tracing.span("object.get", kind="object", child_only=True,
+                          attrs={"num_refs": len(refs)}):
+            for ref in refs:
+                try:
+                    out.append(self._get_one(ref, deadline))
+                except GetTimeoutError:
+                    if not watchdog:
+                        raise
+                    raise GetTimeoutError(
+                        f"get() watchdog: no result after {timeout:.0f}s on "
+                        f"{ref.id().hex()[:12]} — a lost reply or dead owner "
+                        "would otherwise hang forever. For legitimately longer "
+                        "work pass an explicit timeout or raise/disable "
+                        "RAY_TPU_BLOCKING_WATCHDOG_S (0 disables).") from None
         return out
 
     def _remaining(self, deadline) -> float | None:
@@ -817,11 +827,14 @@ class WorkerRuntime:
             retry_exceptions=retry_exceptions, runtime_env=runtime_env,
             owner_id=self.worker_id, owner_addr=self.addr,
             caller_id=self.worker_id, depth=self._depth() + 1)
-        refs = self._register_returns(spec)
-        gen = self.stream_manager.register(spec) if streaming else None
-        self.task_manager.add_pending(spec)
-        self._record_task_event(spec, "SUBMITTED")
-        self.normal_submitter.submit(spec)
+        with tracing.span(f"task.submit:{spec.name}", kind="submit",
+                          attrs={"task_id": spec.task_id.hex()[:16]}):
+            spec.trace_ctx = tracing.inject()
+            refs = self._register_returns(spec)
+            gen = self.stream_manager.register(spec) if streaming else None
+            self.task_manager.add_pending(spec)
+            self._record_task_event(spec, "SUBMITTED")
+            self.normal_submitter.submit(spec)
         return gen if streaming else refs
 
     def submit_actor_creation(self, cls, args: tuple, kwargs: dict, *, actor_id: ActorID,
@@ -847,9 +860,13 @@ class WorkerRuntime:
             max_task_retries=max_task_retries, max_concurrency=max_concurrency,
             is_async_actor=is_async, caller_id=self.worker_id,
             runtime_env=runtime_env, concurrency_groups=concurrency_groups)
-        self.cp_client.call_with_retry(
-            "create_actor", {"spec": spec, "name": name, "detached": detached},
-            timeout=60.0)
+        with tracing.span(f"actor.create:{spec.name}", kind="submit",
+                          attrs={"actor_id": actor_id.hex()[:16]}):
+            spec.trace_ctx = tracing.inject()
+            self.cp_client.call_with_retry(
+                "create_actor",
+                {"spec": spec, "name": name, "detached": detached},
+                timeout=60.0)
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args: tuple,
                           kwargs: dict, *, num_returns: int | str = 1,
@@ -868,11 +885,16 @@ class WorkerRuntime:
             owner_id=self.worker_id, owner_addr=self.addr,
             actor_id=actor_id, caller_id=self.worker_id,
             concurrency_group=concurrency_group)
-        refs = self._register_returns(spec)
-        gen = self.stream_manager.register(spec) if streaming else None
-        self.task_manager.add_pending(spec)
-        self._record_task_event(spec, "SUBMITTED")
-        self.actor_submitter.submit(spec)
+        with tracing.span(f"actor.submit:{spec.name or spec.method_name}",
+                          kind="submit",
+                          attrs={"task_id": spec.task_id.hex()[:16],
+                                 "actor_id": actor_id.hex()[:16]}):
+            spec.trace_ctx = tracing.inject()
+            refs = self._register_returns(spec)
+            gen = self.stream_manager.register(spec) if streaming else None
+            self.task_manager.add_pending(spec)
+            self._record_task_event(spec, "SUBMITTED")
+            self.actor_submitter.submit(spec)
         return gen if streaming else refs
 
     def _bump_counter(self) -> int:
@@ -1393,19 +1415,26 @@ class WorkerRuntime:
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
         try:
-            t0 = time.monotonic()
-            fn = self.function_manager.get(spec.function_id)
-            t1 = time.monotonic()
-            args, kwargs = self._resolve_args(spec)
-            t2 = time.monotonic()
-            if t2 - t0 > 0.05:
-                logger.info("task %s setup: fn_get=%.3fs args=%.3fs",
-                            spec.repr_name(), t1 - t0, t2 - t1)
-            if spec.task_type == TaskType.ACTOR_TASK:
-                method = self._actor_method(spec.method_name)
-                result = method(*args, **kwargs)
-            else:
-                result = fn(*args, **kwargs)
+            # extract the caller's span context from the spec so nested
+            # submits from the task body stitch into the same trace
+            with tracing.span_from(
+                    spec.trace_ctx, f"task.run:{spec.repr_name()}",
+                    attrs={"task_id": spec.task_id.hex()[:16],
+                           "worker_id": self.worker_id.hex()[:16],
+                           "attempt": spec.attempt_number}):
+                t0 = time.monotonic()
+                fn = self.function_manager.get(spec.function_id)
+                t1 = time.monotonic()
+                args, kwargs = self._resolve_args(spec)
+                t2 = time.monotonic()
+                if t2 - t0 > 0.05:
+                    logger.info("task %s setup: fn_get=%.3fs args=%.3fs",
+                                spec.repr_name(), t1 - t0, t2 - t1)
+                if spec.task_type == TaskType.ACTOR_TASK:
+                    method = self._actor_method(spec.method_name)
+                    result = method(*args, **kwargs)
+                else:
+                    result = fn(*args, **kwargs)
             return self._success_reply(spec, result)
         except BaseException as e:  # noqa: BLE001 — app errors ship to the owner
             if isinstance(e, TaskError):
@@ -1422,7 +1451,11 @@ class WorkerRuntime:
             if a.is_ref:
                 oid, owner, owner_addr, key = a.ref
                 ref = ObjectRef(oid, owner, owner_addr, _skip_refcount=True)
-                value = self._get_one(ref, deadline=time.monotonic() + 300.0)
+                with tracing.span("task.dep_fetch", kind="object",
+                                  child_only=True,
+                                  attrs={"object_id": oid.hex()[:16]}):
+                    value = self._get_one(
+                        ref, deadline=time.monotonic() + 300.0)
             else:
                 key = a.contained[0] if a.contained else None
                 value = self.serialization.deserialize(
@@ -1644,14 +1677,17 @@ class WorkerRuntime:
         st = self._actor_state
         try:
             self._bind_exec_thread()
-            cls = self.function_manager.get(spec.function_id)
-            args, kwargs = self._resolve_args(spec)
-            prev = self._ctx.task_id
-            self._ctx.task_id = spec.task_id
-            try:
-                instance = cls(*args, **kwargs)
-            finally:
-                self._ctx.task_id = prev
+            with tracing.span_from(
+                    spec.trace_ctx, f"actor.init:{spec.name}",
+                    attrs={"actor_id": spec.actor_id.hex()[:16]}):
+                cls = self.function_manager.get(spec.function_id)
+                args, kwargs = self._resolve_args(spec)
+                prev = self._ctx.task_id
+                self._ctx.task_id = spec.task_id
+                try:
+                    instance = cls(*args, **kwargs)
+                finally:
+                    self._ctx.task_id = prev
             st.instance = instance
             st.actor_id = spec.actor_id
             st.pool = ThreadPoolExecutor(
@@ -1816,7 +1852,11 @@ class WorkerRuntime:
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
         try:
-            result = await method(*args, **kwargs)
+            with tracing.span_from(
+                    spec.trace_ctx, f"actor.run:{spec.name or spec.method_name}",
+                    attrs={"task_id": spec.task_id.hex()[:16],
+                           "worker_id": self.worker_id.hex()[:16]}):
+                result = await method(*args, **kwargs)
             reply = self._success_reply(spec, result)
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, SystemExit):
@@ -1838,15 +1878,19 @@ class WorkerRuntime:
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
         try:
-            method = self._actor_method(spec.method_name)
-            args, kwargs = self._resolve_args(spec)
-            import inspect
-            if inspect.iscoroutinefunction(method) and st.loop is not None:
-                import asyncio
-                result = asyncio.run_coroutine_threadsafe(
-                    method(*args, **kwargs), st.loop).result()
-            else:
-                result = method(*args, **kwargs)
+            with tracing.span_from(
+                    spec.trace_ctx, f"actor.run:{spec.name or spec.method_name}",
+                    attrs={"task_id": spec.task_id.hex()[:16],
+                           "worker_id": self.worker_id.hex()[:16]}):
+                method = self._actor_method(spec.method_name)
+                args, kwargs = self._resolve_args(spec)
+                import inspect
+                if inspect.iscoroutinefunction(method) and st.loop is not None:
+                    import asyncio
+                    result = asyncio.run_coroutine_threadsafe(
+                        method(*args, **kwargs), st.loop).result()
+                else:
+                    result = method(*args, **kwargs)
             reply = self._success_reply(spec, result)
         except BaseException as e:  # noqa: BLE001
             if isinstance(e, SystemExit):
@@ -1905,6 +1949,7 @@ class WorkerRuntime:
             except Exception:
                 pass
         self.flush_task_events()
+        tracing.flush()
         self.normal_submitter.shutdown()
         self.actor_submitter.shutdown()
         self._server.stop()

@@ -62,7 +62,7 @@ async function refresh() {
     sparkline(ts, "memory_percent_avg", "cluster mem %") +
     sparkline(ts, "logical_cpus_in_use", "logical CPUs in use") +
     sparkline(ts, "object_store_used_bytes", "object store bytes");
-  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks"];
+  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks", "traces"];
   let html = "";
   for (const s of sections) {
     const rows = await (await fetch("/api/" + s)).json();
@@ -75,6 +75,10 @@ async function refresh() {
           let cell = esc(JSON.stringify(r[c]));
           if (s === "nodes" && c === "node_id" && typeof r[c] === "string") {
             cell = "<a href='/api/node/" + encodeURIComponent(r[c]) + "'>" +
+                   cell + "</a>";
+          }
+          if (s === "traces" && c === "trace_id" && typeof r[c] === "string") {
+            cell = "<a href='/trace/" + encodeURIComponent(r[c]) + "'>" +
                    cell + "</a>";
           }
           return "<td>" + cell + "</td>";
@@ -130,6 +134,12 @@ def _autoscaler_state() -> list[dict]:
         except ValueError:
             continue
         scaler = key.rsplit(":", 1)[-1]
+        # a stopped/crashed scaler's key may linger (stop() best-effort
+        # deletes it, but the CP can outlive that notify): hide rows whose
+        # publisher has gone quiet instead of showing dead instances
+        import time as _time
+        if _time.time() - float(state.get("updated_at") or 0) > 60.0:
+            continue
         rows.extend({"scaler": scaler, **i}
                     for i in state.get("instances") or [])
     return rows
@@ -192,6 +202,81 @@ def _hexify(obj):
     if isinstance(obj, bytes):
         return obj.hex()[:16]
     return obj
+
+
+_KIND_COLORS = {"submit": "#36c", "server": "#383", "scheduler": "#a60",
+                "object": "#888", "llm": "#a3a", "internal": "#555"}
+
+
+def _render_waterfall(trace: dict) -> str:
+    """Server-rendered waterfall HTML for one trace: spans sorted into
+    parent-first DFS order, each a bar offset/sized by its wall-clock
+    window relative to the trace extent."""
+    import html as _html
+
+    spans = trace.get("spans") or []
+    if not spans:
+        return "<html><body>empty trace</body></html>"
+    t0 = min(s.get("start") or 0.0 for s in spans)
+    t1 = max((s.get("end") or s.get("start") or 0.0) for s in spans)
+    total = max(t1 - t0, 1e-6)
+    by_id = {s.get("span_id"): s for s in spans}
+    children: dict = {}
+    roots = []
+    for s in sorted(spans, key=lambda s: s.get("start") or 0.0):
+        pid = s.get("parent_id")
+        if pid and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    ordered: list[tuple[dict, int]] = []
+
+    def walk(s, depth):
+        ordered.append((s, depth))
+        for c in children.get(s.get("span_id"), []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    rows = []
+    for s, depth in ordered:
+        start = (s.get("start") or t0) - t0
+        dur = max(((s.get("end") or s.get("start") or t0) - t0) - start, 0.0)
+        left = 100.0 * start / total
+        width = max(100.0 * dur / total, 0.15)
+        color = ("#c33" if s.get("status") == "error"
+                 else _KIND_COLORS.get(s.get("kind"), "#555"))
+        name = _html.escape(str(s.get("name", "span")))
+        label = (f"{name} — {dur * 1e3:.2f} ms "
+                 f"[{_html.escape(str(s.get('kind', '')))}]")
+        rows.append(
+            f"<div class='row'>"
+            f"<div class='label' style='padding-left:{depth * 14}px'"
+            f" title='{_html.escape(json.dumps(s.get('attrs') or {}))}'>"
+            f"{name}</div>"
+            f"<div class='lane'><div class='bar' title='{label}'"
+            f" style='left:{left:.2f}%;width:{width:.2f}%;"
+            f"background:{color}'></div></div>"
+            f"<div class='dur'>{dur * 1e3:.2f} ms</div></div>")
+    meta = trace.get("meta") or {}
+    head = _html.escape(str(meta.get("name", "")))
+    tid = _html.escape(str(trace.get("trace_id", "")))
+    return f"""<!doctype html>
+<html><head><title>trace {tid[:16]}</title><style>
+ body {{ font-family: monospace; margin: 2em; }}
+ .row {{ display: flex; align-items: center; height: 18px; }}
+ .label {{ width: 340px; overflow: hidden; white-space: nowrap;
+           text-overflow: ellipsis; flex-shrink: 0; }}
+ .lane {{ position: relative; flex-grow: 1; height: 12px;
+          background: #f4f4f4; border-left: 1px solid #ccc; }}
+ .bar {{ position: absolute; height: 12px; border-radius: 2px; }}
+ .dur {{ width: 110px; text-align: right; flex-shrink: 0; color: #666; }}
+</style></head><body>
+<h1>trace {tid[:16]}… — {head}</h1>
+<p>{len(spans)} spans over {total * 1e3:.2f} ms ·
+ <a href="/api/trace/{tid}">raw JSON</a> · <a href="/">dashboard</a></p>
+{''.join(rows)}
+</body></html>"""
 
 
 class _Timeseries:
@@ -294,6 +379,8 @@ class Dashboard:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/api/node/{node_id}", self._node_detail)
         app.router.add_get("/api/profile", self._profile)
+        app.router.add_get("/api/trace/{trace_id}", self._trace_detail)
+        app.router.add_get("/trace/{trace_id}", self._trace_view)
         app.router.add_get("/api/{section}", self._api)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
@@ -355,6 +442,8 @@ class Dashboard:
                 return _autoscaler_state()
             if section == "serve":
                 return _serve_apps()
+            if section == "traces":
+                return state.list_traces(limit=100)
             if section == "timeseries":
                 return self._timeseries.snapshot()
             if section == "logs":
@@ -401,6 +490,43 @@ class Dashboard:
         if data is None:
             return web.Response(status=404, text=f"unknown node {node_id}")
         return web.json_response(_hexify(data))
+
+    async def _trace_detail(self, request):
+        """Raw spans of one trace as JSON (id prefix ok)."""
+        from aiohttp import web
+
+        trace_id = request.match_info["trace_id"]
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.util import state
+            return state.get_trace(trace_id)
+
+        data = await loop.run_in_executor(None, fetch)
+        if data is None:
+            return web.Response(status=404,
+                                text=f"unknown trace {trace_id}")
+        return web.json_response(_hexify(data))
+
+    async def _trace_view(self, request):
+        """Per-trace waterfall: one bar per span, positioned by start
+        offset and duration, indented by parent depth (reference: the
+        dashboard's task timeline view, collapsed to one trace)."""
+        from aiohttp import web
+
+        trace_id = request.match_info["trace_id"]
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.util import state
+            return state.get_trace(trace_id)
+
+        data = await loop.run_in_executor(None, fetch)
+        if data is None:
+            return web.Response(status=404,
+                                text=f"unknown trace {trace_id}")
+        return web.Response(text=_render_waterfall(data),
+                            content_type="text/html")
 
     async def _profile(self, request):
         """On-demand sampling profile (reference: dashboard/modules/

@@ -1,0 +1,1 @@
+"""Observability subsystems (tracing; profiling lives in util/)."""

@@ -14,6 +14,7 @@ import json
 import os
 import shutil
 import tempfile
+import threading
 import zipfile
 
 _PKG_PREFIX = "pkg:"
@@ -205,6 +206,36 @@ def _touch_entry(path: str) -> None:
         pass
 
 
+# Env paths referenced by LIVE workers (the node agent pins at spawn and
+# unpins when it reaps the worker): the LRU min-age heuristic alone cannot
+# protect a long-running worker whose env's last *materialization* use aged
+# out — eviction would rmtree the interpreter/site-packages under it.
+_PINNED_LOCK = threading.Lock()
+_PINNED: dict[str, set[str]] = {}  # owner (worker_id hex) -> entry paths
+
+
+def pin_env_paths(owner: str, paths: list[str]) -> None:
+    """Mark cache entries as backing a live worker (idempotent)."""
+    norm = {os.path.normpath(p) for p in paths if p}
+    if not norm:
+        return
+    with _PINNED_LOCK:
+        _PINNED.setdefault(owner, set()).update(norm)
+
+
+def unpin_env_paths(owner: str) -> None:
+    with _PINNED_LOCK:
+        _PINNED.pop(owner, None)
+
+
+def _pinned_paths() -> set[str]:
+    with _PINNED_LOCK:
+        out: set[str] = set()
+        for paths in _PINNED.values():
+            out.update(paths)
+        return out
+
+
 def gc_env_cache(root: str = _ENV_ROOT) -> list[str]:
     """LRU eviction over the cached-env root (reference:
     _private/runtime_env/uri_cache.py): keep at most
@@ -238,10 +269,13 @@ def gc_env_cache(root: str = _ENV_ROOT) -> list[str]:
     if excess <= 0:
         return []
     now = _time.time()
+    pinned = _pinned_paths()
     evicted = []
     for mtime, path in sorted(entries)[:excess]:
         if now - mtime < cfg.runtime_env_cache_min_age_s:
             break  # everything after this is younger still
+        if os.path.normpath(path) in pinned:
+            continue  # a live worker runs out of this env: never rmtree it
         shutil.rmtree(path, ignore_errors=True)
         evicted.append(path)
     return evicted

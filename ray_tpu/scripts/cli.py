@@ -276,6 +276,26 @@ def cmd_timeline(args) -> None:
     print(f"wrote chrome trace to {out} (open in chrome://tracing)")
 
 
+def cmd_trace(args) -> None:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_read_address(args.address))
+    if not args.trace_id:
+        # no id: list what the trace store holds
+        for meta in state.list_traces(limit=args.limit):
+            print(json.dumps(meta))
+        return
+    if args.out:
+        state.trace_timeline(args.trace_id, filename=args.out,
+                             fmt=args.format)
+        hint = (" (open in chrome://tracing)" if args.format == "chrome"
+                else "")
+        print(f"wrote {args.format} trace to {args.out}{hint}")
+    else:
+        print(state.trace_timeline(args.trace_id, fmt=args.format))
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -331,6 +351,18 @@ def main(argv=None) -> None:
     sp.add_argument("--address", default=None)
     sp.add_argument("--out", default=None)
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "trace", help="list traces, or export one by id (chrome/otlp json)")
+    sp.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id (prefix ok); omit to list traces")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--out", default=None,
+                    help="output file (default: print to stdout)")
+    sp.add_argument("--format", choices=("chrome", "otlp"), default="chrome")
+    sp.add_argument("--limit", type=int, default=50,
+                    help="max traces when listing")
+    sp.set_defaults(fn=cmd_trace)
 
     args = p.parse_args(argv)
     if args.cmd == "submit" and args.entrypoint \

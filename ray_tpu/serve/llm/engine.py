@@ -60,6 +60,10 @@ class _Request:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     done_event: threading.Event = field(default_factory=threading.Event)
+    # distributed tracing: carrier captured at submit (the engine loop
+    # thread has no ambient span context), wall-clock start for the span
+    trace_ctx: Optional[dict] = None
+    submitted_wall: float = field(default_factory=time.time)
 
 
 class LLMEngine:
@@ -341,6 +345,8 @@ class LLMEngine:
                          else temperature),
             top_k=self.cfg.top_k if top_k is None else top_k,
             stop_token=getattr(self.tokenizer, "eos_token_id", None))
+        from ray_tpu.observability import tracing
+        req.trace_ctx = tracing.inject()
         if req.top_k != self.cfg.top_k:
             # All sampling (prefill first token + fused decode) uses the
             # ENGINE's top_k: k is static to the compiled programs, and a
@@ -369,6 +375,11 @@ class LLMEngine:
             if req in self._waiting:
                 self._waiting.remove(req)
                 req.done = True
+                req.finished_at = time.monotonic()
+                # a concurrent result() waiter is parked on this event; a
+                # dropped WAITING request must release it immediately, not
+                # leave it blocking to its full timeout
+                req.done_event.set()
                 return
             if not req.done:
                 # finish at next token; keep a tracking entry so the loop's
@@ -746,6 +757,14 @@ class LLMEngine:
             req.pages = []
         for req in finished:
             req.done_event.set()
+            if req.trace_ctx:
+                from ray_tpu.observability import tracing
+                tracing.record_span(
+                    "llm.generate", req.submitted_wall, time.time(),
+                    parent=req.trace_ctx, kind="llm",
+                    attrs={"request_id": req.request_id,
+                           "prompt_tokens": len(req.prompt_tokens),
+                           "generated_tokens": len(req.generated)})
             if getattr(req, "abandoned", False):
                 with self._lock:
                     self._requests.pop(req.request_id, None)

@@ -12,11 +12,13 @@ deployments see a dict request body.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import threading
 from typing import Optional
 
 import ray_tpu
+from ray_tpu.observability import tracing
 from ray_tpu.serve.router import Router
 
 _SSE_DONE = object()  # sentinel: streaming generator exhausted
@@ -133,61 +135,74 @@ class HTTPProxy:
         subpath = path[len(prefix.rstrip("/")):] or "/"
         loop = asyncio.get_event_loop()
         try:
-            wants_dispatch = await loop.run_in_executor(
-                None, self._wants_http_dispatch, app_name, deployment)
-            # SSE only for multi-route (handle_http) ingresses that opt in
-            # via the OpenAI-style "stream" field — a plain deployment whose
-            # payload happens to contain stream=true keeps json responses
-            streaming = (wants_dispatch and isinstance(payload, dict)
-                         and bool(payload.get("stream")))
-            if wants_dispatch:
-                call = (deployment, "handle_http",
-                        (subpath, request.method, payload))
-            else:
-                call = (deployment, "__call__", (payload,))
-            ref = await loop.run_in_executor(
-                None, lambda: router.assign(
-                    call[0], call[1], call[2], {}, streaming=streaming))
-            if streaming and hasattr(ref, "__next__"):
-                # ObjectRefGenerator: stream each chunk to the client the
-                # moment the replica yields it (SSE framing; reference:
-                # proxy ASGI streaming). First byte goes out at first
-                # token, not at completion. Once the response is prepared,
-                # errors must be delivered IN-STREAM (an SSE error event +
-                # [DONE]) — aiohttp cannot start a second response.
-                resp = web.StreamResponse(
-                    headers={"Content-Type": "text/event-stream",
-                             "Cache-Control": "no-cache"})
-                await resp.prepare(request)
-                gen = iter(ref)
+            # root span of the whole Serve request: the assign below runs
+            # on an executor thread, which does NOT inherit this
+            # coroutine's contextvars — copy_context() carries the span
+            # across so the replica call stitches into this trace
+            with tracing.span(f"http.request:{path}", kind="server",
+                              attrs={"method": request.method,
+                                     "app": app_name,
+                                     "deployment": deployment}):
+                wants_dispatch = await loop.run_in_executor(
+                    None, self._wants_http_dispatch, app_name, deployment)
+                # SSE only for multi-route (handle_http) ingresses that opt
+                # in via the OpenAI-style "stream" field — a plain
+                # deployment whose payload happens to contain stream=true
+                # keeps json responses
+                streaming = (wants_dispatch and isinstance(payload, dict)
+                             and bool(payload.get("stream")))
+                if wants_dispatch:
+                    call = (deployment, "handle_http",
+                            (subpath, request.method, payload))
+                else:
+                    call = (deployment, "__call__", (payload,))
+                pctx = contextvars.copy_context()
+                ref = await loop.run_in_executor(
+                    None, lambda: pctx.run(
+                        router.assign, call[0], call[1], call[2], {},
+                        streaming=streaming))
+                if streaming and hasattr(ref, "__next__"):
+                    # ObjectRefGenerator: stream each chunk to the client
+                    # the moment the replica yields it (SSE framing;
+                    # reference: proxy ASGI streaming). First byte goes out
+                    # at first token, not at completion. Once the response
+                    # is prepared, errors must be delivered IN-STREAM (an
+                    # SSE error event + [DONE]) — aiohttp cannot start a
+                    # second response.
+                    resp = web.StreamResponse(
+                        headers={"Content-Type": "text/event-stream",
+                                 "Cache-Control": "no-cache"})
+                    await resp.prepare(request)
+                    gen = iter(ref)
 
-                def _next_chunk():
+                    def _next_chunk():
+                        try:
+                            # bounded: a hung replica must not pin an
+                            # executor thread (and this connection) forever
+                            return ray_tpu.get(next(gen), timeout=120.0)
+                        except StopIteration:
+                            return _SSE_DONE
+
                     try:
-                        # bounded: a hung replica must not pin an executor
-                        # thread (and this connection) forever
-                        return ray_tpu.get(next(gen), timeout=120.0)
-                    except StopIteration:
-                        return _SSE_DONE
-
-                try:
-                    while True:
-                        chunk = await loop.run_in_executor(None, _next_chunk)
-                        if chunk is _SSE_DONE:
-                            break
-                        data = json.dumps(chunk) \
-                            if not isinstance(chunk, str) else chunk
-                        await resp.write(f"data: {data}\n\n".encode())
-                except (ConnectionResetError, asyncio.CancelledError):
-                    raise  # client went away: nothing left to tell it
-                except Exception as e:  # noqa: BLE001 — replica/stream error
-                    await resp.write(
-                        b"data: " + json.dumps(
-                            {"error": {"message": repr(e)}}).encode()
-                        + b"\n\n")
-                await resp.write(b"data: [DONE]\n\n")
-                await resp.write_eof()
-                return resp
-            result = await _aget(ref)
+                        while True:
+                            chunk = await loop.run_in_executor(
+                                None, _next_chunk)
+                            if chunk is _SSE_DONE:
+                                break
+                            data = json.dumps(chunk) \
+                                if not isinstance(chunk, str) else chunk
+                            await resp.write(f"data: {data}\n\n".encode())
+                    except (ConnectionResetError, asyncio.CancelledError):
+                        raise  # client went away: nothing left to tell it
+                    except Exception as e:  # noqa: BLE001 — stream error
+                        await resp.write(
+                            b"data: " + json.dumps(
+                                {"error": {"message": repr(e)}}).encode()
+                            + b"\n\n")
+                    await resp.write(b"data: [DONE]\n\n")
+                    await resp.write_eof()
+                    return resp
+                result = await _aget(ref)
         except TimeoutError as e:
             return web.Response(status=503, text=str(e))
         except Exception as e:  # noqa: BLE001 - surface replica errors as 500

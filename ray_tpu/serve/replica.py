@@ -79,9 +79,14 @@ class ServeReplica:
             # sync callables run on a thread so a long call (e.g. an LLM
             # generation waiting on the chip) can't starve the event loop —
             # health checks and concurrent requests keep flowing (reference:
-            # sync methods execute on the replica's thread pool)
+            # sync methods execute on the replica's thread pool). The pool
+            # thread does not inherit this coroutine's contextvars; copy
+            # the context across so the trace span (and the multiplexed
+            # model id set above) reach the user callable.
+            import contextvars
+            pctx = contextvars.copy_context()
             result = await asyncio.get_running_loop().run_in_executor(
-                self._exec, lambda: target(*args, **kwargs))
+                self._exec, lambda: pctx.run(target, *args, **kwargs))
             if inspect.iscoroutine(result):
                 result = await result
             return result

@@ -122,6 +122,38 @@ def timeline(filename: Optional[str] = None) -> Optional[str]:
     return payload
 
 
+def list_traces(limit: int = 100) -> list[dict]:
+    """Summaries of traces in the control-plane trace store, newest first
+    (observability/tracing.py; ref: the reference's tracing export)."""
+    return _cp().call("list_traces", {"limit": limit}) or []
+
+
+def get_trace(trace_id: str) -> Optional[dict]:
+    """One stitched trace ({trace_id, meta, spans}) by id or id prefix."""
+    return _cp().call("get_trace", {"trace_id": trace_id})
+
+
+def trace_timeline(trace_id: str, filename: Optional[str] = None,
+                   fmt: str = "chrome") -> Optional[str]:
+    """Export one trace as Chrome-trace JSON (chrome://tracing /
+    Perfetto-loadable, same event shape as timeline()) or OTLP-JSON
+    (`fmt="otlp"`, collector-importable)."""
+    from ray_tpu.observability import tracing
+
+    trace = get_trace(trace_id)
+    if trace is None:
+        raise ValueError(f"no trace matching {trace_id!r}")
+    if fmt == "otlp":
+        payload = json.dumps(tracing.to_otlp_json(trace["spans"]))
+    else:
+        payload = json.dumps(tracing.to_chrome_trace(trace["spans"]))
+    if filename:
+        with open(filename, "w") as f:
+            f.write(payload)
+        return None
+    return payload
+
+
 def worker_logs(worker_id: Optional[str] = None,
                 tail: int = 200) -> dict[str, str]:
     """Read per-worker stdout/stderr captured by the node agent

@@ -299,11 +299,10 @@ def test_autoscaler_tracks_instances(ray_start_regular):
             provider.terminate_node(name)
 
 
-def test_kubernetes_provider_with_fake_kubectl(tmp_path, monkeypatch):
-    """KubeRay-style provider drives kubectl correctly: pod manifests with
-    resource requests + labels on create, label-selected listing, delete
-    on terminate. A fake kubectl on PATH records every invocation and
-    serves canned pod listings (hermetic e2e of the provider contract)."""
+def _install_fake_kubectl(tmp_path, monkeypatch):
+    """Fake kubectl on PATH recording every invocation and serving canned
+    pod listings. Mirrors the real verb semantics the provider relies on:
+    `create` FAILS on a name collision (apply would silently succeed)."""
     import json
     import os
     import stat
@@ -319,8 +318,13 @@ stdin = sys.stdin.read() if not sys.stdin.isatty() else ""
 with open({str(log)!r}, "a") as f:
     f.write(json.dumps({{"args": args, "stdin": stdin}}) + "\\n")
 state = json.load(open({str(pods_file)!r}))
-if "apply" in args:
+if "create" in args:
     pod = json.loads(stdin)
+    name = pod["metadata"]["name"]
+    if any(p["metadata"]["name"] == name for p in state["items"]):
+        print(f"Error from server (AlreadyExists): pods {{name!r}} "
+              "already exists", file=sys.stderr)
+        sys.exit(1)
     pod["status"] = {{"phase": "Running"}}
     state["items"].append(pod)
 elif "delete" in args:
@@ -333,6 +337,16 @@ json.dump(state, open({str(pods_file)!r}, "w"))
 """)
     fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
     monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    return log, pods_file
+
+
+def test_kubernetes_provider_with_fake_kubectl(tmp_path, monkeypatch):
+    """KubeRay-style provider drives kubectl correctly: pod manifests with
+    resource requests + labels on create, label-selected listing, delete
+    on terminate (hermetic e2e of the provider contract)."""
+    import json
+
+    log, _ = _install_fake_kubectl(tmp_path, monkeypatch)
 
     from ray_tpu.autoscaler.node_provider import KubernetesNodeProvider
 
@@ -345,8 +359,8 @@ json.dump(state, open({str(pods_file)!r}, "w"))
     assert prov.non_terminated_nodes() == []
 
     calls = [json.loads(l) for l in log.read_text().splitlines()]
-    apply = next(c for c in calls if "apply" in c["args"])
-    pod = json.loads(apply["stdin"])
+    create = next(c for c in calls if "create" in c["args"])
+    pod = json.loads(create["stdin"])
     spec = pod["spec"]["containers"][0]
     assert spec["resources"]["requests"] == {"cpu": "4000m",
                                              "google.com/tpu": "8"}
@@ -359,6 +373,64 @@ json.dump(state, open({str(pods_file)!r}, "w"))
     assert labels["pod_type"] == "v5litepod-8"
     # namespace threaded through every call
     assert all(c["args"][:2] == ["-n", "ml"] for c in calls)
+
+
+def test_kubernetes_pod_names_unique_across_restarts(tmp_path, monkeypatch):
+    """Generated pod names carry a random suffix: the per-provider counter
+    resets on autoscaler restart, so a bare counter name would collide
+    with a pod the previous incarnation left behind."""
+    import re
+
+    _install_fake_kubectl(tmp_path, monkeypatch)
+    from ray_tpu.autoscaler.node_provider import KubernetesNodeProvider
+
+    prov1 = KubernetesNodeProvider("10.0.0.1:9000")
+    name1 = prov1.create_node({"resources": {"CPU": 1}})
+    assert re.fullmatch(r"ray-tpu-worker-1-[0-9a-f]{6}", name1)
+
+    # "restart": a fresh provider whose counter starts over must still
+    # produce a distinct name while pod 1 is alive
+    prov2 = KubernetesNodeProvider("10.0.0.1:9000")
+    name2 = prov2.create_node({"resources": {"CPU": 1}})
+    assert name2 != name1
+    assert sorted(prov2.non_terminated_nodes()) == sorted([name1, name2])
+
+
+def test_kubernetes_create_collision_fails_loudly(tmp_path, monkeypatch):
+    """An explicit node name colliding with a leftover pod must RAISE
+    (kubectl create semantics) rather than silently count phantom
+    capacity (kubectl apply semantics)."""
+    _install_fake_kubectl(tmp_path, monkeypatch)
+    from ray_tpu.autoscaler.node_provider import KubernetesNodeProvider
+
+    prov = KubernetesNodeProvider("10.0.0.1:9000")
+    prov.create_node({"name": "pinned-name", "resources": {"CPU": 1}})
+    with pytest.raises(RuntimeError, match="AlreadyExists"):
+        prov.create_node({"name": "pinned-name", "resources": {"CPU": 1}})
+    # the failed create added no capacity
+    assert prov.non_terminated_nodes() == ["pinned-name"]
+
+
+def test_autoscaler_stop_retracts_published_state(ray_start_regular):
+    """stop() deletes the per-scaler autoscaler:instances:* KV key —
+    otherwise every stop/start cycle leaks a key and the dashboard keeps
+    showing dead instances forever."""
+    from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+    from ray_tpu.autoscaler.node_provider import FakeNodeProvider
+    from ray_tpu.core import api
+
+    rt = api._get_runtime()
+    provider = FakeNodeProvider(rt.cp_addr)
+    scaler = Autoscaler(rt.cp_addr, provider,
+                        AutoscalerConfig(min_workers=0, max_workers=1,
+                                         node_resources={"CPU": 1}))
+    key = f"autoscaler:instances:{scaler.scaler_id}"
+    try:
+        scaler._publish_state()
+        assert rt.cp_client.call("kv_get", {"key": key}) is not None
+    finally:
+        scaler.stop()
+    assert rt.cp_client.call("kv_get", {"key": key}) is None
 
 
 def test_kubernetes_provider_gates_without_kubectl(monkeypatch, tmp_path):

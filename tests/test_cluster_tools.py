@@ -336,3 +336,54 @@ def test_container_runtime_env_gates():
         return
     with pytest.raises(RuntimeEnvError, match="docker or podman"):
         materialize_runtime_env(None, {"image_uri": "ubuntu:22.04"})
+
+
+def test_env_cache_gc_respects_pins(tmp_path, monkeypatch):
+    """Pinned env paths (a live worker runs out of them) survive LRU
+    eviction no matter how old; unpinning the owner makes them evictable
+    again. Guards against gc rmtree-ing a running worker's venv."""
+    import os
+    import time
+
+    from ray_tpu.core import config as cfgmod
+    from ray_tpu.runtime_env.packaging import (gc_env_cache, pin_env_paths,
+                                               unpin_env_paths)
+
+    root = str(tmp_path / "envs")
+    os.makedirs(root)
+    paths = []
+    for i in range(4):
+        d = os.path.join(root, f"venv-{i:02d}")
+        os.makedirs(d)
+        open(os.path.join(d, ".ready"), "w").close()
+        ts = time.time() - (10 - i) * 1000  # all well past min age
+        os.utime(os.path.join(d, ".ready"), (ts, ts))
+        paths.append(d)
+
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE_MAX_ENVS", "1")
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE_MIN_AGE_S", "1")
+    cfgmod.reset_config()
+    try:
+        # two workers pin the two OLDEST envs (prime eviction candidates)
+        pin_env_paths("worker-a", [paths[0]])
+        pin_env_paths("worker-b", [paths[1]])
+        evicted = gc_env_cache(root)
+        left = sorted(os.listdir(root))
+        # budget 1, 3 over: only the unpinned old entry goes; eviction
+        # skips pins rather than stopping at them (venv-02 still evicted)
+        assert [os.path.basename(p) for p in evicted] == ["venv-02"]
+        assert left == ["venv-00", "venv-01", "venv-03"]
+
+        # worker-a dies -> its pin lifts; worker-b's env still survives
+        unpin_env_paths("worker-a")
+        evicted = gc_env_cache(root)
+        assert [os.path.basename(p) for p in evicted] == ["venv-00"]
+        assert sorted(os.listdir(root)) == ["venv-01", "venv-03"]
+
+        # unpinning an unknown owner is a harmless no-op
+        unpin_env_paths("never-registered")
+    finally:
+        unpin_env_paths("worker-b")
+        monkeypatch.delenv("RAY_TPU_RUNTIME_ENV_CACHE_MAX_ENVS")
+        monkeypatch.delenv("RAY_TPU_RUNTIME_ENV_CACHE_MIN_AGE_S")
+        cfgmod.reset_config()

@@ -159,3 +159,43 @@ def test_dashboard_autoscaler_section(dash):
     finally:
         for name in provider.non_terminated_nodes():
             provider.terminate_node(name)
+
+
+def test_dashboard_trace_views(dash):
+    """Spans reported to the CP surface in the traces section, the JSON
+    detail endpoint, and the per-trace waterfall page."""
+    from ray_tpu.core import api
+
+    rt = api._get_runtime()
+    t0 = time.time()
+    tid = "feed" * 8
+    root = {"trace_id": tid, "span_id": "ab" * 8, "parent_id": None,
+            "name": "task.submit:demo", "kind": "submit",
+            "start": t0, "end": t0 + 1.0, "status": "ok", "pid": 7,
+            "attrs": {"task_id": "t1"}}
+    child = {"trace_id": tid, "span_id": "cd" * 8, "parent_id": "ab" * 8,
+             "name": "task.run:demo", "kind": "server",
+             "start": t0 + 0.1, "end": t0 + 0.9, "status": "error",
+             "pid": 8, "attrs": {"error": "ValueError"}}
+    rt.cp_client.notify("report_spans", {"spans": [root, child]})
+
+    deadline = time.monotonic() + 20
+    rows = []
+    while time.monotonic() < deadline:
+        rows = [r for r in _get(dash, "/api/traces")
+                if r["trace_id"] == tid]
+        if rows:
+            break
+        time.sleep(0.25)
+    assert rows and rows[0]["num_spans"] == 2
+    assert rows[0]["name"] == "task.submit:demo"
+
+    detail = _get(dash, f"/api/trace/{tid[:8]}")  # prefix lookup
+    assert detail["trace_id"] == tid and len(detail["spans"]) == 2
+
+    html = _get(dash, f"/trace/{tid}")
+    assert "task.submit:demo" in html and "task.run:demo" in html
+    assert "#c33" in html, "error span not highlighted"
+
+    with pytest.raises(urllib.error.HTTPError):
+        _get(dash, "/api/trace/00000000deadbeef")

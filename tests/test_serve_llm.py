@@ -331,3 +331,29 @@ def test_engine_loads_checkpoint(tmp_path):
     with _pytest.raises(ValueError, match="does not match"):
         llama.load_params(str(tmp_path / "ckpt"),
                           llama.llama_tiny(vocab_size=300))
+
+
+def test_cancel_waiting_request_releases_result_waiter():
+    """cancel() on a still-WAITING request must set done_event: a result()
+    waiter already parked on it would otherwise block for its full
+    timeout even though the request is gone."""
+    import threading
+
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_tiny_cfg(), rng_seed=0)
+    # engine loop deliberately NOT started: the request stays WAITING
+    rid = eng.submit("abc")
+    out = {}
+    waiter = threading.Thread(
+        target=lambda: out.update(eng.result(rid, timeout=60)))
+    waiter.start()
+    time.sleep(0.2)  # let the waiter park on done_event
+    t0 = time.monotonic()
+    eng.cancel(rid)
+    waiter.join(timeout=10)
+    assert not waiter.is_alive(), "result() still blocked after cancel()"
+    assert time.monotonic() - t0 < 5.0
+    assert out["tokens"] == [] and out["error"] is None
+    # cancel removed all tracking state (nothing will ever drain it)
+    assert eng.drain(rid)["error"] == "unknown request"

@@ -1,0 +1,299 @@
+"""Distributed tracing tests (models the reference's
+python/ray/tests/test_tracing.py: spans propagate across task / actor
+boundaries and stitch into one trace; here the store is the control
+plane instead of an OTel collector, so assertions poll util/state).
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import get_config
+from ray_tpu.observability import tracing
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def tracing_cluster():
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=16, _system_config={
+        "tracing_enabled": True,
+        "tracing_sample_rate": 1.0,
+        # tiny batch: spans must not sit in worker buffers for the whole
+        # test — exercises the batch-full flush path too
+        "trace_flush_batch": 4,
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+    })
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _wait_trace(match, min_spans=1, timeout=40.0):
+    """Poll the CP trace store until a trace matching `match(meta)` has
+    at least `min_spans` spans (workers flush asynchronously)."""
+    deadline = time.time() + timeout
+    last = []
+    while time.time() < deadline:
+        last = state.list_traces(limit=50)
+        for meta in last:
+            if meta["num_spans"] >= min_spans and match(meta):
+                return meta
+        time.sleep(0.25)
+    raise AssertionError(f"no matching trace with >={min_spans} spans; "
+                         f"store has: {last}")
+
+
+# ---- cross-process propagation ------------------------------------------
+
+def test_nested_fanout_single_trace(tracing_cluster):
+    """Driver -> task -> (nested task + actor create + actor call) is ONE
+    stitched trace; every span shares the trace id and parents resolve."""
+
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Counter:
+        def bump(self, x):
+            return x * 2
+
+    @ray_tpu.remote
+    def parent(x):
+        c = Counter.remote()
+        y = ray_tpu.get(child.remote(x))
+        return ray_tpu.get(c.bump.remote(y))
+
+    assert ray_tpu.get(parent.remote(1)) == 4
+
+    meta = _wait_trace(lambda m: m["name"] == "task.submit:parent")
+    assert meta["root_seen"]
+
+    expected = ("task.submit:parent", "task.run:parent",
+                "task.submit:child", "task.run:child",
+                "actor.create:Counter", "lease.acquire")
+    # workers flush independently; poll until every expected span landed
+    deadline = time.time() + 40
+    while True:
+        trace = state.get_trace(meta["trace_id"])
+        spans = trace["spans"]
+        names = [s["name"] for s in spans]
+        if all(e in names for e in expected):
+            break
+        assert time.time() < deadline, (expected, names)
+        time.sleep(0.25)
+
+    assert {s["trace_id"] for s in spans} == {meta["trace_id"]}
+    # actor method call: submit side + execute side
+    assert any(n.startswith("actor.submit:") for n in names)
+    assert any(n.startswith("actor.run:") for n in names)
+
+    # exactly one root; every other span's parent is a span in this trace
+    by_id = {s["span_id"]: s for s in spans}
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "task.submit:parent"
+    for s in spans:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in by_id, s["name"]
+
+    # execution spans ran in different processes than the driver submit
+    run = next(s for s in spans if s["name"] == "task.run:parent")
+    sub = next(s for s in spans if s["name"] == "task.submit:parent")
+    assert run["pid"] != sub["pid"]
+
+
+def test_trace_exports_chrome_and_otlp(tracing_cluster, tmp_path):
+    @ray_tpu.remote
+    def ping():
+        return "pong"
+
+    assert ray_tpu.get(ping.remote()) == "pong"
+    # 2 spans minimum: submit + run (lease.acquire only appears when the
+    # submitter actually had to request a lease rather than reuse one)
+    meta = _wait_trace(lambda m: m["name"] == "task.submit:ping",
+                       min_spans=2)
+
+    # prefix lookup (CLI ergonomics: `ray-tpu trace <id8>`)
+    trace = state.get_trace(meta["trace_id"][:8])
+    assert trace and trace["trace_id"] == meta["trace_id"]
+
+    events = json.loads(state.trace_timeline(meta["trace_id"]))
+    assert len(events) >= meta["num_spans"]
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], (int, float))
+        assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        assert ev["name"]
+
+    otlp = json.loads(
+        state.trace_timeline(meta["trace_id"], fmt="otlp"))
+    scope_spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(scope_spans) >= meta["num_spans"]
+    for sp in scope_spans:
+        assert sp["traceId"] == meta["trace_id"]
+        assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])
+
+    # file export path (what the CLI --out flag uses)
+    out = tmp_path / "trace.json"
+    assert state.trace_timeline(meta["trace_id"], filename=str(out)) is None
+    assert json.loads(out.read_text())
+
+
+def test_serve_http_request_single_trace(tracing_cluster):
+    """One HTTP request through the proxy produces one stitched trace
+    rooted at the proxy span, with the replica execution inside it."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, body):
+            return {"got": body}
+
+    serve.run(Echo.bind(), name="traceapp", route_prefix="/traced")
+    proxy = serve.start_http_proxy(port=18127)
+    try:
+        import urllib.request
+        req = urllib.request.Request(
+            "http://127.0.0.1:18127/traced",
+            data=json.dumps({"k": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert body == {"got": {"k": 1}}
+
+        meta = _wait_trace(
+            lambda m: m["name"].startswith("http.request:"), min_spans=2)
+        trace = state.get_trace(meta["trace_id"])
+        names = [s["name"] for s in trace["spans"]]
+        assert any(n.startswith("http.request:") for n in names)
+        assert any(n.startswith("actor.run:") for n in names), names
+        assert {s["trace_id"] for s in trace["spans"]} \
+            == {meta["trace_id"]}
+    finally:
+        proxy.stop()
+        serve.delete("traceapp")
+
+
+# ---- sampling / local span mechanics (no cluster) -----------------------
+
+@pytest.fixture
+def span_capture(monkeypatch):
+    """Capture flushed batches without disturbing a live runtime's sink."""
+    batches = []
+    old = tracing._flusher
+    tracing.flush()  # drain anything a prior test left buffered
+    tracing.register_flusher(lambda spans: batches.append(spans))
+    yield batches
+    tracing.flush()
+    tracing.register_flusher(old)
+
+
+def test_tracing_disabled_is_noop(monkeypatch, span_capture):
+    monkeypatch.setattr(get_config(), "tracing_enabled", False)
+    with tracing.span("root") as s:
+        assert s is None
+        assert tracing.inject() is None
+    tracing.flush()
+    assert span_capture == []
+
+
+def test_sample_rate_zero_no_spans(monkeypatch, span_capture):
+    monkeypatch.setattr(get_config(), "tracing_enabled", True)
+    monkeypatch.setattr(get_config(), "tracing_sample_rate", 0.0)
+    for _ in range(20):
+        with tracing.span("root") as s:
+            assert s is None
+    # child_only spans never root, even at rate 1.0
+    monkeypatch.setattr(get_config(), "tracing_sample_rate", 1.0)
+    with tracing.span("hot", child_only=True) as s:
+        assert s is None
+    # unsampled specs carry no context -> workers are hard no-ops
+    with tracing.span_from(None, "task.run:x") as s:
+        assert s is None
+    tracing.flush()
+    assert span_capture == []
+
+
+def test_propagation_decision_by_presence(monkeypatch, span_capture):
+    """The sampling decision travels by carrier PRESENCE: a carrier makes
+    spans even where local config says disabled (remote processes honor
+    the root's decision)."""
+    monkeypatch.setattr(get_config(), "tracing_enabled", False)
+    carrier = {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+    with tracing.span_from(carrier, "task.run:x") as s:
+        assert s is not None
+        assert s["trace_id"] == carrier["trace_id"]
+        assert s["parent_id"] == carrier["span_id"]
+        assert tracing.inject() == {"trace_id": s["trace_id"],
+                                    "span_id": s["span_id"]}
+    tracing.flush()
+    flat = [s for b in span_capture for s in b]
+    assert [s["name"] for s in flat] == ["task.run:x"]
+
+
+def test_flush_batching(monkeypatch, span_capture):
+    monkeypatch.setattr(get_config(), "tracing_enabled", True)
+    monkeypatch.setattr(get_config(), "tracing_sample_rate", 1.0)
+    monkeypatch.setattr(get_config(), "trace_flush_batch", 3)
+    with tracing.span("outer"):
+        for i in range(7):
+            with tracing.span(f"child-{i}"):
+                pass
+    # children flush in batches of 3 while `outer` is open; the unwind to
+    # an empty stack flushes the remainder (child-6 + outer)
+    assert [len(b) for b in span_capture] == [3, 3, 2]
+    flat = [s for b in span_capture for s in b]
+    assert len({s["trace_id"] for s in flat}) == 1
+    outer = next(s for s in flat if s["name"] == "outer")
+    assert all(s["parent_id"] == outer["span_id"]
+               for s in flat if s is not outer)
+
+
+def test_error_span_status(monkeypatch, span_capture):
+    monkeypatch.setattr(get_config(), "tracing_enabled", True)
+    monkeypatch.setattr(get_config(), "tracing_sample_rate", 1.0)
+    with pytest.raises(ValueError):
+        with tracing.span("boom"):
+            raise ValueError("nope")
+    tracing.flush()
+    flat = [s for b in span_capture for s in b]
+    assert flat[0]["status"] == "error"
+    assert flat[0]["attrs"]["error"] == "ValueError"
+
+
+def test_record_span_requires_parent(monkeypatch, span_capture):
+    monkeypatch.setattr(get_config(), "tracing_enabled", True)
+    assert tracing.record_span("orphan", 0.0, 1.0, parent=None) is None
+    parent = {"trace_id": "ef" * 16, "span_id": "01" * 8}
+    s = tracing.record_span("lease.acquire", 1.0, 2.0, parent=parent,
+                            kind="scheduler", attrs={"granted": True})
+    assert s["parent_id"] == parent["span_id"]
+    tracing.flush()
+    flat = [sp for b in span_capture for sp in b]
+    assert [sp["name"] for sp in flat] == ["lease.acquire"]
+
+
+def test_exporters_pure(monkeypatch):
+    monkeypatch.setattr(get_config(), "tracing_enabled", True)
+    parent = tracing.start_span("a", kind="submit", attrs={"n": 1})
+    child = tracing.start_span(
+        "b", parent={"trace_id": parent["trace_id"],
+                     "span_id": parent["span_id"]})
+    child["end"] = child["start"] + 0.5
+    parent["end"] = parent["start"] + 1.0
+    spans = [parent, child]
+
+    events = tracing.to_chrome_trace(spans)
+    assert [e["name"] for e in events] == ["a", "b"]
+    assert events[0]["dur"] == pytest.approx(1e6)
+
+    otlp = tracing.to_otlp_json(spans, service_name="svc")
+    res = otlp["resourceSpans"][0]
+    svc = [a for a in res["resource"]["attributes"]
+           if a["key"] == "service.name"]
+    assert svc[0]["value"]["stringValue"] == "svc"
+    out = res["scopeSpans"][0]["spans"]
+    assert out[1]["parentSpanId"] == parent["span_id"]
+    assert out[0]["attributes"][0]["key"] == "n"
