@@ -118,8 +118,26 @@ class Config:
     # Per-operator cap on bytes buffered in its output queue.
     data_op_output_buffer_bytes: int = 128 * 1024 * 1024
 
+    # --- serve robustness (serve/proxy.py, core/deadline.py) ---
+    # Default end-to-end request deadline when the client sends no
+    # X-Request-Deadline / X-Request-Timeout-S header and the deployment
+    # sets no request_timeout_s. Every internal wait on the request path is
+    # bounded by the REMAINING budget ("The Tail at Scale": refuse expired
+    # work, never wait past the deadline, cancel on expiry).
+    serve_request_timeout_s: float = 60.0
+    # Proxy admission control: requests beyond this many concurrently
+    # in-flight are shed with a fast 503 + Retry-After instead of queueing.
+    proxy_max_inflight: int = 1000
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
+    # A refused connect means nothing is listening: peers publish their
+    # address only after binding, so refusal almost always means the
+    # process is gone. Retry refused connects only this long (port-reuse
+    # grace), not the full connect budget — otherwise every caller that
+    # races a death (the CP's publish fan-out, the submitters' shared
+    # flusher) wedges for rpc_connect_timeout_s per dead peer.
+    rpc_refused_grace_s: float = 1.0
     rpc_retries: int = 3
     # Deterministic fault injection: "method:prob_req:prob_resp,..."
     # (ref: rpc_chaos.cc, ray_config_def.h:842-849).

@@ -395,7 +395,14 @@ class RpcClient:
             cfg = get_config()
             if connect_timeout is None:
                 connect_timeout = cfg.rpc_connect_timeout_s
-            deadline = time.monotonic() + connect_timeout
+            now = time.monotonic()
+            deadline = now + connect_timeout
+            # refused = nothing listening on a port the peer already
+            # published: the peer is almost certainly dead, so fail fast
+            # (see config.rpc_refused_grace_s) instead of wedging callers
+            # for the full connect budget
+            refused_deadline = now + min(connect_timeout,
+                                         cfg.rpc_refused_grace_s)
             last = None
             while time.monotonic() < deadline:
                 try:
@@ -408,6 +415,9 @@ class RpcClient:
                     return s
                 except OSError as e:
                     last = e
+                    if isinstance(e, ConnectionRefusedError) and \
+                            time.monotonic() >= refused_deadline:
+                        break
                     time.sleep(0.05)
             raise ConnectionLost(f"cannot connect to {self.addr}: {last}")
 

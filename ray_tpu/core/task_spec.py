@@ -121,10 +121,15 @@ class TaskSpec:
     attempt_number: int = 0
     # distributed tracing carrier ({"trace_id","span_id"}; ref:
     # util/tracing/tracing_helper.py _DictPropagator — span context rides
-    # the spec so the executor parents its span under the caller's). Last
-    # field on purpose: older 25-tuple pickles keep loading (shorter
-    # tuples leave later fields at their defaults).
+    # the spec so the executor parents its span under the caller's).
     trace_ctx: dict | None = None
+    # request deadline carrier (core/deadline.py): absolute wall-clock
+    # epoch seconds. The executor refuses to start an expired spec and
+    # re-establishes the ambient deadline around execution so nested
+    # submits inherit it. Carrier fields stay LAST on purpose: older
+    # shorter-tuple pickles keep loading (missing trailing fields keep
+    # their defaults).
+    deadline: float | None = None
 
     # Tuple-based pickling: specs cross the wire once per task (batched into
     # frames, but still serialized per spec) — the default dataclass

@@ -43,8 +43,10 @@ from ray_tpu.core.task_spec import (
     TaskType,
 )
 from ray_tpu.core.refcount import ReferenceCounter
+from ray_tpu.core import deadline as request_deadline
 from ray_tpu.observability import tracing
 from ray_tpu.exceptions import (
+    DeadlineExceededError,
     GetTimeoutError,
     ObjectLostError,
     ObjectStoreFullError,
@@ -294,6 +296,9 @@ class WorkerRuntime:
         self._pubsub_lock = threading.Lock()
         self._pubsub_dispatch_locks: dict[str, threading.Lock] = {}
         self._pubsub_poll_started = False
+        # app-level channel subscribers (e.g. the Serve controller watching
+        # CP "node" death events); called on the dispatch thread
+        self._pubsub_handlers: dict[str, list] = {}
         self._cancelled_tasks: set[TaskID] = set()
         self._device_objects: dict[ObjectID, Any] = {}  # HBM-resident values
         self._normal_exec = _NormalTaskQueue()
@@ -830,6 +835,7 @@ class WorkerRuntime:
         with tracing.span(f"task.submit:{spec.name}", kind="submit",
                           attrs={"task_id": spec.task_id.hex()[:16]}):
             spec.trace_ctx = tracing.inject()
+            spec.deadline = request_deadline.current()
             refs = self._register_returns(spec)
             gen = self.stream_manager.register(spec) if streaming else None
             self.task_manager.add_pending(spec)
@@ -890,6 +896,7 @@ class WorkerRuntime:
                           attrs={"task_id": spec.task_id.hex()[:16],
                                  "actor_id": actor_id.hex()[:16]}):
             spec.trace_ctx = tracing.inject()
+            spec.deadline = request_deadline.current()
             refs = self._register_returns(spec)
             gen = self.stream_manager.register(spec) if streaming else None
             self.task_manager.add_pending(spec)
@@ -1160,7 +1167,22 @@ class WorkerRuntime:
                 lock = self._pubsub_dispatch_locks[channel] = threading.Lock()
             return lock
 
+    def register_pubsub_handler(self, channel: str, callback) -> None:
+        """Subscribe `callback(msg)` to a CP pubsub channel (push + long-poll
+        recovery). Used by in-worker subsystems — the Serve controller wires
+        CP "node" death events into proactive replica replacement."""
+        with self._pubsub_lock:
+            self._pubsub_handlers.setdefault(channel, []).append(callback)
+        self._subscribe_channel(channel)
+
     def _dispatch_pubsub(self, channel: str, msg):
+        with self._pubsub_lock:
+            handlers = list(self._pubsub_handlers.get(channel, ()))
+        for cb in handlers:
+            try:
+                cb(msg)
+            except Exception:  # noqa: BLE001 — app handler must not break pubsub
+                logger.exception("pubsub handler failed for %s", channel)
         if channel.startswith("worker_logs:"):
             # log monitor fan-in: print worker output at the driver with a
             # provenance prefix (ref: _private/log_monitor.py + worker.py
@@ -1409,19 +1431,33 @@ class WorkerRuntime:
         from ray_tpu.core import api
         api._bind_thread_runtime(self)
 
+    @staticmethod
+    def _shed_if_expired(spec: TaskSpec) -> None:
+        """Refuse to START work whose end-to-end deadline already passed
+        (fast shed at the executor's dequeue point — core/deadline.py).
+        The caller sees TaskError(DeadlineExceededError); routers/proxies
+        map it to a 503 instead of retrying."""
+        d = spec.deadline
+        if d is not None and time.time() >= d:
+            raise DeadlineExceededError(
+                f"task {spec.repr_name()} deadline exceeded "
+                f"{time.time() - d:.3f}s before execution started")
+
     def _run_task(self, spec: TaskSpec) -> dict:
         self._bind_exec_thread()
         prev_task = self._ctx.task_id
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
         try:
+            self._shed_if_expired(spec)
             # extract the caller's span context from the spec so nested
             # submits from the task body stitch into the same trace
             with tracing.span_from(
                     spec.trace_ctx, f"task.run:{spec.repr_name()}",
                     attrs={"task_id": spec.task_id.hex()[:16],
                            "worker_id": self.worker_id.hex()[:16],
-                           "attempt": spec.attempt_number}):
+                           "attempt": spec.attempt_number}), \
+                    request_deadline.scope(spec.deadline):
                 t0 = time.monotonic()
                 fn = self.function_manager.get(spec.function_id)
                 t1 = time.monotonic()
@@ -1852,10 +1888,12 @@ class WorkerRuntime:
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
         try:
+            self._shed_if_expired(spec)
             with tracing.span_from(
                     spec.trace_ctx, f"actor.run:{spec.name or spec.method_name}",
                     attrs={"task_id": spec.task_id.hex()[:16],
-                           "worker_id": self.worker_id.hex()[:16]}):
+                           "worker_id": self.worker_id.hex()[:16]}), \
+                    request_deadline.scope(spec.deadline):
                 result = await method(*args, **kwargs)
             reply = self._success_reply(spec, result)
         except BaseException as e:  # noqa: BLE001
@@ -1878,10 +1916,12 @@ class WorkerRuntime:
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = 0
         try:
+            self._shed_if_expired(spec)
             with tracing.span_from(
                     spec.trace_ctx, f"actor.run:{spec.name or spec.method_name}",
                     attrs={"task_id": spec.task_id.hex()[:16],
-                           "worker_id": self.worker_id.hex()[:16]}):
+                           "worker_id": self.worker_id.hex()[:16]}), \
+                    request_deadline.scope(spec.deadline):
                 method = self._actor_method(spec.method_name)
                 args, kwargs = self._resolve_args(spec)
                 import inspect

@@ -73,6 +73,14 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """ray_tpu.get timed out."""
 
 
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's end-to-end deadline passed (core/deadline.py).
+
+    Raised when work is refused at admission because its deadline already
+    expired, or when a wait bounded by the remaining deadline ran out.
+    Carried inside TaskError when an executor sheds an expired TaskSpec."""
+
+
 class TaskCancelledError(RayTpuError):
     """The task was cancelled (ref: TaskCancelledError)."""
 

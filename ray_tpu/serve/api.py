@@ -58,6 +58,8 @@ class Deployment:
                 route_prefix: Optional[str] = "__unset__",
                 ray_actor_options: Optional[dict] = None,
                 health_check_period_s: Optional[float] = None,
+                health_check_failure_threshold: Optional[int] = None,
+                request_timeout_s: Optional[float] = None,
                 graceful_shutdown_timeout_s: Optional[float] = None) -> "Deployment":
         import copy
         cfg = copy.deepcopy(self.config)
@@ -78,6 +80,10 @@ class Deployment:
             cfg.ray_actor_options = ray_actor_options
         if health_check_period_s is not None:
             cfg.health_check_period_s = health_check_period_s
+        if health_check_failure_threshold is not None:
+            cfg.health_check_failure_threshold = health_check_failure_threshold
+        if request_timeout_s is not None:
+            cfg.request_timeout_s = request_timeout_s
         if graceful_shutdown_timeout_s is not None:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         return Deployment(
@@ -100,6 +106,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                ray_actor_options: Optional[dict] = None,
                health_check_period_s: float = 2.0,
                health_check_timeout_s: float = 30.0,
+               health_check_failure_threshold: int = 3,
+               request_timeout_s: Optional[float] = None,
                graceful_shutdown_timeout_s: float = 20.0):
     """@serve.deployment decorator (reference api.py:333)."""
 
@@ -109,6 +117,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             user_config=user_config,
             health_check_period_s=health_check_period_s,
             health_check_timeout_s=health_check_timeout_s,
+            health_check_failure_threshold=health_check_failure_threshold,
+            request_timeout_s=request_timeout_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=ray_actor_options or {})
         if num_replicas == "auto":

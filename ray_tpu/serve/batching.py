@@ -15,6 +15,9 @@ import asyncio
 import functools
 from typing import Any, Callable, List, Optional
 
+from ray_tpu.core import deadline as request_deadline
+from ray_tpu.exceptions import DeadlineExceededError
+
 
 class _BatchQueue:
     def __init__(self, fn: Callable, max_batch_size: int,
@@ -31,10 +34,22 @@ class _BatchQueue:
             self._task = asyncio.ensure_future(self._loop())
 
     async def submit(self, item) -> Any:
+        # admission: an already-expired request must not occupy a batch slot
+        request_deadline.raise_if_expired("batched call")
         self._ensure()
         fut = asyncio.get_event_loop().create_future()
         await self._queue.put((item, fut))
-        return await fut
+        rem = request_deadline.remaining()
+        if rem is None:
+            return await fut
+        try:
+            # bound the wait by the REMAINING deadline; wait_for cancels the
+            # future on timeout, and the batch loop skips done futures — the
+            # expired caller's slot does no further work on its behalf
+            return await asyncio.wait_for(fut, max(rem, 0.001))
+        except asyncio.TimeoutError:
+            raise DeadlineExceededError(
+                "batched call deadline exceeded waiting for batch result")
 
     async def _loop(self):
         while True:

@@ -29,6 +29,32 @@ class AutoscalingConfig:
 
 
 @dataclasses.dataclass
+class RouterConfig:
+    """Per-router tunables (reference: request_router/pow_2_router.py probe
+    constants; retry budget after Finagle's RetryBudget — deposit a fraction
+    of each request, spend one token per retry, so retries are bounded at
+    ~`retry_budget_ratio` of traffic and cannot storm a degraded cluster).
+    """
+
+    # pow-2 queue probe: RPC timeout + cached-length staleness window
+    queue_probe_timeout_s: float = 2.0
+    queue_len_staleness_s: float = 0.5
+    # retries (idempotent requests only; replica-fault errors, never user
+    # exceptions)
+    max_retries_per_request: int = 3
+    retry_budget_ratio: float = 0.1
+    retry_budget_cap: float = 10.0
+    # circuit breaker: consecutive failures before a replica is ejected
+    # from routing, and how long it sits out before a health probe may
+    # readmit it
+    ejection_threshold: int = 3
+    ejection_cooldown_s: float = 3.0
+    health_probe_timeout_s: float = 1.0
+    # how long `call`/`assign` wait for a deployment to have any replica
+    no_replica_timeout_s: float = 30.0
+
+
+@dataclasses.dataclass
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 100
@@ -36,7 +62,14 @@ class DeploymentConfig:
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 2.0
     health_check_timeout_s: float = 30.0
+    # consecutive failed checks before the controller drops (and kills) a
+    # replica — one transient miss must not cost a replica
+    health_check_failure_threshold: int = 3
     graceful_shutdown_timeout_s: float = 20.0
+    # default end-to-end deadline for requests to this deployment when the
+    # client sends no X-Request-Deadline/X-Request-Timeout-S header; None
+    # falls back to the global `serve_request_timeout_s` config flag
+    request_timeout_s: Optional[float] = None
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
 
     def target_replicas(self) -> int:

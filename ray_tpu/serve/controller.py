@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from typing import Any, Optional
 
@@ -36,8 +37,15 @@ class _DeploymentState:
         self.config = config
         self.route_prefix = route_prefix
         self.replicas: list = []
+        # created but not yet past their first health check: NOT routable
+        # (the reference's STARTING state) — requests must never queue
+        # behind actor creation
+        self.starting: list = []
         self.version = 0
         self.target = config.target_replicas()
+        # consecutive failed health checks per replica (actor id hex) — a
+        # replica is dropped only at health_check_failure_threshold
+        self.health_fails: dict[str, int] = {}
         self._last_scale_ts = 0.0
         self._scale_pending_since: Optional[float] = None
         self._pending_target: Optional[int] = None
@@ -55,11 +63,39 @@ class ServeController:
         # __init__ runs off the actor event loop; the control loop is started
         # lazily from the first async method invocation.
         self._loop_task = None
+        # node-death pubsub: the handler runs on the hosting worker's pubsub
+        # dispatch thread; the control loop drains this on its own cadence
+        self._dead_nodes: list = []
+        self._dead_nodes_lock = threading.Lock()
+        self._node_sub_done = False
 
     def _ensure_started(self):
         if self._loop_task is None:
             self._loop_task = asyncio.ensure_future(self._control_loop())
             self._change_event = asyncio.Event()
+            self._subscribe_node_events()
+
+    def _subscribe_node_events(self):
+        """Wire CP `node` pubsub death events into the reconcile loop so
+        replicas on a dead node are replaced PROACTIVELY instead of waiting
+        out health-check timeouts (ref: GcsActorManager::OnNodeDead)."""
+        if self._node_sub_done:
+            return
+        self._node_sub_done = True
+        try:
+            from ray_tpu.core import api as _api
+            rt = _api._try_get_runtime()
+            if rt is not None:
+                rt.register_pubsub_handler("node", self._on_node_event)
+        except Exception:  # noqa: BLE001 — degraded: health checks still work
+            logger.exception("serve controller: node pubsub wiring failed")
+
+    def _on_node_event(self, msg):
+        if isinstance(msg, dict) and msg.get("event") == "dead":
+            node_id = msg.get("node_id")
+            hexed = node_id.hex() if hasattr(node_id, "hex") else str(node_id)
+            with self._dead_nodes_lock:
+                self._dead_nodes.append(hexed)
 
     def _notify_change(self):
         ev = getattr(self, "_change_event", None)
@@ -84,6 +120,7 @@ class ServeController:
                 d["config"], d.get("route_prefix"))
             if existing is not None:
                 state.replicas = existing.replicas
+                state.starting = existing.starting
                 state.version = existing.version + 1
                 # config change with same code → reconfigure in place
                 if d["config"].user_config is not None:
@@ -121,6 +158,12 @@ class ServeController:
         return True
 
     async def _drain_deployment(self, state: _DeploymentState):
+        for r in state.starting:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        state.starting = []
         for r in state.replicas:
             try:
                 await asyncio.wait_for(
@@ -180,6 +223,16 @@ class ServeController:
         self._ensure_started()
         return dict(self._routes)
 
+    async def get_request_timeout(self, app_name: str,
+                                  deployment: str) -> Optional[float]:
+        """Deployment's default end-to-end request timeout (None = fall back
+        to the `serve_request_timeout_s` flag; proxy caches this)."""
+        self._ensure_started()
+        state = self._deployments.get(f"{app_name}#{deployment}")
+        if state is None:
+            return None
+        return getattr(state.config, "request_timeout_s", None)
+
     async def ingress_has_http_dispatch(self, app_name: str,
                                         deployment: str) -> bool:
         """Does the ingress class define handle_http(path, method, payload)?
@@ -229,6 +282,7 @@ class ServeController:
             out[state.full_name()] = {
                 "app": state.app,
                 "replicas": len(state.replicas),
+                "starting": len(state.starting),
                 "target": state.target,
                 "version": state.version,
                 "queue_lens": qlens,
@@ -238,7 +292,7 @@ class ServeController:
     async def shutdown(self) -> bool:
         self._stopped = True
         for state in self._deployments.values():
-            for r in state.replicas:
+            for r in state.replicas + state.starting:
                 try:
                     ray_tpu.kill(r)
                 except Exception:  # noqa: BLE001
@@ -255,19 +309,113 @@ class ServeController:
                 logger.exception("serve control loop error")
             await asyncio.sleep(0.2)
 
+    @staticmethod
+    def _replica_key(replica) -> str:
+        aid = getattr(replica, "_actor_id", None)
+        return aid.hex() if hasattr(aid, "hex") else str(id(replica))
+
+    async def _drop_replicas_on_dead_nodes(self):
+        """Drain node-death events and immediately drop (and kill) replicas
+        placed on those nodes — the reconcile pass below restarts
+        replacements this same tick."""
+        with self._dead_nodes_lock:
+            dead, self._dead_nodes = list(self._dead_nodes), []
+        if not dead:
+            return
+        dead_set = set(dead)
+
+        def _list_actors_blocking():
+            from ray_tpu.util import state as state_api
+            return state_api.list_actors(limit=100000)
+
+        try:
+            actors = await asyncio.get_event_loop().run_in_executor(
+                None, _list_actors_blocking)
+        except Exception:  # noqa: BLE001 — CP briefly away; health checks
+            logger.exception("list_actors failed while handling node death")
+            return
+        on_dead_nodes = {a["actor_id"] for a in actors
+                         if a.get("node_id") in dead_set}
+        for state in self._deployments.values():
+            keep = [r for r in state.replicas
+                    if self._replica_key(r) not in on_dead_nodes]
+            if len(keep) != len(state.replicas):
+                lost = len(state.replicas) - len(keep)
+                logger.warning(
+                    "%s: %d replica(s) on dead node(s) %s — replacing",
+                    state.full_name(), lost,
+                    [n[:8] for n in dead_set])
+                for r in state.replicas:
+                    if self._replica_key(r) in on_dead_nodes:
+                        state.health_fails.pop(self._replica_key(r), None)
+                        try:
+                            ray_tpu.kill(r)  # idempotent; frees CP state
+                        except Exception:  # noqa: BLE001
+                            pass
+                state.replicas = keep
+                state.version += 1
+                self._notify_change()
+            # a STARTING replica on a dead node will never become ready
+            still = [r for r in state.starting
+                     if self._replica_key(r) not in on_dead_nodes]
+            if len(still) != len(state.starting):
+                for r in state.starting:
+                    if self._replica_key(r) in on_dead_nodes:
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:  # noqa: BLE001
+                            pass
+                state.starting = still
+
     async def _reconcile_once(self):
+        await self._drop_replicas_on_dead_nodes()
         for state in list(self._deployments.values()):
-            # health: drop dead replicas
+            # readiness: a freshly created replica becomes routable only
+            # after its first successful health check (the reference's
+            # STARTING → RUNNING transition) — publishing it earlier would
+            # queue live requests behind actor creation
+            if state.starting:
+                ready_flags = await asyncio.gather(
+                    *(_probe_ready(r) for r in state.starting))
+                became = [r for r, ok in zip(state.starting, ready_flags)
+                          if ok]
+                if became:
+                    state.starting = [
+                        r for r, ok in zip(state.starting, ready_flags)
+                        if not ok]
+                    state.replicas.extend(became)
+                    state.version += 1
+                    self._notify_change()
+
+            # health: drop replicas only after `health_check_failure_threshold`
+            # CONSECUTIVE failures (one transient miss must not cost a
+            # replica), and kill() the dropped actor so its worker process
+            # doesn't leak
+            threshold = max(1, state.config.health_check_failure_threshold)
             alive = []
             for r in state.replicas:
+                key = self._replica_key(r)
                 try:
                     await asyncio.wait_for(_as_future(
-                        r.check_health.remote()),
-                        state.config.health_check_timeout_s)
+                        r.check_health.remote(),
+                        timeout=state.config.health_check_timeout_s),
+                        state.config.health_check_timeout_s + 1.0)
+                    state.health_fails.pop(key, None)
                     alive.append(r)
                 except Exception:  # noqa: BLE001
-                    logger.warning("replica of %s failed health check",
-                                   state.full_name())
+                    fails = state.health_fails.get(key, 0) + 1
+                    state.health_fails[key] = fails
+                    logger.warning(
+                        "replica of %s failed health check (%d/%d)",
+                        state.full_name(), fails, threshold)
+                    if fails < threshold:
+                        alive.append(r)
+                        continue
+                    state.health_fails.pop(key, None)
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
             if len(alive) != len(state.replicas):
                 state.replicas = alive
                 state.version += 1
@@ -299,22 +447,25 @@ class ServeController:
                 else:
                     state._pending_target = None
 
-            # scale toward target
+            # scale toward target; new replicas go through STARTING and are
+            # published to routers only once ready (readiness phase above)
             changed_any = False
-            while len(state.replicas) < state.target:
-                changed_any = True
+            while len(state.replicas) + len(state.starting) < state.target:
                 replica = ServeReplica.options(
                     max_concurrency=max(100, state.config.max_ongoing_requests),
                     **state.config.ray_actor_options).remote(
                     state.name, state.serialized_cls, state.init_args,
                     state.init_kwargs, state.config.user_config,
                     state.config.max_ongoing_requests)
-                state.replicas.append(replica)
-                state.version += 1
-            while len(state.replicas) > state.target:
-                changed_any = True
-                victim = state.replicas.pop()
-                state.version += 1
+                state.starting.append(replica)
+            while len(state.replicas) + len(state.starting) > state.target:
+                # prefer killing replicas that never took traffic
+                if state.starting:
+                    victim = state.starting.pop()
+                else:
+                    victim = state.replicas.pop()
+                    state.version += 1
+                    changed_any = True
                 try:
                     ray_tpu.kill(victim)
                 except Exception:  # noqa: BLE001
@@ -323,10 +474,26 @@ class ServeController:
                 self._notify_change()
 
 
-async def _as_future(ref):
-    """Adapt a ray_tpu ObjectRef get to asyncio without blocking the loop."""
+async def _as_future(ref, timeout: Optional[float] = None):
+    """Adapt a ray_tpu ObjectRef get to asyncio without blocking the loop.
+    Pass `timeout` so the executor thread unblocks itself even when the
+    awaiting coroutine gives up first (asyncio.wait_for cannot interrupt
+    a thread already parked in ray_tpu.get)."""
     loop = asyncio.get_event_loop()
-    return await loop.run_in_executor(None, lambda: ray_tpu.get(ref))
+    return await loop.run_in_executor(
+        None, lambda: ray_tpu.get(ref, timeout=timeout))
+
+
+async def _probe_ready(replica) -> bool:
+    """One bounded readiness probe (first health check) of a STARTING
+    replica. The short timeout keeps the reconcile tick fast; a replica
+    still constructing simply stays in STARTING until a later tick."""
+    try:
+        await asyncio.wait_for(
+            _as_future(replica.check_health.remote(), timeout=1.0), 2.0)
+        return True
+    except Exception:  # noqa: BLE001 — not up yet (or already dead)
+        return False
 
 
 def get_or_create_controller():

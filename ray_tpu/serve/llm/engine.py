@@ -64,6 +64,10 @@ class _Request:
     # thread has no ambient span context), wall-clock start for the span
     trace_ctx: Optional[dict] = None
     submitted_wall: float = field(default_factory=time.time)
+    # end-to-end request deadline (core/deadline.py, epoch seconds),
+    # captured at submit: the admission loop sheds waiting requests whose
+    # deadline passed instead of prefilling answers nobody will read
+    deadline: Optional[float] = None
 
 
 class LLMEngine:
@@ -113,7 +117,7 @@ class LLMEngine:
         self._rng = jax.random.PRNGKey(rng_seed + 1)
         self._loop_thread: Optional[threading.Thread] = None
         self.stats = {"steps": 0, "prefills": 0, "tokens_out": 0,
-                      "requests": 0, "compile_s": 0.0}
+                      "requests": 0, "shed_expired": 0, "compile_s": 0.0}
         # Pipelined decode (vLLM-style async token processing, re-shaped for
         # a REMOTE chip): each step's input tokens are the previous step's
         # on-device output, so steps dispatch back-to-back without a host
@@ -345,8 +349,10 @@ class LLMEngine:
                          else temperature),
             top_k=self.cfg.top_k if top_k is None else top_k,
             stop_token=getattr(self.tokenizer, "eos_token_id", None))
+        from ray_tpu.core import deadline as request_deadline
         from ray_tpu.observability import tracing
         req.trace_ctx = tracing.inject()
+        req.deadline = request_deadline.current()
         if req.top_k != self.cfg.top_k:
             # All sampling (prefill first token + fused decode) uses the
             # ENGINE's top_k: k is static to the compiled programs, and a
@@ -409,14 +415,28 @@ class LLMEngine:
         return {"tokens": new, "text": self.tokenizer.decode(new),
                 "done": done, "error": err}
 
-    def result(self, request_id: str, timeout: float = 120.0) -> dict:
-        """Block until the request completes; returns the full completion."""
+    def result(self, request_id: str, timeout: Optional[float] = None) -> dict:
+        """Block until the request completes; returns the full completion.
+
+        The wait is bounded by min(timeout, remaining request deadline);
+        with neither, the 120 s guard still applies (a hung engine must not
+        pin the caller forever). On expiry the request is CANCELLED — its
+        slot/pages free at the next recorded token instead of decoding to
+        max_tokens for nobody."""
+        from ray_tpu.core import deadline as request_deadline
+        if timeout is None:
+            timeout = 120.0
+        timeout = request_deadline.bound(timeout)
         with self._lock:
             req = self._requests.get(request_id)
         if req is None:
             return {"text": "", "tokens": [], "error": "unknown request"}
         if not req.done_event.wait(timeout):
-            return {"text": "", "tokens": [], "error": "timeout"}
+            self.cancel(request_id)
+            expired = (req.deadline is not None
+                       and time.time() >= req.deadline)
+            return {"text": "", "tokens": [],
+                    "error": "deadline exceeded" if expired else "timeout"}
         with self._lock:
             self._requests.pop(request_id, None)
         ttft = (req.first_token_at - req.submitted_at
@@ -512,8 +532,32 @@ class LLMEngine:
             w *= 2
         return min(w, self.cfg.max_batch_size)
 
+    def _shed_expired_waiting(self) -> None:
+        """Drop WAITING requests whose deadline passed: no slot, no pages,
+        no prefill — the caller stopped listening ("The Tail at Scale").
+        Slotted requests are not preempted; cancel() handles those."""
+        now = time.time()
+        shed: list[_Request] = []
+        with self._lock:
+            keep = []
+            for req in self._waiting:
+                if req.deadline is not None and now >= req.deadline:
+                    shed.append(req)
+                else:
+                    keep.append(req)
+            if shed:
+                self._waiting = keep
+                self.stats["shed_expired"] += len(shed)
+                for req in shed:
+                    req.error = "deadline exceeded"
+                    req.done = True
+                    req.finished_at = time.monotonic()
+        for req in shed:
+            req.done_event.set()
+
     def _admit(self) -> int:
         """Move waiting requests into free slots (prefill each)."""
+        self._shed_expired_waiting()
         admitted = 0
         while True:
             with self._lock:

@@ -7,6 +7,15 @@ resolves the route prefix to an application's ingress deployment, routes via
 the pow-2 router, and returns the replica's response. JSON in/out; the
 reference's full ASGI passthrough is out of scope for the HTTP layer v1 —
 deployments see a dict request body.
+
+Request robustness (core/deadline.py): every request gets an ABSOLUTE
+deadline — from the client (`X-Request-Deadline` epoch seconds or
+`X-Request-Timeout-S` relative), the deployment's `request_timeout_s`, or
+the `serve_request_timeout_s` flag — established as the ambient deadline so
+the router, replica, batcher, and engine all bound their waits by the
+remaining budget. Expired or over-capacity requests are shed at admission
+with a fast 503 + Retry-After (OpenAI-style JSON error body on /v1 routes);
+shed/retry/timeout counts are served at `/-/stats`.
 """
 
 from __future__ import annotations
@@ -15,26 +24,45 @@ import asyncio
 import contextvars
 import json
 import threading
+import time
 from typing import Optional
 
 import ray_tpu
+from ray_tpu.core import deadline as request_deadline
+from ray_tpu.core.config import get_config
+from ray_tpu.exceptions import DeadlineExceededError, TaskError
 from ray_tpu.observability import tracing
 from ray_tpu.serve.router import Router
 
 _SSE_DONE = object()  # sentinel: streaming generator exhausted
 
 
+def _is_deadline_error(e: BaseException) -> bool:
+    return isinstance(e, (DeadlineExceededError, TimeoutError)) or (
+        isinstance(e, TaskError)
+        and isinstance(e.cause, (DeadlineExceededError, TimeoutError)))
+
+
 class HTTPProxy:
-    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000,
+                 max_inflight: Optional[int] = None):
         self._controller = controller
         self.host = host
         self.port = port
         self._routers: dict[str, Router] = {}
         self._http_dispatch: dict[tuple, bool] = {}
+        self._req_timeout: dict[tuple, Optional[float]] = {}
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
         self._runner = None
+        self._max_inflight = (max_inflight if max_inflight is not None
+                              else get_config().proxy_max_inflight)
+        self._inflight = 0
+        # mutated only on the proxy event loop — no lock needed
+        self.stats = {"ok": 0, "errors": 0, "shed_expired": 0,
+                      "shed_overload": 0, "deadline_exceeded": 0,
+                      "retries": 0}
 
     # ---- lifecycle -----------------------------------------------------
     def start(self):
@@ -45,8 +73,13 @@ class HTTPProxy:
             raise RuntimeError("http proxy failed to start")
 
     def stop(self):
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        # idempotent: serve.shutdown() stops the proxy even if the caller
+        # already did, and the loop is closed once the serve thread exits
+        if self._loop is not None and not self._loop.is_closed():
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:
+                pass  # loop closed between the check and the call
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
@@ -58,7 +91,7 @@ class HTTPProxy:
 
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
-        # Blocking calls (router.assign, ray_tpu.get for the whole
+        # Blocking calls (router.call, ray_tpu.get for the whole
         # generation) run on the loop's default executor. Its stdlib default
         # is min(32, cpus+4) threads — ~5 on a small host — which silently
         # caps proxy concurrency far below the replicas' batch capacity.
@@ -97,6 +130,58 @@ class HTTPProxy:
                     best = (prefix, target)
         return best
 
+    def _error_response(self, status: int, message: str, path: str, *,
+                        retry_after: Optional[int] = None,
+                        error_type: str = "service_unavailable"):
+        """503s carry Retry-After; /v1 routes (OpenAI surface) get the
+        OpenAI error envelope instead of bare text."""
+        from aiohttp import web
+        headers = {}
+        if retry_after is not None:
+            headers["Retry-After"] = str(retry_after)
+        if "/v1/" in path or path.rstrip("/").endswith("/v1"):
+            return web.json_response(
+                {"error": {"message": message, "type": error_type,
+                           "param": None, "code": status}},
+                status=status, headers=headers)
+        return web.Response(status=status, text=message, headers=headers)
+
+    def _derive_deadline(self, request, app_name: str,
+                         deployment: str) -> float:
+        """Client header wins; else per-deployment config; else the global
+        flag. Always returns an absolute epoch-seconds deadline — every
+        request is bounded."""
+        hdr = request.headers.get("X-Request-Deadline")
+        if hdr:
+            try:
+                return float(hdr)
+            except ValueError:
+                pass
+        hdr = request.headers.get("X-Request-Timeout-S")
+        if hdr:
+            try:
+                return time.time() + max(0.0, float(hdr))
+            except ValueError:
+                pass
+        timeout = self._request_timeout(app_name, deployment)
+        if timeout is None:
+            timeout = get_config().serve_request_timeout_s
+        return time.time() + timeout
+
+    def _request_timeout(self, app_name: str,
+                         deployment: str) -> Optional[float]:
+        """Deployment's request_timeout_s (cached, like _wants_http_dispatch:
+        one controller RPC per deployment, not per request)."""
+        key = (app_name, deployment)
+        if key not in self._req_timeout:
+            try:
+                self._req_timeout[key] = ray_tpu.get(
+                    self._controller.get_request_timeout.remote(
+                        app_name, deployment), timeout=5.0)
+            except Exception:  # noqa: BLE001 — older controller: global flag
+                self._req_timeout[key] = None
+        return self._req_timeout[key]
+
     async def _handle(self, request):
         from aiohttp import web
 
@@ -107,16 +192,38 @@ class HTTPProxy:
                 {p: f"{a}#{d}" for p, (a, d) in routes.items()})
         if path == "/-/healthz":
             return web.Response(text="ok")
+        if path == "/-/stats":
+            out = dict(self.stats, inflight=self._inflight)
+            out["routers"] = {app: r.stats_snapshot()
+                              for app, r in self._routers.items()}
+            return web.json_response(out)
 
         resolved = await self._resolve_route(path)
         if resolved is None:
             return web.Response(status=404, text=f"no route for {path}")
         prefix, (app_name, deployment) = resolved
 
+        # admission control: shed before any work when over capacity
+        if self._inflight >= self._max_inflight:
+            self.stats["shed_overload"] += 1
+            return self._error_response(
+                503, "proxy overloaded: too many in-flight requests", path,
+                retry_after=1, error_type="overloaded")
+
         router = self._routers.get(app_name)
         if router is None:
             router = Router(self._controller, app_name)
             self._routers[app_name] = router
+
+        loop = asyncio.get_event_loop()
+        dl = await loop.run_in_executor(
+            None, self._derive_deadline, request, app_name, deployment)
+        if time.time() >= dl:
+            # already expired: refuse before a replica sees it
+            self.stats["shed_expired"] += 1
+            return self._error_response(
+                503, "request deadline already expired", path,
+                retry_after=1, error_type="timeout")
 
         # build the request payload the user callable sees
         body = await request.read()
@@ -133,16 +240,18 @@ class HTTPProxy:
         # sub-path dispatched to them (OpenAI-style multi-route apps,
         # ray_tpu.serve.llm.openai_api); plain callables get __call__.
         subpath = path[len(prefix.rstrip("/")):] or "/"
-        loop = asyncio.get_event_loop()
+        self._inflight += 1
         try:
-            # root span of the whole Serve request: the assign below runs
-            # on an executor thread, which does NOT inherit this
-            # coroutine's contextvars — copy_context() carries the span
-            # across so the replica call stitches into this trace
+            # root span of the whole Serve request: the router call below
+            # runs on an executor thread, which does NOT inherit this
+            # coroutine's contextvars — copy_context() carries the span AND
+            # the ambient deadline across, so the replica call stitches into
+            # this trace and every hop below bounds its waits
             with tracing.span(f"http.request:{path}", kind="server",
                               attrs={"method": request.method,
                                      "app": app_name,
-                                     "deployment": deployment}):
+                                     "deployment": deployment}) as sp, \
+                    request_deadline.scope(dl):
                 wants_dispatch = await loop.run_in_executor(
                     None, self._wants_http_dispatch, app_name, deployment)
                 # SSE only for multi-route (handle_http) ingresses that opt
@@ -157,57 +266,37 @@ class HTTPProxy:
                 else:
                     call = (deployment, "__call__", (payload,))
                 pctx = contextvars.copy_context()
-                ref = await loop.run_in_executor(
-                    None, lambda: pctx.run(
-                        router.assign, call[0], call[1], call[2], {},
-                        streaming=streaming))
-                if streaming and hasattr(ref, "__next__"):
-                    # ObjectRefGenerator: stream each chunk to the client
-                    # the moment the replica yields it (SSE framing;
-                    # reference: proxy ASGI streaming). First byte goes out
-                    # at first token, not at completion. Once the response
-                    # is prepared, errors must be delivered IN-STREAM (an
-                    # SSE error event + [DONE]) — aiohttp cannot start a
-                    # second response.
-                    resp = web.StreamResponse(
-                        headers={"Content-Type": "text/event-stream",
-                                 "Cache-Control": "no-cache"})
-                    await resp.prepare(request)
-                    gen = iter(ref)
+                if streaming:
+                    ref = await loop.run_in_executor(
+                        None, lambda: pctx.run(
+                            router.assign, call[0], call[1], call[2], {},
+                            streaming=True))
+                    if hasattr(ref, "__next__"):
+                        return await self._stream_sse(request, ref, dl, sp)
+                    result = await _aget(ref)
+                else:
+                    result, attempts = await loop.run_in_executor(
+                        None, lambda: pctx.run(
+                            router.call, call[0], call[1], call[2], {}))
+                    if attempts > 1:
+                        self.stats["retries"] += attempts - 1
+                        if sp is not None:
+                            sp["attrs"]["retries"] = attempts - 1
+        except Exception as e:  # noqa: BLE001 — classify below
+            if _is_deadline_error(e):
+                self.stats["deadline_exceeded"] += 1
+                if sp is not None:
+                    sp["attrs"]["outcome"] = "deadline_exceeded"
+                return self._error_response(
+                    503, f"request deadline exceeded: {e}", path,
+                    retry_after=1, error_type="timeout")
+            self.stats["errors"] += 1
+            return self._error_response(
+                500, repr(e), path, error_type="server_error")
+        finally:
+            self._inflight -= 1
 
-                    def _next_chunk():
-                        try:
-                            # bounded: a hung replica must not pin an
-                            # executor thread (and this connection) forever
-                            return ray_tpu.get(next(gen), timeout=120.0)
-                        except StopIteration:
-                            return _SSE_DONE
-
-                    try:
-                        while True:
-                            chunk = await loop.run_in_executor(
-                                None, _next_chunk)
-                            if chunk is _SSE_DONE:
-                                break
-                            data = json.dumps(chunk) \
-                                if not isinstance(chunk, str) else chunk
-                            await resp.write(f"data: {data}\n\n".encode())
-                    except (ConnectionResetError, asyncio.CancelledError):
-                        raise  # client went away: nothing left to tell it
-                    except Exception as e:  # noqa: BLE001 — stream error
-                        await resp.write(
-                            b"data: " + json.dumps(
-                                {"error": {"message": repr(e)}}).encode()
-                            + b"\n\n")
-                    await resp.write(b"data: [DONE]\n\n")
-                    await resp.write_eof()
-                    return resp
-                result = await _aget(ref)
-        except TimeoutError as e:
-            return web.Response(status=503, text=str(e))
-        except Exception as e:  # noqa: BLE001 - surface replica errors as 500
-            return web.Response(status=500, text=repr(e))
-
+        self.stats["ok"] += 1
         if streaming and isinstance(result, list):
             # server-sent events framing (legacy list-returning replicas)
             resp = web.StreamResponse(
@@ -226,6 +315,60 @@ class HTTPProxy:
         if isinstance(result, str):
             return web.Response(text=result)
         return web.json_response(result)
+
+    async def _stream_sse(self, request, ref, dl: float, sp):
+        """ObjectRefGenerator: stream each chunk to the client the moment
+        the replica yields it (SSE framing; reference: proxy ASGI
+        streaming). First byte goes out at first token, not at completion.
+        Once the response is prepared, errors must be delivered IN-STREAM
+        (an SSE error event + [DONE]) — aiohttp cannot start a second
+        response. Chunk reads are bounded by the REMAINING deadline, not a
+        constant: an expired stream ends with an in-stream timeout error."""
+        from aiohttp import web
+        loop = asyncio.get_event_loop()
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+        gen = iter(ref)
+
+        def _next_chunk():
+            # bounded: a hung replica must not pin an executor thread (and
+            # this connection) forever, and never past the deadline
+            timeout = min(120.0, max(0.001, dl - time.time()))
+            try:
+                return ray_tpu.get(next(gen), timeout=timeout)
+            except StopIteration:
+                return _SSE_DONE
+
+        try:
+            while True:
+                if time.time() >= dl:
+                    raise DeadlineExceededError(
+                        "stream deadline exceeded mid-response")
+                chunk = await loop.run_in_executor(None, _next_chunk)
+                if chunk is _SSE_DONE:
+                    break
+                data = json.dumps(chunk) \
+                    if not isinstance(chunk, str) else chunk
+                await resp.write(f"data: {data}\n\n".encode())
+            self.stats["ok"] += 1
+        except (ConnectionResetError, asyncio.CancelledError):
+            raise  # client went away: nothing left to tell it
+        except Exception as e:  # noqa: BLE001 — stream error
+            if _is_deadline_error(e):
+                self.stats["deadline_exceeded"] += 1
+                if sp is not None:
+                    sp["attrs"]["outcome"] = "deadline_exceeded"
+            else:
+                self.stats["errors"] += 1
+            await resp.write(
+                b"data: " + json.dumps(
+                    {"error": {"message": repr(e)}}).encode()
+                + b"\n\n")
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
 
     def _wants_http_dispatch(self, app_name: str, deployment: str) -> bool:
         """Does the ingress deployment define handle_http? (cached; the

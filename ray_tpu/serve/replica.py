@@ -14,6 +14,7 @@ import time
 from typing import Any, Optional
 
 import ray_tpu
+from ray_tpu.core import deadline as request_deadline
 
 
 @ray_tpu.remote
@@ -61,6 +62,10 @@ class ServeReplica:
 
     async def handle_request(self, method_name: str, args: tuple,
                              kwargs: dict) -> Any:
+        # dequeue-side shed: a request that expired while queued on this
+        # actor must not start computing (the caller stopped listening)
+        request_deadline.raise_if_expired(
+            f"request to {self._deployment_name}")
         self._ongoing += 1
         self._total += 1
         model_id = (kwargs or {}).pop("_multiplexed_model_id", "")
@@ -105,6 +110,8 @@ class ServeReplica:
         Async-generator user code is pumped from this (pool) thread via the
         actor's event loop; sync generators and plain results pass through.
         """
+        request_deadline.raise_if_expired(
+            f"request to {self._deployment_name}")
         self._ongoing += 1
         self._total += 1
         model_id = (kwargs or {}).pop("_multiplexed_model_id", "")

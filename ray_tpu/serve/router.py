@@ -1,4 +1,4 @@
-"""Power-of-two-choices request router.
+"""Power-of-two-choices request router with retries and ejection.
 
 TPU-native analog of the reference's router
 (/root/reference/python/ray/serve/_private/router.py — AsyncioRouter:457,
@@ -6,6 +6,18 @@ assign_request:838; request_router/pow_2_router.py): pick two random
 replicas, probe cached queue lengths, route to the shorter queue. Queue
 lengths are refreshed in the background; routing table updates come from the
 controller via versioned polls (the reference uses long-poll, long_poll.py).
+
+Robustness layer (Dean & Barroso, "The Tail at Scale", CACM 2013):
+
+- `call()` retries replica-fault failures (dead/unreachable replica — never
+  user exceptions) on a different replica, gated by a Finagle-style
+  RetryBudget so retries stay bounded at ~10% of traffic instead of
+  storming a degraded cluster.
+- Consecutive failures eject a replica from routing (circuit breaker);
+  after a cooldown it must pass a health probe before taking traffic again.
+- Every wait is bounded by the ambient request deadline
+  (core/deadline.py); expired requests are refused before a replica is
+  picked.
 """
 
 from __future__ import annotations
@@ -16,48 +28,192 @@ import time
 from typing import Optional
 
 import ray_tpu
+from ray_tpu.core import deadline as request_deadline
+from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
+                                DeadlineExceededError, GetTimeoutError,
+                                NodeDiedError, ObjectLostError, TaskError,
+                                WorkerCrashedError)
+from ray_tpu.serve.config import RouterConfig
+
+# fault classes meaning "the REPLICA is broken, the request may be fine":
+# safe to retry elsewhere. User exceptions and deadline/timeout errors are
+# excluded — retrying those wastes budget on work that will fail again.
+# ObjectLostError counts: the reply object died with the replica's node, so
+# the outcome is unusable and re-execution elsewhere is the recovery.
+_REPLICA_FAULTS = (ActorDiedError, ActorUnavailableError, WorkerCrashedError,
+                   NodeDiedError, ObjectLostError)
+
+
+def is_replica_fault(exc: BaseException) -> bool:
+    if isinstance(exc, _REPLICA_FAULTS):
+        return True
+    if isinstance(exc, TaskError):
+        return isinstance(exc.cause, _REPLICA_FAULTS)
+    return False
+
+
+class RetryBudget:
+    """Token bucket bounding retries to a fraction of request volume
+    (Finagle's RetryBudget): each request deposits `ratio` tokens, each
+    retry withdraws 1.0, balance capped at `cap`. Thread-safe."""
+
+    def __init__(self, ratio: float = 0.1, cap: float = 10.0):
+        self._ratio = ratio
+        self._cap = cap
+        self._balance = cap  # start full: a cold router may retry
+        self._lock = threading.Lock()
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._balance = min(self._cap, self._balance + self._ratio)
+
+    def withdraw(self) -> bool:
+        with self._lock:
+            if self._balance >= 1.0:
+                self._balance -= 1.0
+                return True
+            return False
+
+    def balance(self) -> float:
+        with self._lock:
+            return self._balance
 
 
 class ReplicaSet:
-    """Cached view of one deployment's replicas + queue lengths."""
+    """Cached view of one deployment's replicas + queue lengths + per-replica
+    circuit-breaker state (keyed by actor id, so state survives routing-table
+    refreshes that rebuild the handle list)."""
 
-    def __init__(self):
+    def __init__(self, config: Optional[RouterConfig] = None):
+        self.config = config or RouterConfig()
         self.replicas: list = []           # actor handles
         self.version: int = -1
         self._qlen: dict[int, tuple[float, int]] = {}  # idx -> (ts, len)
-        self._rr = 0
+        # circuit breaker, keyed by actor id hex
+        self._fails: dict[str, int] = {}          # consecutive failures
+        self._ejected: dict[str, float] = {}      # key -> ejected-at ts
+        self._cb_lock = threading.Lock()
+        self.ejections = 0
+        self.readmissions = 0
+
+    @staticmethod
+    def _key(replica) -> str:
+        aid = getattr(replica, "_actor_id", None)
+        return aid.hex() if hasattr(aid, "hex") else str(id(replica))
 
     def update(self, replicas: list, version: int):
         self.replicas = replicas
         self.version = version
         self._qlen = {}
+        live = {self._key(r) for r in replicas}
+        with self._cb_lock:
+            # controller replaced dead replicas: drop breaker state for
+            # handles that no longer route
+            self._fails = {k: v for k, v in self._fails.items() if k in live}
+            self._ejected = {k: v for k, v in self._ejected.items()
+                             if k in live}
 
-    def _probe(self, idx: int, staleness_s: float = 0.5) -> int:
+    # ---- circuit breaker ------------------------------------------------
+    def record_success(self, replica) -> None:
+        with self._cb_lock:
+            self._fails.pop(self._key(replica), None)
+
+    def record_failure(self, replica) -> bool:
+        """Count a replica-fault failure; returns True if this ejected the
+        replica from routing."""
+        key = self._key(replica)
+        with self._cb_lock:
+            n = self._fails.get(key, 0) + 1
+            self._fails[key] = n
+            if n >= self.config.ejection_threshold \
+                    and key not in self._ejected:
+                self._ejected[key] = time.monotonic()
+                self.ejections += 1
+                return True
+        return False
+
+    def _routable(self) -> list:
+        """Replicas not currently ejected; cooled-down ejectees are health
+        probed and readmitted when they pass (re-armed when they don't)."""
+        now = time.monotonic()
+        out = []
+        for r in self.replicas:
+            key = self._key(r)
+            with self._cb_lock:
+                ejected_at = self._ejected.get(key)
+            if ejected_at is None:
+                out.append(r)
+                continue
+            if now - ejected_at < self.config.ejection_cooldown_s:
+                continue
+            # cooldown over: one synchronous health probe decides (bounded
+            # by the ambient deadline — readmission must not burn the
+            # caller's remaining budget)
+            try:
+                ray_tpu.get(r.check_health.remote(),
+                            timeout=request_deadline.bound(
+                                self.config.health_probe_timeout_s))
+                ok = True
+            except Exception:  # noqa: BLE001 — still broken
+                ok = False
+            with self._cb_lock:
+                if ok:
+                    self._ejected.pop(key, None)
+                    self._fails.pop(key, None)
+                    self.readmissions += 1
+                else:
+                    self._ejected[key] = time.monotonic()  # re-arm cooldown
+            if ok:
+                out.append(r)
+        return out
+
+    # ---- selection ------------------------------------------------------
+    _QLEN_DEAD = 1 << 30  # probe-failed sentinel: replica looks infinitely busy
+
+    def _probe(self, idx: int) -> int:
         now = time.monotonic()
         cached = self._qlen.get(idx)
-        if cached and now - cached[0] < staleness_s:
+        if cached and now - cached[0] < self.config.queue_len_staleness_s:
             return cached[1]
         try:
+            # bounded by the ambient deadline too: probing a dead replica
+            # must not burn the caller's remaining budget
             qlen = ray_tpu.get(self.replicas[idx].get_queue_len.remote(),
-                               timeout=2.0)
+                               timeout=request_deadline.bound(
+                                   self.config.queue_probe_timeout_s))
         except Exception:  # noqa: BLE001 - dead replica looks busy
-            qlen = 1 << 30
+            qlen = self._QLEN_DEAD
         self._qlen[idx] = (now, qlen)
         return qlen
 
     def choose(self, model_id: str = "") -> Optional[object]:
-        n = len(self.replicas)
+        candidates = self._routable()
+        n = len(candidates)
         if n == 0:
             return None
         if model_id:
             # multiplexed request: rendezvous-hash affinity keeps the model's
             # per-replica cache hot (serve/multiplex.py)
             from ray_tpu.serve.multiplex import rendezvous_pick
-            return self.replicas[rendezvous_pick(self.replicas, model_id)]
+            return candidates[rendezvous_pick(candidates, model_id)]
         if n == 1:
-            return self.replicas[0]
+            return candidates[0]
         i, j = random.sample(range(n), 2)
-        return self.replicas[i if self._probe(i) <= self._probe(j) else j]
+        # probe cache is indexed into self.replicas (stable across choose
+        # calls within one table version)
+        pi = self.replicas.index(candidates[i])
+        pj = self.replicas.index(candidates[j])
+        qi, qj = self._probe(pi), self._probe(pj)
+        if min(qi, qj) < self._QLEN_DEAD:
+            return candidates[i if qi <= qj else j]
+        # both sampled candidates look dead (a node just died): fall back
+        # to a full scan — any live replica beats two dead ones
+        best, best_q = candidates[i], qi
+        for c in candidates:
+            q = self._probe(self.replicas.index(c))
+            if q < best_q:
+                best, best_q = c, q
+        return best
 
 
 class Router:
@@ -68,21 +224,42 @@ class Router:
     applies changes the moment versions bump — the request path reads only
     the local cache, no controller RPC per request."""
 
-    def __init__(self, controller, app_name: str):
+    def __init__(self, controller, app_name: str,
+                 config: Optional[RouterConfig] = None):
         self._controller = controller
         self._app = app_name
+        self.config = config or RouterConfig()
         self._sets: dict[str, ReplicaSet] = {}
         self._lock = threading.Lock()
         self._stopped = threading.Event()
+        self._budget = RetryBudget(self.config.retry_budget_ratio,
+                                   self.config.retry_budget_cap)
+        self._stats_lock = threading.Lock()
+        self.stats = {"requests": 0, "retries": 0, "retries_denied": 0,
+                      "deadline_exceeded": 0}
         self._poll_thread = threading.Thread(
             target=self._long_poll_loop, name=f"router-poll-{app_name}",
             daemon=True)
         self._poll_thread.start()
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    def stats_snapshot(self) -> dict:
+        with self._stats_lock:
+            out = dict(self.stats)
+        out["retry_budget"] = self._budget.balance()
+        with self._lock:
+            out["ejections"] = sum(rs.ejections for rs in self._sets.values())
+            out["readmissions"] = sum(rs.readmissions
+                                      for rs in self._sets.values())
+        return out
+
     def _apply_table(self, table: dict) -> None:
         with self._lock:
             for dep, (replicas, version) in table.items():
-                cur = self._sets.setdefault(dep, ReplicaSet())
+                cur = self._sets.setdefault(dep, ReplicaSet(self.config))
                 if version != cur.version:
                     cur.update(replicas, version)
             # the table is the app's FULL routing state: deployments that
@@ -111,7 +288,7 @@ class Router:
 
     def _maybe_refresh(self, deployment: str, force: bool = False):
         with self._lock:
-            rs = self._sets.setdefault(deployment, ReplicaSet())
+            rs = self._sets.setdefault(deployment, ReplicaSet(self.config))
             if rs.replicas and not force:
                 return rs
         # cold start / forced: one synchronous fetch
@@ -119,26 +296,98 @@ class Router:
             self._app), timeout=10.0)
         self._apply_table(table)
         with self._lock:
-            return self._sets.setdefault(deployment, ReplicaSet())
+            return self._sets.setdefault(deployment, ReplicaSet(self.config))
 
-    def assign(self, deployment: str, method: str, args: tuple,
-               kwargs: dict, *, streaming: bool = False,
-               timeout_s: float = 30.0, multiplexed_model_id: str = ""):
-        """Pick a replica and submit; returns the reply ObjectRef."""
-        deadline = time.monotonic() + timeout_s
+    def _pick(self, deployment: str, multiplexed_model_id: str,
+              timeout_s: float):
+        """Block until a routable replica exists (bounded by `timeout_s`
+        AND the ambient deadline). Returns (replica_set, replica)."""
+        wait_until = time.monotonic() \
+            + request_deadline.bound(timeout_s)
         while True:
+            request_deadline.raise_if_expired("request")
             rs = self._maybe_refresh(deployment)
             replica = rs.choose(multiplexed_model_id)
             if replica is not None:
-                if streaming:
-                    # streaming-generator call: returns an ObjectRefGenerator
-                    # whose items land as the replica yields them
-                    return replica.handle_request_streaming.options(
-                        num_returns="streaming").remote(method, args, kwargs)
-                return replica.handle_request.remote(method, args, kwargs)
-            if time.monotonic() > deadline:
+                return rs, replica
+            if time.monotonic() > wait_until:
                 raise TimeoutError(
                     f"no replicas available for deployment "
                     f"{deployment!r} after {timeout_s}s")
             self._maybe_refresh(deployment, force=True)
             time.sleep(0.1)
+
+    def assign(self, deployment: str, method: str, args: tuple,
+               kwargs: dict, *, streaming: bool = False,
+               timeout_s: float = 30.0, multiplexed_model_id: str = ""):
+        """Pick a replica and submit; returns the reply ObjectRef.
+
+        No retries — the caller owns the ref (DeploymentHandle path).
+        `call()` is the retrying variant for request/response traffic."""
+        rs, replica = self._pick(deployment, multiplexed_model_id, timeout_s)
+        if streaming:
+            # streaming-generator call: returns an ObjectRefGenerator
+            # whose items land as the replica yields them
+            return replica.handle_request_streaming.options(
+                num_returns="streaming").remote(method, args, kwargs)
+        return replica.handle_request.remote(method, args, kwargs)
+
+    def call(self, deployment: str, method: str, args: tuple, kwargs: dict,
+             *, timeout_s: Optional[float] = None,
+             multiplexed_model_id: str = "") -> tuple:
+        """Submit and WAIT for the reply, absorbing replica faults: a
+        dead/unreachable replica is recorded against the circuit breaker
+        and the request is retried on another replica, gated by the retry
+        budget and `max_retries_per_request`. Waits are bounded by the
+        ambient deadline. Returns (result, attempts_used).
+
+        Raises the final error when retries are exhausted/denied; user
+        exceptions and deadline expiry propagate immediately (retrying
+        them would fail again and burn budget)."""
+        self._bump("requests")
+        self._budget.deposit()
+        attempts = 0
+        no_replica_timeout = (timeout_s if timeout_s is not None
+                              else self.config.no_replica_timeout_s)
+        while True:
+            try:
+                request_deadline.raise_if_expired("request")
+            except DeadlineExceededError:
+                self._bump("deadline_exceeded")
+                raise
+            rs, replica = self._pick(deployment, multiplexed_model_id,
+                                     no_replica_timeout)
+            ref = replica.handle_request.remote(method, args, kwargs)
+            attempts += 1
+            try:
+                result = ray_tpu.get(
+                    ref, timeout=request_deadline.bound(timeout_s))
+                rs.record_success(replica)
+                return result, attempts
+            except (GetTimeoutError, DeadlineExceededError):
+                # the replica may still be healthy — just slow/over-deadline;
+                # don't charge the breaker, don't retry (no budget left in
+                # the deadline anyway)
+                self._bump("deadline_exceeded")
+                try:
+                    ray_tpu.cancel(ref)  # stop computing an answer nobody reads
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+                raise
+            except Exception as e:  # noqa: BLE001 — classify below
+                if isinstance(e, TaskError) and isinstance(
+                        e.cause, DeadlineExceededError):
+                    # replica shed it at dequeue: too late to retry
+                    self._bump("deadline_exceeded")
+                    raise
+                if not is_replica_fault(e):
+                    rs.record_success(replica)  # replica fine; request isn't
+                    raise
+                rs.record_failure(replica)
+                if attempts > self.config.max_retries_per_request:
+                    raise
+                if not self._budget.withdraw():
+                    self._bump("retries_denied")
+                    raise
+                self._bump("retries")
+                self._maybe_refresh(deployment, force=True)

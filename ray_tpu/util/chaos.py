@@ -29,12 +29,15 @@ class WorkerKiller:
 
     def __init__(self, cluster=None, *, interval_s: float = 0.5,
                  kill_probability: float = 1.0, seed: int = 0,
-                 spare_actors: bool = True):
+                 spare_actors: bool = True, max_kills: int | None = None):
         self._cluster = cluster
         self._interval = interval_s
         self._prob = kill_probability
         self._rng = random.Random(seed)
         self._spare_actors = spare_actors
+        # cap total kills (parity with NodeKiller) so chaos-under-serve
+        # tests are deterministic and bounded; None = unbounded
+        self._max = max_kills
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.kills = 0
@@ -60,6 +63,8 @@ class WorkerKiller:
 
     def _loop(self):
         while not self._stop.wait(self._interval):
+            if self._max is not None and self.kills >= self._max:
+                return
             if self._rng.random() > self._prob:
                 continue
             victims = self._victims()

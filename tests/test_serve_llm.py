@@ -357,3 +357,30 @@ def test_cancel_waiting_request_releases_result_waiter():
     assert out["tokens"] == [] and out["error"] is None
     # cancel removed all tracking state (nothing will ever drain it)
     assert eng.drain(rid)["error"] == "unknown request"
+
+
+def test_engine_sheds_expired_waiting_request():
+    """The admission loop drops WAITING requests whose deadline passed —
+    no slot, no pages, no prefill — and the result() waiter gets a fast
+    'deadline exceeded' error instead of its full timeout."""
+    from ray_tpu.core import deadline as request_deadline
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_tiny_cfg(), rng_seed=0)
+    # engine loop deliberately NOT started: the request stays WAITING
+    with request_deadline.scope(time.time() + 0.1):
+        rid = eng.submit("abc")
+    assert eng._requests[rid].deadline is not None  # captured at submit
+    time.sleep(0.15)
+    eng._shed_expired_waiting()  # what _admit() runs first each pass
+    out = eng.result(rid, timeout=5)
+    assert out["error"] == "deadline exceeded"
+    assert out["tokens"] == []
+    assert eng.stats["shed_expired"] == 1
+
+    # a live deadline rides along without shedding
+    with request_deadline.scope(time.time() + 60.0):
+        rid2 = eng.submit("abc")
+    eng._shed_expired_waiting()
+    assert len(eng._waiting) == 1  # still queued, not shed
+    eng.cancel(rid2)

@@ -1,0 +1,686 @@
+"""Request-robustness tests: deadline propagation, admission control,
+router retries/ejection, controller health thresholds, and serve-under-chaos
+(models the reference's serve fault-tolerance tests:
+python/ray/serve/tests/test_failure.py + the release-test chaos suites)."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import deadline as request_deadline
+from ray_tpu.exceptions import DeadlineExceededError, TaskError
+from ray_tpu.serve.config import RouterConfig
+from ray_tpu.serve.router import ReplicaSet, RetryBudget, Router
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular(ray_start_module):
+    yield ray_start_module
+
+
+@pytest.fixture
+def serve_shutdown(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+# ---- pure unit tests (no cluster) ------------------------------------------
+
+def test_deadline_module_semantics():
+    assert request_deadline.current() is None
+    assert request_deadline.remaining() is None
+    assert request_deadline.remaining(default=7.0) == 7.0
+    assert request_deadline.bound(5.0) == 5.0
+    assert not request_deadline.expired()
+    request_deadline.raise_if_expired()  # no deadline: no-op
+
+    dl = time.time() + 10.0
+    with request_deadline.scope(dl):
+        assert request_deadline.current() == dl
+        rem = request_deadline.remaining()
+        assert 9.0 < rem <= 10.0
+        # bound clamps to the remaining budget
+        assert request_deadline.bound(60.0) <= 10.0
+        assert request_deadline.bound(1.0) == 1.0
+        # scope(None) keeps the outer deadline
+        with request_deadline.scope(None):
+            assert request_deadline.current() == dl
+        # nested scopes restore on exit
+        inner = time.time() + 1.0
+        with request_deadline.scope(inner):
+            assert request_deadline.current() == inner
+        assert request_deadline.current() == dl
+    assert request_deadline.current() is None
+
+    with request_deadline.scope(time.time() - 0.5):
+        assert request_deadline.expired()
+        assert request_deadline.remaining() < 0
+        # non-positive budgets floor at a tiny epsilon (fail fast downstream)
+        assert request_deadline.bound(30.0) == pytest.approx(0.001)
+        with pytest.raises(DeadlineExceededError):
+            request_deadline.raise_if_expired("unit test")
+
+
+def test_task_spec_pickle_compat_without_deadline():
+    """Older shorter-tuple TaskSpec pickles (pre-deadline field) must keep
+    loading: trailing fields fall back to class-level defaults."""
+    from ray_tpu.core.task_spec import TaskSpec
+
+    spec = TaskSpec(name="t")
+    state = spec.__getstate__()
+    old_state = state[:-1]  # a spec serialized before the deadline field
+    revived = TaskSpec.__new__(TaskSpec)
+    revived.__setstate__(old_state)
+    assert revived.name == "t"
+    assert revived.deadline is None
+    # current round-trip carries the deadline
+    spec.deadline = 1234.5
+    full = TaskSpec.__new__(TaskSpec)
+    full.__setstate__(spec.__getstate__())
+    assert full.deadline == 1234.5
+
+
+def test_retry_budget():
+    b = RetryBudget(ratio=0.5, cap=2.0)
+    # starts full: a cold router may retry
+    assert b.withdraw()
+    assert b.withdraw()
+    assert not b.withdraw()
+    b.deposit()  # +0.5
+    assert not b.withdraw()
+    b.deposit()  # +0.5 -> 1.0
+    assert b.withdraw()
+    # balance is capped
+    for _ in range(100):
+        b.deposit()
+    assert b.balance() == 2.0
+
+
+class _AID:
+    def __init__(self, h):
+        self._h = h
+
+    def hex(self):
+        return self._h
+
+
+class _FakeMethod:
+    def __init__(self, replica, kind):
+        self._replica = replica
+        self._kind = kind
+
+    def remote(self):
+        return (self._kind, self._replica)
+
+
+class _FakeReplica:
+    def __init__(self, name, healthy=True, qlen=0):
+        self._actor_id = _AID(name)
+        self.healthy = healthy
+        self.qlen = qlen
+
+    @property
+    def check_health(self):
+        return _FakeMethod(self, "health")
+
+    @property
+    def get_queue_len(self):
+        return _FakeMethod(self, "qlen")
+
+
+def _fake_get(ref, timeout=None):
+    kind, replica = ref
+    if not replica.healthy:
+        raise RuntimeError(f"replica {replica._actor_id.hex()} is dead")
+    return replica.qlen if kind == "qlen" else True
+
+
+def test_replica_ejection_and_readmission(monkeypatch):
+    from ray_tpu.serve import router as router_mod
+    monkeypatch.setattr(router_mod.ray_tpu, "get", _fake_get)
+
+    cfg = RouterConfig(ejection_threshold=2, ejection_cooldown_s=0.2,
+                       health_probe_timeout_s=0.5)
+    rs = ReplicaSet(cfg)
+    r1, r2 = _FakeReplica("r1"), _FakeReplica("r2")
+    rs.update([r1, r2], 0)
+
+    # below the threshold nothing is ejected; success resets the count
+    assert not rs.record_failure(r1)
+    rs.record_success(r1)
+    assert not rs.record_failure(r1)
+    assert rs.record_failure(r1)  # 2 consecutive -> ejected
+    assert rs.ejections == 1
+
+    # ejected replica takes no traffic
+    r1.healthy = False
+    for _ in range(10):
+        assert rs.choose() is r2
+
+    # cooldown elapses but the health probe fails: stays out, cooldown re-arms
+    time.sleep(0.25)
+    assert rs.choose() is r2
+    assert rs.readmissions == 0
+
+    # replica recovers: after the next cooldown the probe readmits it
+    r1.healthy = True
+    time.sleep(0.25)
+    chosen = {rs.choose()._actor_id.hex() for _ in range(20)}
+    assert "r1" in chosen
+    assert rs.readmissions == 1
+
+    # table refresh drops breaker state for replicas no longer routed
+    rs.record_failure(r2)
+    rs.update([r1], 1)
+    assert "r2" not in rs._fails and "r2" not in rs._ejected
+
+
+def test_router_queue_probe_config_knobs(monkeypatch):
+    """The 2.0s probe timeout / 0.5s staleness are config now: a wide
+    staleness window serves cached queue lengths without any probe RPC."""
+    from ray_tpu.serve import router as router_mod
+
+    def _no_rpc(ref, timeout=None):
+        raise AssertionError("probe RPC issued despite fresh cache")
+
+    rs = ReplicaSet(RouterConfig(queue_len_staleness_s=100.0))
+    r1, r2 = _FakeReplica("a", qlen=0), _FakeReplica("b", qlen=5)
+    rs.update([r1, r2], 0)
+    now = time.monotonic()
+    rs._qlen = {0: (now, 0), 1: (now, 5)}
+    monkeypatch.setattr(router_mod.ray_tpu, "get", _no_rpc)
+    for _ in range(10):
+        assert rs.choose() is r1  # cached lengths decide; no RPC
+
+    # with a zero staleness window every choose re-probes
+    rs2 = ReplicaSet(RouterConfig(queue_len_staleness_s=0.0,
+                                  queue_probe_timeout_s=0.25))
+    rs2.update([r1, r2], 0)
+    seen_timeouts = []
+
+    def _probing_get(ref, timeout=None):
+        seen_timeouts.append(timeout)
+        return _fake_get(ref)
+
+    monkeypatch.setattr(router_mod.ray_tpu, "get", _probing_get)
+    assert rs2.choose() is r1
+    assert seen_timeouts and all(t == 0.25 for t in seen_timeouts)
+
+
+def test_worker_killer_max_kills():
+    from ray_tpu.util.chaos import WorkerKiller
+
+    class _Proc:
+        def __init__(self):
+            self.killed = False
+
+        def poll(self):
+            return 1 if self.killed else None
+
+        def kill(self):
+            self.killed = True
+
+    class _Info:
+        def __init__(self):
+            self.proc = _Proc()
+            self.actor_id = None
+
+    class _Agent:
+        def __init__(self, n):
+            self._lock = threading.Lock()
+            self._workers = {i: _Info() for i in range(n)}
+
+    class _Cluster:
+        def __init__(self):
+            self.nodes = [_Agent(6)]
+
+    cluster = _Cluster()
+    killer = WorkerKiller(cluster, interval_s=0.01, max_kills=2, seed=3)
+    killer.start()
+    time.sleep(0.5)
+    report = killer.stop()
+    dead = sum(1 for info in cluster.nodes[0]._workers.values()
+               if info.proc.killed)
+    assert report["kills"] == 2
+    assert dead == 2  # the cap held even though victims remained
+
+
+def test_batching_respects_deadline():
+    from ray_tpu.serve.batching import batch
+
+    async def main():
+        @batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+        async def double(items):
+            return [x * 2 for x in items]
+
+        # expired deadline: refused at admission, no batch slot consumed
+        with request_deadline.scope(time.time() - 1.0):
+            with pytest.raises(DeadlineExceededError):
+                await double(1)
+
+        # live deadline: normal result
+        with request_deadline.scope(time.time() + 10.0):
+            assert await double(2) == 4
+
+        # the wait for the batch result is bounded by the REMAINING budget
+        @batch(max_batch_size=2, batch_wait_timeout_s=0.01)
+        async def slow(items):
+            await asyncio.sleep(2.0)
+            return items
+
+        t0 = time.monotonic()
+        with request_deadline.scope(time.time() + 0.25):
+            with pytest.raises(DeadlineExceededError):
+                await slow(1)
+        assert time.monotonic() - t0 < 1.5
+
+    asyncio.run(main())
+
+
+# ---- cluster tests ---------------------------------------------------------
+
+def test_deadline_rides_task_spec(serve_shutdown):
+    """The ambient deadline crosses process hops via TaskSpec.deadline (the
+    trace_ctx carrier pattern), including nested submits; expired specs are
+    shed before execution."""
+
+    @ray_tpu.remote
+    def read_deadline():
+        return request_deadline.current()
+
+    @ray_tpu.remote
+    def read_deadline_nested():
+        # the executor re-establishes the scope, so a child submit inherits
+        return ray_tpu.get(read_deadline.remote(), timeout=30)
+
+    assert ray_tpu.get(read_deadline.remote(), timeout=30) is None
+
+    dl = time.time() + 25.0
+    with request_deadline.scope(dl):
+        direct = read_deadline.remote()
+        nested = read_deadline_nested.remote()
+    assert ray_tpu.get(direct, timeout=30) == dl
+    assert ray_tpu.get(nested, timeout=30) == dl
+
+    @ray_tpu.remote
+    class Holder:
+        def read(self):
+            return request_deadline.current()
+
+    h = Holder.remote()
+    with request_deadline.scope(dl):
+        ref = h.read.remote()
+    assert ray_tpu.get(ref, timeout=30) == dl
+
+    # an expired spec is refused before execution starts
+    with request_deadline.scope(time.time() - 0.5):
+        shed = read_deadline.remote()
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(shed, timeout=30)
+    assert isinstance(ei.value.cause, DeadlineExceededError)
+
+
+def test_pubsub_handler_registry(serve_shutdown):
+    """Worker runtimes expose app-level CP pubsub subscriptions (the hook
+    the Serve controller uses for node-death events)."""
+    from ray_tpu.core import api
+
+    rt = api._get_runtime()
+    got = []
+    rt.register_pubsub_handler("robustness_test_chan", got.append)
+    rt.cp_client.call(
+        "publish", {"channel": "robustness_test_chan",
+                    "msg": {"event": "hello"}}, timeout=10.0)
+    deadline = time.monotonic() + 10.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got and got[0]["event"] == "hello"
+
+
+def test_controller_health_threshold_and_no_leak(serve_shutdown):
+    """One transient health-check miss must not cost a replica; at the
+    threshold the replica is dropped AND killed (no worker leak)."""
+
+    @serve.deployment(num_replicas=1, health_check_period_s=0.2,
+                      health_check_failure_threshold=4)
+    class Moody:
+        def __init__(self):
+            self.uid = uuid.uuid4().hex
+            self.fail_next = 0
+
+        def __call__(self, _):
+            return self.uid
+
+        def set_fail(self, n):
+            self.fail_next = n
+            return True
+
+        def check_health(self):
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise RuntimeError("transiently sick")
+
+    handle = serve.run(Moody.bind(), name="moody", route_prefix=None)
+    uid0 = handle.remote(0).result(timeout_s=30)
+
+    # 2 consecutive failures < threshold 4: the replica survives
+    assert handle.set_fail.remote(2).result(timeout_s=30)
+    time.sleep(2.0)
+    assert handle.remote(0).result(timeout_s=30) == uid0
+
+    # persistent failure: dropped at the threshold and replaced
+    handle.set_fail.remote(10_000).result(timeout_s=30)
+    deadline = time.time() + 60.0
+    uid1 = uid0
+    while time.time() < deadline:
+        try:
+            uid1 = handle.remote(0).result(timeout_s=10)
+            if uid1 != uid0:
+                break
+        except Exception:
+            pass
+        time.sleep(0.3)
+    assert uid1 != uid0, "unhealthy replica was never replaced"
+
+    # no leak: exactly one ServeReplica actor remains ALIVE (the old one
+    # was ray_tpu.kill()ed, not abandoned)
+    from ray_tpu.util import state as state_api
+    deadline = time.time() + 30.0
+    alive = None
+    while time.time() < deadline:
+        alive = [a for a in state_api.list_actors()
+                 if "ServeReplica" in str(a.get("class_name", ""))
+                 and a.get("state") == "ALIVE"]
+        if len(alive) == 1:
+            break
+        time.sleep(0.3)
+    assert len(alive) == 1, f"leaked replica actors: {alive}"
+    serve.delete("moody")
+
+
+def test_router_retry_absorbs_dead_replica(serve_shutdown):
+    """A killed replica's in-flight/new calls fail with an actor fault; the
+    router retries them on the surviving replica (retry budget) and ejects
+    the dead one after consecutive failures."""
+
+    @serve.deployment(num_replicas=2, health_check_period_s=5.0,
+                      health_check_failure_threshold=1000)
+    def echo(x):
+        return x
+
+    serve.run(echo.bind(), name="appretry", route_prefix=None)
+    from ray_tpu.serve.controller import get_or_create_controller
+    ctl = get_or_create_controller()
+    # wide staleness: the queue-len cache keeps the dead replica lookin
+    # routable, forcing the retry path (probes would otherwise dodge it)
+    router = Router(ctl, "appretry", RouterConfig(
+        queue_len_staleness_s=60.0, ejection_threshold=2,
+        ejection_cooldown_s=60.0))
+    try:
+        for i in range(5):  # warm the routing table + qlen cache
+            out, _ = router.call("echo", "__call__", (i,), {}, timeout_s=30)
+            assert out == i
+
+        table = ray_tpu.get(ctl.get_routing_table.remote("appretry"),
+                            timeout=10)
+        replicas, _version = table["echo"]
+        assert len(replicas) == 2
+        ray_tpu.kill(replicas[0])
+        time.sleep(0.5)  # let the death propagate to submitters
+
+        outs = [router.call("echo", "__call__", (i,), {}, timeout_s=30)[0]
+                for i in range(20)]
+        assert outs == list(range(20))
+        stats = router.stats_snapshot()
+        assert stats["requests"] == 25
+        assert stats["retries"] >= 1, f"no retry recorded: {stats}"
+        assert stats["ejections"] >= 1, f"dead replica never ejected: {stats}"
+    finally:
+        router.stop()
+    serve.delete("appretry")
+
+
+def test_proxy_deadline_shed_and_error_shape(serve_shutdown):
+    """Expired requests shed with 503 + Retry-After before reaching a
+    replica; /v1 routes get the OpenAI-style JSON error envelope; counters
+    are served at /-/stats."""
+
+    @serve.deployment
+    def echo(payload):
+        return {"got": payload}
+
+    serve.run(echo.bind(), name="pxapp", route_prefix="/px")
+    serve.run(echo.options(name="v1echo").bind(), name="v1app",
+              route_prefix="/v1")
+    proxy = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{proxy.port}"
+
+    # healthy request (relative timeout header) passes
+    req = urllib.request.Request(
+        f"{base}/px", data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Timeout-S": "30"})
+    body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert body == {"got": {"a": 1}}
+
+    # expired absolute deadline: fast 503, Retry-After, request never
+    # reaches a replica
+    req = urllib.request.Request(
+        f"{base}/px", data=b"{}",
+        headers={"X-Request-Deadline": "1.0"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After") == "1"
+
+    # /v1 routes speak the OpenAI error envelope
+    req = urllib.request.Request(
+        f"{base}/v1/chat/completions", data=b"{}",
+        headers={"X-Request-Deadline": "1.0"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 503
+    err = json.loads(ei.value.read())
+    assert err["error"]["type"] == "timeout"
+    assert err["error"]["code"] == 503
+    assert "deadline" in err["error"]["message"]
+
+    stats = json.loads(urllib.request.urlopen(
+        f"{base}/-/stats", timeout=10).read())
+    assert stats["shed_expired"] >= 2
+    assert stats["ok"] >= 1
+    assert "routers" in stats
+    serve.delete("pxapp")
+    serve.delete("v1app")
+
+
+def test_proxy_enforces_request_timeout(serve_shutdown):
+    """A slow replica call is cut off at the deployment's request_timeout_s
+    (bounded get + 503), not at a hardcoded 120s."""
+
+    @serve.deployment(request_timeout_s=1.0)
+    def sleepy(payload):
+        time.sleep(5.0)
+        return {"ok": True}
+
+    serve.run(sleepy.bind(), name="slowapp", route_prefix="/slow")
+    proxy = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{proxy.port}"
+
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            urllib.request.Request(f"{base}/slow", data=b"{}"), timeout=30)
+    elapsed = time.monotonic() - t0
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After") == "1"
+    assert elapsed < 4.0, f"deadline not enforced: took {elapsed:.1f}s"
+
+    stats = json.loads(urllib.request.urlopen(
+        f"{base}/-/stats", timeout=10).read())
+    assert stats["deadline_exceeded"] >= 1
+    serve.delete("slowapp")
+
+
+def test_proxy_overload_shed(serve_shutdown):
+    """max_inflight admission control sheds with 503 + Retry-After."""
+    from ray_tpu.serve.controller import get_or_create_controller
+    from ray_tpu.serve.proxy import HTTPProxy
+
+    @serve.deployment
+    def echo(payload):
+        return {"got": payload}
+
+    serve.run(echo.bind(), name="ovapp", route_prefix="/ov")
+    proxy = HTTPProxy(get_or_create_controller(), port=0, max_inflight=0)
+    proxy.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{proxy.port}/ov", data=b"{}"),
+                timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "1"
+        assert proxy.stats["shed_overload"] == 1
+    finally:
+        proxy.stop()
+    serve.delete("ovapp")
+
+
+# ---- chaos: serve keeps its SLO while a replica-bearing node dies ---------
+# LAST in the file on purpose: it tears down the module-shared runtime and
+# builds its own multi-node cluster.
+
+@pytest.mark.slow
+def test_serve_survives_node_death_under_traffic():
+    """Acceptance: with a NodeKiller killing one replica-bearing node under
+    sustained proxy traffic, >= 99% of requests succeed (retries + ejection
+    absorb the death), no successful response exceeds its deadline plus
+    grace, and already-expired requests are shed with 503 (shed counters)."""
+    import concurrent.futures
+
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.config import get_config
+    from ray_tpu.util.chaos import NodeKiller
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    # the in-process CP reads the live Config singleton: tighten node-death
+    # detection BEFORE the cluster starts
+    cfg = get_config()
+    cfg.health_check_period_s = 0.2
+    cfg.health_check_failure_threshold = 3
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)   # node0: spared by NodeKiller; controller
+    ray_tpu.init(address=cluster.address, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+    })
+    try:
+        # pin the controller to node0 by creating it while node0 is the
+        # only node, THEN add the replica-bearing nodes
+        from ray_tpu.serve.controller import get_or_create_controller
+        ctl = get_or_create_controller()
+        ray_tpu.get(ctl.status.remote(), timeout=60)
+        cluster.add_node(num_cpus=3)
+        cluster.add_node(num_cpus=3)
+
+        REQUEST_TIMEOUT_S = 15.0
+        GRACE_S = 3.0
+
+        @serve.deployment(num_replicas=3, health_check_period_s=0.2,
+                          health_check_failure_threshold=3,
+                          request_timeout_s=REQUEST_TIMEOUT_S)
+        def work(payload):
+            time.sleep(0.02)
+            return {"ok": True}
+
+        serve.run(work.bind(), name="chaosapp", route_prefix="/chaos")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}"
+
+        results = []  # (ok: bool, elapsed: float, detail: str)
+        results_lock = threading.Lock()
+        stop_traffic = threading.Event()
+        traffic_t0 = time.monotonic()
+
+        def one_request():
+            t0 = time.monotonic()
+            try:
+                resp = urllib.request.urlopen(
+                    urllib.request.Request(f"{base}/chaos", data=b"{}"),
+                    timeout=REQUEST_TIMEOUT_S + GRACE_S)
+                ok = resp.status == 200 and \
+                    json.loads(resp.read())["ok"] is True
+                detail = f"http {resp.status}"
+            except urllib.error.HTTPError as e:
+                ok = False
+                detail = f"http {e.code}: {e.read()[:200]!r}"
+            except Exception as e:  # noqa: BLE001 — failure is data here
+                ok = False
+                detail = repr(e)[:200]
+            with results_lock:
+                results.append(
+                    (ok, time.monotonic() - t0,
+                     f"@{t0 - traffic_t0:.1f}s {detail}"))
+
+        def traffic(worker_id):
+            while not stop_traffic.is_set():
+                one_request()
+                time.sleep(0.02)
+
+        killer = NodeKiller(cluster, interval_s=3.0, max_kills=1, seed=7)
+        with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [pool.submit(traffic, i) for i in range(4)]
+            killer.start()
+            time.sleep(18.0)  # kill lands ~3s in; recovery under load
+            stop_traffic.set()
+            for f in futs:
+                f.result(timeout=REQUEST_TIMEOUT_S + GRACE_S + 10)
+        report = killer.stop()
+        assert report["nodes_killed"] == 1, "chaos never fired"
+
+        total = len(results)
+        succ = sum(1 for ok, _, _ in results if ok)
+        assert total >= 100, f"not enough traffic generated: {total}"
+        rate = succ / total
+        failures = [(f"{t:.1f}s", d) for ok, t, d in results if not ok]
+        if rate < 0.99:
+            try:
+                dbg = urllib.request.urlopen(
+                    f"{base}/-/stats", timeout=10).read().decode()
+            except Exception as e:  # noqa: BLE001
+                dbg = repr(e)
+            raise AssertionError(
+                f"success rate {rate:.3f} ({succ}/{total}) under node "
+                f"death; failures: {failures[:10]}; server stats: {dbg}")
+        # no successful response may exceed its deadline plus grace
+        slow = [t for ok, t, _ in results
+                if ok and t > REQUEST_TIMEOUT_S + GRACE_S]
+        assert not slow, f"successful responses exceeded deadline+grace: {slow}"
+
+        # already-expired requests are shed with 503 before any replica
+        for _ in range(3):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"{base}/chaos", data=b"{}",
+                        headers={"X-Request-Deadline": "1.0"}), timeout=30)
+            assert ei.value.code == 503
+        stats = json.loads(urllib.request.urlopen(
+            f"{base}/-/stats", timeout=10).read())
+        assert stats["shed_expired"] >= 3
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        cluster.shutdown()
