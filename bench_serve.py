@@ -76,7 +76,34 @@ def main():
     ap.add_argument("--curve", action="store_true",
                     help="sweep concurrency levels up to --concurrency and "
                          "record a TTFT-vs-throughput curve")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="measure the shared_prefix_1024 operating point "
+                         "(1024-token shared prefix, unique suffixes) with "
+                         "the prefix cache on vs off; merges the result "
+                         "into --out (implied by --curve)")
+    ap.add_argument("--out", default="SERVE_BENCH.json",
+                    help="JSON file the shared-prefix result merges into")
+    ap.add_argument("--no-preflight", action="store_true",
+                    help="skip the serve-LLM smoke tests before benching")
     args = ap.parse_args()
+    args.shared_prefix = args.shared_prefix or args.curve
+
+    # Preflight: a perf number from a broken engine is worse than no
+    # number. The smoke tests run tiny-on-CPU in a subprocess so the
+    # driver stays off the TPU (one process per chip).
+    if not args.no_preflight:
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.abspath(__file__))
+        rc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q",
+             "tests/test_serve_llm.py"],
+            cwd=repo, env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
+        if rc != 0:
+            sys.exit(f"preflight failed: pytest -q tests/test_serve_llm.py "
+                     f"exited {rc} — not benchmarking a broken serve path "
+                     f"(--no-preflight to override)")
 
     import ray_tpu
     from ray_tpu import serve
@@ -91,10 +118,15 @@ def main():
 
     if args.tiny or not has_tpu:
         model_cfg = llama.llama_tiny(vocab_size=2048)
+        # the shared-prefix point carries 1024-token prompts: size the
+        # window and the page pool for 8 concurrent long requests plus
+        # parked cached pages
         llm_cfg = LLMConfig(
             model_id="llama-tiny", model_config=model_cfg,
-            max_batch_size=8, page_size=32, num_pages=256,
-            max_prompt_len=256, max_seq_len=512,
+            max_batch_size=8, page_size=32,
+            num_pages=448 if args.shared_prefix else 256,
+            max_prompt_len=1280 if args.shared_prefix else 256,
+            max_seq_len=1536 if args.shared_prefix else 512,
             max_tokens=args.max_tokens)
     else:
         # ~1.2B on one v5e chip, bf16 weights + paged bf16 KV. 32 decode
@@ -105,10 +137,15 @@ def main():
         # best TTFT/throughput point on one v5e with the Pallas paged-
         # attention kernel + async host fetches (engine sweep in
         # BENCH_NOTES.md: 498 tok/s, p50 TTFT 323ms at concurrency 16)
+        # shared-prefix mode widens the prompt window (prefix + suffix >
+        # 1024) and adds pool headroom so parked cached pages never starve
+        # admissions at full slot occupancy (32 slots * 9 pages = 288)
         llm_cfg = LLMConfig(
             model_id="llama3-1b", model_config=model_cfg,
-            max_batch_size=32, page_size=128, num_pages=288,
-            max_prompt_len=1024, max_seq_len=2048,
+            max_batch_size=32, page_size=128,
+            num_pages=320 if args.shared_prefix else 288,
+            max_prompt_len=1280 if args.shared_prefix else 1024,
+            max_seq_len=2048,
             decode_block=8, pipeline_depth=3, pressure_decode_block=2,
             max_tokens=args.max_tokens,
             ray_actor_options={"resources": {"TPU": 1}})
@@ -137,27 +174,34 @@ def main():
 
     def run_point(concurrency: int, requests: int,
                   point_prompt: str | None = None,
-                  label: str | None = None) -> dict:
+                  label: str | None = None,
+                  prompt_fn=None, max_tokens: int | None = None) -> dict:
         """Drive one operating point over SSE; TTFT is CLIENT-observed
         (first data: byte), engine-side ttft recorded alongside so the
-        proxy/router/transport share is visible per point."""
+        proxy/router/transport share is visible per point. prompt_fn(i)
+        gives per-request prompts (shared-prefix point: unique suffixes)."""
         p = point_prompt if point_prompt is not None else prompt
+        mt = args.max_tokens if max_tokens is None else max_tokens
         ttfts: list[float] = []
         engine_ttfts: list[float] = []
         latencies: list[float] = []
         tokens = 0
+        prompt_tokens = 0
 
-        def one(_i: int):
+        def one(i: int):
             out = _post_stream(
-                base, {"prompt": p, "max_tokens": args.max_tokens})
+                base, {"prompt": prompt_fn(i) if prompt_fn else p,
+                       "max_tokens": mt})
             return (out["client_ttft_s"], out["client_latency_s"],
                     out["engine"].get("ttft_s"),
-                    out["usage"].get("completion_tokens", 0))
+                    out["usage"].get("completion_tokens", 0),
+                    out["usage"].get("prompt_tokens", 0))
 
         cpu0 = _proc_cpu_s()
         t0 = time.monotonic()
         with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
-            for ttft, lat, engine_ttft, ntok in pool.map(one, range(requests)):
+            for ttft, lat, engine_ttft, ntok, nptok in pool.map(
+                    one, range(requests)):
                 if ttft is not None:
                     ttfts.append(ttft)
                 if engine_ttft is not None:
@@ -165,6 +209,7 @@ def main():
                 if lat is not None:
                     latencies.append(lat)
                 tokens += ntok
+                prompt_tokens += nptok
         wall = time.monotonic() - t0
         proxy_cpu = _proc_cpu_s() - cpu0
         p50 = statistics.median(ttfts) * 1e3 if ttfts else float("nan")
@@ -182,6 +227,7 @@ def main():
             "p50_latency_ms": round(
                 statistics.median(latencies) * 1e3, 2) if latencies else None,
             "gen_tokens_per_s": round(tokens / wall, 1),
+            "prompt_tokens_total": prompt_tokens,
             # driver-process (proxy+router+client threads) CPU share of the
             # point's wall time: the "is the proxy eating the core?" number
             "proxy_cpu_share": round(proxy_cpu / wall, 3),
@@ -211,9 +257,81 @@ def main():
         points = [run_point(args.concurrency, args.requests)]
     head = points[-2] if args.curve else points[-1]
 
+    # shared_prefix_1024: every request carries the same 1024-token prefix
+    # (system prompt) plus a short unique suffix — the workload automatic
+    # prefix caching exists for. Measured cache-on against the live app,
+    # then cache-off on a redeployed replica (same sizing), hit rate from
+    # the engine's prefix counters over the point's offered prompt tokens.
+    prefix_cache = None
+    if args.shared_prefix:
+        import dataclasses as _dc
+
+        stats_url = base.replace("/completions", "/stats")
+
+        def _stats() -> dict:
+            with urllib.request.urlopen(stats_url, timeout=60) as r:
+                return json.loads(r.read())
+
+        prefix_text = (
+            "You are a helpful, terse assistant. Cite your sources. " * 32
+        )[:1024]
+
+        def _mk_prompt(i: int) -> str:
+            return prefix_text + f" Q{i:05d}: summarize item {i}."
+
+        sp_req = max(8, args.requests // 2)
+        sp_conc = max(2, min(args.concurrency, 8))
+        sp_tokens = min(32, args.max_tokens)
+
+        def shared_point(label: str) -> dict:
+            # warm: compile the long-prompt bucket, then (cache on) the
+            # suffix-chunk program, seeding the prefix in the index
+            _post_stream(base, {"prompt": _mk_prompt(90000), "max_tokens": 4})
+            _post_stream(base, {"prompt": _mk_prompt(90001), "max_tokens": 4})
+            s0 = _stats()
+            row = run_point(sp_conc, sp_req, label=label,
+                            prompt_fn=_mk_prompt, max_tokens=sp_tokens)
+            s1 = _stats()
+            hit_toks = (s1.get("prefix_hit_tokens", 0)
+                        - s0.get("prefix_hit_tokens", 0))
+            if row["prompt_tokens_total"]:
+                row["cache_hit_rate"] = round(
+                    hit_toks / row["prompt_tokens_total"], 3)
+            row["prefix_hit_tokens"] = hit_toks
+            row["prefix_evictions"] = s1.get("prefix_evictions", 0)
+            return row
+
+        on_row = shared_point("shared_prefix_1024_cache_on")
+        points.append(on_row)
+
+        # A/B: fresh replica with the cache disabled, same pool sizing
+        serve.shutdown()
+        app = build_openai_app(
+            _dc.replace(llm_cfg, prefix_cache_enabled=False),
+            route_prefix="/v1")
+        serve.run(app, name="llm-bench-off", route_prefix="/v1")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}/v1/completions"
+        stats_url = base.replace("/completions", "/stats")
+        off_row = shared_point("shared_prefix_1024_cache_off")
+        points.append(off_row)
+
+        prefix_cache = {
+            "label": "shared_prefix_1024",
+            "prefix_tokens": len(prefix_text),
+            "model": llm_cfg.model_id,
+            "env": "tpu" if (has_tpu and not args.tiny) else "cpu-tiny",
+            "cache_on": on_row,
+            "cache_off": off_row,
+            "cache_hit_rate": on_row.get("cache_hit_rate"),
+            "ttft_speedup": round(
+                off_row["p50_ttft_ms"] / on_row["p50_ttft_ms"], 2)
+            if on_row["p50_ttft_ms"] else None,
+        }
+
     serve.shutdown()
 
-    print(json.dumps({
+    result = {
         "metric": "serve_p50_ttft_ms",
         "value": head["p50_ttft_ms"],
         "unit": "ms",
@@ -224,7 +342,23 @@ def main():
             "model": llm_cfg.model_id,
             "operating_points": points,
         },
-    }))
+    }
+    if prefix_cache is not None:
+        result["extra"]["prefix_cache"] = prefix_cache
+        # merge into --out WITHOUT clobbering earlier headline rows (e.g.
+        # a TPU curve recorded by a previous run)
+        import os
+        merged = result
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    merged = json.load(f)
+                merged.setdefault("extra", {})["prefix_cache"] = prefix_cache
+            except ValueError:
+                merged = result
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

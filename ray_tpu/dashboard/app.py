@@ -410,9 +410,26 @@ class Dashboard:
         def fetch():
             from ray_tpu.core import api
             from ray_tpu.util.metrics import collect_prometheus
-            text = api._get_runtime().cp_client.call_with_retry(
+            rt = api._get_runtime()
+            text = rt.cp_client.call_with_retry(
                 "get_metrics", None, timeout=10.0)
-            return text + collect_prometheus()
+            # user/worker metrics pushed to the CP KV (util.metrics
+            # push_to_control_plane — e.g. LLM replica engine gauges incl.
+            # prefix-cache counters) ride the same scrape
+            parts = [text]
+            try:
+                keys = rt.cp_client.call_with_retry(
+                    "kv_keys", {"prefix": "metrics:"}, timeout=10.0) or []
+                for key in sorted(keys):
+                    raw = rt.cp_client.call_with_retry(
+                        "kv_get", {"key": key}, timeout=10.0)
+                    if raw:
+                        parts.append(raw.decode()
+                                     if isinstance(raw, bytes) else raw)
+            except Exception:  # noqa: BLE001 — scrape must stay best-effort
+                pass
+            parts.append(collect_prometheus())
+            return "\n".join(p.strip("\n") for p in parts if p) + "\n"
 
         text = await loop.run_in_executor(None, fetch)
         return web.Response(text=text, content_type="text/plain")

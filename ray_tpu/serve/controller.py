@@ -273,12 +273,35 @@ class ServeController:
             except Exception:  # noqa: BLE001 — replica busy/dead
                 return None
 
+        # engine stats ride next to the live queue lens: deployments whose
+        # callable defines engine_stats() (LLM servers) report steps /
+        # prefills / tokens_out / shed counts / prefix-cache hit-miss-evict
+        # counters per replica; anything else probes to None
+        _ENGINE_KEYS = ("steps", "prefills", "tokens_out", "shed_expired",
+                        "active_slots", "waiting", "free_pages",
+                        "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+                        "prefix_cached_pages", "prefix_shared_pages",
+                        "prefix_evictions")
+
+        async def probe_engine(replica):
+            try:
+                stats = await asyncio.wait_for(
+                    replica.handle_request.remote("engine_stats", (), {}),
+                    timeout=2.0)
+            except Exception:  # noqa: BLE001 — not an engine / busy / dead
+                return None
+            if not isinstance(stats, dict):
+                return None
+            return {k: stats[k] for k in _ENGINE_KEYS if k in stats}
+
         out = {}
         for state in self._deployments.values():
             # concurrent probes: a deployment of N hung replicas must cost
             # one 2s timeout, not N of them (the dashboard polls this)
             qlens = list(await asyncio.gather(
                 *(probe(r) for r in state.replicas)))
+            engines = list(await asyncio.gather(
+                *(probe_engine(r) for r in state.replicas)))
             out[state.full_name()] = {
                 "app": state.app,
                 "replicas": len(state.replicas),
@@ -286,6 +309,8 @@ class ServeController:
                 "target": state.target,
                 "version": state.version,
                 "queue_lens": qlens,
+                "engine": (engines if any(e is not None for e in engines)
+                           else None),
             }
         return out
 

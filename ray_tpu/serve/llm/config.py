@@ -56,6 +56,20 @@ class LLMConfig:
     # of on first use mid-traffic (a compile stalls every active request)
     warmup_compile: bool = True
 
+    # Automatic prefix caching (RadixAttention/vLLM-style): full pages of
+    # prompt KV are kept in a refcounted hash-chained index after a request
+    # finishes prefill, and later admissions with a matching token prefix
+    # point their page tables at the shared pages and prefill ONLY the
+    # suffix. Host-side bookkeeping between steps — compiled programs and
+    # their static shapes are untouched. Disabled automatically on the
+    # disaggregated path (disagg.py), where the prefill tier owns prompt
+    # computation and decode pools only ever receive handed-off KV.
+    prefix_cache_enabled: bool = True
+    # cap on refcount-zero cached pages retained for reuse (LRU beyond it);
+    # 0 = bounded only by the pool (cached pages evict under alloc pressure
+    # either way, so the pool can never be starved by the cache)
+    prefix_cache_max_pages: int = 0
+
     # sampling defaults (overridable per request)
     max_tokens: int = 128
     temperature: float = 0.0          # 0 = greedy

@@ -23,10 +23,21 @@ Pieces:
   the handed-off first token.
 - ``build_disagg_openai_app``       — OpenAI ingress whose completions
   path is prefill-replica → KV blob → local decode engine.
+
+Prefix caching: the disagg path BYPASSES the prefix-cache index by
+decision (``_disable_prefix_cache``), not by accident. Prefill replicas
+allocate and free their pages inside one call, so nothing survives to
+index; decode pools only ever receive handed-off KV blobs whose prompt
+computation happened on another engine — indexing those pages would
+advertise KV this engine never computed against its own admission path,
+and the KV-handoff accounting (pool fully recycled per request) is an
+invariant the disagg tests pin. Cross-replica prefix reuse belongs in the
+prefill tier's router, not here.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 import uuid
@@ -36,6 +47,14 @@ import numpy as np
 
 from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.engine import LLMEngine, _Request
+
+
+def _disable_prefix_cache(cfg: LLMConfig) -> LLMConfig:
+    """Disagg engines run with the prefix cache OFF (module docstring);
+    returns the config unchanged when it already is."""
+    if not cfg.prefix_cache_enabled:
+        return cfg
+    return dataclasses.replace(cfg, prefix_cache_enabled=False)
 
 
 # ---------------------------------------------------------------------------
@@ -117,7 +136,8 @@ class DecodeEngine(LLMEngine):
     continues from the handed-off first token."""
 
     def __init__(self, cfg: LLMConfig, params=None, rng_seed: int = 0):
-        super().__init__(cfg, params=params, rng_seed=rng_seed)
+        super().__init__(_disable_prefix_cache(cfg), params=params,
+                         rng_seed=rng_seed)
         self._inject_q: list[tuple[_Request, dict]] = []
         self._inject_fn = None
 
@@ -229,7 +249,8 @@ class PrefillServer:
         if isinstance(llm_config, dict):
             llm_config = LLMConfig(**llm_config)
         self.cfg = llm_config
-        self.engine = LLMEngine(llm_config)  # loop NOT started
+        # loop NOT started; prefix cache off (module docstring)
+        self.engine = LLMEngine(_disable_prefix_cache(llm_config))
 
     def prefill(self, prompt, sampling: dict) -> dict:
         return prefill_only(
@@ -361,7 +382,10 @@ class DisaggLLMServer:
                           "owned_by": "ray_tpu", "mode": "disagg"}]}
 
     def engine_stats(self) -> dict:
-        return {**self.engine.engine_stats(), "mode": "disagg"}
+        from ray_tpu.serve.llm.llm_server import _export_engine_stats
+        stats = {**self.engine.engine_stats(), "mode": "disagg"}
+        _export_engine_stats(self.cfg.model_id, stats)
+        return stats
 
     def check_health(self) -> bool:
         return True

@@ -53,6 +53,12 @@ class _Request:
     generated: list[int] = field(default_factory=list)
     dispatched: int = 0  # tokens whose computation has been dispatched
     prefill_pos: int = 0  # prompt tokens already prefilled (chunked prefill)
+    # prompt tokens served from the prefix cache (shared pages; prefill_pos
+    # starts here so only the suffix is computed)
+    cached_tokens: int = 0
+    # cancelled/shed while mid chunked prefill: the loop frees slot+pages
+    # promptly via _abort_prefilling instead of finishing the prompt pass
+    prefill_cancelled: bool = False
     drained_upto: int = 0
     done: bool = False
     error: Optional[str] = None
@@ -98,7 +104,13 @@ class LLMEngine:
         self.max_pages_per_seq = -(-cfg.max_seq_len // cfg.page_size)
         self.kv = kvc.init_paged_cache(
             self.model_cfg, cfg.num_pages, cfg.page_size)
-        self.allocator = kvc.PageAllocator(cfg.num_pages)
+        # Prefix caching (see kv_cache.PageAllocator): all bookkeeping is
+        # host-side between steps — the page table indirection means shared
+        # pages change WHICH pool pages a slot reads, never the compiled
+        # programs or their shapes.
+        self._prefix_cache_on = bool(cfg.prefix_cache_enabled)
+        self.allocator = kvc.PageAllocator(
+            cfg.num_pages, cache_pages=cfg.prefix_cache_max_pages)
         self.page_tables = np.zeros((b, self.max_pages_per_seq), np.int32)
         self.seq_lens = np.zeros((b,), np.int32)
         self.slot_req: list[Optional[_Request]] = [None] * b
@@ -117,7 +129,9 @@ class LLMEngine:
         self._rng = jax.random.PRNGKey(rng_seed + 1)
         self._loop_thread: Optional[threading.Thread] = None
         self.stats = {"steps": 0, "prefills": 0, "tokens_out": 0,
-                      "requests": 0, "shed_expired": 0, "compile_s": 0.0}
+                      "requests": 0, "shed_expired": 0, "compile_s": 0.0,
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_hit_tokens": 0}
         # Pipelined decode (vLLM-style async token processing, re-shaped for
         # a REMOTE chip): each step's input tokens are the previous step's
         # on-device output, so steps dispatch back-to-back without a host
@@ -387,6 +401,20 @@ class LLMEngine:
                 # leave it blocking to its full timeout
                 req.done_event.set()
                 return
+            if req in self._prefilling:
+                # mid chunked prefill: flag it and let the LOOP free the
+                # slot/pages (_abort_prefilling) — the loop may be building
+                # a chunk dispatch from req.pages on the host right now, so
+                # freeing here could hand those pages to a later admission
+                # while this one still writes them. Without this branch the
+                # request would chunk-prefill its ENTIRE remaining prompt,
+                # decode a token, and only then free — the _prefilling
+                # cancel leak.
+                req.prefill_cancelled = True
+                req.abandoned = True
+                self._requests[request_id] = req  # loop reaps on abort
+                self._wake.set()
+                return
             if not req.done:
                 # finish at next token; keep a tracking entry so the loop's
                 # completion path still finds consistent state, and flag it
@@ -465,9 +493,18 @@ class LLMEngine:
         # mid-chunked-prefill requests hold a slot + pages but are not yet
         # in slot_req: load monitoring must see them (as waiting) or
         # autoscaling under-counts
-        return {**self.stats, "active_slots": active,
-                "waiting": waiting + prefilling, "prefilling": prefilling,
-                "free_pages": self.allocator.available()}
+        out = {**self.stats, "active_slots": active,
+               "waiting": waiting + prefilling, "prefilling": prefilling,
+               "free_pages": self.allocator.available()}
+        if self._prefix_cache_on:
+            cs = self.allocator.cache_stats()
+            out.update({"prefix_cached_pages": cs["cached_pages"],
+                        "prefix_evictable_pages": cs["evictable_pages"],
+                        "prefix_shared_pages": cs["shared_pages"],
+                        "prefix_evictions": cs["evicted"],
+                        "prefix_hit_pages": cs["hit_pages"],
+                        "prefix_inserted_pages": cs["inserted"]})
+        return out
 
     # ---- engine loop ---------------------------------------------------
     def _loop(self):
@@ -564,21 +601,47 @@ class LLMEngine:
                 if not self._waiting or not self.free_slots:
                     return admitted
                 req = self._waiting[0]
+                # cache-aware admission: longest indexed full-page prefix
+                # (increffed — shared pages go into this slot's page table
+                # and only the suffix gets prefilled). match_prefix caps
+                # the match so at least one suffix token remains: the
+                # suffix pass is what produces the first sampled token.
+                matched: list[int] = []
+                if self._prefix_cache_on:
+                    matched = self.allocator.match_prefix(
+                        req.prompt_tokens, self.cfg.page_size)
                 n_pages = -(-max(len(req.prompt_tokens) + req.max_tokens, 1)
                             // self.cfg.page_size)
                 n_pages = min(n_pages, self.max_pages_per_seq)
-                pages = self.allocator.alloc(n_pages)
+                pages = self.allocator.alloc(n_pages - len(matched))
                 if pages is None:
-                    return admitted  # page pool exhausted; retry next loop
+                    # page pool exhausted; drop the match refs (pages park
+                    # back in the cached LRU, still matchable) + retry next
+                    # loop
+                    if matched:
+                        self.allocator.free(matched)
+                    return admitted
                 self._waiting.pop(0)
                 slot = self.free_slots.pop()
                 req.slot = slot
-                req.pages = pages
-            if (self.cfg.prefill_chunk > 0
-                    and len(req.prompt_tokens) > self.cfg.prefill_chunk):
-                # long prompt: prefill in chunks interleaved with decode
-                # blocks (the loop drives _prefill_chunks) so active
-                # generations stall at most one chunk, not the whole prompt
+                req.pages = matched + pages
+                req.cached_tokens = len(matched) * self.cfg.page_size
+                req.prefill_pos = req.cached_tokens
+                if self._prefix_cache_on \
+                        and len(req.prompt_tokens) > self.cfg.page_size:
+                    key = "prefix_hits" if matched else "prefix_misses"
+                    self.stats[key] += 1
+                    self.stats["prefix_hit_tokens"] += req.cached_tokens
+            suffix = len(req.prompt_tokens) - req.prefill_pos
+            if req.prefill_pos > 0 or (self.cfg.prefill_chunk > 0
+                                       and suffix > self.cfg.prefill_chunk):
+                # long prompt OR cached prefix: prefill the (remaining)
+                # suffix in chunks interleaved with decode blocks (the loop
+                # drives _prefill_chunks). A cached prefix MUST go through
+                # the chunk program — paged_prefill writes from position 0
+                # and would scribble on the shared pages; the chunk pass
+                # starts at prefill_pos and reads the cached prefix back
+                # through the page table.
                 with self._lock:
                     self._prefilling.append(req)
             else:
@@ -619,6 +682,19 @@ class LLMEngine:
             self._dirty_slots[req.slot] = (plen, req.temperature)
             self._overrides[req.slot] = tok_dev
             self._pending.append((tok_dev, [(0, req.slot, req)], 1))
+        if self._prefix_cache_on:
+            # Index the prompt's FULL pages now (not at completion): the
+            # writes are merely dispatched, but any matcher's reads are
+            # dispatched later on the same ordered device stream, so a
+            # concurrent same-prefix admission can already share. Partial
+            # trailing pages are never indexed — and decode writes land at
+            # positions >= plen, past every full prompt page — so a shared
+            # page is never written after insertion (the would-be COW case
+            # is excluded by construction; a FULL-prefix match instead
+            # drops its last page and recomputes it into a private page,
+            # copy-on-write by recompute).
+            self.allocator.insert_prefix(
+                req.prompt_tokens, req.pages, self.cfg.page_size)
         self.stats["prefills"] += 1
 
     def _prefill_chunks(self) -> int:
@@ -631,13 +707,22 @@ class LLMEngine:
         jnp = self._jnp
         with self._lock:
             active = list(self._prefilling)
+        now = time.time()
         for req in active:
+            if req.prefill_cancelled or (req.deadline is not None
+                                         and now >= req.deadline):
+                self._abort_prefilling(req)
+                continue
             plen = len(req.prompt_tokens)
             start = req.prefill_pos
             remaining = plen - start
-            final = remaining <= self.cfg.prefill_chunk
-            clen = (self._bucket(remaining) if final
-                    else self.cfg.prefill_chunk)
+            # prefill_chunk 0 disables chunking, but a cached-prefix
+            # admission still rides this path (suffix-only prefill): the
+            # whole suffix then goes as one chunk
+            chunk = (self.cfg.prefill_chunk if self.cfg.prefill_chunk > 0
+                     else remaining)
+            final = remaining <= chunk
+            clen = self._bucket(remaining) if final else chunk
             toks = np.zeros((1, clen), np.int32)
             seg = req.prompt_tokens[start: start + clen]
             toks[0, : len(seg)] = seg
@@ -655,6 +740,34 @@ class LLMEngine:
                     self._prefilling.remove(req)
                 self._arm_slot(req, table, tok_dev, plen)
         return len(active)
+
+    def _abort_prefilling(self, req: _Request) -> None:
+        """Release a mid-chunked-prefill request NOW (cancelled, or its
+        deadline passed): slot, pages and tracking — not after the
+        remaining chunks plus a decode step, which is how the _prefilling
+        path used to leak pool capacity under cancel. Loop thread only:
+        in-flight chunk dispatches may still write these pages, but the
+        device stream is ordered, so any later prefill reusing them is
+        dispatched — and therefore executes — after. The slot was never
+        armed, so its device page-table row is still the zeros its
+        previous occupant left."""
+        expired = not getattr(req, "abandoned", False)
+        with self._lock:
+            if req in self._prefilling:
+                self._prefilling.remove(req)
+            if req.slot >= 0:
+                self.free_slots.append(req.slot)
+                req.slot = -1
+            req.done = True
+            req.finished_at = time.monotonic()
+            if expired:
+                req.error = "deadline exceeded"
+                self.stats["shed_expired"] += 1
+            else:
+                self._requests.pop(req.request_id, None)
+        self.allocator.free(req.pages)
+        req.pages = []
+        req.done_event.set()
 
     def _record_token(self, req: _Request, tok: int) -> None:
         """Append a sampled token; mark done on stop/max. Lock held."""

@@ -26,7 +26,11 @@ Page 0 is RESERVED as the trash page; the allocator never hands it out.
 
 from __future__ import annotations
 
+import hashlib
 import threading
+from collections import OrderedDict
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -51,34 +55,177 @@ def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int):
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
+def _chain_digest(parent: bytes, chunk) -> bytes:
+    """Hash-chain node key for one FULL page of prompt tokens: digest of
+    (parent page's digest, this page's token ids). Chaining makes the key
+    encode the entire token prefix, so equal digests mean equal prefixes —
+    the flat-dict equivalent of a radix-tree path (SGLang RadixAttention;
+    vLLM's hash-based prefix caching uses the same chained-hash trick).
+    blake2b-128 so a collision (which would silently serve the wrong KV)
+    is cryptographically excluded rather than merely unlikely."""
+    return hashlib.blake2b(
+        parent + np.asarray(chunk, np.int32).tobytes(),
+        digest_size=16).digest()
+
+
 class PageAllocator:
-    """Host-side free list over the page pool (page 0 reserved as trash).
+    """Host-side free list + prefix cache over the page pool (page 0
+    reserved as trash).
 
     Mirrors vLLM's BlockAllocator role; plain Python because allocation
     happens between steps, never inside the compiled program.
+
+    Prefix caching: pages are REFCOUNTED, and full pages of prompt tokens
+    can be registered in a hash-chained index (one node per full page,
+    keyed on the chain digest of every token up to the page's end). A page
+    whose refcount drops to zero while indexed is not returned to the free
+    list — it parks in an LRU of cached pages, its KV content intact, and
+    is either resurrected by a later ``match_prefix`` (refcount 1 again,
+    shared) or evicted back to the free list under pool pressure. Because
+    only refcount-zero pages are evictable, eviction can never free a page
+    a live slot's page table still references.
+
+    ``cache_pages`` caps how many refcount-zero cached pages are retained
+    (0 = bounded only by the pool itself).
     """
 
-    def __init__(self, num_pages: int):
+    def __init__(self, num_pages: int, cache_pages: int = 0):
         self._free = list(range(num_pages - 1, 0, -1))  # stack; never page 0
         self._lock = threading.Lock()
         self.num_pages = num_pages
+        self._cache_cap = int(cache_pages)
+        self._ref: dict[int, int] = {}          # live page -> refcount
+        self._index: dict[bytes, int] = {}      # chain digest -> page
+        self._page_key: dict[int, bytes] = {}   # indexed page -> digest
+        self._lru: OrderedDict[int, None] = OrderedDict()  # ref-0 cached
+        self.counters = {"hit_pages": 0, "miss_pages": 0, "evicted": 0,
+                         "inserted": 0}
+
+    # ---- allocation ----------------------------------------------------
+    def _evict_one_locked(self) -> bool:
+        """Drop the least-recently-used refcount-zero cached page back to
+        the free list (its index node dies with it). Lock held."""
+        if not self._lru:
+            return False
+        page, _ = self._lru.popitem(last=False)
+        key = self._page_key.pop(page)
+        if self._index.get(key) == page:
+            del self._index[key]
+        self._free.append(page)
+        self.counters["evicted"] += 1
+        return True
 
     def alloc(self, n: int) -> list[int] | None:
+        """n fresh pages at refcount 1, evicting cached pages LRU-first
+        under pressure; None when free + evictable can't cover n."""
         with self._lock:
-            if len(self._free) < n:
-                return None
+            if len(self._free) + len(self._lru) < n:
+                return None  # can't be satisfied — don't evict for nothing
+            while len(self._free) < n:
+                self._evict_one_locked()
             out = [self._free.pop() for _ in range(n)]
+            for p in out:
+                self._ref[p] = 1
             return out
 
     def free(self, pages: list[int]) -> None:
+        """Decref; a page reaching zero parks in the cached LRU if indexed
+        (content stays valid for later matches), else rejoins the free
+        list. Safe against double-free of already-dead pages."""
+        with self._lock:
+            for p in pages:
+                if p == 0:
+                    continue
+                cur = self._ref.get(p)
+                if cur is None:
+                    # already dead: a double free must not re-append the
+                    # page (duplicate free-list entries would hand one
+                    # page to two requests)
+                    continue
+                if cur > 1:
+                    self._ref[p] = cur - 1
+                    continue
+                del self._ref[p]
+                if p in self._page_key:
+                    self._lru[p] = None
+                    self._lru.move_to_end(p)
+                    while self._cache_cap > 0 \
+                            and len(self._lru) > self._cache_cap:
+                        self._evict_one_locked()
+                else:
+                    self._free.append(p)
+
+    def incref(self, pages: list[int]) -> None:
         with self._lock:
             for p in pages:
                 if p != 0:
-                    self._free.append(p)
+                    self._ref[p] = self._ref.get(p, 0) + 1
 
     def available(self) -> int:
+        """Pages an alloc() could obtain: free + evictable cached."""
         with self._lock:
-            return len(self._free)
+            return len(self._free) + len(self._lru)
+
+    # ---- prefix index --------------------------------------------------
+    def match_prefix(self, tokens, page_size: int) -> list[int]:
+        """Longest indexed chain of FULL token pages that prefixes
+        ``tokens``, capped so at least one token is left to prefill (the
+        suffix pass is what produces the first sampled token). Matched
+        pages are increffed (cached ref-0 pages resurrect from the LRU) —
+        the caller owns one reference and releases it via free()."""
+        limit = (len(tokens) - 1) // page_size
+        out: list[int] = []
+        if limit <= 0:
+            return out
+        with self._lock:
+            digest = b""
+            for i in range(limit):
+                digest = _chain_digest(
+                    digest, tokens[i * page_size:(i + 1) * page_size])
+                page = self._index.get(digest)
+                if page is None:
+                    self.counters["miss_pages"] += 1
+                    break
+                out.append(page)
+            for p in out:
+                if p in self._lru:
+                    del self._lru[p]
+                self._ref[p] = self._ref.get(p, 0) + 1
+            self.counters["hit_pages"] += len(out)
+        return out
+
+    def insert_prefix(self, tokens, pages: list[int],
+                      page_size: int) -> int:
+        """Register a request's FULL prompt pages in the index (pages[i]
+        holds tokens [i*page_size, (i+1)*page_size)). First writer wins: a
+        chunk whose digest is already indexed keeps the existing page (the
+        duplicate page simply stays un-indexed and frees normally).
+        Returns how many new nodes were added."""
+        added = 0
+        with self._lock:
+            digest = b""
+            for i in range(min(len(tokens) // page_size, len(pages))):
+                digest = _chain_digest(
+                    digest, tokens[i * page_size:(i + 1) * page_size])
+                if digest in self._index:
+                    continue
+                page = pages[i]
+                if page == 0 or page in self._page_key:
+                    continue
+                self._index[digest] = page
+                self._page_key[page] = digest
+                added += 1
+            self.counters["inserted"] += added
+        return added
+
+    def cache_stats(self) -> dict:
+        """Snapshot for engine stats / metrics export."""
+        with self._lock:
+            return {**self.counters,
+                    "cached_pages": len(self._page_key),
+                    "evictable_pages": len(self._lru),
+                    "shared_pages": sum(1 for c in self._ref.values()
+                                        if c > 1)}
 
 
 # ---------------------------------------------------------------------------

@@ -16,6 +16,43 @@ from typing import Any, Iterator, Optional
 from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.engine import LLMEngine
 
+# Engine stats as one tagged Prometheus gauge family (util.metrics →
+# CP KV "metrics:<worker>" → dashboard /metrics). Module-level singleton:
+# the metrics registry is per-process and a replica restart in the same
+# worker must not register a duplicate family.
+_ENGINE_GAUGE = None
+_EXPORTED_STATS = (
+    "steps", "prefills", "tokens_out", "requests", "shed_expired",
+    "active_slots", "waiting", "prefilling", "free_pages",
+    "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+    "prefix_hit_pages", "prefix_cached_pages", "prefix_evictable_pages",
+    "prefix_shared_pages", "prefix_evictions", "prefix_inserted_pages")
+
+
+def _export_engine_stats(model_id: str, stats: dict) -> None:
+    """Record engine counters as gauges and push to the control plane
+    (best-effort: benches/tests run engines with no runtime up)."""
+    global _ENGINE_GAUGE
+    try:
+        from ray_tpu.core import api
+        from ray_tpu.util import metrics
+        if _ENGINE_GAUGE is None:
+            _ENGINE_GAUGE = metrics.Gauge(
+                "ray_tpu_llm_engine",
+                "LLM engine counters (incl. prefix-cache hit/miss/evict)",
+                tag_keys=("model", "replica", "stat"))
+        rt = api._try_get_runtime()
+        replica = rt.worker_id.hex()[:8] if rt is not None else "local"
+        for key in _EXPORTED_STATS:
+            if key in stats:
+                _ENGINE_GAUGE.set(
+                    float(stats[key]),
+                    tags={"model": model_id, "replica": replica,
+                          "stat": key})
+        metrics.push_to_control_plane()
+    except Exception:  # noqa: BLE001 — observability must not fail serving
+        pass
+
 
 def _chat_prompt(messages: list[dict]) -> str:
     """Minimal chat template (role-tagged concatenation)."""
@@ -171,9 +208,14 @@ class LLMServer:
         return self.engine.drain(request_id)
 
     def engine_stats(self) -> dict:
-        return self.engine.engine_stats()
+        stats = self.engine.engine_stats()
+        _export_engine_stats(self.cfg.model_id, stats)
+        return stats
 
     def check_health(self) -> bool:
+        # periodic health checks double as the metrics heartbeat: every
+        # probe refreshes this replica's engine gauges on the CP
+        _export_engine_stats(self.cfg.model_id, self.engine.engine_stats())
         return True
 
     # ---- HTTP ingress dispatch (proxy calls handle_http when defined) --

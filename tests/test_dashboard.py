@@ -127,6 +127,59 @@ def test_dashboard_serve_section(dash):
         row = rows[0]
         assert row["replicas"] >= 1
         assert "queue_lens" in row
+        # plain function deployment: engine column present but empty
+        assert row.get("engine") is None
+    finally:
+        serve.shutdown()
+
+
+def test_dashboard_serve_engine_stats_and_metrics(dash):
+    """LLM deployments surface engine counters (steps/prefills/tokens_out/
+    shed + prefix-cache hit/miss/evict) in the serve view next to the
+    queue lens, and the replica's pushed gauges ride the dashboard's
+    Prometheus scrape."""
+    from ray_tpu import serve
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig, build_openai_app
+
+    cfg = LLMConfig(model_config=llama.llama_tiny(vocab_size=512),
+                    max_batch_size=4, page_size=16, num_pages=64,
+                    max_prompt_len=64, max_seq_len=128, max_tokens=4)
+    serve.run(build_openai_app(cfg, route_prefix="/v1"),
+              name="dash-llm", route_prefix="/v1")
+    proxy = serve.start_http_proxy(port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/v1/completions",
+            data=json.dumps({"prompt": "the quick brown fox jumps",
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+
+        engine = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rows = _get(dash, "/api/serve")
+            llm_rows = [r for r in rows if r.get("engine")]
+            if llm_rows:
+                engine = llm_rows[0]["engine"][0]
+                if engine and engine.get("tokens_out", 0) >= 4:
+                    break
+            time.sleep(0.5)
+        assert engine, "no engine stats in the serve view"
+        for key in ("steps", "prefills", "tokens_out", "shed_expired",
+                    "prefix_hits", "prefix_misses", "prefix_cached_pages",
+                    "prefix_evictions"):
+            assert key in engine, f"missing engine stat {key}"
+        assert engine["prefills"] >= 1
+        assert engine["prefix_misses"] + engine["prefix_hits"] >= 1
+
+        # the /api/serve probe itself pushed the gauges to the CP KV;
+        # the Prometheus scrape must aggregate them
+        scrape = _get(dash, "/metrics")
+        assert "ray_tpu_llm_engine" in scrape
+        assert 'stat="prefix_hits"' in scrape
     finally:
         serve.shutdown()
 
