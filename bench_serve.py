@@ -81,6 +81,11 @@ def main():
                          "(1024-token shared prefix, unique suffixes) with "
                          "the prefix cache on vs off; merges the result "
                          "into --out (implied by --curve)")
+    ap.add_argument("--metrics-ab", action="store_true",
+                    help="A/B the built-in metrics pipeline: rerun the "
+                         "headline point with metrics_enabled=False on a "
+                         "fresh cluster and assert the p50 TTFT delta is "
+                         "within noise (ISSUE 4 overhead bound)")
     ap.add_argument("--out", default="SERVE_BENCH.json",
                     help="JSON file the shared-prefix result merges into")
     ap.add_argument("--no-preflight", action="store_true",
@@ -112,7 +117,12 @@ def main():
 
     # Logical CPUs: serving actors (controller + replicas) are IO-bound hosts
     # around the chip-bound engine; don't let a small host starve scheduling.
-    ray_tpu.init(num_cpus=max(8, (__import__("os").cpu_count() or 1)))
+    bench_cpus = max(8, (__import__("os").cpu_count() or 1))
+    # metrics A/B: the "on" arm flushes aggressively (1 s vs the 10 s
+    # default) so the pipeline is actually exercised during a short run
+    ray_tpu.init(num_cpus=bench_cpus, _system_config=(
+        {"metrics_enabled": True, "metrics_flush_interval_s": 1.0}
+        if args.metrics_ab else None))
     has_tpu = any(n.get("resources", {}).get("TPU", 0) > 0
                   for n in ray_tpu.nodes())
 
@@ -257,6 +267,45 @@ def main():
         points = [run_point(args.concurrency, args.requests)]
     head = points[-2] if args.curve else points[-1]
 
+    # metrics pipeline A/B (ISSUE 4): the headline point above ran with
+    # every process flushing deltas to the CP store each second; rerun the
+    # same point on a fresh cluster with the pipeline disabled and bound
+    # the p50 TTFT overhead. Tolerance is noise-sized, not zero-sized:
+    # cpu-tiny run-to-run variance dominates any real flusher cost.
+    metrics_overhead = None
+    if args.metrics_ab:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=bench_cpus,
+                     _system_config={"metrics_enabled": False})
+        app = build_openai_app(llm_cfg, route_prefix="/v1")
+        serve.run(app, name="llm-bench-nometrics", route_prefix="/v1")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}/v1/completions"
+        _post(base, {"prompt": prompt, "max_tokens": 4})
+        _post_stream(base, {"prompt": prompt, "max_tokens": 4})
+        off_row = run_point(args.concurrency, args.requests,
+                            label="metrics_flusher_off")
+        points.append(off_row)
+        delta_ms = round(head["p50_ttft_ms"] - off_row["p50_ttft_ms"], 2)
+        tol_ms = round(max(0.25 * off_row["p50_ttft_ms"], 30.0), 2)
+        metrics_overhead = {
+            "flusher_on": {k: head[k] for k in
+                           ("p50_ttft_ms", "p90_ttft_ms", "req_per_s",
+                            "proxy_cpu_share")},
+            "flusher_off": {k: off_row[k] for k in
+                            ("p50_ttft_ms", "p90_ttft_ms", "req_per_s",
+                             "proxy_cpu_share")},
+            "p50_delta_ms": delta_ms,
+            "tolerance_ms": tol_ms,
+            "within_noise": delta_ms <= tol_ms,
+        }
+        if not metrics_overhead["within_noise"]:
+            print(json.dumps({"metrics_overhead": metrics_overhead}))
+            raise SystemExit(
+                f"metrics pipeline overhead out of bounds: p50 TTFT "
+                f"+{delta_ms}ms with the flusher on (tolerance {tol_ms}ms)")
+
     # shared_prefix_1024: every request carries the same 1024-token prefix
     # (system prompt) plus a short unique suffix — the workload automatic
     # prefix caching exists for. Measured cache-on against the live app,
@@ -343,6 +392,8 @@ def main():
             "operating_points": points,
         },
     }
+    if metrics_overhead is not None:
+        result["extra"]["metrics_overhead"] = metrics_overhead
     if prefix_cache is not None:
         result["extra"]["prefix_cache"] = prefix_cache
         # merge into --out WITHOUT clobbering earlier headline rows (e.g.

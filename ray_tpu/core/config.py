@@ -158,6 +158,16 @@ class Config:
     # control-plane trace store: evict whole oldest traces past this
     # total span count (bounded ring, ref: GcsTaskManager's bounded sink)
     trace_store_max_spans: int = 50000
+    # Metrics pipeline (util/metrics.py MetricsFlusher → CP TimeSeriesStore).
+    # Every worker/driver/node-agent process runs one background flusher
+    # pushing delta snapshots on this period (plus once on clean shutdown).
+    metrics_enabled: bool = True
+    metrics_flush_interval_s: float = 10.0
+    # CP time-series retention: points older than the window are evicted;
+    # a series past the point cap is downsampled (every other point of its
+    # older half dropped) instead of hard-truncated.
+    metrics_retention_s: float = 3600.0
+    metrics_max_points_per_series: int = 1024
 
     # --- misc ---
     worker_register_timeout_s: float = 30.0

@@ -28,8 +28,24 @@ from ray_tpu.core.rpc import ClientPool, RpcServer
 from ray_tpu.core.scheduler import NodeView, add, pick_node, place_bundles, place_slice_bundles, subtract
 from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.exceptions import PlacementGroupSchedulingError
+from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
+
+# Built-in scheduler metrics (ISSUE 4; ref: stats/metric_defs.cc
+# scheduler_* series). Module-level: several CP instances in one test
+# process must not register duplicate series.
+_SCHED_PENDING_GAUGE = _metrics.Gauge(
+    "ray_tpu_scheduler_pending_actors",
+    "actors waiting for placement (incl. mid-pass snapshot)")
+_SCHED_PLACING_GAUGE = _metrics.Gauge(
+    "ray_tpu_scheduler_placing_actors",
+    "actor placements with an in-flight lease RPC")
+_LEASE_LATENCY_HIST = _metrics.Histogram(
+    "ray_tpu_scheduler_lease_latency_seconds",
+    "actor lease dispatch -> grant/reject round-trip",
+    boundaries=[0.001, 0.01, 0.1, 1, 10],
+    tag_keys=("granted",))
 
 
 class ActorState(enum.Enum):
@@ -125,6 +141,15 @@ class ControlPlane:
         self._trace_meta: dict[str, dict] = {}         # trace_id -> summary
         self._trace_order: list[str] = []              # insertion order
         self._trace_span_count = 0
+        # time-series store (util/metrics.py flusher sink; Monarch-shaped:
+        # per-series bounded ring, delta reports accumulated CP-side into
+        # cumulative points so queries never re-derive counter state)
+        # (name, tag-values tuple, source) -> {"points": [(ts, value)]}
+        self._metric_series: dict[tuple, dict] = {}
+        self._metrics_meta: dict[str, dict] = {}   # name -> kind/desc/...
+        self._metric_sources: dict[str, set] = {}  # source -> series keys
+        self._source_nodes: dict[str, str] = {}    # source -> node_id hex
+        self._dead_workers: set[str] = set()       # retracted worker ids
         self._store = make_meta_store(
             store_path if store_path is not None
             else (get_config().cp_store_path or None))
@@ -140,6 +165,12 @@ class ControlPlane:
         self._health_thread = threading.Thread(
             target=self._health_loop, name="cp-health", daemon=True)
         self._health_thread.start()
+        # the CP process's own registry (rpc server histograms, scheduler
+        # gauges) flushes straight into the local store — no RPC hop
+        self._metrics_flusher = None
+        if get_config().metrics_enabled:
+            self._metrics_flusher = _metrics.start_flusher(
+                self._h_metrics_report, source="cp")
 
     # ------------------------------------------------------------------
     def _restore(self):
@@ -243,46 +274,6 @@ class ControlPlane:
                      "available": dict(n.view.available),
                      "metrics": dict(getattr(n, "metrics", None) or {})}
                     for n in self._nodes.values()]
-
-    def _h_get_metrics(self, body):
-        """Prometheus exposition of cluster system metrics: CP-derived
-        gauges + per-node agent gauges (TPU-native analog of the reference's
-        metrics export pipeline, stats/metric_defs.cc + dashboard/modules/
-        metrics/; scraped via the dashboard's /metrics endpoint)."""
-        out = []
-
-        def emit(name, value, tags=""):
-            out.append(f"ray_tpu_{name}{tags} {value}")
-
-        with self._lock:
-            nodes = list(self._nodes.values())
-            actors_by_state: dict[str, int] = {}
-            for a in self._actors.values():
-                s = getattr(a.state, "name", str(a.state))
-                actors_by_state[s] = actors_by_state.get(s, 0) + 1
-            pgs = len(self._pgs)
-            jobs = len(self._jobs)
-            events_by_state = dict(self._task_event_counts)
-        emit("nodes_alive", sum(1 for n in nodes if n.view.alive))
-        emit("nodes_total", len(nodes))
-        for s, c in sorted(actors_by_state.items()):
-            emit("actors", c, f'{{state="{s}"}}')
-        emit("placement_groups", pgs)
-        emit("jobs", jobs)
-        for s, c in sorted(events_by_state.items()):
-            emit("task_events_total", c, f'{{state="{s}"}}')
-        for n in nodes:
-            if not n.view.alive:
-                continue
-            nid = n.view.node_id.hex()[:12]
-            for k, v in (getattr(n, "metrics", None) or {}).items():
-                if ":" in k:
-                    base, res = k.split(":", 1)
-                    emit(f"node_{base}", v,
-                         f'{{node="{nid}",resource="{res}"}}')
-                else:
-                    emit(f"node_{k}", v, f'{{node="{nid}"}}')
-        return "\n".join(out) + "\n"
 
     def _h_get_nodes(self, body):
         with self._lock:
@@ -556,6 +547,251 @@ class ControlPlane:
                      if t in self._trace_meta]
         return metas[:limit]
 
+    # ---- metrics time-series store (util/metrics.py flusher sink) ------
+    def _h_metrics_report(self, body):
+        """Accept one delta snapshot from a process flusher. Counters and
+        histogram buckets arrive as deltas and are accumulated into
+        cumulative points here (one accumulator per (name, tags, source));
+        gauges arrive as absolute values. The caller's `ts` is honored so
+        replayed/fake-clock injections land where they claim to be."""
+        body = body or {}
+        source = str(body.get("source") or "unknown")
+        try:
+            ts = float(body.get("ts"))
+        except (TypeError, ValueError):
+            ts = time.time()
+        cfg = get_config()
+        with self._lock:
+            if source in self._dead_workers:
+                return {"ok": False, "retracted": True}
+            node_id = body.get("node_id")
+            if node_id:
+                self._source_nodes[source] = str(node_id)
+            for md in body.get("metrics") or ():
+                name = md.get("name")
+                if not name:
+                    continue
+                kind = md.get("kind", "gauge")
+                meta = self._metrics_meta.get(name)
+                if meta is None:
+                    meta = self._metrics_meta[name] = {
+                        "name": name, "kind": kind,
+                        "description": md.get("description", ""),
+                        "tag_keys": list(md.get("tag_keys") or ()),
+                        "boundaries": list(md.get("boundaries") or ())}
+                elif not meta["description"] and md.get("description"):
+                    meta["description"] = md["description"]
+                for s in md.get("series") or ():
+                    tags = tuple(s.get("tags") or ())
+                    key = (name, tags, source)
+                    ser = self._metric_series.get(key)
+                    if ser is None:
+                        ser = self._metric_series[key] = {"points": []}
+                        self._metric_sources.setdefault(
+                            source, set()).add(key)
+                    pts = ser["points"]
+                    prev = pts[-1][1] if pts else None
+                    if kind == "counter":
+                        val = (prev or 0.0) + float(
+                            s.get("delta", s.get("value", 0.0)))
+                    elif kind == "histogram":
+                        buckets = list(s.get("buckets") or ())
+                        dsum = float(s.get("sum", 0.0))
+                        dcount = int(s.get("count", 0))
+                        if isinstance(prev, dict) and \
+                                len(prev.get("buckets") or ()) == len(buckets):
+                            buckets = [a + b for a, b in
+                                       zip(prev["buckets"], buckets)]
+                            dsum += prev["sum"]
+                            dcount += prev["count"]
+                        val = {"buckets": buckets, "sum": dsum,
+                               "count": dcount}
+                    else:
+                        val = float(s.get("value", 0.0))
+                    pts.append((ts, val))
+                    # retention window, oldest-first (relative to the
+                    # series' own clock so fake-clock series age coherently)
+                    cutoff = ts - cfg.metrics_retention_s
+                    while pts and pts[0][0] < cutoff:
+                        pts.pop(0)
+                    # point cap: downsample (thin every other point of the
+                    # older half) instead of hard truncation, preserving
+                    # both history shape and the fresh tail
+                    cap = max(4, cfg.metrics_max_points_per_series)
+                    if len(pts) > cap:
+                        half = len(pts) // 2
+                        ser["points"] = pts[:half][::2] + pts[half:]
+        return {"ok": True}
+
+    @staticmethod
+    def _tags_match(tag_keys: list, tag_values: tuple,
+                    want: dict | None) -> bool:
+        if not want:
+            return True
+        got = dict(zip(tag_keys, tag_values))
+        return all(got.get(k) == v for k, v in want.items())
+
+    def _h_metrics_query(self, body):
+        """Points of one metric: tag-subset filter + [since, until] time
+        range. Histogram points come back as {buckets, sum, count} dicts;
+        `merged` carries the cross-source cumulative merge of each series'
+        latest in-range point (the percentile views build on it)."""
+        body = body or {}
+        name = body.get("name") or ""
+        want = body.get("tags") or None
+        since = body.get("since")
+        until = body.get("until")
+        with self._lock:
+            meta = self._metrics_meta.get(name)
+            if meta is None:
+                return None
+            out = {"name": name, "kind": meta["kind"],
+                   "description": meta["description"],
+                   "tag_keys": list(meta["tag_keys"]),
+                   "boundaries": list(meta["boundaries"]), "series": []}
+            for (n, tags, source), ser in self._metric_series.items():
+                if n != name or not self._tags_match(
+                        meta["tag_keys"], tags, want):
+                    continue
+                pts = [[ts, val] for ts, val in ser["points"]
+                       if (since is None or ts >= since)
+                       and (until is None or ts <= until)]
+                if pts:
+                    out["series"].append(
+                        {"tags": list(tags), "source": source,
+                         "points": pts})
+        if meta["kind"] == "histogram":
+            latest = [{"boundaries": out["boundaries"],
+                       **s["points"][-1][1]} for s in out["series"]]
+            out["merged"] = _metrics.merge_histograms(latest)
+        return out
+
+    def _h_metrics_list_series(self, body):
+        """Catalogue of stored series (name, kind, tags, source, point
+        count, last timestamp), optionally filtered by name prefix."""
+        prefix = (body or {}).get("prefix", "")
+        with self._lock:
+            out = []
+            for (name, tags, source), ser in self._metric_series.items():
+                if not name.startswith(prefix) or not ser["points"]:
+                    continue
+                meta = self._metrics_meta.get(name) or {}
+                out.append({
+                    "name": name, "kind": meta.get("kind", "gauge"),
+                    "tags": dict(zip(meta.get("tag_keys") or (), tags)),
+                    "source": source, "points": len(ser["points"]),
+                    "last_ts": ser["points"][-1][0]})
+        out.sort(key=lambda r: (r["name"], r["source"]))
+        return out
+
+    def _retract_metrics_source(self, source: str) -> None:
+        """Drop every stored series owned by one flusher source (worker or
+        node agent death). Caller holds self._lock."""
+        for key in self._metric_sources.pop(source, ()):  # noqa: B020
+            self._metric_series.pop(key, None)
+        self._source_nodes.pop(source, None)
+
+    def _metrics_dump_locked(self, exclude_sources: set) -> list[dict]:
+        """Latest point of every stored series, grouped per metric in the
+        shared metric-dict shape (render_exposition input)."""
+        by_name: dict[str, list] = {}
+        for (name, tags, source), ser in self._metric_series.items():
+            if source in exclude_sources or not ser["points"]:
+                continue
+            latest = ser["points"][-1][1]
+            if isinstance(latest, dict):
+                by_name.setdefault(name, []).append(
+                    {"tags": list(tags), **latest})
+            else:
+                by_name.setdefault(name, []).append(
+                    {"tags": list(tags), "value": latest})
+        return [{**self._metrics_meta[name], "series": series}
+                for name, series in by_name.items()
+                if name in self._metrics_meta]
+
+    def _cp_state_dicts_locked(self) -> list[dict]:
+        """CP-derived system gauges in metric-dict shape (node membership,
+        actor states, per-node heartbeat gauges — the old ad-hoc /metrics
+        emitter, now through the shared renderer)."""
+        nodes = list(self._nodes.values())
+        actors_by_state: dict[str, int] = {}
+        for a in self._actors.values():
+            s = getattr(a.state, "name", str(a.state))
+            actors_by_state[s] = actors_by_state.get(s, 0) + 1
+        dicts = [
+            {"name": "ray_tpu_nodes_alive", "kind": "gauge",
+             "description": "alive nodes", "tag_keys": [],
+             "series": [{"tags": [], "value": sum(
+                 1 for n in nodes if n.view.alive)}]},
+            {"name": "ray_tpu_nodes_total", "kind": "gauge",
+             "description": "registered nodes", "tag_keys": [],
+             "series": [{"tags": [], "value": len(nodes)}]},
+            {"name": "ray_tpu_actors", "kind": "gauge",
+             "description": "actors by state", "tag_keys": ["state"],
+             "series": [{"tags": [s], "value": c} for s, c in
+                        sorted(actors_by_state.items())]},
+            {"name": "ray_tpu_placement_groups", "kind": "gauge",
+             "description": "placement groups", "tag_keys": [],
+             "series": [{"tags": [], "value": len(self._pgs)}]},
+            {"name": "ray_tpu_jobs", "kind": "gauge",
+             "description": "jobs", "tag_keys": [],
+             "series": [{"tags": [], "value": len(self._jobs)}]},
+            {"name": "ray_tpu_task_events_total", "kind": "counter",
+             "description": "task events by state", "tag_keys": ["state"],
+             "series": [{"tags": [s], "value": c} for s, c in
+                        sorted(self._task_event_counts.items())]},
+        ]
+        plain: dict[str, list] = {}
+        resource: dict[str, list] = {}
+        for n in nodes:
+            if not n.view.alive:
+                continue
+            nid = n.view.node_id.hex()[:12]
+            for k, v in (getattr(n, "metrics", None) or {}).items():
+                if ":" in k:
+                    base, res = k.split(":", 1)
+                    resource.setdefault(base, []).append(
+                        {"tags": [nid, res], "value": v})
+                else:
+                    plain.setdefault(k, []).append(
+                        {"tags": [nid], "value": v})
+        for k, series in sorted(plain.items()):
+            dicts.append({"name": f"ray_tpu_node_{k}", "kind": "gauge",
+                          "description": "node agent heartbeat gauge",
+                          "tag_keys": ["node"], "series": series})
+        for k, series in sorted(resource.items()):
+            dicts.append({"name": f"ray_tpu_node_{k}", "kind": "gauge",
+                          "description": "node agent heartbeat gauge",
+                          "tag_keys": ["node", "resource"],
+                          "series": series})
+        return dicts
+
+    def _h_metrics_dump(self, body):
+        """Aggregatable snapshot for scrapers: CP system gauges + latest
+        stored series (minus `exclude_sources` — a scraper co-resident with
+        a flusher substitutes its own fresher local registry) + legacy
+        liveness-filtered KV exposition blobs."""
+        exclude = set((body or {}).get("exclude_sources") or ())
+        with self._lock:
+            dicts = (self._cp_state_dicts_locked()
+                     + self._metrics_dump_locked(exclude))
+            kv_text = [v.decode() if isinstance(v, bytes) else str(v)
+                       for k, v in sorted(self._kv.items())
+                       if k.startswith("metrics:")
+                       and k.split(":", 1)[1] not in self._dead_workers
+                       and k.split(":", 1)[1] not in exclude]
+        return {"metrics": dicts, "kv_text": kv_text}
+
+    def _h_get_metrics(self, body):
+        """Prometheus exposition of cluster metrics: CP-derived gauges +
+        the aggregated time-series store (counters summed and histogram
+        buckets merged across workers — duplicate series never emitted;
+        ref: stats/metric_defs.cc + dashboard/modules/metrics/)."""
+        dump = self._h_metrics_dump(body)
+        text = _metrics.render_exposition(dump["metrics"])
+        parts = [text] + dump["kv_text"]
+        return "\n".join(p.strip("\n") for p in parts if p) + "\n"
+
     # ---- actors -------------------------------------------------------
     def _h_create_actor(self, body):
         spec: TaskSpec = body["spec"]
@@ -612,7 +848,17 @@ class ControlPlane:
         return {"ok": True}
 
     def _h_worker_died(self, body):
-        """Reported by a node agent (ref: GcsActorManager::OnWorkerDead)."""
+        """Reported by a node agent (ref: GcsActorManager::OnWorkerDead).
+        Besides actor failover, a dead worker's metric series are retracted
+        and its legacy `metrics:<wid>` KV blob GC'd — a scrape must never
+        keep serving a gone process's series."""
+        wid = body.get("worker_id")
+        if wid is not None:
+            whex = wid.hex() if hasattr(wid, "hex") else str(wid)
+            with self._lock:
+                self._dead_workers.add(whex)
+                self._retract_metrics_source(whex)
+                self._h_kv_del({"key": f"metrics:{whex}"})
         aid = body.get("actor_id")
         if aid is not None:
             self._on_actor_down(aid, body.get("reason", "worker died"), clean=False)
@@ -772,6 +1018,9 @@ class ControlPlane:
         actor (~2/s at 1,000-actor scale)."""
         self._expire_stale_leases()
         with self._lock:
+            _SCHED_PENDING_GAUGE.set(
+                len(self._pending_actors) + len(self._scheduling_pass))
+            _SCHED_PLACING_GAUGE.set(len(self._placing_actors))
             if not self._pending_actors:
                 return False
             pending, self._pending_actors = self._pending_actors, []
@@ -890,6 +1139,9 @@ class ControlPlane:
     def _on_actor_lease_reply(self, info: ActorInfo, node_id, node_addr,
                               resources, reserved_version, token, ok, reply):
         granted = ok and isinstance(reply, dict) and reply.get("granted")
+        _LEASE_LATENCY_HIST.observe(
+            time.monotonic() - token[1],
+            tags={"granted": str(bool(granted)).lower()})
         with self._lock:
             cp_node = self._nodes.get(node_id)
             current = self._placing_actors.get(info.actor_id) is token
@@ -1076,6 +1328,17 @@ class ControlPlane:
             for aid in placing:
                 del self._placing_actors[aid]
                 self._pending_actors.append(aid)
+            # retract every metric series reported from the dead node (the
+            # agent's own source plus each worker flusher that tagged its
+            # payloads with this node)
+            nhex = node_id.hex()
+            gone = [s for s, n in self._source_nodes.items() if n == nhex]
+            gone.append(f"node:{nhex}")
+            for src in gone:
+                self._retract_metrics_source(src)
+                if not src.startswith("node:"):
+                    self._dead_workers.add(src)
+                    self._h_kv_del({"key": f"metrics:{src}"})
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("node", {"event": "dead", "node_id": node_id})
         for aid in victims:
@@ -1092,6 +1355,7 @@ class ControlPlane:
 
     def stop(self):
         self._stopped.set()
+        _metrics.stop_flusher(self._metrics_flusher, final=False)
         self._wake_scheduler()
         self._server.stop()
         self._pool.close_all()

@@ -32,8 +32,34 @@ from ray_tpu.core.ids import ActorID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_store import make_store
 from ray_tpu.core.rpc import ClientPool, RpcServer
 from ray_tpu.core.scheduler import add, fits, subtract
+from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
+
+# Built-in node-agent metrics (ISSUE 4; ref: stats/metric_defs.cc
+# raylet-side series). Shipped to the CP by the per-process MetricsFlusher.
+_SPILLBACK_COUNTER = _metrics.Counter(
+    "ray_tpu_scheduler_spillbacks_total",
+    "lease requests redirected to another node (hybrid spillback)")
+_STORE_BYTES_STORED = _metrics.Counter(
+    "ray_tpu_object_store_bytes_stored_total",
+    "bytes allocated in this node's shared-memory store")
+_STORE_HITS = _metrics.Counter(
+    "ray_tpu_object_store_hits_total",
+    "object lookups served from the local store")
+_STORE_MISSES = _metrics.Counter(
+    "ray_tpu_object_store_misses_total",
+    "object lookups that required a remote pull or failed locally")
+_STORE_SPILLED_GAUGE = _metrics.Gauge(
+    "ray_tpu_object_store_spilled_objects",
+    "objects spilled to disk by this node's store")
+_WORKER_COUNT_GAUGE = _metrics.Gauge(
+    "ray_tpu_node_agent_workers",
+    "worker processes in this agent's pool, by state",
+    tag_keys=("state",))
+_ENV_CACHE_GAUGE = _metrics.Gauge(
+    "ray_tpu_node_agent_env_cache_entries",
+    "materialized runtime-env cache entries on this node")
 
 
 class _InProcHandle:
@@ -150,6 +176,17 @@ class NodeAgent:
             pool_size=16)
         self.addr = self._server.addr
         self._register_with_cp()
+        # per-process metrics auto-flush (ISSUE 4): delta snapshots to the
+        # CP time-series store every metrics_flush_interval_s + once on
+        # stop(). In-process harnesses share one flusher per process (first
+        # component to start it wins; `stop_flusher` is owner-checked).
+        self._metrics_flusher = None
+        if cfg.metrics_enabled:
+            self._metrics_flusher = _metrics.start_flusher(
+                lambda p: self._pool.get(self.cp_addr).notify(
+                    "metrics_report", p),
+                source=f"node:{self.node_id.hex()}",
+                node_id=self.node_id.hex())
         self._memory_monitor = None
         if cfg.memory_usage_threshold > 0:
             from ray_tpu.core.memory_monitor import MemoryMonitor
@@ -479,6 +516,17 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 - store impl without counters
             pass
         m["object_store_num_spilled"] = getattr(self.store, "num_spilled", 0)
+        # mirror into the flusher registry (the heartbeat copy feeds the CP
+        # exposition's per-node gauges; these feed the time-series store)
+        _WORKER_COUNT_GAUGE.set(m["workers_total"], tags={"state": "total"})
+        _WORKER_COUNT_GAUGE.set(m["workers_busy"], tags={"state": "busy"})
+        _WORKER_COUNT_GAUGE.set(m["workers_actor"], tags={"state": "actor"})
+        _STORE_SPILLED_GAUGE.set(m["object_store_num_spilled"])
+        try:
+            from ray_tpu.runtime_env.packaging import env_cache_size
+            _ENV_CACHE_GAUGE.set(env_cache_size())
+        except Exception:  # noqa: BLE001 - gauge only
+            pass
         for k, v in self.resources_total.items():
             m[f"resource_total:{k}"] = float(v)
         with self._lock:
@@ -667,6 +715,7 @@ class NodeAgent:
                 if try_redirect:
                     target = self._find_remote_node(resources)
                     if target is not None:
+                        _SPILLBACK_COUNTER.inc()
                         return {"granted": False, "redirect": target}
                     if time.monotonic() > busy_deadline:
                         return {"granted": False, "busy": True}
@@ -838,6 +887,8 @@ class NodeAgent:
     def _h_store_create(self, body):
         name, offset = self.store.create(body["object_id"], body["size"],
                                          body.get("device_hint", ""))
+        if body["size"] > 0:
+            _STORE_BYTES_STORED.inc(body["size"])
         if body.get("owner_addr") is not None:
             self._object_owners[body["object_id"]] = tuple(body["owner_addr"])
         return {"shm_name": name, "offset": offset}
@@ -847,7 +898,9 @@ class NodeAgent:
         return {"ok": True}
 
     def _h_store_get_meta(self, body):
-        return self.store.get_meta(body["object_id"])
+        meta = self.store.get_meta(body["object_id"])
+        (_STORE_HITS if meta is not None else _STORE_MISSES).inc()
+        return meta
 
     def _h_store_read_done(self, body):
         """Reader finished deserializing: release its read lease so the
@@ -910,7 +963,9 @@ class NodeAgent:
         wait for the leader instead of racing the chunk writes."""
         object_id = body["object_id"]
         if self.store.contains(object_id):
+            _STORE_HITS.inc()
             return {"ok": True}
+        _STORE_MISSES.inc()
         # single-flight per object (ref: PullManager object-level dedup)
         with self._pull_cv:
             leader = object_id not in self._pulls_in_progress
@@ -1073,14 +1128,17 @@ class NodeAgent:
             except Exception:  # noqa: BLE001 - already gone
                 pass
         self._report_resources()
-        if info.actor_id is not None:
-            try:
-                self._pool.get(self.cp_addr).notify(
-                    "worker_died",
-                    {"actor_id": info.actor_id, "node_id": self.node_id,
-                     "reason": f"worker process exited with code {code}"})
-            except Exception:
-                pass
+        # ALWAYS notify the CP (not just for actors): a dead worker's metric
+        # series must be retracted from the time-series store / exposition
+        # even when it held no actor (ISSUE 4 metrics GC)
+        try:
+            self._pool.get(self.cp_addr).notify(
+                "worker_died",
+                {"worker_id": info.worker_id, "actor_id": info.actor_id,
+                 "node_id": self.node_id,
+                 "reason": f"worker process exited with code {code}"})
+        except Exception:
+            pass
         self._report_resources()
 
     # ---- lifecycle -------------------------------------------------------
@@ -1109,6 +1167,12 @@ class NodeAgent:
                         info.proc.kill()
                     except Exception:
                         pass
+        # final metrics flush while the CP client pool is still open (clean
+        # shutdown must not drop the last interval's deltas)
+        if self._metrics_flusher is not None:
+            _metrics.stop_flusher(self._metrics_flusher)
+        else:
+            _metrics.flush_now()
         self._server.stop()
         # the monitor thread reads store stats for heartbeats; it must be
         # gone before the native arena handle is destroyed (use-after-free

@@ -22,8 +22,25 @@ import time
 from typing import Any, Callable
 
 from ray_tpu.core.config import get_config
+from ray_tpu.util import metrics as _metrics
 
 _REQ, _RESP, _ONEWAY = 0, 1, 2
+
+# Built-in transport metrics (ISSUE 4). Module-level: one registration per
+# process no matter how many servers/clients it hosts; tag cardinality is
+# bounded by the method-name set.
+_RPC_LATENCY = _metrics.Histogram(
+    "ray_tpu_rpc_request_latency_seconds",
+    "server-side RPC handler latency per method",
+    boundaries=[0.001, 0.01, 0.1, 1, 10],
+    tag_keys=("method",))
+_RPC_INFLIGHT = _metrics.Gauge(
+    "ray_tpu_rpc_inflight_requests",
+    "RPC handler invocations currently executing",
+    tag_keys=("method",))
+_RPC_RECONNECTS = _metrics.Counter(
+    "ray_tpu_rpc_reconnects_total",
+    "client connections re-established after a drop")
 
 # Process-local server registry for the loopback fast path: when the caller
 # and the target server share a process (driver->in-proc CP/agent; the
@@ -244,7 +261,8 @@ class RpcServer:
         def run():
             try:
                 body = pickle.loads(body_pickled)
-                result, ok = self._handler(method, body, ("loopback", 0)), True
+                result, ok = self._timed_handler(
+                    method, body, ("loopback", 0)), True
             except BaseException as e:  # noqa: BLE001 — propagate to caller
                 result, ok = e, False
             if ok and isinstance(result, DeferredReply):
@@ -321,9 +339,21 @@ class RpcServer:
             except OSError:
                 pass
 
+    def _timed_handler(self, method, body, peer):
+        """Handler invocation under the per-method latency histogram and
+        in-flight gauge (both socket and loopback dispatch paths)."""
+        _RPC_INFLIGHT.inc(tags={"method": method})
+        t0 = time.monotonic()
+        try:
+            return self._handler(method, body, peer)
+        finally:
+            _RPC_LATENCY.observe(time.monotonic() - t0,
+                                 tags={"method": method})
+            _RPC_INFLIGHT.dec(tags={"method": method})
+
     def _dispatch(self, conn, wlock, kind, msg_id, method, body, peer):
         try:
-            result, ok = self._handler(method, body, peer), True
+            result, ok = self._timed_handler(method, body, peer), True
         except BaseException as e:  # noqa: BLE001 — errors propagate to caller
             result, ok = e, False
         if ok and isinstance(result, DeferredReply):
@@ -383,6 +413,7 @@ class RpcClient:
         self._pending: dict[int, list] = {}  # msg_id -> [event, ok, body]
         self._next_id = 0
         self._closed = False
+        self._had_conn = False  # a later successful connect is a reconnect
 
     def _ensure_conn(self, connect_timeout: float | None = None) -> socket.socket:
         """Returns the live socket (never read self._sock without the lock —
@@ -410,6 +441,9 @@ class RpcClient:
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     s.settimeout(None)
                     self._sock = s
+                    if self._had_conn:
+                        _RPC_RECONNECTS.inc()
+                    self._had_conn = True
                     threading.Thread(target=self._read_loop, args=(s,),
                                      name=f"{self._name}-read", daemon=True).start()
                     return s

@@ -19,8 +19,24 @@ from dataclasses import dataclass, field
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID, TaskID
 from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.util import metrics as _metrics
 
 logger = logging.getLogger(__name__)
+
+# Built-in task lifecycle metrics (ISSUE 4; ref: stats/metric_defs.cc
+# task_* series). Module-level: every owner (driver + workers) shares one
+# registration per process.
+_TASK_PENDING_GAUGE = _metrics.Gauge(
+    "ray_tpu_tasks_pending", "tasks submitted by this owner, not yet done")
+_TASK_LIFECYCLE_HIST = _metrics.Histogram(
+    "ray_tpu_task_lifecycle_seconds",
+    "submit -> state-transition latency on the owner",
+    boundaries=[0.001, 0.01, 0.1, 1, 10, 100],
+    tag_keys=("transition",))
+_TASK_FAILURES = _metrics.Counter(
+    "ray_tpu_task_failures_total",
+    "task failures observed by the owner, by error type",
+    tag_keys=("error_type",))
 
 
 @dataclass
@@ -57,6 +73,7 @@ class TaskManager:
             if get_config().enable_object_reconstruction:
                 for oid in spec.return_ids():
                     self._lineage[oid] = spec
+            _TASK_PENDING_GAUGE.set(len(self._pending))
 
     def complete(self, task_id: TaskID) -> float | None:
         """Returns the submit-to-completion latency (None if unknown) for
@@ -64,8 +81,13 @@ class TaskManager:
         with self._lock:
             ent = self._pending.pop(task_id, None)
             self._reconstructing.discard(task_id)
-            return (None if ent is None
-                    else time.monotonic() - ent.submitted_ts)
+            _TASK_PENDING_GAUGE.set(len(self._pending))
+            latency = (None if ent is None
+                       else time.monotonic() - ent.submitted_ts)
+        if latency is not None:
+            _TASK_LIFECYCLE_HIST.observe(latency,
+                                         tags={"transition": "completed"})
+        return latency
 
     def claim_reply(self, task_id: TaskID, attempt: int | None) -> TaskSpec | None:
         """Atomically claim the right to process a terminal reply (or
@@ -91,6 +113,9 @@ class TaskManager:
         with self._lock:
             ent = self._pending.get(task_id)
             if ent is None or ent.retries_left <= 0:
+                if ent is not None:
+                    _TASK_FAILURES.inc(
+                        tags={"error_type": "system_retries_exhausted"})
                 return None
             if ent.reply_claimed:
                 # a reply for this task is being processed right now (e.g.
@@ -100,16 +125,23 @@ class TaskManager:
                 return None
             ent.retries_left -= 1
             ent.spec.attempt_number += 1
+            _TASK_FAILURES.inc(tags={"error_type": "system"})
+            _TASK_LIFECYCLE_HIST.observe(
+                time.monotonic() - ent.submitted_ts,
+                tags={"transition": "retried"})
             return ent.spec
 
     def should_retry_app_error(self, task_id: TaskID) -> TaskSpec | None:
         with self._lock:
             ent = self._pending.get(task_id)
             if ent is None or not ent.spec.retry_exceptions or ent.retries_left <= 0:
+                if ent is not None:
+                    _TASK_FAILURES.inc(tags={"error_type": "app_error"})
                 return None
             ent.retries_left -= 1
             ent.spec.attempt_number += 1
             ent.reply_claimed = False  # the retry's reply must be processable
+            _TASK_FAILURES.inc(tags={"error_type": "app_error_retried"})
             return ent.spec
 
     def get_pending_spec(self, task_id: TaskID) -> TaskSpec | None:
@@ -143,6 +175,7 @@ class TaskManager:
             self._reconstructing.add(spec.task_id)
             spec.attempt_number += 1
             self._pending[spec.task_id] = _PendingTask(spec, spec.max_retries)
+            _TASK_FAILURES.inc(tags={"error_type": "object_lost"})
         logger.info("reconstructing object %s by resubmitting task %s",
                     object_id.hex()[:12], spec.repr_name())
         self._rt.resubmit_spec(spec)

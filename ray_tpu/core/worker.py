@@ -336,6 +336,16 @@ class WorkerRuntime:
         tracing.register_flusher(
             lambda spans: self.cp_client.notify(
                 "report_spans", {"spans": spans}))
+        # metrics auto-flush (ISSUE 4): every worker/driver pushes delta
+        # snapshots to the CP time-series store; the handle is None when a
+        # co-resident component (the head process's CP) started it first.
+        self._metrics_flusher = None
+        if get_config().metrics_enabled:
+            from ray_tpu.util import metrics as _metrics
+            self._metrics_flusher = _metrics.start_flusher(
+                lambda p: self.cp_client.notify("metrics_report", p),
+                source=self.worker_id.hex(),
+                node_id=self.node_id.hex() if self.node_id else None)
         self._server = RpcServer(
             self._handle, host=host, name=f"{mode}-rpc",
             blocking_methods={"push_task", "get_object_status", "wait_object"},
@@ -1955,6 +1965,11 @@ class WorkerRuntime:
         def exit_later():
             # let the final reply flush to the caller before announcing death
             time.sleep(0.25)
+            try:  # last metrics before the CP retracts this worker's series
+                from ray_tpu.util import metrics as _metrics
+                _metrics.flush_now()
+            except Exception:
+                pass
             try:
                 self.cp_client.call(
                     "actor_exited", {"actor_id": self._actor_state.actor_id}, timeout=5.0)
@@ -1990,6 +2005,13 @@ class WorkerRuntime:
                 pass
         self.flush_task_events()
         tracing.flush()
+        # final metrics flush while cp_client is still open; a joiner (head
+        # process: the CP owns the shared flusher) flushes without stopping
+        from ray_tpu.util import metrics as _metrics
+        if self._metrics_flusher is not None:
+            _metrics.stop_flusher(self._metrics_flusher)
+        else:
+            _metrics.flush_now()
         self.normal_submitter.shutdown()
         self.actor_submitter.shutdown()
         self._server.stop()

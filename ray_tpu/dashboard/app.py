@@ -26,6 +26,8 @@ _INDEX = """<!doctype html>
 </div>
 <pre id="profout" style="max-height:300px;overflow:auto;background:#f7f7f7"></pre>
 <div id="charts"></div>
+<h2>metrics (control-plane time-series store)</h2>
+<div id="metriccharts">no stored series yet</div>
 <div id="content">loading…</div>
 <script>
 function esc(s) {
@@ -55,7 +57,32 @@ async function profile() {
   document.getElementById("profstatus").textContent =
     out.rounds + " rounds";
 }
+async function refreshMetrics() {
+  // CP time-series panel: busiest stored series, one sparkline per metric
+  const cat = await (await fetch("/api/metrics/series")).json();
+  const byName = {};
+  for (const row of cat) {
+    if (!byName[row.name] || row.points > byName[row.name].points)
+      byName[row.name] = row;
+  }
+  const top = Object.values(byName)
+    .sort((a, b) => b.points - a.points).slice(0, 6);
+  let html = "";
+  for (const row of top) {
+    const q = await (await fetch("/api/metrics/query?name=" +
+      encodeURIComponent(row.name))).json();
+    if (!q.series || !q.series.length) continue;
+    // histogram points are {buckets,sum,count} dicts: chart the count
+    const samples = q.series[0].points.map(p => ({v:
+      (p[1] !== null && typeof p[1] === "object") ? p[1].count : p[1]}));
+    html += sparkline(samples, "v",
+      row.name + (Object.keys(row.tags || {}).length
+                  ? " " + JSON.stringify(row.tags) : ""));
+  }
+  if (html) document.getElementById("metriccharts").innerHTML = html;
+}
 async function refresh() {
+  await refreshMetrics().catch(() => {});
   const ts = await (await fetch("/api/timeseries")).json();
   document.getElementById("charts").innerHTML =
     sparkline(ts, "cpu_percent_avg", "cluster cpu %") +
@@ -381,6 +408,8 @@ class Dashboard:
         app.router.add_get("/api/profile", self._profile)
         app.router.add_get("/api/trace/{trace_id}", self._trace_detail)
         app.router.add_get("/trace/{trace_id}", self._trace_view)
+        app.router.add_get("/api/metrics/query", self._metrics_query)
+        app.router.add_get("/api/metrics/series", self._metrics_series)
         app.router.add_get("/api/{section}", self._api)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
@@ -409,30 +438,66 @@ class Dashboard:
 
         def fetch():
             from ray_tpu.core import api
-            from ray_tpu.util.metrics import collect_prometheus
+            from ray_tpu.util import metrics as _m
             rt = api._get_runtime()
-            text = rt.cp_client.call_with_retry(
-                "get_metrics", None, timeout=10.0)
-            # user/worker metrics pushed to the CP KV (util.metrics
-            # push_to_control_plane — e.g. LLM replica engine gauges incl.
-            # prefix-cache counters) ride the same scrape
-            parts = [text]
-            try:
-                keys = rt.cp_client.call_with_retry(
-                    "kv_keys", {"prefix": "metrics:"}, timeout=10.0) or []
-                for key in sorted(keys):
-                    raw = rt.cp_client.call_with_retry(
-                        "kv_get", {"key": key}, timeout=10.0)
-                    if raw:
-                        parts.append(raw.decode()
-                                     if isinstance(raw, bytes) else raw)
-            except Exception:  # noqa: BLE001 — scrape must stay best-effort
-                pass
-            parts.append(collect_prometheus())
+            # one render over CP dump + this process's registry: same-name
+            # series merge (counters sum, histogram buckets add), HELP/TYPE
+            # emitted once, no duplicate series. The local flusher's source
+            # is excluded from the dump — the registry here is fresher than
+            # its last flush, and counting both would double it.
+            local = _m._collect_dicts()
+            exclude = [s for s in (_m.flusher_source(),) if s]
+            dump = rt.cp_client.call_with_retry(
+                "metrics_dump", {"exclude_sources": exclude}, timeout=10.0)
+            if dump is None:
+                dump = {"metrics": [], "kv_text": []}
+            parts = [_m.render_exposition(dump["metrics"] + local)]
+            parts.extend(dump.get("kv_text") or ())
             return "\n".join(p.strip("\n") for p in parts if p) + "\n"
 
         text = await loop.run_in_executor(None, fetch)
         return web.Response(text=text, content_type="text/plain")
+
+    async def _metrics_query(self, request):
+        """JSON time-series query against the CP store:
+        /api/metrics/query?name=...&since=...&until=...&tag.KEY=VALUE"""
+        from aiohttp import web
+        loop = asyncio.get_event_loop()
+        name = request.query.get("name", "")
+        tags = {k[4:]: v for k, v in request.query.items()
+                if k.startswith("tag.")}
+
+        def _f(key):
+            raw = request.query.get(key)
+            try:
+                return float(raw) if raw is not None else None
+            except ValueError:
+                return None
+
+        since, until = _f("since"), _f("until")
+
+        def fetch():
+            from ray_tpu.util import state
+            return state.query_metrics(name, tags=tags or None,
+                                       since=since, until=until)
+
+        result = await loop.run_in_executor(None, fetch)
+        if result is None:
+            return web.json_response(
+                {"error": f"unknown metric: {name}"}, status=404)
+        return web.json_response(result)
+
+    async def _metrics_series(self, request):
+        """Catalogue of stored series: /api/metrics/series?prefix=..."""
+        from aiohttp import web
+        loop = asyncio.get_event_loop()
+        prefix = request.query.get("prefix", "")
+
+        def fetch():
+            from ray_tpu.util import state
+            return state.list_metric_series(prefix=prefix)
+
+        return web.json_response(await loop.run_in_executor(None, fetch))
 
     async def _api(self, request):
         from aiohttp import web

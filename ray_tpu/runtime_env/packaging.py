@@ -236,6 +236,18 @@ def _pinned_paths() -> set[str]:
         return out
 
 
+def env_cache_size(root: str = _ENV_ROOT) -> int:
+    """Number of materialized entries in the cached-env root (node-agent
+    observability gauge; mirrors gc_env_cache's entry filter)."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    return sum(1 for name in names
+               if ".tmp." not in name
+               and os.path.isdir(os.path.join(root, name)))
+
+
 def gc_env_cache(root: str = _ENV_ROOT) -> list[str]:
     """LRU eviction over the cached-env root (reference:
     _private/runtime_env/uri_cache.py): keep at most

@@ -15,6 +15,7 @@ Usage:
     python -m ray_tpu stop
     python -m ray_tpu microbenchmark
     python -m ray_tpu timeline --out trace.json
+    python -m ray_tpu metrics [NAME] [--tags k=v] [--since TS] [--watch]
 """
 
 from __future__ import annotations
@@ -296,6 +297,50 @@ def cmd_trace(args) -> None:
         print(state.trace_timeline(args.trace_id, fmt=args.format))
 
 
+def _parse_tags(spec: str | None) -> dict | None:
+    tags = _parse_labels(spec)
+    return tags or None
+
+
+def cmd_metrics(args) -> None:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_read_address(args.address))
+    if not args.name:
+        # no name: catalogue of stored series
+        for row in state.list_metric_series(prefix=args.prefix):
+            print(json.dumps(row))
+        return
+
+    def show():
+        res = state.query_metrics(args.name, tags=_parse_tags(args.tags),
+                                  since=args.since, until=args.until)
+        if res is None:
+            print(f"no stored metric named {args.name!r}", file=sys.stderr)
+            return
+        for ser in res["series"]:
+            tags = dict(zip(res["tag_keys"], ser["tags"]))
+            print(f"# source={ser['source']} tags={tags}")
+            for ts, val in ser["points"][-args.limit:]:
+                print(json.dumps({"ts": ts, "value": val}))
+        if res.get("merged"):
+            from ray_tpu.util.metrics import percentiles_from_buckets
+            qs = percentiles_from_buckets(res["boundaries"],
+                                          res["merged"]["buckets"])
+            print(f"# merged count={res['merged']['count']} "
+                  f"sum={res['merged']['sum']:.6g} "
+                  + " ".join(f"p{round(q * 100)}="
+                             f"{'n/a' if v is None else format(v, '.6g')}"
+                             for q, v in qs.items()))
+
+    show()
+    while args.watch:
+        time.sleep(args.interval)
+        print("---")
+        show()
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -363,6 +408,26 @@ def main(argv=None) -> None:
     sp.add_argument("--limit", type=int, default=50,
                     help="max traces when listing")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser(
+        "metrics", help="list stored metric series, or query one by name")
+    sp.add_argument("name", nargs="?", default=None,
+                    help="metric name; omit to list the series catalogue")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--prefix", default="",
+                    help="name prefix filter when listing")
+    sp.add_argument("--tags", default=None,
+                    help="tag filter, k=v[,k2=v2]")
+    sp.add_argument("--since", type=float, default=None,
+                    help="epoch-seconds lower bound")
+    sp.add_argument("--until", type=float, default=None,
+                    help="epoch-seconds upper bound")
+    sp.add_argument("--limit", type=int, default=20,
+                    help="max points printed per series")
+    sp.add_argument("--watch", action="store_true",
+                    help="re-query every --interval seconds")
+    sp.add_argument("--interval", type=float, default=5.0)
+    sp.set_defaults(fn=cmd_metrics)
 
     args = p.parse_args(argv)
     if args.cmd == "submit" and args.entrypoint \

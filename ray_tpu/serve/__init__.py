@@ -10,6 +10,7 @@ from ray_tpu.serve.api import (
     Deployment,
     delete,
     deployment,
+    detailed_status,
     get_app_handle,
     get_deployment_handle,
     run,
@@ -30,7 +31,7 @@ from ray_tpu.serve.handle import (
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
-    "batch", "delete", "deployment", "get_app_handle",
+    "batch", "delete", "deployment", "detailed_status", "get_app_handle",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
     "run", "shutdown", "start_grpc_proxy", "start_http_proxy", "status",
 ]

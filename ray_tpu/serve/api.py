@@ -205,6 +205,13 @@ def status() -> dict:
     return ray_tpu.get(controller.status.remote(), timeout=10.0)
 
 
+def detailed_status() -> dict:
+    """Per-deployment status incl. replica details and `latency_ms`
+    p50/p95/p99 from the merged replica-processing histogram."""
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.detailed_status.remote(), timeout=30.0)
+
+
 def delete(name: str = "default") -> None:
     controller = get_or_create_controller()
     ray_tpu.get(controller.delete_application.remote(name), timeout=60.0)

@@ -311,8 +311,35 @@ class ServeController:
                 "queue_lens": qlens,
                 "engine": (engines if any(e is not None for e in engines)
                            else None),
+                "latency_ms": self._latency_percentiles(state.name),
             }
         return out
+
+    @staticmethod
+    def _latency_percentiles(deployment: str) -> dict | None:
+        """p50/p95/p99 (ms) from the CP time-series store: the merged
+        cross-replica cumulative histogram of on-replica processing latency
+        (ISSUE 4 percentile views). None until the replicas' flushers have
+        reported."""
+        try:
+            from ray_tpu.core import api as _api
+            from ray_tpu.util.metrics import percentiles_from_buckets
+            rt = _api._try_get_runtime()
+            if rt is None:
+                return None
+            res = rt.cp_client.call(
+                "metrics_query",
+                {"name": "ray_tpu_serve_replica_processing_seconds",
+                 "tags": {"deployment": deployment}}, timeout=5.0)
+            merged = (res or {}).get("merged")
+            if not merged or not merged.get("count"):
+                return None
+            qs = percentiles_from_buckets(
+                res.get("boundaries") or [], merged["buckets"])
+            return {f"p{round(q * 100)}": (None if v is None else v * 1000.0)
+                    for q, v in qs.items()}
+        except Exception:  # noqa: BLE001 — metrics are best-effort
+            return None
 
     async def shutdown(self) -> bool:
         self._stopped = True

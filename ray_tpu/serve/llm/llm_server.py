@@ -49,7 +49,9 @@ def _export_engine_stats(model_id: str, stats: dict) -> None:
                     float(stats[key]),
                     tags={"model": model_id, "replica": replica,
                           "stat": key})
-        metrics.push_to_control_plane()
+        # immediate flush (not the 10s interval): dashboards scrape engine
+        # gauges right after probing stats, so they must be current
+        metrics.flush_now()
     except Exception:  # noqa: BLE001 — observability must not fail serving
         pass
 

@@ -33,8 +33,20 @@ from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import DeadlineExceededError, TaskError
 from ray_tpu.observability import tracing
 from ray_tpu.serve.router import Router
+from ray_tpu.util import metrics as _metrics
 
 _SSE_DONE = object()  # sentinel: streaming generator exhausted
+
+# Built-in proxy metrics (ISSUE 4). Route is tagged with the MATCHED prefix
+# (not the raw path) so series cardinality stays bounded by the route table.
+_REQ_LATENCY = _metrics.Histogram(
+    "ray_tpu_serve_request_latency_seconds",
+    "end-to-end HTTP request latency at the proxy",
+    boundaries=[0.001, 0.01, 0.1, 1, 10, 100],
+    tag_keys=("deployment", "route", "status"))
+_PROXY_INFLIGHT = _metrics.Gauge(
+    "ray_tpu_serve_proxy_inflight_requests",
+    "HTTP requests currently in flight at the proxy")
 
 
 def _is_deadline_error(e: BaseException) -> bool:
@@ -116,6 +128,14 @@ class HTTPProxy:
         finally:
             loop.run_until_complete(runner.cleanup())
             loop.close()
+
+    @staticmethod
+    def _observe_request(deployment: str, route: str, status: int,
+                         t0: float) -> None:
+        _REQ_LATENCY.observe(
+            time.monotonic() - t0,
+            tags={"deployment": deployment, "route": route,
+                  "status": str(status)})
 
     # ---- request path --------------------------------------------------
     async def _resolve_route(self, path: str):
@@ -202,10 +222,12 @@ class HTTPProxy:
         if resolved is None:
             return web.Response(status=404, text=f"no route for {path}")
         prefix, (app_name, deployment) = resolved
+        t0 = time.monotonic()
 
         # admission control: shed before any work when over capacity
         if self._inflight >= self._max_inflight:
             self.stats["shed_overload"] += 1
+            self._observe_request(deployment, prefix, 503, t0)
             return self._error_response(
                 503, "proxy overloaded: too many in-flight requests", path,
                 retry_after=1, error_type="overloaded")
@@ -221,6 +243,7 @@ class HTTPProxy:
         if time.time() >= dl:
             # already expired: refuse before a replica sees it
             self.stats["shed_expired"] += 1
+            self._observe_request(deployment, prefix, 503, t0)
             return self._error_response(
                 503, "request deadline already expired", path,
                 retry_after=1, error_type="timeout")
@@ -241,6 +264,7 @@ class HTTPProxy:
         # ray_tpu.serve.llm.openai_api); plain callables get __call__.
         subpath = path[len(prefix.rstrip("/")):] or "/"
         self._inflight += 1
+        _PROXY_INFLIGHT.set(self._inflight)
         try:
             # root span of the whole Serve request: the router call below
             # runs on an executor thread, which does NOT inherit this
@@ -272,7 +296,10 @@ class HTTPProxy:
                             router.assign, call[0], call[1], call[2], {},
                             streaming=True))
                     if hasattr(ref, "__next__"):
-                        return await self._stream_sse(request, ref, dl, sp)
+                        resp = await self._stream_sse(request, ref, dl, sp)
+                        self._observe_request(
+                            deployment, prefix, resp.status, t0)
+                        return resp
                     result = await _aget(ref)
                 else:
                     result, attempts = await loop.run_in_executor(
@@ -287,16 +314,20 @@ class HTTPProxy:
                 self.stats["deadline_exceeded"] += 1
                 if sp is not None:
                     sp["attrs"]["outcome"] = "deadline_exceeded"
+                self._observe_request(deployment, prefix, 503, t0)
                 return self._error_response(
                     503, f"request deadline exceeded: {e}", path,
                     retry_after=1, error_type="timeout")
             self.stats["errors"] += 1
+            self._observe_request(deployment, prefix, 500, t0)
             return self._error_response(
                 500, repr(e), path, error_type="server_error")
         finally:
             self._inflight -= 1
+            _PROXY_INFLIGHT.set(self._inflight)
 
         self.stats["ok"] += 1
+        self._observe_request(deployment, prefix, 200, t0)
         if streaming and isinstance(result, list):
             # server-sent events framing (legacy list-returning replicas)
             resp = web.StreamResponse(

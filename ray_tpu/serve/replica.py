@@ -15,6 +15,20 @@ from typing import Any, Optional
 
 import ray_tpu
 from ray_tpu.core import deadline as request_deadline
+from ray_tpu.util import metrics as _metrics
+
+# Built-in replica metrics (ISSUE 4): registered once per worker process
+# (several replicas of different deployments may share one, hence the
+# deployment tag), flushed by the worker's MetricsFlusher.
+_PROCESSING_HIST = _metrics.Histogram(
+    "ray_tpu_serve_replica_processing_seconds",
+    "on-replica request processing latency (dequeue to reply)",
+    boundaries=[0.001, 0.01, 0.1, 1, 10, 100],
+    tag_keys=("deployment",))
+_QUEUE_DEPTH_GAUGE = _metrics.Gauge(
+    "ray_tpu_serve_replica_queue_depth",
+    "requests ongoing on this replica",
+    tag_keys=("deployment",))
 
 
 @ray_tpu.remote
@@ -68,6 +82,9 @@ class ServeReplica:
             f"request to {self._deployment_name}")
         self._ongoing += 1
         self._total += 1
+        _QUEUE_DEPTH_GAUGE.set(self._ongoing,
+                               tags={"deployment": self._deployment_name})
+        t0 = time.monotonic()
         model_id = (kwargs or {}).pop("_multiplexed_model_id", "")
         if model_id:
             from ray_tpu.serve.multiplex import _set_multiplexed_model_id
@@ -97,6 +114,11 @@ class ServeReplica:
             return result
         finally:
             self._ongoing -= 1
+            _PROCESSING_HIST.observe(
+                time.monotonic() - t0,
+                tags={"deployment": self._deployment_name})
+            _QUEUE_DEPTH_GAUGE.set(
+                self._ongoing, tags={"deployment": self._deployment_name})
 
     def handle_request_streaming(self, method_name: str, args: tuple,
                                  kwargs: dict):
@@ -114,6 +136,9 @@ class ServeReplica:
             f"request to {self._deployment_name}")
         self._ongoing += 1
         self._total += 1
+        _QUEUE_DEPTH_GAUGE.set(self._ongoing,
+                               tags={"deployment": self._deployment_name})
+        t0 = time.monotonic()
         model_id = (kwargs or {}).pop("_multiplexed_model_id", "")
         if model_id:
             from ray_tpu.serve.multiplex import _set_multiplexed_model_id
@@ -140,6 +165,11 @@ class ServeReplica:
                     yield result
         finally:
             self._ongoing -= 1
+            _PROCESSING_HIST.observe(
+                time.monotonic() - t0,
+                tags={"deployment": self._deployment_name})
+            _QUEUE_DEPTH_GAUGE.set(
+                self._ongoing, tags={"deployment": self._deployment_name})
 
     @staticmethod
     def _actor_loop():

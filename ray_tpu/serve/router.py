@@ -29,6 +29,7 @@ from typing import Optional
 
 import ray_tpu
 from ray_tpu.core import deadline as request_deadline
+from ray_tpu.util import metrics as _metrics
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 DeadlineExceededError, GetTimeoutError,
                                 NodeDiedError, ObjectLostError, TaskError,
@@ -42,6 +43,17 @@ from ray_tpu.serve.config import RouterConfig
 # the outcome is unusable and re-execution elsewhere is the recovery.
 _REPLICA_FAULTS = (ActorDiedError, ActorUnavailableError, WorkerCrashedError,
                    NodeDiedError, ObjectLostError)
+
+# Built-in router metrics (ISSUE 4): flushed to the CP time-series store by
+# the hosting process's MetricsFlusher.
+_RETRY_SPEND = _metrics.Counter(
+    "ray_tpu_serve_router_retries_total",
+    "retry-budget spend: requests retried on another replica",
+    tag_keys=("deployment",))
+_EJECTION_COUNTER = _metrics.Counter(
+    "ray_tpu_serve_router_ejections_total",
+    "replicas ejected from routing by the circuit breaker",
+    tag_keys=("deployment",))
 
 
 def is_replica_fault(exc: BaseException) -> bool:
@@ -383,11 +395,13 @@ class Router:
                 if not is_replica_fault(e):
                     rs.record_success(replica)  # replica fine; request isn't
                     raise
-                rs.record_failure(replica)
+                if rs.record_failure(replica):
+                    _EJECTION_COUNTER.inc(tags={"deployment": deployment})
                 if attempts > self.config.max_retries_per_request:
                     raise
                 if not self._budget.withdraw():
                     self._bump("retries_denied")
                     raise
                 self._bump("retries")
+                _RETRY_SPEND.inc(tags={"deployment": deployment})
                 self._maybe_refresh(deployment, force=True)

@@ -1,11 +1,14 @@
-"""User-defined metrics: Counter / Gauge / Histogram.
+"""User-defined and built-in metrics: Counter / Gauge / Histogram.
 
 TPU-native analog of the reference's ray.util.metrics
 (/root/reference/python/ray/util/metrics.py — Counter:165, Histogram:232,
-Gauge:310). Metrics are recorded locally and pushed to the control-plane KV
-under "metrics:" keys on flush; a Prometheus-style exposition dump is
-available via `collect_prometheus()` (the reference exports through the
-dashboard agent → Prometheus pipeline, §5.5)."""
+Gauge:310) plus its dashboard-agent pipeline (SURVEY §5.5): every process
+owns ONE background ``MetricsFlusher`` pushing *delta snapshots* of the
+local registry to the control plane's time-series store on a period and
+once on clean shutdown; the CP accumulates them into cumulative series and
+renders one aggregated Prometheus exposition (summed counters, merged
+histogram buckets — never duplicate series). A local exposition dump is
+still available via `collect_prometheus()`."""
 
 from __future__ import annotations
 
@@ -25,6 +28,10 @@ class Metric:
         self._default_tags: dict = {}
         self._lock = threading.Lock()
         self._values: dict[tuple, float] = {}
+        # last-flushed cumulative values per series: the delta baseline.
+        # Single consumer (the process flusher) — no per-series locking
+        # beyond self._lock needed.
+        self._flushed_values: dict[tuple, float] = {}
         _registry_add(self)
 
     @property
@@ -42,6 +49,15 @@ class Metric:
         if unknown:
             raise ValueError(f"unknown tag keys {unknown} for {self._name}")
         return tuple(merged.get(k, "") for k in self._tag_keys)
+
+    def __reduce__(self):
+        # Metrics hold locks and live in a per-process registry, so they
+        # pickle as a (kind, name, schema) recipe resolved against the
+        # DESTINATION process's registry (cloudpickle captures module-level
+        # metric instances when shipping deployment classes by value).
+        return (_resolve_metric, (
+            type(self)._kind(self), self._name, self._description,
+            self._tag_keys, getattr(self, "_boundaries", None)))
 
 
 class Counter(Metric):
@@ -61,6 +77,14 @@ class Gauge(Metric):
         with self._lock:
             self._values[self._tag_tuple(tags)] = float(value)
 
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(value)
+
+    def dec(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        self.inc(-value, tags)
+
     def _kind(self):
         return "gauge"
 
@@ -71,9 +95,13 @@ class Histogram(Metric):
                  tag_keys: Optional[Sequence[str]] = None):
         super().__init__(name, description, tag_keys)
         self._boundaries = list(boundaries or [0.01, 0.1, 1, 10, 100])
+        # per-series NON-cumulative bucket counts, +1 overflow slot
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        self._flushed_counts: dict[tuple, list[int]] = {}
+        self._flushed_sums: dict[tuple, float] = {}
+        self._flushed_totals: dict[tuple, int] = {}
 
     def observe(self, value: float, tags: Optional[dict] = None) -> None:
         key = self._tag_tuple(tags)
@@ -100,45 +128,389 @@ def _registry_add(metric: Metric) -> None:
         _registry.append(metric)
 
 
-def collect_prometheus() -> str:
-    """Prometheus text exposition of all registered metrics."""
-    lines = []
+def _resolve_metric(kind: str, name: str, description: str,
+                    tag_keys: tuple, boundaries) -> Metric:
+    """Unpickle target for Metric.__reduce__: the already-registered metric
+    of the same name in THIS process if one exists (normally the importing
+    module re-created it), else a fresh registration."""
     with _registry_lock:
-        metrics = list(_registry)
-    for m in metrics:
-        kind = m._kind()
-        lines.append(f"# HELP {m._name} {m._description}")
-        lines.append(f"# TYPE {m._name} {kind}")
-        if isinstance(m, Histogram):
-            for key, counts in m._counts.items():
-                tags = _fmt_tags(m._tag_keys, key)
+        for m in _registry:
+            if m._name == name and m._kind() == kind:
+                return m
+    if kind == "histogram":
+        return Histogram(name, description, boundaries=boundaries,
+                         tag_keys=tag_keys)
+    cls = Counter if kind == "counter" else Gauge
+    return cls(name, description, tag_keys=tag_keys)
+
+
+# ---------------------------------------------------------------------------
+# exposition rendering (shared by the local dump, the CP aggregate, and the
+# serve percentile views)
+# ---------------------------------------------------------------------------
+
+def _label_str(keys: Sequence[str], values: Sequence) -> str:
+    """`k1="v1",k2="v2"` or "" when there are no tag keys."""
+    if not keys:
+        return ""
+    return ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+
+
+def render_exposition(metric_dicts: Sequence[dict]) -> str:
+    """Render metric dicts (the snapshot/TS-store shape: name, kind,
+    description, tag_keys, [boundaries], series=[{tags, value | buckets+
+    sum+count}]) as valid Prometheus text exposition.
+
+    Correctness rules the ad-hoc emitters got wrong, centralized here:
+    `# HELP`/`# TYPE` appear ONCE per metric name even when the name was
+    registered by several processes; same-name same-tags series are
+    aggregated (counters/gauges summed, histogram buckets merged) instead
+    of emitted as duplicates; empty tag sets render bare names, never
+    `name{}`."""
+    order: list[str] = []
+    groups: dict[str, dict] = {}
+    for md in metric_dicts:
+        name = md.get("name")
+        if not name:
+            continue
+        g = groups.get(name)
+        if g is None:
+            g = groups[name] = {
+                "kind": md.get("kind", "gauge"),
+                "description": md.get("description", ""),
+                "tag_keys": list(md.get("tag_keys") or ()),
+                "boundaries": list(md.get("boundaries") or ()),
+                "series": {},
+            }
+            order.append(name)
+        elif not g["description"] and md.get("description"):
+            g["description"] = md["description"]
+        for s in md.get("series") or ():
+            key = tuple(s.get("tags") or ())
+            if g["kind"] == "histogram":
+                buckets = list(s.get("buckets") or ())
+                prev = g["series"].get(key)
+                if prev is None:
+                    g["series"][key] = {
+                        "buckets": buckets,
+                        "sum": float(s.get("sum", 0.0)),
+                        "count": int(s.get("count", 0))}
+                elif len(prev["buckets"]) == len(buckets):
+                    prev["buckets"] = [a + b for a, b in
+                                       zip(prev["buckets"], buckets)]
+                    prev["sum"] += float(s.get("sum", 0.0))
+                    prev["count"] += int(s.get("count", 0))
+            else:
+                val = float(s.get("value", s.get("delta", 0.0)))
+                g["series"][key] = g["series"].get(key, 0.0) + val
+    lines: list[str] = []
+    for name in order:
+        g = groups[name]
+        lines.append(f"# HELP {name} {g['description']}")
+        lines.append(f"# TYPE {name} {g['kind']}")
+        keys = g["tag_keys"]
+        if g["kind"] == "histogram":
+            bounds = g["boundaries"]
+            for tagvals, s in g["series"].items():
+                lbl = _label_str(keys, tagvals)
+                extra = f",{lbl}" if lbl else ""
                 cum = 0
-                for b, c in zip(m._boundaries, counts):
+                for b, c in zip(bounds, s["buckets"]):
                     cum += c
-                    lines.append(
-                        f'{m._name}_bucket{{le="{b}"{tags}}} {cum}')
-                cum += counts[-1]
-                lines.append(f'{m._name}_bucket{{le="+Inf"{tags}}} {cum}')
-                lines.append(f"{m._name}_sum{{{tags.lstrip(',')}}} "
-                             f"{m._sums[key]}")
-                lines.append(f"{m._name}_count{{{tags.lstrip(',')}}} "
-                             f"{m._totals[key]}")
+                    lines.append(f'{name}_bucket{{le="{b}"{extra}}} {cum}')
+                if len(s["buckets"]) > len(bounds):
+                    cum += s["buckets"][-1]
+                lines.append(f'{name}_bucket{{le="+Inf"{extra}}} {cum}')
+                suffix = f"{{{lbl}}}" if lbl else ""
+                lines.append(f'{name}_sum{suffix} {s["sum"]}')
+                lines.append(f'{name}_count{suffix} {s["count"]}')
         else:
-            for key, val in m._values.items():
-                tags = _fmt_tags(m._tag_keys, key)
-                suffix = f"{{{tags.lstrip(',')}}}" if tags else ""
-                lines.append(f"{m._name}{suffix} {val}")
+            for tagvals, val in g["series"].items():
+                lbl = _label_str(keys, tagvals)
+                suffix = f"{{{lbl}}}" if lbl else ""
+                lines.append(f"{name}{suffix} {val}")
     return "\n".join(lines) + "\n"
 
 
-def _fmt_tags(keys: tuple, values: tuple) -> str:
-    if not keys:
-        return ""
-    return "," + ",".join(f'{k}="{v}"' for k, v in zip(keys, values))
+def _collect_dicts() -> list[dict]:
+    """Full (cumulative) snapshot of the local registry in the shared
+    metric-dict shape."""
+    with _registry_lock:
+        metrics = list(_registry)
+    out = []
+    for m in metrics:
+        if isinstance(m, Histogram):
+            with m._lock:
+                series = [{"tags": list(key), "buckets": list(counts),
+                           "sum": m._sums.get(key, 0.0),
+                           "count": m._totals.get(key, 0)}
+                          for key, counts in m._counts.items()]
+            out.append({"name": m._name, "kind": "histogram",
+                        "description": m._description,
+                        "tag_keys": list(m._tag_keys),
+                        "boundaries": list(m._boundaries),
+                        "series": series})
+        else:
+            with m._lock:
+                series = [{"tags": list(key), "value": val}
+                          for key, val in m._values.items()]
+            out.append({"name": m._name, "kind": m._kind(),
+                        "description": m._description,
+                        "tag_keys": list(m._tag_keys),
+                        "series": series})
+    return out
+
+
+def collect_prometheus() -> str:
+    """Prometheus text exposition of all registered metrics."""
+    return render_exposition(_collect_dicts())
+
+
+# ---------------------------------------------------------------------------
+# histogram math (CP query views + serve detailed_status percentiles)
+# ---------------------------------------------------------------------------
+
+def merge_histograms(series: Sequence[dict]) -> Optional[dict]:
+    """Merge cumulative histogram series ({boundaries, buckets, sum, count})
+    from several workers into one. Series whose boundaries disagree with
+    the first are skipped (same code registers the metric everywhere, so
+    this only guards corrupt payloads)."""
+    merged: Optional[dict] = None
+    for s in series:
+        if not s or not s.get("buckets"):
+            continue
+        if merged is None:
+            merged = {"boundaries": list(s.get("boundaries") or ()),
+                      "buckets": list(s["buckets"]),
+                      "sum": float(s.get("sum", 0.0)),
+                      "count": int(s.get("count", 0))}
+            continue
+        if list(s.get("boundaries") or ()) != merged["boundaries"] or \
+                len(s["buckets"]) != len(merged["buckets"]):
+            continue
+        merged["buckets"] = [a + b for a, b in
+                             zip(merged["buckets"], s["buckets"])]
+        merged["sum"] += float(s.get("sum", 0.0))
+        merged["count"] += int(s.get("count", 0))
+    return merged
+
+
+def percentiles_from_buckets(boundaries: Sequence[float],
+                             buckets: Sequence[int],
+                             qs: Sequence[float] = (0.5, 0.95, 0.99),
+                             ) -> dict[float, Optional[float]]:
+    """Estimate quantiles from non-cumulative bucket counts (len(buckets) ==
+    len(boundaries)+1, last slot is the +Inf overflow) by linear
+    interpolation inside the covering bucket. The overflow bucket has no
+    upper edge, so anything landing there reports the top boundary."""
+    total = sum(buckets)
+    out: dict[float, Optional[float]] = {}
+    if total <= 0 or not boundaries:
+        return {q: None for q in qs}
+    for q in qs:
+        target = max(q, 0.0) * total
+        cum = 0.0
+        val: Optional[float] = float(boundaries[-1])
+        for i, c in enumerate(buckets):
+            if c > 0 and cum + c >= target:
+                if i >= len(boundaries):
+                    val = float(boundaries[-1])
+                else:
+                    lo = 0.0 if i == 0 else float(boundaries[i - 1])
+                    hi = float(boundaries[i])
+                    val = lo + (hi - lo) * ((target - cum) / c)
+                break
+            cum += c
+        out[q] = val
+    return out
+
+
+# ---------------------------------------------------------------------------
+# delta snapshots + the per-process flusher
+# ---------------------------------------------------------------------------
+
+def snapshot_deltas() -> list[dict]:
+    """Drain unsent increments from the local registry: counters report the
+    delta since the last snapshot (only when > 0), histograms per-bucket
+    delta counts (only when anything was observed), gauges always report
+    their current value. Single consumer assumed — the baselines stored in
+    the metric objects advance on every call."""
+    with _registry_lock:
+        metrics = list(_registry)
+    out = []
+    for m in metrics:
+        if isinstance(m, Histogram):
+            series = []
+            with m._lock:
+                for key, counts in m._counts.items():
+                    prev = m._flushed_counts.get(key)
+                    if prev is None or len(prev) != len(counts):
+                        prev = [0] * len(counts)
+                    delta = [c - p for c, p in zip(counts, prev)]
+                    dcount = (m._totals.get(key, 0)
+                              - m._flushed_totals.get(key, 0))
+                    if dcount <= 0 and not any(delta):
+                        continue
+                    series.append({
+                        "tags": list(key), "buckets": delta,
+                        "sum": (m._sums.get(key, 0.0)
+                                - m._flushed_sums.get(key, 0.0)),
+                        "count": dcount})
+                    m._flushed_counts[key] = list(counts)
+                    m._flushed_sums[key] = m._sums.get(key, 0.0)
+                    m._flushed_totals[key] = m._totals.get(key, 0)
+            if series:
+                out.append({"name": m._name, "kind": "histogram",
+                            "description": m._description,
+                            "tag_keys": list(m._tag_keys),
+                            "boundaries": list(m._boundaries),
+                            "series": series})
+        elif m._kind() == "counter":
+            series = []
+            with m._lock:
+                for key, val in m._values.items():
+                    delta = val - m._flushed_values.get(key, 0.0)
+                    if delta <= 0:
+                        continue
+                    series.append({"tags": list(key), "delta": delta})
+                    m._flushed_values[key] = val
+            if series:
+                out.append({"name": m._name, "kind": "counter",
+                            "description": m._description,
+                            "tag_keys": list(m._tag_keys),
+                            "series": series})
+        else:
+            with m._lock:
+                series = [{"tags": list(key), "value": val}
+                          for key, val in m._values.items()]
+            if series:
+                out.append({"name": m._name, "kind": "gauge",
+                            "description": m._description,
+                            "tag_keys": list(m._tag_keys),
+                            "series": series})
+    return out
+
+
+class MetricsFlusher:
+    """Background delta flusher — the per-process metrics agent (reference:
+    dashboard agent / OpenCensus exporter loop). ``send(payload)`` delivers
+    one snapshot to the CP's `metrics_report`; failures are swallowed
+    (observability must never take a worker down)."""
+
+    def __init__(self, send, source: str, interval_s: float = 10.0,
+                 node_id: Optional[str] = None):
+        self._send = send
+        self.source = source
+        self.node_id = node_id
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._flush_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsFlusher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"metrics-flusher:{self.source[:12]}")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+
+    def flush(self) -> None:
+        with self._flush_lock:
+            mets = snapshot_deltas()
+            if not mets:
+                return
+            payload = {"source": self.source, "node_id": self.node_id,
+                       "ts": time.time(), "metrics": mets}
+            try:
+                self._send(payload)
+            except Exception:  # noqa: BLE001 — flush is best-effort
+                pass
+
+    @property
+    def alive(self) -> bool:
+        return not self._stop.is_set()
+
+    def stop(self, final: bool = True) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        if final:
+            self.flush()
+
+
+# One flusher per process: head mode hosts CP + node agent + driver runtime
+# in a single process, and the registry baselines tolerate exactly one
+# consumer — the first component to start a flusher owns it for everyone.
+_flusher: Optional[MetricsFlusher] = None
+_flusher_guard = threading.Lock()
+
+
+def start_flusher(send, source: str, interval_s: Optional[float] = None,
+                  node_id: Optional[str] = None) -> MetricsFlusher:
+    """Start the process-wide flusher. First caller wins and gets the
+    handle back (pass it to `stop_flusher` on shutdown); later callers
+    join the existing flusher and get None — they must not stop it (use
+    `flush_now` for their own shutdown flush instead)."""
+    global _flusher
+    with _flusher_guard:
+        if _flusher is not None and _flusher.alive:
+            return None
+        if interval_s is None:
+            try:
+                from ray_tpu.core.config import get_config
+                interval_s = get_config().metrics_flush_interval_s
+            except Exception:  # noqa: BLE001
+                interval_s = 10.0
+        _flusher = MetricsFlusher(send, source, interval_s,
+                                  node_id=node_id).start()
+        return _flusher
+
+
+def stop_flusher(flusher: Optional[MetricsFlusher] = None,
+                 final: bool = True) -> None:
+    """Stop the process flusher (with one last flush by default). Only the
+    handle returned by the winning `start_flusher` call stops it — a None
+    handle (a component that merely joined the shared flusher) is a no-op,
+    so one component's shutdown can't silence the rest of the process."""
+    global _flusher
+    with _flusher_guard:
+        cur = _flusher
+        if flusher is None or cur is not flusher:
+            return
+        _flusher = None
+    cur.stop(final=final)
+
+
+def flusher_source() -> Optional[str]:
+    """Source name of this process's live flusher (None without one). A
+    scraper merging the CP dump with its own local registry excludes this
+    source from the dump — the local copy is fresher and must not be
+    double-counted."""
+    with _flusher_guard:
+        cur = _flusher
+    return cur.source if cur is not None and cur.alive else None
+
+
+def flush_now() -> None:
+    """One immediate flush through the process flusher, if any (shutdown
+    paths that don't own the flusher: actor exit, worker teardown)."""
+    with _flusher_guard:
+        cur = _flusher
+    if cur is not None and cur.alive:
+        cur.flush()
 
 
 def push_to_control_plane() -> None:
-    """Snapshot all metrics into the cluster KV (metrics:<worker>)."""
+    """Snapshot all metrics into the cluster KV (metrics:<worker>). Legacy
+    full-exposition push — the flusher's delta pipeline supersedes it, but
+    explicit callers (e.g. engines exporting gauges between flushes) keep
+    working; the CP retracts the key when the worker dies."""
     from ray_tpu.core import api
     rt = api._try_get_runtime()
     if rt is None:

@@ -122,6 +122,24 @@ def timeline(filename: Optional[str] = None) -> Optional[str]:
     return payload
 
 
+def query_metrics(name: str, tags: Optional[dict] = None,
+                  since: Optional[float] = None,
+                  until: Optional[float] = None) -> Optional[dict]:
+    """Points of one metric from the control-plane time-series store
+    (util/metrics.py flusher pipeline): per-source series filtered by a
+    tag subset and a [since, until] epoch-seconds range, plus `merged`
+    (the cross-source cumulative merge) for histograms. None if the
+    metric has never been reported."""
+    return _cp().call("metrics_query", {
+        "name": name, "tags": tags, "since": since, "until": until})
+
+
+def list_metric_series(prefix: str = "") -> list[dict]:
+    """Catalogue of stored metric series ({name, kind, tags, source,
+    points, last_ts}), optionally filtered by name prefix."""
+    return _cp().call("metrics_list_series", {"prefix": prefix}) or []
+
+
 def list_traces(limit: int = 100) -> list[dict]:
     """Summaries of traces in the control-plane trace store, newest first
     (observability/tracing.py; ref: the reference's tracing export)."""

@@ -1,0 +1,222 @@
+"""Cluster-wide metrics pipeline tests (ISSUE 4).
+
+Covers the flusher -> CP time-series store -> query/exposition path:
+built-in runtime series appearing without manual pushes, time-bounded
+queries, cross-worker histogram merging, dead-worker series retraction,
+and the serve percentile views. Fake-clock scenarios inject delta
+snapshots directly through the `metrics_report` RPC with explicit
+timestamps — the store honors the caller's clock.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.util import state
+from ray_tpu.util.metrics import percentiles_from_buckets
+
+
+@pytest.fixture
+def metrics_cluster():
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=4, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+        "metrics_flush_interval_s": 0.2,
+    })
+    yield ctx
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _cp():
+    from ray_tpu.core import api
+    return api._get_runtime().cp_client
+
+
+def _report(source, ts, metrics, node_id=None):
+    return _cp().call("metrics_report", {
+        "source": source, "node_id": node_id, "ts": ts,
+        "metrics": metrics}, timeout=10.0)
+
+
+def _hist_md(name, boundaries, tag_keys, series):
+    return {"name": name, "kind": "histogram", "description": name,
+            "tag_keys": list(tag_keys), "boundaries": list(boundaries),
+            "series": series}
+
+
+def _wait_for(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_builtin_series_flow_without_manual_push(metrics_cluster):
+    """A task + serve round-trip lands built-in series in the CP store via
+    the auto-flushers alone — no explicit push anywhere."""
+
+    @ray_tpu.remote
+    def add(x):
+        return x + 1
+
+    assert ray_tpu.get([add.remote(i) for i in range(10)]) == list(
+        range(1, 11))
+
+    @serve.deployment
+    def echo(payload):
+        return {"got": payload}
+
+    serve.run(echo.bind(), name="mapp", route_prefix="/m")
+    proxy = serve.start_http_proxy(port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{proxy.port}/m",
+        data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert body == {"got": {"a": 1}}
+
+    def have(*names):
+        stored = {r["name"] for r in state.list_metric_series()}
+        return all(n in stored for n in names)
+
+    _wait_for(
+        lambda: have("ray_tpu_task_lifecycle_seconds",
+                     "ray_tpu_rpc_request_latency_seconds",
+                     "ray_tpu_task_latency_seconds",
+                     "ray_tpu_node_agent_workers",
+                     "ray_tpu_serve_replica_processing_seconds",
+                     "ray_tpu_serve_request_latency_seconds"),
+        msg="built-in series in the CP store")
+
+    # the lifecycle histogram saw the 10 completions
+    q = state.query_metrics("ray_tpu_task_lifecycle_seconds",
+                            tags={"transition": "completed"})
+    assert q is not None and q["merged"]["count"] >= 10
+
+    # proxy series carries deployment/route/status tags
+    q = state.query_metrics("ray_tpu_serve_request_latency_seconds",
+                            tags={"deployment": "echo", "route": "/m",
+                                  "status": "200"})
+    assert q is not None and q["merged"] is not None
+    assert q["merged"]["count"] >= 1
+    serve.delete("mapp")
+
+
+def test_metrics_query_time_bounded(metrics_cluster):
+    md = {"name": "fake_clock_gauge", "kind": "gauge",
+          "description": "g", "tag_keys": [], "series": []}
+    for ts, val in ((1000.0, 1.0), (2000.0, 2.0), (3000.0, 3.0)):
+        r = _report("fake-src", ts, [
+            {**md, "series": [{"tags": [], "value": val}]}])
+        assert r and r.get("ok")
+
+    q = state.query_metrics("fake_clock_gauge", since=1500.0, until=2500.0)
+    assert q is not None
+    pts = [p for s in q["series"] for p in s["points"]]
+    assert pts == [[2000.0, 2.0]]
+
+    # unbounded: all three, in order
+    q = state.query_metrics("fake_clock_gauge")
+    pts = [p for s in q["series"] for p in s["points"]]
+    assert [p[0] for p in pts] == [1000.0, 2000.0, 3000.0]
+    assert state.query_metrics("never_reported_metric") is None
+
+
+def test_histogram_merge_across_two_workers(metrics_cluster):
+    bounds = [0.1, 1.0]
+    name = "merge_hist"
+    # worker 1 reports twice (deltas accumulate into cumulative store-side)
+    _report("w1", 100.0, [_hist_md(name, bounds, [], [
+        {"tags": [], "buckets": [1, 2, 0], "sum": 1.0, "count": 3}])])
+    _report("w1", 101.0, [_hist_md(name, bounds, [], [
+        {"tags": [], "buckets": [0, 1, 1], "sum": 2.5, "count": 2}])])
+    # worker 2 reports once
+    _report("w2", 102.0, [_hist_md(name, bounds, [], [
+        {"tags": [], "buckets": [2, 0, 1], "sum": 3.0, "count": 3}])])
+
+    q = state.query_metrics(name)
+    assert q is not None
+    by_source = {s["source"]: s["points"][-1][1] for s in q["series"]}
+    assert by_source["w1"]["buckets"] == [1, 3, 1]  # cumulative across flushes
+    assert by_source["w2"]["buckets"] == [2, 0, 1]
+    merged = q["merged"]
+    assert merged["buckets"] == [3, 3, 2]
+    assert merged["count"] == 8
+    assert abs(merged["sum"] - 6.5) < 1e-9
+
+    # exposition: ONE series (merged), cumulative le-buckets, no duplicates
+    text = _cp().call("get_metrics", None, timeout=10.0)
+    lines = [ln for ln in text.splitlines() if ln.startswith(name)]
+    assert f'{name}_bucket{{le="0.1"}} 3' in lines
+    assert f'{name}_bucket{{le="1.0"}} 6' in lines
+    assert f'{name}_bucket{{le="+Inf"}} 8' in lines
+    assert f'{name}_count 8' in lines
+    assert len([ln for ln in lines if ln.startswith(f"{name}_count")]) == 1
+    assert len([ln for ln in text.splitlines()
+                if ln.startswith(f"# TYPE {name} ")]) == 1
+
+
+def test_dead_worker_series_retracted(metrics_cluster):
+    src = "deadbeef01"
+    r = _report(src, time.time(), [
+        {"name": "doomed_gauge", "kind": "gauge", "description": "",
+         "tag_keys": [], "series": [{"tags": [], "value": 7.0}]}])
+    assert r and r.get("ok")
+    # legacy KV exposition blob for the same worker rides the scrape
+    _cp().call("kv_put", {"key": f"metrics:{src}",
+                          "value": b"legacy_series 1\n", "overwrite": True})
+    assert any(row["name"] == "doomed_gauge"
+               for row in state.list_metric_series())
+    assert "legacy_series 1" in _cp().call("get_metrics", None, timeout=10.0)
+
+    _cp().call("worker_died", {"worker_id": src, "reason": "test kill"})
+
+    assert not any(row["name"] == "doomed_gauge"
+                   for row in state.list_metric_series())
+    text = _cp().call("get_metrics", None, timeout=10.0)
+    assert "doomed_gauge" not in text
+    assert "legacy_series" not in text
+    # late flush from the dead worker is refused, not resurrected
+    r = _report(src, time.time(), [
+        {"name": "doomed_gauge", "kind": "gauge", "description": "",
+         "tag_keys": [], "series": [{"tags": [], "value": 8.0}]}])
+    assert r and r.get("retracted")
+    assert not any(row["name"] == "doomed_gauge"
+                   for row in state.list_metric_series())
+
+
+def test_detailed_status_percentiles_from_fake_clock(metrics_cluster):
+    @serve.deployment
+    class Quiet:
+        def __call__(self, x):
+            return x
+
+    serve.run(Quiet.bind(), name="papp", route_prefix=None)
+
+    # inject a known latency distribution for the deployment, with the
+    # replica histogram's schema (boundaries + deployment tag)
+    bounds = [0.001, 0.01, 0.1, 1, 10, 100]
+    buckets = [0, 500, 450, 50, 0, 0, 0]
+    _report("fake-replica", time.time(), [_hist_md(
+        "ray_tpu_serve_replica_processing_seconds", bounds,
+        ["deployment"],
+        [{"tags": ["Quiet"], "buckets": buckets,
+          "sum": 25.0, "count": 1000}])])
+
+    st = serve.detailed_status()
+    lat = st["papp#Quiet"]["latency_ms"]
+    assert lat is not None
+    expect = percentiles_from_buckets(bounds, buckets)
+    # the controller's own engine-stat probes may add a few sub-ms
+    # observations; the injected 1000 points dominate
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        assert lat[key] == pytest.approx(expect[q] * 1000.0, rel=0.10), key
+    serve.delete("papp")

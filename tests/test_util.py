@@ -159,6 +159,73 @@ def test_metrics():
         c.inc(1, tags={"bad": "x"})
 
 
+def test_metrics_exposition_text_format():
+    """Validate render_exposition output line-by-line against the
+    Prometheus text format over two simulated workers' payloads covering
+    tagged and untagged counters, gauges, and histograms (ISSUE 4: the
+    ad-hoc emitters used to produce `name{}` and duplicate HELP/TYPE)."""
+    import re
+
+    def worker_payload(route, lat_buckets):
+        return [
+            {"name": "w_reqs_total", "kind": "counter",
+             "description": "requests", "tag_keys": ["route"],
+             "series": [{"tags": [route], "value": 2.0}]},
+            {"name": "w_restarts_total", "kind": "counter",
+             "description": "restarts", "tag_keys": [],
+             "series": [{"tags": [], "value": 1.0}]},
+            {"name": "w_inflight", "kind": "gauge",
+             "description": "in flight", "tag_keys": [],
+             "series": [{"tags": [], "value": 3.0}]},
+            {"name": "w_queue_depth", "kind": "gauge",
+             "description": "queued", "tag_keys": ["route"],
+             "series": [{"tags": [route], "value": 4.0}]},
+            {"name": "w_latency_s", "kind": "histogram",
+             "description": "latency", "tag_keys": [],
+             "boundaries": [0.1, 1.0],
+             "series": [{"tags": [], "buckets": lat_buckets,
+                         "sum": 1.5, "count": sum(lat_buckets)}]},
+            {"name": "w_step_s", "kind": "histogram",
+             "description": "step", "tag_keys": ["route"],
+             "boundaries": [0.1, 1.0],
+             "series": [{"tags": [route], "buckets": [1, 0, 0],
+                         "sum": 0.05, "count": 1}]},
+        ]
+
+    text = metrics.render_exposition(
+        worker_payload("/a", [1, 2, 0]) + worker_payload("/b", [0, 1, 1]))
+
+    help_re = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                      # metric name
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'              # first label
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'         # more labels
+        r' [0-9.+\-eEInf]+$')                             # value
+    helps, types = {}, {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("#"):
+            assert help_re.match(ln), f"bad comment line: {ln!r}"
+            kind, name = ln.split()[1], ln.split()[2]
+            seen = helps if kind == "HELP" else types
+            assert name not in seen, f"duplicate # {kind} for {name}"
+            seen[name] = ln
+        else:
+            assert sample_re.match(ln), f"bad sample line: {ln!r}"
+            assert "{}" not in ln, f"empty label set rendered: {ln!r}"
+    assert set(helps) == set(types)  # every metric gets exactly one of each
+
+    # untagged series render bare names and merge across the two workers
+    assert "w_restarts_total 2.0" in text
+    assert 'w_latency_s_bucket{le="+Inf"} 5' in text
+    assert "w_latency_s_count 5" in text
+    # tagged series stay distinct
+    assert 'w_reqs_total{route="/a"} 2.0' in text
+    assert 'w_reqs_total{route="/b"} 2.0' in text
+    assert 'w_step_s_bucket{le="0.1",route="/a"} 1' in text
+
+
 def test_profiling_trace_and_annotation(tmp_path):
     """XPlane trace capture (SURVEY §5.1 — the TPU-native profiler path)."""
     import jax.numpy as jnp
