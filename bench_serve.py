@@ -81,6 +81,12 @@ def main():
                          "(1024-token shared prefix, unique suffixes) with "
                          "the prefix cache on vs off; merges the result "
                          "into --out (implied by --curve)")
+    ap.add_argument("--spec-ab", action="store_true",
+                    help="A/B speculative decoding on a repetitive-suffix "
+                         "greedy workload: spec-on vs spec-off deployments, "
+                         "hard-asserts token identity, reports accepted "
+                         "draft tokens per verify round; merges the result "
+                         "into --out")
     ap.add_argument("--metrics-ab", action="store_true",
                     help="A/B the built-in metrics pipeline: rerun the "
                          "headline point with metrics_enabled=False on a "
@@ -101,13 +107,16 @@ def main():
         import subprocess
         import sys
         repo = os.path.dirname(os.path.abspath(__file__))
+        preflight_tests = ["tests/test_serve_llm.py"]
+        if args.spec_ab:
+            preflight_tests.append("tests/test_spec_decode.py")
         rc = subprocess.run(
-            [sys.executable, "-m", "pytest", "-q",
-             "tests/test_serve_llm.py"],
+            [sys.executable, "-m", "pytest", "-q", *preflight_tests],
             cwd=repo, env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
         if rc != 0:
-            sys.exit(f"preflight failed: pytest -q tests/test_serve_llm.py "
-                     f"exited {rc} — not benchmarking a broken serve path "
+            sys.exit(f"preflight failed: pytest -q "
+                     f"{' '.join(preflight_tests)} exited {rc} — not "
+                     f"benchmarking a broken serve path "
                      f"(--no-preflight to override)")
 
     import ray_tpu
@@ -378,6 +387,123 @@ def main():
             if on_row["p50_ttft_ms"] else None,
         }
 
+    # speculative decoding A/B (ISSUE 5): repetitive-suffix greedy
+    # completions — the workload n-gram drafting exists for — against a
+    # spec-on and a spec-off deployment of the same engine. Token identity
+    # is a HARD assert: speculation must be a pure perf knob. On cpu-tiny
+    # the point runs a deeper tiny model (dim 256, 4 layers) so a forward
+    # pass is weights-bound like real serving; the default 2-layer dim-64
+    # model is dispatch-bound on CPU, which hides the verify round's
+    # extra-positions-are-nearly-free economics and makes any spec
+    # measurement noise.
+    spec_decode = None
+    if args.spec_ab:
+        import dataclasses as _dc
+
+        if args.tiny or not has_tpu:
+            spec_cfg = LLMConfig(
+                model_id="llama-tiny-d256",
+                model_config=llama.llama_tiny(
+                    vocab_size=2048, dim=256, n_layers=4, n_heads=8,
+                    n_kv_heads=4, ffn_dim=1024),
+                max_batch_size=8, page_size=32, num_pages=256,
+                max_prompt_len=256, max_seq_len=512, max_tokens=64,
+                warmup_compile=True, spec_draft_len=8)
+        else:
+            spec_cfg = _dc.replace(llm_cfg, spec_draft_len=8)
+        # single-stream: speculative decoding is a LATENCY feature — it
+        # spends extra FLOPs per pass to cut sequential passes, so its
+        # home turf is the latency-bound low-concurrency regime (at high
+        # batch the chip is already compute-saturated and the extra verify
+        # positions just displace other slots' work)
+        sp_req = max(3, min(args.requests, 4))
+        sp_conc = 1
+        sp_tokens = min(64, spec_cfg.max_tokens)
+
+        def _spec_prompt(i: int) -> str:
+            return "the cat sat on the mat. " * 6 + f"Q{i}: "
+
+        def spec_arm(enabled: bool) -> dict:
+            serve.shutdown()
+            tag = "on" if enabled else "off"
+            arm_app = build_openai_app(
+                _dc.replace(spec_cfg, spec_decode_enabled=enabled),
+                route_prefix="/v1")
+            serve.run(arm_app, name=f"llm-bench-spec-{tag}",
+                      route_prefix="/v1")
+            arm_proxy = serve.start_http_proxy(port=0)
+            url = f"http://127.0.0.1:{arm_proxy.port}/v1/completions"
+            surl = url.replace("/completions", "/stats")
+
+            def _arm_stats() -> dict:
+                with urllib.request.urlopen(surl, timeout=60) as r:
+                    return json.loads(r.read())
+
+            # warm: compile prefill buckets (decode + verify programs are
+            # covered by warmup_compile at replica init)
+            _post(url, {"prompt": _spec_prompt(0), "max_tokens": 4,
+                        "temperature": 0.0})
+            s0 = _arm_stats()
+
+            def one(i: int) -> dict:
+                return _post(url, {"prompt": _spec_prompt(i),
+                                   "max_tokens": sp_tokens,
+                                   "temperature": 0.0})
+
+            t0 = time.monotonic()
+            with concurrent.futures.ThreadPoolExecutor(sp_conc) as pool:
+                outs = list(pool.map(one, range(sp_req)))
+            wall = time.monotonic() - t0
+            s1 = _arm_stats()
+            row = {
+                "label": f"spec_{tag}",
+                "requests": sp_req, "concurrency": sp_conc,
+                "max_tokens": sp_tokens,
+                "gen_tokens_per_s": round(sum(
+                    o["usage"]["completion_tokens"] for o in outs) / wall, 1),
+                # per-request (text, n_tokens): the identity fingerprint
+                "completions": [(o["choices"][0]["text"],
+                                 o["usage"]["completion_tokens"])
+                                for o in outs],
+            }
+            for key in ("spec_rounds", "spec_drafted_tokens",
+                        "spec_accepted_tokens"):
+                row[key] = s1.get(key, 0) - s0.get(key, 0)
+            return row
+
+        off_row = spec_arm(False)
+        on_row = spec_arm(True)
+        identical = off_row["completions"] == on_row["completions"]
+        rounds = on_row["spec_rounds"]
+        spec_decode = {
+            "label": "spec_repetitive_suffix",
+            "model": spec_cfg.model_id,
+            "env": "tpu" if (has_tpu and not args.tiny) else "cpu-tiny",
+            "draft_len": spec_cfg.spec_draft_len,
+            "greedy_identical": identical,
+            "spec_rounds": rounds,
+            # the headline acceptance number: mean accepted DRAFT tokens
+            # per verify round (each round additionally emits one
+            # verified bonus token on top of these)
+            "accepted_per_round": round(
+                on_row["spec_accepted_tokens"] / rounds, 2) if rounds
+            else 0.0,
+            "gen_tokens_per_s_on": on_row["gen_tokens_per_s"],
+            "gen_tokens_per_s_off": off_row["gen_tokens_per_s"],
+            "speedup": round(on_row["gen_tokens_per_s"]
+                             / off_row["gen_tokens_per_s"], 2)
+            if off_row["gen_tokens_per_s"] else None,
+        }
+        for row in (off_row, on_row):
+            row.pop("completions")
+            points.append(row)
+        if not identical:
+            print(json.dumps({"spec_decode": spec_decode}))
+            raise SystemExit(
+                "speculative decoding changed greedy output: spec-on and "
+                "spec-off completions differ — the accept/rollback path is "
+                "broken, not benchmarking it")
+
     serve.shutdown()
 
     result = {
@@ -394,8 +520,10 @@ def main():
     }
     if metrics_overhead is not None:
         result["extra"]["metrics_overhead"] = metrics_overhead
-    if prefix_cache is not None:
-        result["extra"]["prefix_cache"] = prefix_cache
+    mergeable = {"prefix_cache": prefix_cache, "spec_decode": spec_decode}
+    mergeable = {k: v for k, v in mergeable.items() if v is not None}
+    if mergeable:
+        result["extra"].update(mergeable)
         # merge into --out WITHOUT clobbering earlier headline rows (e.g.
         # a TPU curve recorded by a previous run)
         import os
@@ -404,7 +532,7 @@ def main():
             try:
                 with open(args.out) as f:
                     merged = json.load(f)
-                merged.setdefault("extra", {})["prefix_cache"] = prefix_cache
+                merged.setdefault("extra", {}).update(mergeable)
             except ValueError:
                 merged = result
         with open(args.out, "w") as f:

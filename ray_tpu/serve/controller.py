@@ -281,7 +281,10 @@ class ServeController:
                         "active_slots", "waiting", "free_pages",
                         "prefix_hits", "prefix_misses", "prefix_hit_tokens",
                         "prefix_cached_pages", "prefix_shared_pages",
-                        "prefix_evictions")
+                        "prefix_evictions",
+                        "decode_block_effective", "pending_pipeline_depth",
+                        "spec_rounds", "spec_drafted_tokens",
+                        "spec_accepted_tokens")
 
         async def probe_engine(replica):
             try:

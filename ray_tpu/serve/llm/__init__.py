@@ -14,6 +14,8 @@ Public surface:
 - LLMEngine            — the engine itself (usable standalone, e.g. bench)
 - build_disagg_openai_app — prefill/decode-disaggregated application
   (prefill replicas hand KV pages to decode replicas; serve/llm/disagg.py)
+- NGramProposer         — n-gram draft proposer for speculative decoding
+  (serve/llm/spec_decode.py; enabled via LLMConfig.spec_decode_enabled)
 """
 
 from ray_tpu.serve.llm.config import LLMConfig
@@ -27,9 +29,10 @@ from ray_tpu.serve.llm.disagg import (
 from ray_tpu.serve.llm.engine import LLMEngine
 from ray_tpu.serve.llm.llm_server import LLMServer, build_llm_deployment
 from ray_tpu.serve.llm.openai_api import build_openai_app
+from ray_tpu.serve.llm.spec_decode import NGramProposer
 
 __all__ = [
     "LLMConfig", "LLMEngine", "LLMServer", "build_llm_deployment",
     "build_openai_app", "build_disagg_openai_app", "PrefillServer",
-    "DisaggLLMServer", "DecodeEngine", "prefill_only",
+    "DisaggLLMServer", "DecodeEngine", "prefill_only", "NGramProposer",
 ]

@@ -70,6 +70,26 @@ class LLMConfig:
     # either way, so the pool can never be starved by the cache)
     prefix_cache_max_pages: int = 0
 
+    # Speculative decoding (n-gram draft + batched verify-k): greedy slots
+    # whose recent tokens end with an n-gram seen earlier in their own
+    # prompt+output get up to spec_draft_len tokens drafted for free
+    # (prompt lookup — no draft model), and ONE fused verify program
+    # scores the whole batch's drafts against the paged KV in a single
+    # dispatch. Accepted tokens are bit-identical to ordinary greedy
+    # decode (the verify pass computes the same logits step-by-step);
+    # rejected drafts roll seq_lens back with no page traffic. Wins on
+    # repetitive/long outputs; costs one wasted lane-step per rejected
+    # token, so it is off by default. Disabled automatically on the
+    # disagg prefill tier (no decode loop there — same bypass-by-decision
+    # as the prefix cache); decode-side disagg engines support it.
+    spec_decode_enabled: bool = False
+    # drafted tokens per verify round (k). The verify program runs k+1
+    # fused steps, so each round emits 1..k+1 tokens; k is static to the
+    # compiled program (one verify program per bucket width).
+    spec_draft_len: int = 4
+    # longest suffix n-gram used for the lookup (longer match first)
+    spec_ngram_max: int = 3
+
     # sampling defaults (overridable per request)
     max_tokens: int = 128
     temperature: float = 0.0          # 0 = greedy

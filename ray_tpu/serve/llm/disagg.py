@@ -57,6 +57,18 @@ def _disable_prefix_cache(cfg: LLMConfig) -> LLMConfig:
     return dataclasses.replace(cfg, prefix_cache_enabled=False)
 
 
+def _disable_spec_decode(cfg: LLMConfig) -> LLMConfig:
+    """Prefill replicas run with speculative decoding OFF by decision
+    (same pattern as the prefix cache): a prefill engine never enters the
+    decode loop, so a verify-k program would only waste warmup compile
+    time there. DECODE engines keep the caller's setting — handed-off
+    requests satisfy the spec path's length invariant (seq_len ==
+    prompt + generated - 1) exactly like locally prefilled ones."""
+    if not cfg.spec_decode_enabled:
+        return cfg
+    return dataclasses.replace(cfg, spec_decode_enabled=False)
+
+
 # ---------------------------------------------------------------------------
 # prefill side
 # ---------------------------------------------------------------------------
@@ -249,8 +261,10 @@ class PrefillServer:
         if isinstance(llm_config, dict):
             llm_config = LLMConfig(**llm_config)
         self.cfg = llm_config
-        # loop NOT started; prefix cache off (module docstring)
-        self.engine = LLMEngine(_disable_prefix_cache(llm_config))
+        # loop NOT started; prefix cache + spec decode off (module
+        # docstring / _disable_spec_decode)
+        self.engine = LLMEngine(
+            _disable_spec_decode(_disable_prefix_cache(llm_config)))
 
     def prefill(self, prompt, sampling: dict) -> dict:
         return prefill_only(

@@ -59,6 +59,12 @@ class _Request:
     # cancelled/shed while mid chunked prefill: the loop frees slot+pages
     # promptly via _abort_prefilling instead of finishing the prompt pass
     prefill_cancelled: bool = False
+    # speculative decoding: per-request n-gram proposer (spec_decode.py),
+    # created lazily on the first draft attempt; spec_inflight marks a slot
+    # with an unharvested verify round so the decode path never dispatches
+    # it concurrently (its device seq_len is k+1 ahead until rollback)
+    spec: Any = None
+    spec_inflight: bool = False
     drained_upto: int = 0
     done: bool = False
     error: Optional[str] = None
@@ -131,7 +137,26 @@ class LLMEngine:
         self.stats = {"steps": 0, "prefills": 0, "tokens_out": 0,
                       "requests": 0, "shed_expired": 0, "compile_s": 0.0,
                       "prefix_hits": 0, "prefix_misses": 0,
-                      "prefix_hit_tokens": 0}
+                      "prefix_hit_tokens": 0,
+                      "spec_rounds": 0, "spec_drafted_tokens": 0,
+                      "spec_accepted_tokens": 0}
+        # Speculative decoding (spec_decode.py + the verify-k program
+        # below): host-side n-gram drafts verified k-at-a-time in one
+        # fused dispatch. Greedy-only guarantee — non-greedy slots are
+        # never drafted and ride the normal decode path.
+        self._spec_on = bool(cfg.spec_decode_enabled)
+        # last decode-block k actually dispatched + live pipeline depth
+        # (engine_stats gauges: the k=1/pressure/full tier transitions are
+        # observable instead of inferred from throughput wiggles)
+        self._last_block = 0
+        # Probe ONCE whether this jax exposes Array.is_ready(): the old
+        # per-call AttributeError fallback silently returned False forever,
+        # disabling eager harvest for the whole process on older jax. With
+        # no readiness API the loop instead runs a bounded harvest (see
+        # _loop): pop the oldest block while at least one newer block is
+        # already dispatched behind it on the ordered device stream.
+        self._is_ready_supported = hasattr(
+            jnp.zeros((), jnp.int32), "is_ready")
         # Pipelined decode (vLLM-style async token processing, re-shaped for
         # a REMOTE chip): each step's input tokens are the previous step's
         # on-device output, so steps dispatch back-to-back without a host
@@ -166,6 +191,15 @@ class LLMEngine:
             lambda params, kv, pt, sl, toks, rng, temp, idx, n:
             self._decode_impl(params, kv, pt, sl, toks, rng, temp, idx, n),
             donate_argnums=(1, 3, 4), static_argnums=(8,))
+        # verify-k (speculative decoding): same packed-width shape as
+        # _decode, but the scan consumes the DRAFTED tokens instead of its
+        # own samples; the draft length is static via drafts.shape — one
+        # verify program per bucket width, ever.
+        self._verify = jax.jit(
+            lambda params, kv, pt, sl, toks, rng, temp, idx, drafts:
+            self._verify_impl(params, kv, pt, sl, toks, rng, temp, idx,
+                              drafts),
+            donate_argnums=(1, 3, 4))
         self._prefill_cache: dict[int, Any] = {}
         # Slot-state patches run at ONE fixed shape (B+1 rows, trash-row
         # padded) through these jitted fns. Eager .at[idx].set() with a
@@ -220,6 +254,55 @@ class LLMEngine:
         trash = self.cfg.max_batch_size
         sl_full = sl_full.at[idx].set(jnp.where(idx == trash, 0, new_lens))
         toks_full = toks_full.at[idx].set(last)
+        return all_toks, toks_full, kv, sl_full, rng
+
+    def _verify_impl(self, params, kv, pt_full, sl_full, toks_full, rng,
+                     temps_full, idx, drafts):
+        """Verify-k program (speculative decoding): k+1 token positions
+        per slot — the current token followed by its k drafted tokens —
+        scored in ONE fused multi-position pass (paged_verify_step) at the
+        packed width W. logits[t] match what sequential decode would
+        compute after consuming the first t draft tokens, so with greedy
+        sampling output s[t] is bit-identical to baseline decode: the host
+        accepts the longest prefix with drafts[t] == s[t] and emits
+        s[:a+1] — one guaranteed token (s[0]) plus up to k free ones. The
+        per-layer paged-cache read happens once per ROUND instead of once
+        per token, which is the speedup (decode is memory-bound).
+
+        Rejected tail positions wrote junk KV past the accepted length;
+        the host rolls seq_lens back (dirty-slot patch), and because
+        decode positions are always >= the prompt length those writes land
+        in the slot's own suffix pages — never in shared prefix-cache
+        pages — and are overwritten before any later step can attend to
+        them. drafts: [W, k] int32 (-1 pads lanes/short drafts; -1 never
+        equals a sampled token so padding can't be accepted, and junk
+        from padded positions is causally invisible to earlier positions).
+        Sampling uses one rng split for all positions — only greedy slots
+        are ever drafted (_propose_locked), where sampling is argmax.
+        Returns all samples [k+1, W] plus the carried full-size state."""
+        jax = self._jax
+        jnp = self._jnp
+        pt = pt_full[idx]
+        lens0 = sl_full[idx]
+        temps = temps_full[idx]
+        tokens = jnp.concatenate(
+            [toks_full[idx][:, None], drafts.astype(jnp.int32)], axis=1)
+        rng, sub = jax.random.split(rng)
+        logits, kv, new_lens = self._kvc.paged_verify_step(
+            params, kv, pt, lens0, tokens, self.model_cfg,
+            self.cfg.page_size)
+        t = tokens.shape[1]
+        out = self._kvc.sample_tokens(
+            logits.reshape(-1, logits.shape[-1]), sub,
+            jnp.repeat(temps, t), self.cfg.top_k).reshape(-1, t)
+        all_toks = jnp.swapaxes(out, 0, 1)                  # [k+1, W]
+        # scattered lens are k+1 past the truth for every rejected draft;
+        # the harvest marks every participating slot dirty with the
+        # rolled-back length, so this value is never read by a later
+        # dispatch. Trash row pinned to zero as in _decode_impl.
+        trash = self.cfg.max_batch_size
+        sl_full = sl_full.at[idx].set(jnp.where(idx == trash, 0, new_lens))
+        toks_full = toks_full.at[idx].set(all_toks[-1])
         return all_toks, toks_full, kv, sl_full, rng
 
     def _prefill_fn(self, bucket: int):
@@ -300,14 +383,28 @@ class LLMEngine:
         toks = self._dev_tokens
         if toks is None:
             toks = jnp.zeros((self.cfg.max_batch_size + 1,), jnp.int32)
+        tiers = {1, max(1, min(self.cfg.pressure_decode_block,
+                               self.cfg.decode_block)),
+                 self.cfg.decode_block}
+        if self._spec_on:
+            # the spec-capped idle tier (_select_block) dispatches too
+            tiers.add(min(self.cfg.decode_block,
+                          max(1, self.cfg.spec_draft_len)))
         for w in widths:
             idx = jnp.full((w,), trash, jnp.int32)
-            for k in {1, max(1, min(self.cfg.pressure_decode_block,
-                                    self.cfg.decode_block)),
-                      self.cfg.decode_block}:
+            for k in tiers:
                 _all, toks, self.kv, self._sl_dev, self._rng = self._decode(
                     self.params, self.kv, self._pt_dev, self._sl_dev,
                     toks, self._rng, self._temps_dev, idx, k)
+            if self._spec_on:
+                # the verify-k program per width too: an uncompiled verify
+                # stalls the first speculative round mid-traffic exactly
+                # like an uncompiled decode block would
+                drafts = jnp.full((w, self.cfg.spec_draft_len), -1,
+                                  jnp.int32)
+                _all, toks, self.kv, self._sl_dev, self._rng = self._verify(
+                    self.params, self.kv, self._pt_dev, self._sl_dev,
+                    toks, self._rng, self._temps_dev, idx, drafts)
         # the fixed-shape slot patches (all-trash write of zeros is a no-op)
         didx = jnp.full((trash + 1,), trash, jnp.int32)
         self._pt_dev, self._sl_dev, self._temps_dev = self._patch_state(
@@ -495,7 +592,18 @@ class LLMEngine:
         # autoscaling under-counts
         out = {**self.stats, "active_slots": active,
                "waiting": waiting + prefilling, "prefilling": prefilling,
-               "free_pages": self.allocator.available()}
+               "free_pages": self.allocator.available(),
+               # gauges: the decode-block tier actually dispatched last
+               # (1 / pressure_decode_block / decode_block — admission
+               # pressure made visible) and the live dispatched-but-
+               # unharvested block count (vs cfg.pipeline_depth)
+               "decode_block_effective": self._last_block,
+               "pending_pipeline_depth": len(self._pending)}
+        if self._spec_on:
+            d = self.stats["spec_drafted_tokens"]
+            out["spec_accept_rate"] = (
+                round(self.stats["spec_accepted_tokens"] / d, 4) if d
+                else 0.0)
         if self._prefix_cache_on:
             cs = self.allocator.cache_stats()
             out.update({"prefix_cached_pages": cs["cached_pages"],
@@ -517,8 +625,16 @@ class LLMEngine:
             # Eager harvest: pop every block whose device result already
             # landed (is_ready) — holding computed tokens unharvested just
             # adds their age to TTFT/ITL. The blocking PIPELINE_DEPTH trim
-            # in _step still bounds the queue when results are slow.
-            while self._pending and self._ready(self._pending[0][0]):
+            # in _step still bounds the queue when results are slow. On
+            # jax without a readiness API (probed once at init), fall back
+            # to a BOUNDED harvest: pop the oldest block while at least
+            # one newer block is dispatched behind it — the wait is
+            # bounded by work the device is already retiring, and one
+            # block stays in flight so the device never idles.
+            while self._pending and (
+                    self._ready(self._pending[0][0])
+                    or (not self._is_ready_supported
+                        and len(self._pending) > 1)):
                 self._harvest_one()
             if not dispatched:
                 if self._pending:
@@ -527,11 +643,12 @@ class LLMEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
-    @staticmethod
-    def _ready(dev_arr) -> bool:
+    def _ready(self, dev_arr) -> bool:
+        if not self._is_ready_supported:
+            return False
         try:
             return dev_arr.is_ready()
-        except AttributeError:  # older jax: no readiness API
+        except AttributeError:  # probe object mismatch; be conservative
             return False
 
     @staticmethod
@@ -784,47 +901,41 @@ class LLMEngine:
             req.done = True
             req.finished_at = time.monotonic()
 
-    def _step(self) -> bool:
-        """Dispatch one fused decode block (1..decode_block steps) without
-        waiting for its result; harvest PIPELINE_DEPTH blocks behind.
-        Device execution is a single ordered stream, so an in-flight block
-        that still references a freed slot's pages runs BEFORE any later
-        prefill that reuses them.
+    def _select_block(self) -> int:
+        """Decode-block tier for the next dispatch (lock held). k is
+        STATIC to the jitted program: only three values ever occur (1
+        while admissions wait, pressure_decode_block while requests queue
+        for slots, decode_block otherwise), so at most three programs
+        compile per width. The slot-starved middle tier trades dispatch
+        amortization for TTFT: a finishing request's stop token is
+        detected (and its slot freed for the queue) within
+        ~pipeline_depth*k steps, so big blocks at saturation hold slots
+        long past completion.
 
-        Steady-state decode is ONE jitted call with all-device arguments
-        (page tables, seq lens, temps, last tokens, rng all live on device;
-        slot admissions patch them with small eager updates). On a tunneled
-        chip every dispatch costs a round trip, so the block fusion brings
-        per-token cost to ~RTT/decode_block; block size drops to 1 while
-        admissions are pending so new requests don't wait a whole block."""
+        With speculative decoding on, the idle tier is additionally capped
+        at spec_draft_len: a draft can only continue the CURRENT head
+        token, and the engine probes for drafts once per loop iteration,
+        so long decode blocks would skip almost every draft opportunity
+        (the head lands mid-block). Verify rounds are themselves k+1 fused
+        steps, so speculation recovers the dispatch amortization the
+        shorter blocks give up — and on non-repetitive traffic the cap is
+        the documented cost of leaving the flag on."""
+        if self._admissions_blocked():
+            return 1
+        if self._waiting:
+            return max(1, min(self.cfg.pressure_decode_block,
+                              self.cfg.decode_block))
+        k = self.cfg.decode_block
+        if self._spec_on:
+            k = min(k, max(1, self.cfg.spec_draft_len))
+        return k
+
+    def _flush_slot_patches(self, dirty: dict, overrides: dict):
+        """Apply queued slot-state patches at the fixed B+1 shape (trash-
+        row padded — see the compile-stall note on _patch_state) and
+        return the patched device token vector. Shared by the decode and
+        verify-k dispatch paths; loop thread only."""
         jnp = self._jnp
-        with self._lock:
-            snapshot = [(i, i, req) for i, req in enumerate(self.slot_req)
-                        if req is not None
-                        and req.dispatched < req.max_tokens]
-            if not snapshot:
-                return False
-            # k is STATIC to the jitted program: only three values ever
-            # occur (1 while admissions wait, pressure_decode_block while
-            # requests queue for slots, decode_block otherwise), so at most
-            # three programs compile per width. Overshoot past a request's
-            # max_tokens is by-design safe: extra writes land in the slot's
-            # own tail pages or the trash page, and harvest discards them.
-            # The slot-starved middle tier trades dispatch amortization for
-            # TTFT: a finishing request's stop token is detected (and its
-            # slot freed for the queue) within ~pipeline_depth*k steps, so
-            # big blocks at saturation hold slots long past completion.
-            if self._admissions_blocked():
-                k = 1
-            elif self._waiting:
-                k = max(1, min(self.cfg.pressure_decode_block,
-                               self.cfg.decode_block))
-            else:
-                k = self.cfg.decode_block
-            dirty, self._dirty_slots = self._dirty_slots, {}
-            overrides, self._overrides = self._overrides, {}
-            for _col, _slot, req in snapshot:
-                req.dispatched += k
         trash_row = self.cfg.max_batch_size
         if dirty:
             # fixed-shape patch: pad to B+1 rows onto the trash row (whose
@@ -846,9 +957,10 @@ class LLMEngine:
         if toks is None:
             toks = jnp.zeros((self.cfg.max_batch_size + 1,), jnp.int32)
         if overrides:
-            # values are device scalars from async prefills: stacking and
-            # scattering them stays on device — no host sync. Same
-            # fixed-shape padding (trash-row writes of 0) as the state patch.
+            # values are device scalars from async prefills (or host ints
+            # from verify-round acceptance): stacking and scattering stays
+            # on device — no host sync. Same fixed-shape padding (trash-row
+            # writes of 0) as the state patch.
             if self._zero_tok is None:
                 self._zero_tok = jnp.int32(0)
             pad = (trash_row + 1) - len(overrides)
@@ -858,6 +970,46 @@ class LLMEngine:
                 [jnp.asarray(v, jnp.int32) for v in overrides.values()]
                 + [self._zero_tok] * pad)
             toks = self._patch_toks(toks, oidx, ovals)
+        return toks
+
+    def _step(self) -> bool:
+        """Dispatch the iteration's device work: a speculative verify-k
+        round for slots with drafts (spec_decode_enabled), then one fused
+        decode block for the rest."""
+        did_spec = self._spec_on and self._spec_step()
+        return self._decode_step() or did_spec
+
+    def _decode_step(self) -> bool:
+        """Dispatch one fused decode block (1..decode_block steps) without
+        waiting for its result; harvest PIPELINE_DEPTH blocks behind.
+        Device execution is a single ordered stream, so an in-flight block
+        that still references a freed slot's pages runs BEFORE any later
+        prefill that reuses them.
+
+        Steady-state decode is ONE jitted call with all-device arguments
+        (page tables, seq lens, temps, last tokens, rng all live on device;
+        slot admissions patch them with small eager updates). On a tunneled
+        chip every dispatch costs a round trip, so the block fusion brings
+        per-token cost to ~RTT/decode_block; block size drops to 1 while
+        admissions are pending so new requests don't wait a whole block."""
+        jnp = self._jnp
+        with self._lock:
+            snapshot = [(i, i, req) for i, req in enumerate(self.slot_req)
+                        if req is not None
+                        and req.dispatched < req.max_tokens
+                        and not req.spec_inflight]
+            if not snapshot:
+                return False
+            # Overshoot past a request's max_tokens is by-design safe:
+            # extra writes land in the slot's own tail pages or the trash
+            # page, and harvest discards them.
+            k = self._select_block()
+            self._last_block = k
+            dirty, self._dirty_slots = self._dirty_slots, {}
+            overrides, self._overrides = self._overrides, {}
+            for _col, _slot, req in snapshot:
+                req.dispatched += k
+        toks = self._flush_slot_patches(dirty, overrides)
         # bucketed width: pack the active slots, pad with the trash row —
         # a lightly loaded engine runs a narrow program
         active_slots = [slot for _c, slot, _r in snapshot]
@@ -877,18 +1029,181 @@ class LLMEngine:
             self._harvest_one()
         return True
 
+    # ---- speculative decoding ------------------------------------------
+    def _propose_locked(self, req: _Request) -> list[int]:
+        """Draft tokens for one slot (lock held). Greedy slots only — the
+        bit-identity guarantee is a greedy property; non-greedy slots ride
+        the normal decode path untouched. The draft is capped so a fully
+        accepted round cannot emit past max_tokens."""
+        if req.temperature != 0.0:
+            return []
+        remaining = req.max_tokens - len(req.generated)
+        if remaining <= 1:
+            return []
+        if req.spec is None:
+            from ray_tpu.serve.llm import spec_decode
+            req.spec = spec_decode.NGramProposer(
+                self.cfg.spec_ngram_max, self.cfg.spec_draft_len)
+        draft = req.spec.propose(req.prompt_tokens + req.generated)
+        return draft[: remaining - 1]
+
+    def _dispatch_verify(self, rows) -> None:
+        """Dispatch ONE verify-k round for ``rows`` of (slot, req, draft,
+        base_len) whose host state is exact (just drained or just
+        harvested). Loop thread only; lock NOT held."""
+        jnp = self._jnp
+        k = self.cfg.spec_draft_len
+        with self._lock:
+            for _slot, req, _draft, _base in rows:
+                req.spec_inflight = True
+                req.dispatched += k + 1
+            dirty, self._dirty_slots = self._dirty_slots, {}
+            overrides, self._overrides = self._overrides, {}
+        toks = self._flush_slot_patches(dirty, overrides)
+        spec_slots = [slot for slot, _r, _d, _b in rows]
+        w = self._bucket_width(len(spec_slots))
+        trash = self.cfg.max_batch_size
+        idx = jnp.asarray(
+            spec_slots + [trash] * (w - len(spec_slots)), jnp.int32)
+        draft_mat = np.full((w, k), -1, np.int32)
+        entry = []  # (col, slot, req, draft, base_len)
+        for col, (slot, req, draft, base_len) in enumerate(rows):
+            draft_mat[col, : len(draft)] = draft
+            entry.append((col, slot, req, draft, base_len))
+        all_toks, self._dev_tokens, self.kv, self._sl_dev, self._rng = \
+            self._verify(self.params, self.kv, self._pt_dev, self._sl_dev,
+                         toks, self._rng, self._temps_dev, idx,
+                         jnp.asarray(draft_mat))
+        self._start_fetch(all_toks)
+        self._pending.append((all_toks, entry, ("spec", k)))
+        self.stats["steps"] += k + 1
+
+    def _spec_step(self) -> bool:
+        """TRANSITION decode-mode slots with drafts into verify rounds.
+
+        Speculation needs the host's view of a slot to be authoritative
+        (drafts continue the slot's true token sequence, and rollback
+        needs its true cache length), so entering spec mode drains the
+        in-flight pipeline once — every entry's successors are already
+        dispatched on the ordered device stream, so those harvests are
+        bounded by work the device is retiring anyway. After that the slot
+        CHAINS drain-free: each verify harvest leaves its host state
+        exact, so _apply_verify re-proposes and dispatches the next round
+        directly, and the slot only falls back into decode blocks when a
+        draft misses. Slots without a draft are left to _decode_step in
+        the same iteration (their blocks never touch a chained slot:
+        spec_inflight excludes it from decode snapshots). A cheap
+        pre-check on the (possibly pipeline-stale) host context avoids
+        paying the drain when nothing would draft."""
+        with self._lock:
+            # gate on generated (host truth lower bound), NOT dispatched:
+            # pipelined decode runs dispatched ahead to max_tokens within a
+            # few blocks, which would silence speculation for the rest of
+            # the generation. A stale-context false positive just costs the
+            # drain (the post-drain re-propose is authoritative).
+            if not any(req is not None and not req.done
+                       and len(req.generated) < req.max_tokens
+                       and not req.spec_inflight
+                       and self._propose_locked(req)
+                       for req in self.slot_req):
+                return False
+            n = len(self._pending)
+        # drain the entries present NOW: chained verify rounds appended by
+        # these harvests belong to already-speculating slots and never
+        # reference the transitioning ones
+        for _ in range(n):
+            self._harvest_one()
+        with self._lock:
+            rows = []  # (slot, req, draft, base_len)
+            for slot, req in enumerate(self.slot_req):
+                if req is None or req.spec_inflight \
+                        or req.dispatched >= req.max_tokens:
+                    continue
+                draft = self._propose_locked(req)
+                if not draft:
+                    continue
+                # device cache length for this slot: prompt + every
+                # recorded token except the current one (which is the
+                # verify round's position-0 input). Exact because the
+                # pipeline was just drained.
+                base_len = len(req.prompt_tokens) + len(req.generated) - 1
+                rows.append((slot, req, draft, base_len))
+        if not rows:
+            return False
+        self._dispatch_verify(rows)
+        return True
+
+    def _apply_verify(self, dev_toks, rows, k: int) -> None:
+        """Record a verify round: per slot, accept the longest draft
+        prefix matching the per-position outputs, emit accepted+1 tokens
+        through _record_token (stream ordering unchanged), and roll the
+        slot's seq_len back past the rejected tail via the dirty-slot
+        patch. Rollback is pure length accounting — no allocator calls, so
+        shared prefix-cache pages are never decreffed or evicted by a
+        rejection; the junk KV past the new length sits in the slot's own
+        suffix pages and is overwritten before it can be attended.
+
+        Slots whose fresh context drafts again chain straight into the
+        next verify round (their just-harvested host state is exact — no
+        pipeline drain needed); the rest drop back to decode blocks."""
+        from ray_tpu.serve.llm import spec_decode
+        host = np.asarray(dev_toks).reshape(k + 1, -1)
+        finished: list[_Request] = []
+        chain = []  # (slot, req, draft, base_len)
+        with self._lock:
+            self.stats["spec_rounds"] += 1
+            for col, slot, req, draft, base_len in rows:
+                req.spec_inflight = False
+                outs = [int(host[s, col]) for s in range(k + 1)]
+                a = spec_decode.accept_length(draft, outs)
+                self.stats["spec_drafted_tokens"] += len(draft)
+                self.stats["spec_accepted_tokens"] += a
+                emitted = 0
+                for tok in outs[: a + 1]:
+                    if req.done:
+                        break  # stop token inside the accepted run
+                    self._record_token(req, tok)
+                    emitted += 1
+                if req.done:
+                    finished.append(req)
+                    if self.slot_req[slot] is req:
+                        self.slot_req[slot] = None
+                        self.free_slots.append(slot)
+                        self.page_tables[slot] = 0
+                        self.seq_lens[slot] = 0
+                        self._dirty_slots[slot] = (0, 0.0)
+                    continue
+                # roll back: device seq_len advanced k+1 during the round;
+                # the truth is base_len + emitted (the accepted tokens are
+                # in cache, the last emitted token is the new current one)
+                new_len = base_len + emitted
+                self.seq_lens[slot] = new_len
+                self._dirty_slots[slot] = (new_len, req.temperature)
+                self._overrides[slot] = outs[emitted - 1]
+                req.dispatched = len(req.generated)
+                nxt = self._propose_locked(req)
+                if nxt:
+                    chain.append((slot, req, nxt, new_len))
+        if chain:
+            self._dispatch_verify(chain)
+        self._finish_requests(finished)
+
     def _harvest_one(self) -> None:
         """Block on the OLDEST in-flight block's tokens and record them.
 
-        Entries are either decode blocks (tokens [k, W] at the PACKED
-        bucket width — the column is the request's position in that
-        block's packed index vector, NOT its slot id) or prefill
-        first-tokens (scalar, column 0); snapshot rows are
-        (token_column, slot, request)."""
+        Entries are decode blocks (tokens [k, W] at the PACKED bucket
+        width — the column is the request's position in that block's
+        packed index vector, NOT its slot id), prefill first-tokens
+        (scalar, column 0) with snapshot rows (token_column, slot,
+        request), or verify-k rounds (meta ("spec", k), handled by
+        _apply_verify)."""
         with self._lock:
             if not self._pending:
                 return
             dev_toks, snapshot, k = self._pending.pop(0)
+        if isinstance(k, tuple):  # ("spec", draft_len) verify round
+            self._apply_verify(dev_toks, snapshot, k[1])
+            return
         host_toks = np.asarray(dev_toks)  # sync point: oldest block only
         host_toks = host_toks.reshape(k, -1)
         finished: list[_Request] = []
@@ -909,6 +1224,11 @@ class LLMEngine:
                             # page table keeps scattering this slot's junk
                             # KV into pages after they're reallocated
                             self._dirty_slots[slot] = (0, 0.0)
+        self._finish_requests(finished)
+
+    def _finish_requests(self, finished: list[_Request]) -> None:
+        """Completion tail shared by decode and verify harvests: free
+        pages, release waiters, emit trace spans, reap abandoned."""
         for req in finished:
             self.allocator.free(req.pages)
             req.pages = []

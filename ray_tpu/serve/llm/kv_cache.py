@@ -166,6 +166,15 @@ class PageAllocator:
         with self._lock:
             return len(self._free) + len(self._lru)
 
+    def refcount(self, page: int) -> int:
+        """Current refcount of one page (0 = free or parked in the cached
+        LRU). Inspection only — used by tests that pin allocator
+        invariants, e.g. that a speculative verify-k rollback never
+        releases a reference on a shared prefix page (rollback is pure
+        seq-len accounting in the engine; no allocator call sites)."""
+        with self._lock:
+            return self._ref.get(page, 0)
+
     # ---- prefix index --------------------------------------------------
     def match_prefix(self, tokens, page_size: int) -> list[int]:
         """Longest indexed chain of FULL token pages that prefixes
@@ -345,6 +354,86 @@ def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}, seq_lens + 1
+
+
+def paged_verify_step(params, kv, page_tables, seq_lens, tokens,
+                      cfg: LlamaConfig, page_size: int):
+    """Speculative verify: T tokens per slot in ONE fused pass.
+
+    tokens: [B, T] — slot b's current token followed by its T-1 drafted
+    tokens; tokens[b, t] lands at position seq_lens[b] + t. All T
+    positions are computed together (causal within the span, full
+    attention over the paged cache), so the per-layer cache read happens
+    ONCE per round instead of once per token — the decode pass is
+    memory-bound, which is where verifying k drafts gets cheaper than k
+    decode steps. logits[b, t] equals what paged_decode_step would
+    produce after consuming tokens[b, :t+1] sequentially, which is what
+    makes greedy speculative acceptance bit-identical to baseline decode.
+
+    Uses the gather attention path on every backend: the Pallas paged-
+    attention kernel is single-query (a multi-query speculative variant
+    is the TPU follow-up), and the gather view here is [B, T, L] — T
+    times the decode fallback's traffic, bounded by small T (draft_len+1).
+    Returns (logits [B, T, vocab], new_kv, seq_lens + T).
+    """
+    b, t = tokens.shape
+    max_pages = page_tables.shape[1]
+    max_len = max_pages * page_size
+
+    x = params["embed"][tokens].astype(cfg.dtype)                 # [B,T,D]
+    pos = seq_lens[:, None] + jnp.arange(t)[None, :]              # [B,T]
+    cos, sin = rope_freqs(cfg, pos)
+    page_idx = jnp.take_along_axis(page_tables, pos // page_size,
+                                   axis=1)                        # [B,T]
+    offset = pos % page_size
+    kpos = jnp.arange(max_len)                                    # [L]
+    # position t sees cache + the span's tokens 0..t (its own write)
+    valid = kpos[None, None, :] <= pos[:, :, None]                # [B,T,L]
+    sm = cfg.head_dim ** -0.5
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+
+    def body(carry, inputs):
+        (x,) = carry
+        layer, k_cache, v_cache = inputs
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wq"])
+        k = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wk"])
+        v = jnp.einsum("btd,dhk->bthk", h, layer["attn"]["wv"])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # write all T tokens' k/v, then attend through the paged view —
+        # same write-then-gather shape as paged_prefill_chunk, batched.
+        # Distinct slots write distinct pages and distinct t distinct
+        # offsets, so the scatter is conflict-free for real slots.
+        k_cache = k_cache.at[:, page_idx, offset].set(
+            jnp.moveaxis(k, 2, 0).astype(k_cache.dtype))
+        v_cache = v_cache.at[:, page_idx, offset].set(
+            jnp.moveaxis(v, 2, 0).astype(v_cache.dtype))
+        k_seq = jnp.moveaxis(
+            jnp.take(k_cache, page_tables, axis=1), 0, 3).reshape(
+            b, max_len, cfg.n_kv_heads, cfg.head_dim)
+        v_seq = jnp.moveaxis(
+            jnp.take(v_cache, page_tables, axis=1), 0, 3).reshape(
+            b, max_len, cfg.n_kv_heads, cfg.head_dim)
+        k_full = _gqa_expand(k_seq, n_rep)
+        v_full = _gqa_expand(v_seq, n_rep)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
+            jnp.float32) * sm
+        logits = jnp.where(valid[:, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
+        up = h2 @ layer["mlp"]["w_up"]
+        x = x + (gate * up) @ layer["mlp"]["w_down"]
+        return (x,), (k_cache, v_cache)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        body, (x,), (params["layers"], kv["k"], kv["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)          # [B,T,V]
+    return logits, {"k": new_k, "v": new_v}, seq_lens + t
 
 
 def paged_prefill(params, kv, page_table, tokens, true_len,

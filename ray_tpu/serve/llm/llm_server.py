@@ -26,7 +26,9 @@ _EXPORTED_STATS = (
     "active_slots", "waiting", "prefilling", "free_pages",
     "prefix_hits", "prefix_misses", "prefix_hit_tokens",
     "prefix_hit_pages", "prefix_cached_pages", "prefix_evictable_pages",
-    "prefix_shared_pages", "prefix_evictions", "prefix_inserted_pages")
+    "prefix_shared_pages", "prefix_evictions", "prefix_inserted_pages",
+    "decode_block_effective", "pending_pipeline_depth",
+    "spec_rounds", "spec_drafted_tokens", "spec_accepted_tokens")
 
 
 def _export_engine_stats(model_id: str, stats: dict) -> None:

@@ -438,3 +438,59 @@ def test_prefix_cache_chaos_stress():
         assert eng._decode._cache_size() <= 3  # the three block lengths
     finally:
         eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: speculative verify-k rollback vs shared prefix pages
+# ---------------------------------------------------------------------------
+
+
+def test_spec_rollback_never_evicts_or_decrefs_shared_prefix_pages():
+    """A verify-k round that rejects drafted tokens rolls the slot's
+    seq_len back with PURE length accounting — no allocator calls — so a
+    rejection can never release a reference on (or evict) a shared prefix
+    page. Junk KV from the rejected tail lands past the prompt length, in
+    the slot's own suffix pages, never in the indexed prompt pages."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    prompt = PROMPT + " " + PROMPT  # 87 byte tokens -> 5 full pages indexed
+    off = LLMEngine(_tiny_cfg(prefix_cache_enabled=False, max_tokens=32),
+                    rng_seed=0)
+    off.start()
+    try:
+        want = off.generate(prompt, max_tokens=32,
+                            temperature=0.0)["tokens"]
+    finally:
+        off.shutdown()
+
+    cfg = _tiny_cfg(spec_decode_enabled=True, max_tokens=32)
+    eng = LLMEngine(cfg, rng_seed=0)
+    eng.start()
+    try:
+        # warm: index the prompt's full pages, then let them park at ref 0
+        cold = eng.generate(prompt, max_tokens=32, temperature=0.0)
+        assert cold["tokens"] == want
+        shared = list(eng.allocator._page_key)
+        assert len(shared) >= 2
+        assert all(eng.allocator.refcount(p) == 0 for p in shared)
+        baseline = eng.allocator.available()
+
+        # hot: prefix hit shares the indexed pages while verify rounds
+        # run (and reject) against the same slot
+        hot = eng.generate(prompt, max_tokens=32, temperature=0.0)
+        assert hot["tokens"] == want  # identity through cache + spec
+        stats = eng.engine_stats()
+        assert stats["prefix_hits"] >= 1
+        assert stats["spec_rounds"] > 0          # verify rounds ran
+        assert stats["spec_drafted_tokens"] > \
+            stats["spec_accepted_tokens"]        # rejections happened
+        # every shared page survived: still indexed, refcount drained to
+        # zero (never negative / double-freed), nothing evicted, pool at
+        # baseline
+        for p in shared:
+            assert p in eng.allocator._page_key
+            assert eng.allocator.refcount(p) == 0
+        assert eng.allocator.counters["evicted"] == 0
+        assert eng.allocator.available() == baseline
+    finally:
+        eng.shutdown()

@@ -384,3 +384,83 @@ def test_engine_sheds_expired_waiting_request():
     eng._shed_expired_waiting()
     assert len(eng._waiting) == 1  # still queued, not shed
     eng.cancel(rid2)
+
+
+def test_decode_block_tier_selection():
+    """_select_block's three tiers: admissions blocked (waiting + free
+    slots, or a chunked prefill mid-flight) -> 1; slot-starved (waiting,
+    no free slots) -> pressure_decode_block; idle -> decode_block."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_tiny_cfg(decode_block=8, pressure_decode_block=2),
+                    rng_seed=0)
+    assert eng._select_block() == 8          # idle: full block
+    eng._waiting = [object()]
+    assert eng._select_block() == 1          # waiting + free slots
+    eng.free_slots = []
+    assert eng._select_block() == 2          # slot-starved: pressure tier
+    eng._waiting = []
+    eng._prefilling = [object()]
+    assert eng._select_block() == 1          # chunked prefill mid-flight
+    eng._prefilling = []
+    assert eng._select_block() == 8          # back to idle
+
+    # pressure tier clamps to decode_block (a misconfigured larger value
+    # must not out-dispatch the idle tier)
+    big = LLMEngine(_tiny_cfg(decode_block=4, pressure_decode_block=16),
+                    rng_seed=0)
+    big._waiting = [object()]
+    big.free_slots = []
+    assert big._select_block() == 4
+
+    # spec decode caps the idle tier at spec_draft_len (draft probing
+    # happens between blocks; see _select_block docstring)
+    spec = LLMEngine(_tiny_cfg(decode_block=8, spec_decode_enabled=True,
+                               spec_draft_len=4), rng_seed=0)
+    assert spec._select_block() == 4
+
+
+def test_bucket_width_padding():
+    """_bucket_width packs active slots into power-of-two widths with a
+    floor of 4, capped at max_batch_size."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_tiny_cfg(max_batch_size=16, num_pages=96), rng_seed=0)
+    assert eng._bucket_width(1) == 4    # floor
+    assert eng._bucket_width(4) == 4
+    assert eng._bucket_width(5) == 8
+    assert eng._bucket_width(9) == 16
+    assert eng._bucket_width(16) == 16  # cap == max_batch_size
+
+    small = LLMEngine(_tiny_cfg(max_batch_size=3), rng_seed=0)
+    assert small._bucket_width(2) == 3  # cap below the floor
+    assert small._bucket_width(3) == 3
+
+
+def test_engine_serves_without_is_ready_api():
+    """Satellite regression: on jax builds without Array.is_ready() the
+    engine must fall back to a BOUNDED harvest (pop the oldest block while
+    a newer one is in flight), not silently disable eager harvest — and
+    outputs stay identical."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    want_eng = LLMEngine(_tiny_cfg(max_tokens=16), rng_seed=0)
+    want_eng.start()
+    try:
+        want = want_eng.generate("fallback probe", max_tokens=16,
+                                 temperature=0.0)["tokens"]
+    finally:
+        want_eng.shutdown()
+
+    eng = LLMEngine(_tiny_cfg(max_tokens=16), rng_seed=0)
+    eng._is_ready_supported = False  # simulate the probe failing
+    assert eng._ready(object()) is False  # never touches the array
+    eng.start()
+    try:
+        rids = [eng.submit("fallback probe", max_tokens=16,
+                           temperature=0.0) for _ in range(3)]
+        outs = [eng.result(r, timeout=120.0) for r in rids]
+        assert all(o["error"] is None for o in outs)
+        assert all(o["tokens"] == want for o in outs)
+    finally:
+        eng.shutdown()
