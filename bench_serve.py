@@ -87,6 +87,10 @@ def main():
                          "hard-asserts token identity, reports accepted "
                          "draft tokens per verify round; merges the result "
                          "into --out")
+    ap.add_argument("--profile-ab", action="store_true",
+                    help="A/B the engine phase timers (profiling_enabled "
+                         "on vs off) on the headline point; exits nonzero "
+                         "if the p50 TTFT overhead exceeds noise")
     ap.add_argument("--metrics-ab", action="store_true",
                     help="A/B the built-in metrics pipeline: rerun the "
                          "headline point with metrics_enabled=False on a "
@@ -315,6 +319,46 @@ def main():
                 f"metrics pipeline overhead out of bounds: p50 TTFT "
                 f"+{delta_ms}ms with the flusher on (tolerance {tol_ms}ms)")
 
+    # phase-timer A/B (ISSUE 6): the headline point ran with the engine
+    # profiler on (the default); redeploy the same engine with
+    # profiling_enabled=False and bound the p50 TTFT cost of the timers.
+    # Same noise-sized tolerance as the metrics A/B: on cpu-tiny the
+    # run-to-run spread dwarfs a few perf_counter calls per loop pass.
+    profiling_overhead = None
+    if args.profile_ab:
+        import dataclasses as _dc
+
+        serve.shutdown()
+        app = build_openai_app(
+            _dc.replace(llm_cfg, profiling_enabled=False),
+            route_prefix="/v1")
+        serve.run(app, name="llm-bench-noprof", route_prefix="/v1")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}/v1/completions"
+        _post(base, {"prompt": prompt, "max_tokens": 4})
+        _post_stream(base, {"prompt": prompt, "max_tokens": 4})
+        off_row = run_point(args.concurrency, args.requests,
+                            label="phase_timers_off")
+        points.append(off_row)
+        delta_ms = round(head["p50_ttft_ms"] - off_row["p50_ttft_ms"], 2)
+        tol_ms = round(max(0.25 * off_row["p50_ttft_ms"], 30.0), 2)
+        profiling_overhead = {
+            "timers_on": {k: head[k] for k in
+                          ("p50_ttft_ms", "p90_ttft_ms", "req_per_s",
+                           "proxy_cpu_share")},
+            "timers_off": {k: off_row[k] for k in
+                           ("p50_ttft_ms", "p90_ttft_ms", "req_per_s",
+                            "proxy_cpu_share")},
+            "p50_delta_ms": delta_ms,
+            "tolerance_ms": tol_ms,
+            "within_noise": delta_ms <= tol_ms,
+        }
+        if not profiling_overhead["within_noise"]:
+            print(json.dumps({"profiling_overhead": profiling_overhead}))
+            raise SystemExit(
+                f"phase-timer overhead out of bounds: p50 TTFT "
+                f"+{delta_ms}ms with profiling on (tolerance {tol_ms}ms)")
+
     # shared_prefix_1024: every request carries the same 1024-token prefix
     # (system prompt) plus a short unique suffix — the workload automatic
     # prefix caching exists for. Measured cache-on against the live app,
@@ -520,6 +564,8 @@ def main():
     }
     if metrics_overhead is not None:
         result["extra"]["metrics_overhead"] = metrics_overhead
+    if profiling_overhead is not None:
+        result["extra"]["profiling_overhead"] = profiling_overhead
     mergeable = {"prefix_cache": prefix_cache, "spec_decode": spec_decode}
     mergeable = {k: v for k, v in mergeable.items() if v is not None}
     if mergeable:

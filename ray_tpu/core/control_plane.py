@@ -156,7 +156,9 @@ class ControlPlane:
         self._restore()
         self._server = RpcServer(
             self._handle, host=host, port=port, name="controlplane",
-            blocking_methods={"resolve_actor", "pg_ready", "get_actor_by_name", "pubsub_poll"},
+            blocking_methods={"resolve_actor", "pg_ready", "get_actor_by_name", "pubsub_poll",
+                              "profiling_start", "profiling_stop",
+                              "save_device_memory_profile"},
             pool_size=16)
         self.addr = self._server.addr
         self._sched_thread = threading.Thread(
@@ -769,18 +771,14 @@ class ControlPlane:
     def _h_metrics_dump(self, body):
         """Aggregatable snapshot for scrapers: CP system gauges + latest
         stored series (minus `exclude_sources` — a scraper co-resident with
-        a flusher substitutes its own fresher local registry) + legacy
-        liveness-filtered KV exposition blobs."""
+        a flusher substitutes its own fresher local registry). Every
+        producer reports through the flusher pipeline now — the legacy
+        `metrics:<worker>` KV exposition blobs are gone."""
         exclude = set((body or {}).get("exclude_sources") or ())
         with self._lock:
             dicts = (self._cp_state_dicts_locked()
                      + self._metrics_dump_locked(exclude))
-            kv_text = [v.decode() if isinstance(v, bytes) else str(v)
-                       for k, v in sorted(self._kv.items())
-                       if k.startswith("metrics:")
-                       and k.split(":", 1)[1] not in self._dead_workers
-                       and k.split(":", 1)[1] not in exclude]
-        return {"metrics": dicts, "kv_text": kv_text}
+        return {"metrics": dicts}
 
     def _h_get_metrics(self, body):
         """Prometheus exposition of cluster metrics: CP-derived gauges +
@@ -788,9 +786,92 @@ class ControlPlane:
         buckets merged across workers — duplicate series never emitted;
         ref: stats/metric_defs.cc + dashboard/modules/metrics/)."""
         dump = self._h_metrics_dump(body)
-        text = _metrics.render_exposition(dump["metrics"])
-        parts = [text] + dump["kv_text"]
-        return "\n".join(p.strip("\n") for p in parts if p) + "\n"
+        return _metrics.render_exposition(dump["metrics"])
+
+    # ---- on-demand profiling (observability/profiling.py) -------------
+    def _profiling_targets(self, node_sel) -> list:
+        """(node_hex, agent_addr) for the selected node — full or prefix
+        hex id — or every alive node when no selector is given."""
+        with self._lock:
+            nodes = [(n.view.node_id.hex(), n.view.addr)
+                     for n in self._nodes.values() if n.view.alive]
+        if not node_sel:
+            return nodes
+        sel = str(node_sel)
+        hits = [t for t in nodes if t[0].startswith(sel)]
+        if not hits:
+            raise ValueError(f"no alive node matches id '{sel}'")
+        return hits
+
+    def _profiling_fanout(self, method: str, body) -> dict:
+        """Forward a profiling RPC to the selected node agents (they fan
+        out to their workers). Runs nested RPCs — registered in
+        blocking_methods so a slow capture never parks the CP's shared
+        handler pool."""
+        body = body or {}
+        targets = self._profiling_targets(body.get("node_id"))
+        fwd = {k: v for k, v in body.items() if k != "node_id"}
+        out = {}
+        for nhex, addr in targets:
+            try:
+                out[nhex] = self._pool.get(tuple(addr)).call(
+                    method, fwd, timeout=60.0, connect_timeout=3.0)
+            except Exception as e:  # noqa: BLE001 - report per node
+                out[nhex] = {"ok": False, "error": repr(e)}
+        return out
+
+    def _h_profiling_start(self, body):
+        """Start an XPlane capture on the selected node(s)' workers
+        (`ray-tpu profile` / dashboard `/api/profile?node=`)."""
+        return {"nodes": self._profiling_fanout("profiling_start", body)}
+
+    def _h_profiling_stop(self, body):
+        """Stop the captures and REGISTER each produced trace as a
+        `profile_artifact:<id>` KV entry (node, worker, pid, logdir,
+        duration) — the dashboard lists and serves these."""
+        import json
+        import uuid
+
+        nodes = self._profiling_fanout("profiling_stop", body)
+        artifacts = []
+        for nhex, nres in nodes.items():
+            workers = (nres.get("workers") or {}) \
+                if isinstance(nres, dict) else {}
+            for wid, wres in workers.items():
+                if not (isinstance(wres, dict) and wres.get("ok")
+                        and wres.get("logdir")):
+                    continue
+                art = {"id": uuid.uuid4().hex[:12], "kind": "xplane",
+                       "node_id": nhex, "worker_id": wid,
+                       "pid": wres.get("pid"), "logdir": wres["logdir"],
+                       "duration_s": wres.get("duration_s"),
+                       "ts": time.time()}
+                self._h_kv_put({"key": f"profile_artifact:{art['id']}",
+                                "value": json.dumps(art).encode()})
+                artifacts.append(art)
+        return {"nodes": nodes, "artifacts": artifacts}
+
+    def _h_save_device_memory_profile(self, body):
+        """Device-memory (pprof) dump on the selected node(s)' workers."""
+        return {"nodes": self._profiling_fanout(
+            "save_device_memory_profile", body)}
+
+    def _h_list_profile_artifacts(self, body):
+        """Registered capture artifacts, newest first."""
+        import json
+
+        with self._lock:
+            raw = [v for k, v in self._kv.items()
+                   if k.startswith("profile_artifact:")]
+        out = []
+        for v in raw:
+            try:
+                out.append(json.loads(
+                    v.decode() if isinstance(v, bytes) else v))
+            except Exception:  # noqa: BLE001 - skip corrupt entries
+                continue
+        out.sort(key=lambda a: a.get("ts") or 0, reverse=True)
+        return out
 
     # ---- actors -------------------------------------------------------
     def _h_create_actor(self, body):
@@ -850,15 +931,14 @@ class ControlPlane:
     def _h_worker_died(self, body):
         """Reported by a node agent (ref: GcsActorManager::OnWorkerDead).
         Besides actor failover, a dead worker's metric series are retracted
-        and its legacy `metrics:<wid>` KV blob GC'd — a scrape must never
-        keep serving a gone process's series."""
+        — a scrape must never keep serving a gone process's series — and
+        late flusher reports from it are rejected (_dead_workers)."""
         wid = body.get("worker_id")
         if wid is not None:
             whex = wid.hex() if hasattr(wid, "hex") else str(wid)
             with self._lock:
                 self._dead_workers.add(whex)
                 self._retract_metrics_source(whex)
-                self._h_kv_del({"key": f"metrics:{whex}"})
         aid = body.get("actor_id")
         if aid is not None:
             self._on_actor_down(aid, body.get("reason", "worker died"), clean=False)
@@ -1338,7 +1418,6 @@ class ControlPlane:
                 self._retract_metrics_source(src)
                 if not src.startswith("node:"):
                     self._dead_workers.add(src)
-                    self._h_kv_del({"key": f"metrics:{src}"})
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("node", {"event": "dead", "node_id": node_id})
         for aid in victims:

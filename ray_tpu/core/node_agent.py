@@ -370,6 +370,51 @@ class NodeAgent:
                     out[f"worker-{wid}"] = text
         return out
 
+    def _fanout_workers(self, method: str, body, timeout: float) -> dict:
+        """Call ``method`` on every registered worker with an RPC address
+        (same shape as _h_dump_node_stacks: concurrent, per-worker budget,
+        unreachable workers reported instead of failing the node)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._lock:
+            targets = [(w.hex(), i.addr) for w, i in
+                       self._workers.items() if i.addr is not None]
+
+        def probe(item):
+            wid, addr = item
+            try:
+                return wid, self._pool.get(tuple(addr)).call(
+                    method, body, timeout=timeout, connect_timeout=2.0)
+            except Exception as e:  # noqa: BLE001
+                return wid, {"ok": False, "error": repr(e)}
+
+        out: dict[str, dict] = {}
+        if targets:
+            with ThreadPoolExecutor(max_workers=min(16, len(targets))) as ex:
+                for wid, res in ex.map(probe, targets):
+                    out[wid] = res
+        return out
+
+    def _h_profiling_start(self, body):
+        """Start an XPlane capture on every worker process of this node
+        (the per-node hop of the cluster-wide `ray-tpu profile` path)."""
+        return {"node_id": self.node_id.hex(),
+                "workers": self._fanout_workers(
+                    "profiling_start", body or {}, timeout=15.0)}
+
+    def _h_profiling_stop(self, body):
+        """Stop the active captures; per-worker results carry the trace
+        logdirs the caller registers as artifacts."""
+        return {"node_id": self.node_id.hex(),
+                "workers": self._fanout_workers(
+                    "profiling_stop", body or {}, timeout=30.0)}
+
+    def _h_save_device_memory_profile(self, body):
+        """Device-memory (pprof) dump on every worker of this node."""
+        return {"node_id": self.node_id.hex(),
+                "workers": self._fanout_workers(
+                    "save_device_memory_profile", body or {}, timeout=30.0)}
+
     # ---- worker pool ---------------------------------------------------
     def _spawn_inproc_worker(self, for_tpu: bool,
                              runtime_env: dict | None) -> _WorkerInfo:

@@ -1095,6 +1095,43 @@ class WorkerRuntime:
         return {"worker_id": self.worker_id.hex(), "pid": os.getpid(),
                 "stacks": dump_thread_stacks()}
 
+    def _h_profiling_start(self, body):
+        """Begin an XPlane (jax.profiler) capture in THIS process — the
+        leaf of the cluster-wide `ray-tpu profile` fan-out (CP → node
+        agent → worker). One capture per process at a time; a concurrent
+        start reports the error instead of corrupting the active run."""
+        from ray_tpu.observability import profiling
+        try:
+            info = profiling.start_capture((body or {}).get("logdir"))
+            return {"ok": True, "worker_id": self.worker_id.hex(), **info}
+        except Exception as e:  # noqa: BLE001 - report, don't kill the RPC
+            return {"ok": False, "worker_id": self.worker_id.hex(),
+                    "pid": os.getpid(), "error": repr(e)}
+
+    def _h_profiling_stop(self, body):
+        """End the active XPlane capture; returns the trace logdir (the
+        artifact the CP registers and the dashboard serves)."""
+        from ray_tpu.observability import profiling
+        try:
+            info = profiling.stop_capture()
+            return {"ok": True, "worker_id": self.worker_id.hex(), **info}
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "worker_id": self.worker_id.hex(),
+                    "pid": os.getpid(), "error": repr(e)}
+
+    def _h_save_device_memory_profile(self, body):
+        """Dump this process's device (HBM) memory profile — the remote
+        'why is replica 3 OOMing' tool."""
+        from ray_tpu.observability import profiling
+        try:
+            path = profiling.save_device_memory_profile(
+                (body or {}).get("path"))
+            return {"ok": True, "worker_id": self.worker_id.hex(),
+                    "pid": os.getpid(), "path": path}
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "worker_id": self.worker_id.hex(),
+                    "pid": os.getpid(), "error": repr(e)}
+
     def _h_inc_borrow(self, body):
         if isinstance(body, dict):
             self.reference_counter.inc_borrow(

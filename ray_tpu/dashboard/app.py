@@ -23,6 +23,7 @@ _INDEX = """<!doctype html>
 <div>
  <button onclick="profile()">profile cluster (3s)</button>
  <span id="profstatus"></span>
+ · <a href="/profiling">engine profiling &amp; XProf captures</a>
 </div>
 <pre id="profout" style="max-height:300px;overflow:auto;background:#f7f7f7"></pre>
 <div id="charts"></div>
@@ -406,6 +407,11 @@ class Dashboard:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/api/node/{node_id}", self._node_detail)
         app.router.add_get("/api/profile", self._profile)
+        app.router.add_get("/api/profile/artifacts",
+                           self._profile_artifacts)
+        app.router.add_get("/api/profile/download/{artifact_id}",
+                           self._profile_download)
+        app.router.add_get("/profiling", self._profiling_view)
         app.router.add_get("/api/trace/{trace_id}", self._trace_detail)
         app.router.add_get("/trace/{trace_id}", self._trace_view)
         app.router.add_get("/api/metrics/query", self._metrics_query)
@@ -450,10 +456,8 @@ class Dashboard:
             dump = rt.cp_client.call_with_retry(
                 "metrics_dump", {"exclude_sources": exclude}, timeout=10.0)
             if dump is None:
-                dump = {"metrics": [], "kv_text": []}
-            parts = [_m.render_exposition(dump["metrics"] + local)]
-            parts.extend(dump.get("kv_text") or ())
-            return "\n".join(p.strip("\n") for p in parts if p) + "\n"
+                dump = {"metrics": []}
+            return _m.render_exposition(dump["metrics"] + local)
 
         text = await loop.run_in_executor(None, fetch)
         return web.Response(text=text, content_type="text/plain")
@@ -611,10 +615,17 @@ class Dashboard:
                             content_type="text/html")
 
     async def _profile(self, request):
-        """On-demand sampling profile (reference: dashboard/modules/
-        reporter/profile_manager.py py-spy endpoints): repeatedly snapshot
-        cluster (or one worker's) stacks for ``duration`` seconds and
-        return collapsed flamegraph lines ('frame;frame;frame count')."""
+        """On-demand profiling. Default: repeatedly snapshot cluster (or
+        one worker's) stacks for ``duration`` seconds and return collapsed
+        flamegraph lines ('frame;frame;frame count') — sampling this
+        dashboard's view of every process (reference: dashboard/modules/
+        reporter/profile_manager.py py-spy endpoints).
+
+        With ``?node=<id prefix>`` (or ``node=all``): capture an XPlane
+        (jax.profiler) trace ON THE TARGET WORKERS instead, via the
+        cluster profiling RPC (CP → node agent → worker); the response
+        lists the registered artifacts, downloadable from
+        /api/profile/download/<id>."""
         from aiohttp import web
 
         try:
@@ -623,6 +634,20 @@ class Dashboard:
                                                              "3"))))
         except ValueError:
             return web.Response(status=400, text="bad duration")
+        node = request.query.get("node")
+        if node is not None:
+            def capture():
+                from ray_tpu.util import state
+                return state.capture_xprof(
+                    node_id=None if node in ("", "all") else node,
+                    duration=duration)
+
+            loop = asyncio.get_event_loop()
+            try:
+                data = await loop.run_in_executor(None, capture)
+            except Exception as e:  # noqa: BLE001 — bad node id, CP down
+                return web.json_response({"error": repr(e)}, status=400)
+            return web.json_response(_hexify(data))
         process = request.query.get("process")  # substring filter
         loop = asyncio.get_event_loop()
 
@@ -652,6 +677,154 @@ class Dashboard:
 
         data = await loop.run_in_executor(None, sample)
         return web.json_response(data)
+
+    async def _profile_artifacts(self, request):
+        """Registered XPlane/memory capture artifacts (newest first)."""
+        from aiohttp import web
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.util import state
+            return state.list_profile_artifacts()
+
+        return web.json_response(
+            _hexify(await loop.run_in_executor(None, fetch)))
+
+    async def _profile_download(self, request):
+        """One artifact's trace directory as a .tar.gz (the logdir must be
+        visible from the dashboard host — single-host clusters and shared
+        filesystems; elsewhere the response 404s with the remote path so
+        the operator knows where the bytes live)."""
+        import io
+        import os
+        import tarfile
+
+        from aiohttp import web
+
+        art_id = request.match_info["artifact_id"]
+        loop = asyncio.get_event_loop()
+
+        def build():
+            from ray_tpu.util import state
+            arts = state.list_profile_artifacts()
+            art = next((a for a in arts
+                        if str(a.get("id", "")).startswith(art_id)), None)
+            if art is None:
+                return None, f"unknown artifact {art_id}"
+            logdir = art.get("logdir") or ""
+            if not os.path.isdir(logdir):
+                return None, (f"artifact {art['id']} logdir not on this "
+                              f"host: {logdir}")
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                tar.add(logdir, arcname=os.path.basename(
+                    logdir.rstrip("/")) or "profile")
+            return buf.getvalue(), art["id"]
+
+        data, info = await loop.run_in_executor(None, build)
+        if data is None:
+            return web.Response(status=404, text=info)
+        return web.Response(
+            body=data, content_type="application/gzip",
+            headers={"Content-Disposition":
+                     f'attachment; filename="xprof-{info}.tar.gz"'})
+
+    async def _profiling_view(self, request):
+        """Server-rendered profiling panel: per-replica engine phase
+        p50/p95 + compile/memory introspection (serve detailed_status)
+        and the registered capture artifacts with download links."""
+        from aiohttp import web
+
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.util import state
+            apps = _serve_apps()
+            try:
+                arts = state.list_profile_artifacts()
+            except Exception:  # noqa: BLE001 — CP down
+                arts = []
+            return apps, arts
+
+        apps, arts = await loop.run_in_executor(None, fetch)
+        return web.Response(text=_render_profiling(apps, arts),
+                            content_type="text/html")
+
+
+def _render_profiling(apps: list[dict], artifacts: list[dict]) -> str:
+    """HTML for the /profiling panel (same server-rendered idiom as the
+    trace waterfall)."""
+    import html as _html
+    import time as _time
+
+    phase_keys = ["admit", "prefill", "chunk_prefill", "decode_dispatch",
+                  "verify_dispatch", "harvest"]
+    scalar_keys = ["itl_s", "compile_events", "mid_traffic_compiles",
+                   "compile_s", "kv_page_occupancy", "weights_bytes",
+                   "kv_pool_bytes", "device_bytes_in_use"]
+    sections = []
+    for app in apps:
+        engines = app.get("engine") or []
+        name = _html.escape(str(app.get("deployment", "?")))
+        rows = []
+        for i, eng in enumerate(engines):
+            if not isinstance(eng, dict):
+                continue
+            cells = [f"<td>replica {i}</td>"]
+            for p in phase_keys:
+                p50 = eng.get(f"phase_{p}_p50_ms")
+                p95 = eng.get(f"phase_{p}_p95_ms")
+                cells.append(
+                    "<td>—</td>" if p50 is None else
+                    f"<td>{p50:.2f} / {p95:.2f}</td>")
+            for k in scalar_keys:
+                v = eng.get(k)
+                cells.append(f"<td>{_html.escape(str(v))}</td>")
+            rows.append("<tr>" + "".join(cells) + "</tr>")
+        if not rows:
+            continue
+        head = ("<tr><th></th>"
+                + "".join(f"<th>{p}<br>p50/p95 ms</th>"
+                          for p in phase_keys)
+                + "".join(f"<th>{k}</th>" for k in scalar_keys) + "</tr>")
+        sections.append(f"<h2>{name}</h2><table>{head}{''.join(rows)}"
+                        "</table>")
+    art_rows = []
+    for a in artifacts:
+        aid = _html.escape(str(a.get("id", "")))
+        age = _time.time() - float(a.get("ts") or 0)
+        art_rows.append(
+            "<tr>"
+            f"<td><a href='/api/profile/download/{aid}'>{aid}</a></td>"
+            f"<td>{_html.escape(str(a.get('kind', '')))}</td>"
+            f"<td>{_html.escape(str(a.get('node_id', ''))[:12])}</td>"
+            f"<td>{_html.escape(str(a.get('worker_id', ''))[:12])}</td>"
+            f"<td>{_html.escape(str(a.get('duration_s', '')))}</td>"
+            f"<td>{_html.escape(str(a.get('logdir', '')))}</td>"
+            f"<td>{age:.0f}s ago</td></tr>")
+    arts_html = (
+        "<table><tr><th>artifact</th><th>kind</th><th>node</th>"
+        "<th>worker</th><th>dur s</th><th>logdir</th><th>age</th></tr>"
+        + "".join(art_rows) + "</table>" if art_rows
+        else "<p>no captures yet</p>")
+    body = ("".join(sections)
+            or "<p>no LLM engine replicas reporting (deploy a serve LLM "
+               "app, then reload)</p>")
+    return f"""<!doctype html>
+<html><head><title>ray_tpu profiling</title><style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; margin-bottom: 2em; }}
+ td, th {{ border: 1px solid #999; padding: 4px 8px; text-align: left; }}
+ th {{ background: #eee; }}
+</style></head><body>
+<h1>engine profiling</h1>
+<p><a href="/">dashboard</a> ·
+ capture an XPlane trace: <code>GET /api/profile?node=all&amp;duration=3</code>
+ or <code>ray-tpu profile --node &lt;id&gt; --duration 3</code></p>
+{body}
+<h2>capture artifacts</h2>
+{arts_html}
+</body></html>"""
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:

@@ -1,1 +1,4 @@
-"""Observability subsystems (tracing; profiling lives in util/)."""
+"""Observability subsystems: distributed tracing (tracing.py) and
+performance introspection — engine phase timers, compile-event tracking,
+device-memory accounting, on-demand XProf capture (profiling.py). Local
+context-manager profiling helpers remain in ray_tpu.util.profiling."""

@@ -1,0 +1,357 @@
+"""Engine performance introspection + cluster-wide on-demand XProf capture.
+
+Three concerns the serving runtime attributes ITSELF (the MegaScale
+argument: in-situ diagnostics are a precondition for operating a fleet —
+offline benching found the 138 ms/step residual cost, production needs the
+runtime to find the next one):
+
+- **Phase timers** (`EngineProfiler.record`): the engine loop stamps each
+  phase — admit / prefill / chunk_prefill / decode_dispatch /
+  verify_dispatch / harvest — into bounded rings and a tagged Histogram.
+  Dispatch phases measure host-side dispatch cost (the loop never blocks
+  on the device); `harvest` is where the device sync lives
+  (`np.asarray` on the oldest in-flight block), so device slowness shows
+  up there, attributed, instead of smeared across the loop.
+- **Compile-event tracking** (`compile_scope`): every jit entry point's
+  first dispatch per static signature (prefill bucket, chunk length,
+  decode (width, block), verify width) is timed as a compile event.
+  Compiles while traffic is in flight are the documented loop-stall
+  failure class (engine.py `_warmup_decode_programs`): they're flagged
+  `mid_traffic`, logged as warnings, and counted — a regression here is
+  a serving-latency regression.
+- **Device-memory accounting**: weights / KV-pool byte gauges computed
+  from array layouts, KV page occupancy, and the backend allocator's
+  live/peak bytes when the platform reports them (`device.memory_stats()`
+  — absent on the cpu backend, surfaced as None rather than guessed).
+
+Plus the **capture controller**: a process-wide start/stop pair around
+`jax.profiler` XPlane tracing, callable from an RPC handler, so
+`ray-tpu profile --node <id>` captures a trace on any live worker and the
+dashboard serves the artifact. Local context-manager helpers stay in
+`ray_tpu.util.profiling`; this module is the remote-drivable subsystem.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from ray_tpu.util import metrics as _metrics
+
+logger = logging.getLogger(__name__)
+
+# engine phases, in loop order (the drift-guard test and README table key
+# off this tuple — extend it and both follow)
+PHASES = ("admit", "prefill", "chunk_prefill", "decode_dispatch",
+          "verify_dispatch", "harvest")
+
+_PHASE_BOUNDS = (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03,
+                 0.1, 0.3, 1.0, 3.0, 10.0)
+_ITL_BOUNDS = (0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0)
+_COMPILE_BOUNDS = (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0)
+
+PHASE_SECONDS = _metrics.Histogram(
+    "ray_tpu_llm_engine_phase_seconds",
+    "Engine loop time per phase (dispatch phases are host cost; harvest "
+    "carries the device sync)", boundaries=_PHASE_BOUNDS,
+    tag_keys=("phase",))
+ITL_SECONDS = _metrics.Histogram(
+    "ray_tpu_llm_itl_seconds",
+    "Inter-token latency (host record-time gaps; pipelined harvests land "
+    "in bursts of decode_block)", boundaries=_ITL_BOUNDS)
+COMPILE_EVENTS = _metrics.Counter(
+    "ray_tpu_llm_compile_events_total",
+    "XLA compilations by jit entry point; mid_traffic=true ones stalled "
+    "live requests", tag_keys=("kind", "mid_traffic"))
+COMPILE_SECONDS = _metrics.Histogram(
+    "ray_tpu_llm_compile_seconds",
+    "Wall time of first-dispatch-per-signature (≈ trace+compile)",
+    boundaries=_COMPILE_BOUNDS, tag_keys=("kind",))
+DEVICE_MEMORY = _metrics.Gauge(
+    "ray_tpu_llm_device_memory_bytes",
+    "Device/HBM bytes by component (weights, kv_pool, in_use, peak)",
+    tag_keys=("component",))
+KV_OCCUPANCY = _metrics.Gauge(
+    "ray_tpu_llm_kv_page_occupancy",
+    "Fraction of KV pool pages held by live sequences (evictable cached "
+    "prefix pages count as free — an alloc can reclaim them)")
+
+
+def _pct(sorted_vals: list, q: float) -> float:
+    """Interpolated percentile of an ascending list (non-empty)."""
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+class _Noop:
+    """Reusable no-op context manager (compile_scope fast path: the
+    signature was already seen, so the per-dispatch cost is one set
+    lookup and no allocation)."""
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class _CompileScope:
+    def __init__(self, prof: "EngineProfiler", kind: str, sig,
+                 mid_traffic: bool):
+        self._prof = prof
+        self._kind = kind
+        self._sig = sig
+        self._mid = mid_traffic
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return None
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._prof._record_compile(
+                self._kind, self._sig, time.perf_counter() - self._t0,
+                self._mid)
+        return False
+
+
+class EngineProfiler:
+    """Per-engine introspection state: phase rings, compile tracker, ITL
+    ring, memory layout. All mutating entry points are cheap enough to
+    sit on the engine loop's hot path; `enabled=False` reduces phase/ITL
+    recording to a single attribute check (the `--profile-ab` bench
+    bounds the enabled-path overhead). Compile tracking stays on either
+    way — it only does work on the FIRST dispatch of a new signature,
+    and a silent mid-traffic compile is exactly what this exists to
+    catch."""
+
+    def __init__(self, enabled: bool = True, ring_size: int = 256,
+                 itl_ring_size: int = 2048):
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._rings: dict[str, collections.deque] = {
+            p: collections.deque(maxlen=ring_size) for p in PHASES}
+        self._itl: collections.deque = collections.deque(maxlen=itl_ring_size)
+        self._seen: set = set()
+        self.compile_events = 0
+        self.mid_traffic_compiles = 0
+        self.compile_s = 0.0
+        # memory layout (set once by the engine after weights/pool init)
+        self.weights_bytes = 0
+        self.kv_pool_bytes = 0
+
+    # ---- phase timers --------------------------------------------------
+    def record(self, phase: str, dt: float) -> None:
+        if not self.enabled:
+            return
+        self._rings[phase].append(dt)
+        PHASE_SECONDS.observe(dt, {"phase": phase})
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time a block as one phase sample (skips the clock reads
+        entirely when disabled)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0)
+
+    def record_itl(self, gap_s: float) -> None:
+        if not self.enabled:
+            return
+        self._itl.append(gap_s)
+        ITL_SECONDS.observe(gap_s)
+
+    def phase_stats(self) -> dict:
+        """`phase_<name>_p50_ms` / `_p95_ms` per phase plus `itl_s` (p50);
+        None where no samples exist yet (or profiling is disabled)."""
+        out: dict[str, Optional[float]] = {}
+        for p in PHASES:
+            vals = sorted(self._rings[p])
+            out[f"phase_{p}_p50_ms"] = (
+                round(_pct(vals, 0.5) * 1e3, 4) if vals else None)
+            out[f"phase_{p}_p95_ms"] = (
+                round(_pct(vals, 0.95) * 1e3, 4) if vals else None)
+        itl = sorted(self._itl)
+        out["itl_s"] = round(_pct(itl, 0.5), 6) if itl else None
+        return out
+
+    # ---- compile tracking ----------------------------------------------
+    def compile_scope(self, kind: str, sig, mid_traffic: bool = False):
+        """Context manager around a jit entry point's dispatch. First use
+        of ``sig`` is timed and counted as a compile event; later uses
+        return a shared no-op. ``mid_traffic`` should be True when any
+        request has been submitted — such a compile stalled live work."""
+        if sig in self._seen:
+            return _NOOP
+        return _CompileScope(self, kind, sig, mid_traffic)
+
+    def _record_compile(self, kind: str, sig, dt: float,
+                        mid_traffic: bool) -> None:
+        with self._lock:
+            if sig in self._seen:
+                return
+            self._seen.add(sig)
+            self.compile_events += 1
+            self.compile_s += dt
+            if mid_traffic:
+                self.mid_traffic_compiles += 1
+        COMPILE_EVENTS.inc(1, {"kind": kind,
+                               "mid_traffic": str(bool(mid_traffic)).lower()})
+        COMPILE_SECONDS.observe(dt, {"kind": kind})
+        if mid_traffic:
+            logger.warning(
+                "mid-traffic compile: kind=%s sig=%s took %.2fs — every "
+                "active generation stalled for it (warm this program at "
+                "startup, see engine warmup_compile)", kind, sig, dt)
+
+    # ---- memory accounting ---------------------------------------------
+    def set_memory_layout(self, weights_bytes: int,
+                          kv_pool_bytes: int) -> None:
+        self.weights_bytes = int(weights_bytes)
+        self.kv_pool_bytes = int(kv_pool_bytes)
+        DEVICE_MEMORY.set(self.weights_bytes, {"component": "weights"})
+        DEVICE_MEMORY.set(self.kv_pool_bytes, {"component": "kv_pool"})
+
+    def memory_stats(self, used_pages: Optional[int] = None,
+                     total_pages: Optional[int] = None) -> dict:
+        occ = None
+        if used_pages is not None and total_pages:
+            occ = round(used_pages / total_pages, 4)
+            KV_OCCUPANCY.set(occ)
+        in_use, peak = device_memory_stats()
+        if in_use is not None:
+            DEVICE_MEMORY.set(in_use, {"component": "in_use"})
+        if peak is not None:
+            DEVICE_MEMORY.set(peak, {"component": "peak"})
+        return {"weights_bytes": self.weights_bytes,
+                "kv_pool_bytes": self.kv_pool_bytes,
+                "kv_page_occupancy": occ,
+                "device_bytes_in_use": in_use,
+                "device_peak_bytes": peak}
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of every array leaf in a pytree (weights / KV pool
+    sizing; shape*dtype math, no device round trip)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            size = getattr(leaf, "size", None)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+            nbytes = size * itemsize if size and itemsize else 0
+        total += int(nbytes)
+    return total
+
+
+def device_memory_stats() -> tuple[Optional[int], Optional[int]]:
+    """(bytes_in_use, peak_bytes_in_use) from the default device's
+    allocator, or (None, None) where the backend doesn't report (cpu)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+    except Exception:  # noqa: BLE001 - stats are strictly best-effort
+        return None, None
+    if not stats:
+        return None, None
+    return (stats.get("bytes_in_use"), stats.get("peak_bytes_in_use"))
+
+
+# ---------------------------------------------------------------------------
+# on-demand XPlane capture (remote-drivable: worker RPC handlers call these)
+# ---------------------------------------------------------------------------
+
+class CaptureController:
+    """Process-wide start/stop around `jax.profiler` tracing. jax allows
+    ONE active trace per process, so this serializes: a second start while
+    active raises instead of corrupting the run."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._logdir: Optional[str] = None
+        self._started_at: Optional[float] = None
+
+    def start(self, logdir: Optional[str] = None) -> dict:
+        import jax
+
+        with self._lock:
+            if self._logdir is not None:
+                raise RuntimeError(
+                    f"capture already active (logdir={self._logdir})")
+            if not logdir:
+                logdir = os.path.join(
+                    "/tmp", "ray_tpu_xprof",
+                    f"{int(time.time())}-{os.getpid()}")
+            os.makedirs(logdir, exist_ok=True)
+            jax.profiler.start_trace(logdir, create_perfetto_link=False)
+            self._logdir = logdir
+            self._started_at = time.time()
+            return {"logdir": logdir, "pid": os.getpid()}
+
+    def stop(self) -> dict:
+        import jax
+
+        with self._lock:
+            if self._logdir is None:
+                raise RuntimeError("no capture active")
+            jax.profiler.stop_trace()
+            logdir, self._logdir = self._logdir, None
+            dur = time.time() - (self._started_at or time.time())
+            self._started_at = None
+        return {"logdir": logdir, "duration_s": round(dur, 3),
+                "pid": os.getpid()}
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"active": self._logdir is not None,
+                    "logdir": self._logdir, "pid": os.getpid()}
+
+
+_capture = CaptureController()
+
+
+def start_capture(logdir: Optional[str] = None) -> dict:
+    return _capture.start(logdir)
+
+
+def stop_capture() -> dict:
+    return _capture.stop()
+
+
+def capture_status() -> dict:
+    return _capture.status()
+
+
+def save_device_memory_profile(path: Optional[str] = None) -> str:
+    """pprof device-memory dump, RPC-friendly default path."""
+    import jax
+
+    if not path:
+        path = os.path.join(
+            "/tmp", "ray_tpu_xprof",
+            f"memory-{int(time.time())}-{os.getpid()}.prof")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    jax.profiler.save_device_memory_profile(path)
+    return path

@@ -297,6 +297,38 @@ def cmd_trace(args) -> None:
         print(state.trace_timeline(args.trace_id, fmt=args.format))
 
 
+def cmd_profile(args) -> None:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_read_address(args.address))
+    if args.list:
+        for art in state.list_profile_artifacts():
+            print(json.dumps(art))
+        return
+    if args.memory:
+        out = state.save_device_memory_profile(node_id=args.node,
+                                               path=args.logdir)
+        print(json.dumps(out, indent=2))
+        return
+    print(f"capturing XPlane trace for {args.duration:g}s "
+          f"({'node ' + args.node if args.node else 'all nodes'})…",
+          file=sys.stderr)
+    out = state.capture_xprof(node_id=args.node, duration=args.duration,
+                              logdir=args.logdir)
+    arts = out.get("artifacts") or []
+    for art in arts:
+        print(json.dumps(art))
+    if arts:
+        print(f"{len(arts)} capture(s); inspect with "
+              f"`tensorboard --logdir {arts[0]['logdir']}` (Profile tab)",
+              file=sys.stderr)
+    else:
+        print("no captures produced:", file=sys.stderr)
+        print(json.dumps(out, indent=2), file=sys.stderr)
+        raise SystemExit(1)
+
+
 def _parse_tags(spec: str | None) -> dict | None:
     tags = _parse_labels(spec)
     return tags or None
@@ -428,6 +460,25 @@ def main(argv=None) -> None:
                     help="re-query every --interval seconds")
     sp.add_argument("--interval", type=float, default=5.0)
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser(
+        "profile",
+        help="capture an on-demand XPlane (jax.profiler) trace cluster-wide")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--node", default=None,
+                    help="node id (hex prefix ok); default: all alive nodes")
+    sp.add_argument("--duration", type=float, default=3.0,
+                    help="capture window in seconds")
+    sp.add_argument("--logdir", default=None,
+                    help="trace output dir on the worker host "
+                         "(default: /tmp/ray_tpu_xprof/<ts>-<pid>); "
+                         "with --memory, the pprof output path")
+    sp.add_argument("--memory", action="store_true",
+                    help="dump device (HBM) memory profiles instead of "
+                         "a time trace")
+    sp.add_argument("--list", action="store_true",
+                    help="list registered capture artifacts and exit")
+    sp.set_defaults(fn=cmd_profile)
 
     args = p.parse_args(argv)
     if args.cmd == "submit" and args.entrypoint \

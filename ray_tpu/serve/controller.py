@@ -276,7 +276,9 @@ class ServeController:
         # engine stats ride next to the live queue lens: deployments whose
         # callable defines engine_stats() (LLM servers) report steps /
         # prefills / tokens_out / shed counts / prefix-cache hit-miss-evict
-        # counters per replica; anything else probes to None
+        # counters per replica — plus the ISSUE-6 introspection surface
+        # (per-phase p50/p95, ITL, compile events, device memory) that the
+        # dashboard /profiling panel renders; anything else probes to None
         _ENGINE_KEYS = ("steps", "prefills", "tokens_out", "shed_expired",
                         "active_slots", "waiting", "free_pages",
                         "prefix_hits", "prefix_misses", "prefix_hit_tokens",
@@ -284,7 +286,16 @@ class ServeController:
                         "prefix_evictions",
                         "decode_block_effective", "pending_pipeline_depth",
                         "spec_rounds", "spec_drafted_tokens",
-                        "spec_accepted_tokens")
+                        "spec_accepted_tokens",
+                        "itl_s", "compile_events", "mid_traffic_compiles",
+                        "compile_s", "weights_bytes", "kv_pool_bytes",
+                        "kv_page_occupancy", "device_bytes_in_use",
+                        "device_peak_bytes") + tuple(
+                            f"phase_{p}_{q}_ms"
+                            for p in ("admit", "prefill", "chunk_prefill",
+                                      "decode_dispatch", "verify_dispatch",
+                                      "harvest")
+                            for q in ("p50", "p95"))
 
         async def probe_engine(replica):
             try:

@@ -56,6 +56,16 @@ class LLMConfig:
     # of on first use mid-traffic (a compile stalls every active request)
     warmup_compile: bool = True
 
+    # Engine performance introspection (observability/profiling.py):
+    # phase timers (admit/prefill/chunk/decode/verify/harvest p50+p95),
+    # inter-token-latency ring, and device-memory gauges in engine_stats().
+    # Default ON — overhead is host-side clock reads on a loop that
+    # dispatches device work asynchronously, A/B-bounded by
+    # `bench_serve.py --profile-ab`. Compile-event tracking stays on even
+    # when this is False (it only does work on first-dispatch-per-shape,
+    # and silent mid-traffic compiles are the failure class it catches).
+    profiling_enabled: bool = True
+
     # Automatic prefix caching (RadixAttention/vLLM-style): full pages of
     # prompt KV are kept in a refcounted hash-chained index after a request
     # finishes prefill, and later admissions with a matching token prefix

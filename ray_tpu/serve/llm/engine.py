@@ -70,6 +70,12 @@ class _Request:
     error: Optional[str] = None
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
+    # inter-token latency: host record-time of the last token plus the
+    # per-token gaps (pipelined harvests record blocks in bursts, so the
+    # gap distribution shows the streaming cadence a drain() consumer
+    # actually sees — k-1 near-zero gaps then one block-sized one)
+    last_token_at: Optional[float] = None
+    itl_gaps: list[float] = field(default_factory=list)
     finished_at: Optional[float] = None
     done_event: threading.Event = field(default_factory=threading.Event)
     # distributed tracing: carrier captured at submit (the engine loop
@@ -110,6 +116,17 @@ class LLMEngine:
         self.max_pages_per_seq = -(-cfg.max_seq_len // cfg.page_size)
         self.kv = kvc.init_paged_cache(
             self.model_cfg, cfg.num_pages, cfg.page_size)
+        # performance introspection (observability/profiling.py): phase
+        # timers + ITL ring gate on cfg.profiling_enabled; compile-event
+        # tracking is always on (work only on first-dispatch-per-shape).
+        # Weights/KV-pool byte accounting is shape*dtype math — the KV
+        # pool is donated every step but its layout never changes.
+        from ray_tpu.observability import profiling as profiling_mod
+        self._prof = profiling_mod.EngineProfiler(
+            enabled=bool(cfg.profiling_enabled))
+        self._prof.set_memory_layout(
+            profiling_mod.tree_bytes(self.params),
+            profiling_mod.tree_bytes(self.kv))
         # Prefix caching (see kv_cache.PageAllocator): all bookkeeping is
         # host-side between steps — the page table indirection means shared
         # pages change WHICH pool pages a slot reads, never the compiled
@@ -393,18 +410,28 @@ class LLMEngine:
         for w in widths:
             idx = jnp.full((w,), trash, jnp.int32)
             for k in tiers:
-                _all, toks, self.kv, self._sl_dev, self._rng = self._decode(
-                    self.params, self.kv, self._pt_dev, self._sl_dev,
-                    toks, self._rng, self._temps_dev, idx, k)
+                # compile_scope registers each (width, block) signature so
+                # the traffic-path scopes see it as already compiled; a
+                # warmup compile is by definition not mid-traffic
+                with self._prof.compile_scope("decode", ("decode", w, k)):
+                    _all, toks, self.kv, self._sl_dev, self._rng = \
+                        self._decode(
+                            self.params, self.kv, self._pt_dev,
+                            self._sl_dev, toks, self._rng,
+                            self._temps_dev, idx, k)
             if self._spec_on:
                 # the verify-k program per width too: an uncompiled verify
                 # stalls the first speculative round mid-traffic exactly
                 # like an uncompiled decode block would
                 drafts = jnp.full((w, self.cfg.spec_draft_len), -1,
                                   jnp.int32)
-                _all, toks, self.kv, self._sl_dev, self._rng = self._verify(
-                    self.params, self.kv, self._pt_dev, self._sl_dev,
-                    toks, self._rng, self._temps_dev, idx, drafts)
+                with self._prof.compile_scope(
+                        "verify", ("verify", w, self.cfg.spec_draft_len)):
+                    _all, toks, self.kv, self._sl_dev, self._rng = \
+                        self._verify(
+                            self.params, self.kv, self._pt_dev,
+                            self._sl_dev, toks, self._rng,
+                            self._temps_dev, idx, drafts)
         # the fixed-shape slot patches (all-trash write of zeros is a no-op)
         didx = jnp.full((trash + 1,), trash, jnp.int32)
         self._pt_dev, self._sl_dev, self._temps_dev = self._patch_state(
@@ -566,6 +593,7 @@ class LLMEngine:
             self._requests.pop(request_id, None)
         ttft = (req.first_token_at - req.submitted_at
                 if req.first_token_at else None)
+        gaps = sorted(req.itl_gaps)
         return {
             "text": self.tokenizer.decode(req.generated),
             "tokens": list(req.generated),
@@ -573,6 +601,10 @@ class LLMEngine:
             "num_generated_tokens": len(req.generated),
             "error": req.error,
             "ttft_s": ttft,
+            # median inter-token gap at host record time (None for 0/1
+            # token completions); bursty under pipelined harvests — see
+            # _Request.itl_gaps
+            "itl_s": gaps[len(gaps) // 2] if gaps else None,
             "latency_s": (req.finished_at or time.monotonic())
             - req.submitted_at,
         }
@@ -590,15 +622,28 @@ class LLMEngine:
         # mid-chunked-prefill requests hold a slot + pages but are not yet
         # in slot_req: load monitoring must see them (as waiting) or
         # autoscaling under-counts
+        free = self.allocator.available()
         out = {**self.stats, "active_slots": active,
                "waiting": waiting + prefilling, "prefilling": prefilling,
-               "free_pages": self.allocator.available(),
+               "free_pages": free,
                # gauges: the decode-block tier actually dispatched last
                # (1 / pressure_decode_block / decode_block — admission
                # pressure made visible) and the live dispatched-but-
                # unharvested block count (vs cfg.pipeline_depth)
                "decode_block_effective": self._last_block,
                "pending_pipeline_depth": len(self._pending)}
+        # introspection (observability/profiling.py): per-phase p50/p95 +
+        # itl_s (None until sampled / while profiling_enabled=False),
+        # compile-event counters (always live), device-memory gauges.
+        # compile_s is the profiler's measured total — the stats-dict slot
+        # predates the tracker and is overridden here.
+        out.update(self._prof.phase_stats())
+        out["compile_events"] = self._prof.compile_events
+        out["mid_traffic_compiles"] = self._prof.mid_traffic_compiles
+        out["compile_s"] = round(self._prof.compile_s, 3)
+        out.update(self._prof.memory_stats(
+            used_pages=self.cfg.num_pages - free,
+            total_pages=self.cfg.num_pages))
         if self._spec_on:
             d = self.stats["spec_drafted_tokens"]
             out["spec_accept_rate"] = (
@@ -616,8 +661,18 @@ class LLMEngine:
 
     # ---- engine loop ---------------------------------------------------
     def _loop(self):
+        prof = self._prof
         while not self._stop.is_set():
-            self._admit()
+            # admit timing covers the whole admission pass (including the
+            # async prefill dispatches of short prompts, which are ALSO
+            # sampled individually as "prefill"); idle passes that admit
+            # nothing are not recorded — the ring holds work, not waiting
+            if prof.enabled:
+                t0 = time.perf_counter()
+                if self._admit():
+                    prof.record("admit", time.perf_counter() - t0)
+            else:
+                self._admit()
             chunks = self._prefill_chunks()
             # chunk dispatches count as progress: an otherwise-idle engine
             # mid-chunked-prefill must not sleep between chunks
@@ -779,10 +834,16 @@ class LLMEngine:
         table[: len(req.pages)] = req.pages
         fn = self._prefill_fn(bucket)
         self._rng, sub = self._jax.random.split(self._rng)
-        tok_dev, self.kv = fn(
-            self.params, self.kv, jnp.asarray(table), jnp.asarray(toks),
-            jnp.int32(plen), sub,
-            jnp.asarray([req.temperature], jnp.float32))
+        # a first-use prefill bucket compiles HERE, with a live request
+        # waiting on it — warmup doesn't cover prompt buckets, so this is
+        # always a mid-traffic compile when it fires
+        with self._prof.phase("prefill"), self._prof.compile_scope(
+                "prefill", ("prefill", bucket),
+                mid_traffic=self.stats["requests"] > 0):
+            tok_dev, self.kv = fn(
+                self.params, self.kv, jnp.asarray(table), jnp.asarray(toks),
+                jnp.int32(plen), sub,
+                jnp.asarray([req.temperature], jnp.float32))
         self._arm_slot(req, table, tok_dev, plen)
 
     def _arm_slot(self, req: _Request, table, tok_dev, plen: int) -> None:
@@ -847,10 +908,13 @@ class LLMEngine:
             table[: len(req.pages)] = req.pages
             fn = self._chunk_fn(clen)
             self._rng, sub = self._jax.random.split(self._rng)
-            tok_dev, self.kv = fn(
-                self.params, self.kv, jnp.asarray(table), jnp.asarray(toks),
-                jnp.int32(start), jnp.int32(plen), sub,
-                jnp.asarray([req.temperature], jnp.float32))
+            with self._prof.phase("chunk_prefill"), self._prof.compile_scope(
+                    "chunk", ("chunk", clen),
+                    mid_traffic=self.stats["requests"] > 0):
+                tok_dev, self.kv = fn(
+                    self.params, self.kv, jnp.asarray(table),
+                    jnp.asarray(toks), jnp.int32(start), jnp.int32(plen),
+                    sub, jnp.asarray([req.temperature], jnp.float32))
             req.prefill_pos = min(start + clen, plen)
             if req.prefill_pos >= plen:
                 with self._lock:
@@ -890,8 +954,14 @@ class LLMEngine:
         """Append a sampled token; mark done on stop/max. Lock held."""
         if req.done:
             return
+        now = time.monotonic()
         if req.first_token_at is None:
-            req.first_token_at = time.monotonic()
+            req.first_token_at = now
+        elif req.last_token_at is not None:
+            gap = now - req.last_token_at
+            req.itl_gaps.append(gap)
+            self._prof.record_itl(gap)
+        req.last_token_at = now
         req.generated.append(tok)
         self.stats["tokens_out"] += 1
         hit_stop = (req.stop_token is not None and tok == req.stop_token)
@@ -1009,6 +1079,11 @@ class LLMEngine:
             overrides, self._overrides = self._overrides, {}
             for _col, _slot, req in snapshot:
                 req.dispatched += k
+        # decode_dispatch times the HOST cost of getting the block onto
+        # the device stream (patch flush + jit dispatch); the result sync
+        # is the harvest phase. The pipeline-trim harvest below is
+        # excluded — it's already sampled inside _harvest_one.
+        t0 = time.perf_counter() if self._prof.enabled else 0.0
         toks = self._flush_slot_patches(dirty, overrides)
         # bucketed width: pack the active slots, pad with the trash row —
         # a lightly loaded engine runs a narrow program
@@ -1019,12 +1094,18 @@ class LLMEngine:
             active_slots + [trash] * (w - len(active_slots)), jnp.int32)
         snapshot = [(col, slot, req)
                     for col, (_c, slot, req) in enumerate(snapshot)]
-        all_toks, self._dev_tokens, self.kv, self._sl_dev, self._rng = \
-            self._decode(self.params, self.kv, self._pt_dev, self._sl_dev,
-                         toks, self._rng, self._temps_dev, idx, k)
+        with self._prof.compile_scope(
+                "decode", ("decode", w, k),
+                mid_traffic=self.stats["requests"] > 0):
+            all_toks, self._dev_tokens, self.kv, self._sl_dev, self._rng = \
+                self._decode(self.params, self.kv, self._pt_dev,
+                             self._sl_dev, toks, self._rng,
+                             self._temps_dev, idx, k)
         self._start_fetch(all_toks)
         self._pending.append((all_toks, snapshot, k))
         self.stats["steps"] += k
+        if self._prof.enabled:
+            self._prof.record("decode_dispatch", time.perf_counter() - t0)
         if len(self._pending) > self.PIPELINE_DEPTH:
             self._harvest_one()
         return True
@@ -1059,6 +1140,7 @@ class LLMEngine:
                 req.dispatched += k + 1
             dirty, self._dirty_slots = self._dirty_slots, {}
             overrides, self._overrides = self._overrides, {}
+        t0 = time.perf_counter() if self._prof.enabled else 0.0
         toks = self._flush_slot_patches(dirty, overrides)
         spec_slots = [slot for slot, _r, _d, _b in rows]
         w = self._bucket_width(len(spec_slots))
@@ -1070,13 +1152,18 @@ class LLMEngine:
         for col, (slot, req, draft, base_len) in enumerate(rows):
             draft_mat[col, : len(draft)] = draft
             entry.append((col, slot, req, draft, base_len))
-        all_toks, self._dev_tokens, self.kv, self._sl_dev, self._rng = \
-            self._verify(self.params, self.kv, self._pt_dev, self._sl_dev,
-                         toks, self._rng, self._temps_dev, idx,
-                         jnp.asarray(draft_mat))
+        with self._prof.compile_scope(
+                "verify", ("verify", w, k),
+                mid_traffic=self.stats["requests"] > 0):
+            all_toks, self._dev_tokens, self.kv, self._sl_dev, self._rng = \
+                self._verify(self.params, self.kv, self._pt_dev,
+                             self._sl_dev, toks, self._rng,
+                             self._temps_dev, idx, jnp.asarray(draft_mat))
         self._start_fetch(all_toks)
         self._pending.append((all_toks, entry, ("spec", k)))
         self.stats["steps"] += k + 1
+        if self._prof.enabled:
+            self._prof.record("verify_dispatch", time.perf_counter() - t0)
 
     def _spec_step(self) -> bool:
         """TRANSITION decode-mode slots with drafts into verify rounds.
@@ -1147,7 +1234,13 @@ class LLMEngine:
         next verify round (their just-harvested host state is exact — no
         pipeline drain needed); the rest drop back to decode blocks."""
         from ray_tpu.serve.llm import spec_decode
-        host = np.asarray(dev_toks).reshape(k + 1, -1)
+        if self._prof.enabled:
+            t0 = time.perf_counter()
+            host = np.asarray(dev_toks)  # device sync (oldest round)
+            self._prof.record("harvest", time.perf_counter() - t0)
+            host = host.reshape(k + 1, -1)
+        else:
+            host = np.asarray(dev_toks).reshape(k + 1, -1)
         finished: list[_Request] = []
         chain = []  # (slot, req, draft, base_len)
         with self._lock:
@@ -1204,7 +1297,15 @@ class LLMEngine:
         if isinstance(k, tuple):  # ("spec", draft_len) verify round
             self._apply_verify(dev_toks, snapshot, k[1])
             return
-        host_toks = np.asarray(dev_toks)  # sync point: oldest block only
+        if self._prof.enabled:
+            # THE device sync: all device slowness (or a fetch that wasn't
+            # prefetched) surfaces here, attributed as "harvest" instead
+            # of smeared across the loop
+            t0 = time.perf_counter()
+            host_toks = np.asarray(dev_toks)  # sync point: oldest block only
+            self._prof.record("harvest", time.perf_counter() - t0)
+        else:
+            host_toks = np.asarray(dev_toks)
         host_toks = host_toks.reshape(k, -1)
         finished: list[_Request] = []
         with self._lock:

@@ -16,10 +16,15 @@ from typing import Any, Iterator, Optional
 from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.engine import LLMEngine
 
-# Engine stats as one tagged Prometheus gauge family (util.metrics →
-# CP KV "metrics:<worker>" → dashboard /metrics). Module-level singleton:
-# the metrics registry is per-process and a replica restart in the same
-# worker must not register a duplicate family.
+# Engine stats as one tagged gauge family through the util.metrics
+# registry + flusher pipeline (delta reports into the CP time-series
+# store — the legacy `metrics:<worker>` KV blob path is gone).
+# Module-level singleton: the metrics registry is per-process and a
+# replica restart in the same worker must not register a duplicate
+# family. Phase/compile/ITL histograms are NOT re-exported here — the
+# engine's profiler records those into their own metric families
+# (observability/profiling.py); this family carries the scalar
+# counters/gauges, including the profiler-derived scalars below.
 _ENGINE_GAUGE = None
 _EXPORTED_STATS = (
     "steps", "prefills", "tokens_out", "requests", "shed_expired",
@@ -28,12 +33,17 @@ _EXPORTED_STATS = (
     "prefix_hit_pages", "prefix_cached_pages", "prefix_evictable_pages",
     "prefix_shared_pages", "prefix_evictions", "prefix_inserted_pages",
     "decode_block_effective", "pending_pipeline_depth",
-    "spec_rounds", "spec_drafted_tokens", "spec_accepted_tokens")
+    "spec_rounds", "spec_drafted_tokens", "spec_accepted_tokens",
+    # introspection scalars (ISSUE 6): compile tracker + memory gauges;
+    # None-valued entries (no samples yet / cpu backend) are skipped
+    "compile_events", "mid_traffic_compiles", "compile_s",
+    "weights_bytes", "kv_pool_bytes", "kv_page_occupancy",
+    "device_bytes_in_use", "device_peak_bytes", "itl_s")
 
 
 def _export_engine_stats(model_id: str, stats: dict) -> None:
-    """Record engine counters as gauges and push to the control plane
-    (best-effort: benches/tests run engines with no runtime up)."""
+    """Record engine counters as registry gauges and flush (best-effort:
+    benches/tests run engines with no runtime up)."""
     global _ENGINE_GAUGE
     try:
         from ray_tpu.core import api
@@ -46,7 +56,7 @@ def _export_engine_stats(model_id: str, stats: dict) -> None:
         rt = api._try_get_runtime()
         replica = rt.worker_id.hex()[:8] if rt is not None else "local"
         for key in _EXPORTED_STATS:
-            if key in stats:
+            if stats.get(key) is not None:
                 _ENGINE_GAUGE.set(
                     float(stats[key]),
                     tags={"model": model_id, "replica": replica,

@@ -504,18 +504,3 @@ def flush_now() -> None:
         cur = _flusher
     if cur is not None and cur.alive:
         cur.flush()
-
-
-def push_to_control_plane() -> None:
-    """Snapshot all metrics into the cluster KV (metrics:<worker>). Legacy
-    full-exposition push — the flusher's delta pipeline supersedes it, but
-    explicit callers (e.g. engines exporting gauges between flushes) keep
-    working; the CP retracts the key when the worker dies."""
-    from ray_tpu.core import api
-    rt = api._try_get_runtime()
-    if rt is None:
-        return
-    payload = collect_prometheus()
-    rt.cp_client.notify("kv_put", {
-        "key": f"metrics:{rt.worker_id.hex()}",
-        "value": payload.encode(), "overwrite": True})

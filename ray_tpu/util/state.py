@@ -233,3 +233,60 @@ def dump_cluster_stacks() -> dict[str, str]:
         except Exception as e:  # noqa: BLE001
             out[f"node-{nid}"] = f"<unreachable: {e!r}>"
     return out
+
+
+def profiling_start(node_id: Optional[str] = None,
+                    logdir: Optional[str] = None) -> dict:
+    """Begin an XPlane (jax.profiler) capture on the selected node's
+    workers — every alive node when `node_id` is None. Routed CP → node
+    agent → worker; returns per-node/per-worker start results."""
+    body: dict = {}
+    if node_id:
+        body["node_id"] = node_id
+    if logdir:
+        body["logdir"] = logdir
+    return _cp().call("profiling_start", body, timeout=90.0)
+
+
+def profiling_stop(node_id: Optional[str] = None) -> dict:
+    """End the active captures; the CP registers each produced trace
+    directory as a `profile_artifact:<id>` (see list_profile_artifacts)
+    and the result carries the registered artifact records."""
+    body = {"node_id": node_id} if node_id else {}
+    return _cp().call("profiling_stop", body, timeout=90.0)
+
+
+def capture_xprof(node_id: Optional[str] = None, duration: float = 3.0,
+                  logdir: Optional[str] = None) -> dict:
+    """One-shot cluster capture: start, run for `duration` seconds, stop.
+    Returns the stop result — `result["artifacts"]` lists the XPlane
+    trace directories (open them with `tensorboard --logdir <dir>`,
+    Profile tab). The `ray-tpu profile` CLI and the dashboard's
+    `/api/profile?node=` endpoint both drive this."""
+    import time as _time
+
+    start = profiling_start(node_id=node_id, logdir=logdir)
+    try:
+        _time.sleep(max(0.0, float(duration)))
+    finally:
+        out = profiling_stop(node_id=node_id)
+    out["start"] = start
+    return out
+
+
+def list_profile_artifacts() -> list[dict]:
+    """Registered capture artifacts (newest first): id, kind, node,
+    worker, pid, logdir, duration."""
+    return _cp().call("list_profile_artifacts", None, timeout=10.0) or []
+
+
+def save_device_memory_profile(node_id: Optional[str] = None,
+                               path: Optional[str] = None) -> dict:
+    """Dump each selected worker's device (HBM) memory profile (pprof) —
+    the remote 'why is replica 3 OOMing' tool."""
+    body: dict = {}
+    if node_id:
+        body["node_id"] = node_id
+    if path:
+        body["path"] = path
+    return _cp().call("save_device_memory_profile", body, timeout=90.0)

@@ -175,8 +175,8 @@ def test_dashboard_serve_engine_stats_and_metrics(dash):
         assert engine["prefills"] >= 1
         assert engine["prefix_misses"] + engine["prefix_hits"] >= 1
 
-        # the /api/serve probe itself pushed the gauges to the CP KV;
-        # the Prometheus scrape must aggregate them
+        # the /api/serve probe itself flushed the gauges through the
+        # registry pipeline; the Prometheus scrape must aggregate them
         scrape = _get(dash, "/metrics")
         assert "ray_tpu_llm_engine" in scrape
         assert 'stat="prefix_hits"' in scrape

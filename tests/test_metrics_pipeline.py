@@ -170,12 +170,14 @@ def test_dead_worker_series_retracted(metrics_cluster):
         {"name": "doomed_gauge", "kind": "gauge", "description": "",
          "tag_keys": [], "series": [{"tags": [], "value": 7.0}]}])
     assert r and r.get("ok")
-    # legacy KV exposition blob for the same worker rides the scrape
+    # legacy `metrics:<worker>` KV blobs no longer ride the scrape — the
+    # registry/flusher pipeline is the only exposition source
     _cp().call("kv_put", {"key": f"metrics:{src}",
                           "value": b"legacy_series 1\n", "overwrite": True})
     assert any(row["name"] == "doomed_gauge"
                for row in state.list_metric_series())
-    assert "legacy_series 1" in _cp().call("get_metrics", None, timeout=10.0)
+    assert "legacy_series" not in _cp().call("get_metrics", None,
+                                             timeout=10.0)
 
     _cp().call("worker_died", {"worker_id": src, "reason": "test kill"})
 
@@ -183,7 +185,6 @@ def test_dead_worker_series_retracted(metrics_cluster):
                    for row in state.list_metric_series())
     text = _cp().call("get_metrics", None, timeout=10.0)
     assert "doomed_gauge" not in text
-    assert "legacy_series" not in text
     # late flush from the dead worker is refused, not resurrected
     r = _report(src, time.time(), [
         {"name": "doomed_gauge", "kind": "gauge", "description": "",

@@ -1,0 +1,285 @@
+"""Performance-introspection tests (observability/profiling.py): engine
+phase timers, compile-event tracking, device-memory accounting, and the
+cluster-wide XProf capture path — all on the cpu backend."""
+
+import os
+import re
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular(ray_start_module):
+    yield ray_start_module
+
+
+def _tiny_cfg(**kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+
+    d = dict(model_config=llama.llama_tiny(vocab_size=512),
+             max_batch_size=4, page_size=16, num_pages=64,
+             max_prompt_len=64, max_seq_len=128, max_tokens=8)
+    d.update(kw)
+    return LLMConfig(**d)
+
+
+def _mk_engine(**kw):
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_tiny_cfg(**kw))
+    eng.start()
+    return eng
+
+
+# ---- phase timers -----------------------------------------------------
+
+
+def test_phase_timers_record_after_traffic():
+    eng = _mk_engine()
+    try:
+        out = eng.generate("the quick brown fox jumps over", max_tokens=6)
+        assert out["num_generated_tokens"] >= 1
+        stats = eng.engine_stats()
+        # every decode path phase must have samples; verify is spec-only
+        for phase in ("admit", "prefill", "decode_dispatch", "harvest"):
+            p50 = stats[f"phase_{phase}_p50_ms"]
+            p95 = stats[f"phase_{phase}_p95_ms"]
+            assert p50 is not None and p50 >= 0.0, phase
+            assert p95 is not None and p95 >= p50, phase
+        assert stats["phase_verify_dispatch_p50_ms"] is None
+    finally:
+        eng.shutdown()
+
+
+def test_phase_timers_disabled_stay_empty():
+    eng = _mk_engine(profiling_enabled=False)
+    try:
+        eng.generate("hello world one two three", max_tokens=4)
+        stats = eng.engine_stats()
+        for phase in ("admit", "prefill", "chunk_prefill",
+                      "decode_dispatch", "verify_dispatch", "harvest"):
+            assert stats[f"phase_{phase}_p50_ms"] is None, phase
+        # compile tracking is NOT gated by profiling_enabled
+        assert stats["compile_events"] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_itl_recorded_per_request():
+    eng = _mk_engine()
+    try:
+        out = eng.generate("a b c d e f g h", max_tokens=8)
+        assert out["num_generated_tokens"] >= 2
+        # per-request median ITL (host record-time gaps)
+        assert out["itl_s"] is not None and out["itl_s"] >= 0.0
+        assert eng.engine_stats()["itl_s"] is not None
+    finally:
+        eng.shutdown()
+
+
+# ---- compile-event tracking -------------------------------------------
+
+
+def test_compile_once_and_mid_traffic_counter():
+    # prefix cache off: a cache hit would route the repeat through the
+    # chunked suffix-prefill path and compile a chunk program — this test
+    # wants shape-for-shape repeats
+    eng = _mk_engine(prefix_cache_enabled=False)
+    try:
+        stats0 = eng.engine_stats()
+        # warmup compiles (decode/verify tiers) are NOT mid-traffic
+        assert stats0["compile_events"] >= 1
+        assert stats0["mid_traffic_compiles"] == 0
+
+        prompt = "one two three four five six"
+        eng.generate(prompt, max_tokens=4)
+        stats1 = eng.engine_stats()
+        # first prompt hits an unwarmed prefill bucket -> mid-traffic
+        assert stats1["mid_traffic_compiles"] >= 1
+        assert stats1["compile_s"] > 0.0
+
+        # repeating the same shapes must not compile again
+        eng.generate(prompt, max_tokens=4)
+        stats2 = eng.engine_stats()
+        assert stats2["compile_events"] == stats1["compile_events"]
+        assert stats2["mid_traffic_compiles"] == stats1["mid_traffic_compiles"]
+
+        # a NEW prompt bucket mid-traffic is flagged (regression guard)
+        long_prompt = " ".join(["tok"] * 40)  # 159 bytes -> bucket 64
+        eng.generate(long_prompt, max_tokens=4)
+        stats3 = eng.engine_stats()
+        assert stats3["mid_traffic_compiles"] > stats2["mid_traffic_compiles"]
+        assert stats3["compile_events"] > stats2["compile_events"]
+    finally:
+        eng.shutdown()
+
+
+# ---- device-memory accounting -----------------------------------------
+
+
+def test_memory_gauges_sane():
+    from ray_tpu.observability import profiling as prof
+
+    eng = _mk_engine()
+    try:
+        stats = eng.engine_stats()
+        assert stats["weights_bytes"] == prof.tree_bytes(eng.params)
+        assert stats["kv_pool_bytes"] == prof.tree_bytes(eng.kv)
+        assert stats["weights_bytes"] > 0
+        assert stats["kv_pool_bytes"] > 0
+        assert 0.0 <= stats["kv_page_occupancy"] <= 1.0
+        eng.generate("occupy some pages please now", max_tokens=4)
+        # finished requests free their pages; occupancy stays a fraction
+        assert 0.0 <= eng.engine_stats()["kv_page_occupancy"] <= 1.0
+    finally:
+        eng.shutdown()
+
+
+def test_save_device_memory_profile_local(tmp_path):
+    from ray_tpu.observability import profiling as prof
+
+    path = str(tmp_path / "mem.prof")
+    out = prof.save_device_memory_profile(path)
+    assert out == path
+    assert os.path.getsize(path) > 0
+
+
+# ---- XProf capture ----------------------------------------------------
+
+
+def test_capture_round_trip_local(tmp_path):
+    """start/stop produce a non-empty XPlane trace dir on cpu backend."""
+    import jax.numpy as jnp
+
+    from ray_tpu.observability import profiling as prof
+
+    logdir = str(tmp_path / "xprof")
+    info = prof.start_capture(logdir)
+    assert info["logdir"] == logdir
+    assert prof.capture_status()["active"]
+    # double-start is refused while a capture is live
+    with pytest.raises(RuntimeError):
+        prof.start_capture(str(tmp_path / "other"))
+    (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    out = prof.stop_capture()
+    assert out["logdir"] == logdir
+    assert out["duration_s"] > 0.0
+    assert not prof.capture_status()["active"]
+    # the profiler writes <logdir>/plugins/profile/<run>/...
+    plugin_dir = os.path.join(logdir, "plugins", "profile")
+    assert os.path.isdir(plugin_dir)
+    runs = os.listdir(plugin_dir)
+    assert runs and os.listdir(os.path.join(plugin_dir, runs[0]))
+
+
+def test_cluster_capture_end_to_end(ray_start_regular, tmp_path):
+    """state.capture_xprof drives CP -> node agent -> worker and registers
+    a downloadable artifact."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def burn():
+        import jax.numpy as jnp
+        return float((jnp.ones((32, 32)) @ jnp.ones((32, 32))).sum())
+
+    assert ray_tpu.get(burn.remote()) > 0  # a worker exists and runs jax
+
+    # default logdir: per-worker /tmp/ray_tpu_xprof/<ts>-<pid> (an explicit
+    # shared dir would collide when several workers share a host)
+    out = state.capture_xprof(duration=1.0)
+    assert out["nodes"], "no nodes reached"
+    arts = out["artifacts"]
+    assert arts, f"no artifacts registered: {out}"
+    for art in arts:
+        assert art["kind"] == "xplane"
+        assert art["duration_s"] > 0.0
+        assert os.path.isdir(art["logdir"])
+
+    listed = state.list_profile_artifacts()
+    ids = {a["id"] for a in listed}
+    assert all(a["id"] in ids for a in arts)
+
+    # second capture works (per-process controller resets cleanly)
+    out2 = state.capture_xprof(duration=0.5)
+    assert out2["artifacts"]
+
+
+def test_cluster_memory_profile(ray_start_regular, tmp_path):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def touch():
+        return 1
+
+    assert ray_tpu.get(touch.remote()) == 1
+    out = state.save_device_memory_profile(
+        path=str(tmp_path / "cluster-mem.prof"))
+    workers = [w for n in out["nodes"].values() if isinstance(n, dict)
+               for w in (n.get("workers") or {}).values()]
+    assert workers
+    assert any(isinstance(w, dict) and w.get("ok") for w in workers), out
+
+
+# ---- README drift guard -----------------------------------------------
+
+
+def test_readme_engine_stats_table_matches_live_keys():
+    """Every key engine_stats() emits must be documented in README's
+    engine-telemetry table, and every documented key must exist — with
+    prefix cache, spec decoding, and profiling all on."""
+    eng = _mk_engine(prefix_cache_enabled=True, spec_decode_enabled=True,
+                     spec_draft_len=2)
+    try:
+        eng.generate("drift guard prompt one two three", max_tokens=6)
+        live = set(eng.engine_stats().keys())
+    finally:
+        eng.shutdown()
+
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    section = readme.split("### Engine telemetry (`engine_stats()`)")[1]
+    table = section.split("\n## ")[0]
+    documented = set()
+    for row in re.findall(r"^\|([^|]+)\|", table, flags=re.M):
+        documented.update(re.findall(r"`([a-z0-9_]+)`", row))
+
+    missing_docs = live - documented
+    assert not missing_docs, \
+        f"engine_stats keys missing from README table: {sorted(missing_docs)}"
+    stale_docs = documented - live
+    assert not stale_docs, \
+        f"README documents keys engine_stats no longer emits: {sorted(stale_docs)}"
+
+
+# ---- dashboard panel --------------------------------------------------
+
+
+def test_dashboard_profiling_routes(ray_start_regular):
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    dash = start_dashboard(port=0)
+    try:
+        base = f"http://127.0.0.1:{dash.port}"
+        with urllib.request.urlopen(base + "/profiling", timeout=30) as r:
+            assert r.status == 200
+            assert b"engine profiling" in r.read()
+        with urllib.request.urlopen(base + "/api/profile/artifacts",
+                                    timeout=30) as r:
+            assert isinstance(json.loads(r.read()), list)
+        # unknown artifact id -> 404, not a crash
+        try:
+            urllib.request.urlopen(
+                base + "/api/profile/download/nope", timeout=30)
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code == 404
+        assert raised
+    finally:
+        dash.stop()
