@@ -87,6 +87,13 @@ def main():
                          "hard-asserts token identity, reports accepted "
                          "draft tokens per verify round; merges the result "
                          "into --out")
+    ap.add_argument("--kv-tier-ab", action="store_true",
+                    help="A/B the cluster tiered KV cache on a shared-"
+                         "prefix greedy workload: a COLD replica B "
+                         "restoring replica A's spilled prefix pages "
+                         "through the CP index vs cold prefill, "
+                         "hard-asserts token identity; merges the result "
+                         "into --out")
     ap.add_argument("--profile-ab", action="store_true",
                     help="A/B the engine phase timers (profiling_enabled "
                          "on vs off) on the headline point; exits nonzero "
@@ -114,6 +121,11 @@ def main():
         preflight_tests = ["tests/test_serve_llm.py"]
         if args.spec_ab:
             preflight_tests.append("tests/test_spec_decode.py")
+        if args.kv_tier_ab:
+            # no -m filter here, so this includes the slow two-replica
+            # cross-restore stress test — exactly the coverage a kv-tier
+            # perf number needs behind it
+            preflight_tests.append("tests/test_kv_tier.py")
         rc = subprocess.run(
             [sys.executable, "-m", "pytest", "-q", *preflight_tests],
             cwd=repo, env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
@@ -548,6 +560,109 @@ def main():
                 "spec-off completions differ — the accept/rollback path is "
                 "broken, not benchmarking it")
 
+    # tiered-KV-cache A/B (ISSUE 7): shared-prefix greedy completions
+    # against three engines — a tier-off control (cold-prefill TTFT), a
+    # tier-on replica A that seeds and spills the prefix chains, and a
+    # COLD tier-on replica B that has never seen the prompts and must
+    # restore A's spilled pages through the CP index + object plane.
+    # Token identity is a HARD assert: restore must be a pure perf knob.
+    # Runs the deeper cpu-tiny model (like --spec-ab) so prefill is
+    # weights-bound and the restored-scatter-vs-recompute delta is real.
+    kv_tier = None
+    if args.kv_tier_ab:
+        import dataclasses as _dc
+
+        from ray_tpu.serve.llm import LLMEngine
+
+        kvt_cfg = LLMConfig(
+            model_id="llama-tiny-d256",
+            model_config=llama.llama_tiny(
+                vocab_size=2048, dim=256, n_layers=4, n_heads=8,
+                n_kv_heads=4, ffn_dim=1024),
+            max_batch_size=4, page_size=32, num_pages=128,
+            max_prompt_len=704, max_seq_len=768, max_tokens=16,
+            warmup_compile=True,
+            # small retention cap: drained prefix chains spill promptly
+            # instead of parking in the local LRU forever
+            prefix_cache_max_pages=2, kv_tier_enabled=True)
+        shared = "shared context " * 40             # 600 tokens ~ 18 pages
+        kv_prompts = [shared + f"Q{i}: " for i in range(4)]
+
+        def kvt_run(eng) -> tuple[list, list]:
+            ttfts, comps = [], []
+            for p in kv_prompts:
+                out = eng.generate(p, max_tokens=16, temperature=0.0)
+                if out["error"]:
+                    raise SystemExit(f"kv-tier A/B request failed: "
+                                     f"{out['error']}")
+                ttfts.append(out["ttft_s"])
+                comps.append((out["text"], len(out["tokens"])))
+            return ttfts, comps
+
+        cold_eng = LLMEngine(_dc.replace(kvt_cfg, kv_tier_enabled=False,
+                                         prefix_cache_enabled=False),
+                             rng_seed=0)
+        cold_eng.start()
+        try:
+            cold_ttfts, want = kvt_run(cold_eng)
+        finally:
+            cold_eng.shutdown()
+
+        # A must stay alive while B restores: its shutdown retracts the
+        # index entries and drops the shm blobs B fetches
+        a_eng = LLMEngine(kvt_cfg, rng_seed=0)
+        a_eng.start()
+        b_eng = None
+        try:
+            _a_ttfts, a_comps = kvt_run(a_eng)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and \
+                    a_eng.engine_stats()["spilled_pages"] < 1:
+                time.sleep(0.05)
+            a_st = a_eng.engine_stats()
+            if a_st["spilled_pages"] < 1:
+                raise SystemExit("kv-tier A/B: replica A spilled nothing "
+                                 "— eviction->spill path inert, not "
+                                 "benchmarking it")
+            b_eng = LLMEngine(kvt_cfg, rng_seed=0)
+            b_eng.start()
+            b_ttfts, b_comps = kvt_run(b_eng)
+            b_st = b_eng.engine_stats()
+        finally:
+            a_eng.shutdown()
+            if b_eng is not None:
+                b_eng.shutdown()
+
+        identical = want == a_comps == b_comps
+        p50_cold = statistics.median(cold_ttfts) * 1e3
+        p50_warm = statistics.median(b_ttfts) * 1e3
+        kv_tier = {
+            "label": "kv_tier_cross_replica",
+            "model": kvt_cfg.model_id,
+            "env": "tpu" if (has_tpu and not args.tiny) else "cpu-tiny",
+            "requests": len(kv_prompts),
+            "shared_prefix_tokens": len(shared),
+            "greedy_identical": identical,
+            "spilled_pages_a": a_st["spilled_pages"],
+            "restored_pages_b": b_st["restored_pages"],
+            "tier_hit_tokens_b": b_st["tier_hit_tokens"],
+            "p50_ttft_cold_ms": round(p50_cold, 2),
+            "p50_ttft_warm_b_ms": round(p50_warm, 2),
+            "ttft_speedup": round(p50_cold / p50_warm, 2)
+            if p50_warm else None,
+        }
+        if not identical:
+            print(json.dumps({"kv_tier": kv_tier}))
+            raise SystemExit(
+                "kv-tier restore changed greedy output: tier-restored "
+                "completions differ from cold prefill — the spill/restore "
+                "path is corrupting KV, not benchmarking it")
+        if b_st["restored_pages"] < 1:
+            print(json.dumps({"kv_tier": kv_tier}))
+            raise SystemExit(
+                "kv-tier A/B: cold replica B restored nothing — the CP "
+                "index/object-plane path is inert, not benchmarking it")
+
     serve.shutdown()
 
     result = {
@@ -566,7 +681,8 @@ def main():
         result["extra"]["metrics_overhead"] = metrics_overhead
     if profiling_overhead is not None:
         result["extra"]["profiling_overhead"] = profiling_overhead
-    mergeable = {"prefix_cache": prefix_cache, "spec_decode": spec_decode}
+    mergeable = {"prefix_cache": prefix_cache, "spec_decode": spec_decode,
+                 "kv_tier": kv_tier}
     mergeable = {k: v for k, v in mergeable.items() if v is not None}
     if mergeable:
         result["extra"].update(mergeable)

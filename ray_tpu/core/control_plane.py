@@ -30,6 +30,10 @@ from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.exceptions import PlacementGroupSchedulingError
 from ray_tpu.util import metrics as _metrics
 
+# cluster prefix-index namespace for the tiered KV cache
+# (serve/llm/kv_tier.py); one key per spilled page chain digest
+_KV_TIER_PREFIX = "kv_tier:"
+
 logger = logging.getLogger(__name__)
 
 # Built-in scheduler metrics (ISSUE 4; ref: stats/metric_defs.cc
@@ -150,6 +154,10 @@ class ControlPlane:
         self._metric_sources: dict[str, set] = {}  # source -> series keys
         self._source_nodes: dict[str, str] = {}    # source -> node_id hex
         self._dead_workers: set[str] = set()       # retracted worker ids
+        # kv_tier: namespace hit accounting (serve/llm/kv_tier.py cluster
+        # index — surfaced by _h_kv_tier_index for the CLI/dashboard)
+        self._kv_tier_counters = {"match_calls": 0, "hits": 0,
+                                  "misses": 0, "hit_pages": 0}
         self._store = make_meta_store(
             store_path if store_path is not None
             else (get_config().cp_store_path or None))
@@ -366,6 +374,96 @@ class ControlPlane:
         prefix = body.get("prefix", "")
         with self._lock:
             return [k for k in self._kv if k.startswith(prefix)]
+
+    # ---- kv tier (serve/llm/kv_tier.py cluster prefix index) ----------
+    # One kv_tier:<chain-digest-hex> entry per spilled KV page; values are
+    # JSON dicts carrying {owner, node, store, ref, blob, off, tokens,
+    # nbytes, tier, ts, ttl_s}. Entries die with their owning worker/node
+    # (same GC shape as the metrics store) or by TTL (_h_kv_tier_gc).
+
+    @staticmethod
+    def _kv_tier_entry(value):
+        import json
+        try:
+            return json.loads(value.decode() if isinstance(value, bytes)
+                              else value)
+        except (ValueError, AttributeError):
+            return None
+
+    def _h_kv_tier_match(self, body):
+        """Longest-prefix probe: returns the stored values for the
+        leading contiguous run of ``digests`` present in the index (one
+        round trip for the whole chain probe instead of one kv_get per
+        page)."""
+        digests = body.get("digests") or []
+        with self._lock:
+            vals = [self._kv.get(_KV_TIER_PREFIX + d) for d in digests]
+            run = 0
+            for v in vals:
+                if v is None:
+                    break
+                run += 1
+            c = self._kv_tier_counters
+            c["match_calls"] += 1
+            if run:
+                c["hits"] += 1
+                c["hit_pages"] += run
+            else:
+                c["misses"] += 1
+            return {"entries": vals[:run]}
+
+    def _h_kv_tier_index(self, body):
+        """Whole-index dump for `ray-tpu kvtier` / the dashboard table:
+        parsed entries (ref stripped — it's a pickled object ref) plus
+        the CP-side hit counters."""
+        with self._lock:
+            raw = {k: v for k, v in self._kv.items()
+                   if k.startswith(_KV_TIER_PREFIX)}
+            counters = dict(self._kv_tier_counters)
+        entries = []
+        for k, v in raw.items():
+            e = self._kv_tier_entry(v)
+            if e is None:
+                continue
+            e.pop("ref", None)
+            e["digest"] = k[len(_KV_TIER_PREFIX):]
+            entries.append(e)
+        entries.sort(key=lambda e: (e.get("owner", ""), e.get("blob", ""),
+                                    e.get("off", 0)))
+        return {"entries": entries, "counters": counters}
+
+    def _h_kv_tier_gc(self, body):
+        """Drop expired (and unparseable) index entries — the owner
+        normally retracts its own, but a wedged owner's entries must not
+        advertise restorable prefixes forever."""
+        now = time.time()
+        dropped = 0
+        with self._lock:
+            for k in [k for k in self._kv
+                      if k.startswith(_KV_TIER_PREFIX)]:
+                e = self._kv_tier_entry(self._kv[k])
+                ttl = (e or {}).get("ttl_s") or 0
+                if e is None or (ttl > 0
+                                 and now - e.get("ts", now) > ttl):
+                    self._kv.pop(k, None)
+                    self._store.delete("kv", k.encode())
+                    dropped += 1
+        return {"dropped": dropped}
+
+    def _retract_kv_tier_locked(self, whex: str | None = None,
+                                nhex: str | None = None) -> None:
+        """Drop every kv_tier: entry owned by a dead worker or node —
+        their object refs are unservable, and a cold replica probing the
+        index must miss, not hang on a fetch. Caller holds self._lock
+        (same discipline as _retract_metrics_source)."""
+        for k in [k for k in self._kv if k.startswith(_KV_TIER_PREFIX)]:
+            e = self._kv_tier_entry(self._kv[k])
+            if e is None:
+                continue
+            if (whex is not None and e.get("owner") == whex) or \
+                    (nhex is not None and e.get("node") == nhex):
+                self._kv.pop(k, None)
+                self._store.delete("kv", k.encode())
 
     # ---- pubsub -------------------------------------------------------
     def _h_subscribe(self, body):
@@ -939,6 +1037,10 @@ class ControlPlane:
             with self._lock:
                 self._dead_workers.add(whex)
                 self._retract_metrics_source(whex)
+                # its spilled KV chains are gone with it: a replica
+                # probing the tier index must miss instead of fetching
+                # a dead worker's object refs
+                self._retract_kv_tier_locked(whex=whex)
         aid = body.get("actor_id")
         if aid is not None:
             self._on_actor_down(aid, body.get("reason", "worker died"), clean=False)
@@ -1418,6 +1520,8 @@ class ControlPlane:
                 self._retract_metrics_source(src)
                 if not src.startswith("node:"):
                     self._dead_workers.add(src)
+            # every kv_tier entry spilled from this node is unservable
+            self._retract_kv_tier_locked(nhex=nhex)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("node", {"event": "dead", "node_id": node_id})
         for aid in victims:

@@ -90,7 +90,7 @@ async function refresh() {
     sparkline(ts, "memory_percent_avg", "cluster mem %") +
     sparkline(ts, "logical_cpus_in_use", "logical CPUs in use") +
     sparkline(ts, "object_store_used_bytes", "object store bytes");
-  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks", "traces"];
+  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks", "traces", "kvtier"];
   let html = "";
   for (const s of sections) {
     const rows = await (await fetch("/api/" + s)).json();
@@ -530,6 +530,10 @@ class Dashboard:
                 return _serve_apps()
             if section == "traces":
                 return state.list_traces(limit=100)
+            if section == "kvtier":
+                # tiered-KV prefix index rows (same CP query `ray-tpu
+                # kvtier` renders); the generic section loop tables them
+                return (state.list_kv_tier() or {}).get("entries") or []
             if section == "timeseries":
                 return self._timeseries.snapshot()
             if section == "logs":

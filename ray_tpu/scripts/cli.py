@@ -329,6 +329,49 @@ def cmd_profile(args) -> None:
         raise SystemExit(1)
 
 
+def cmd_kvtier(args) -> None:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_read_address(args.address))
+    if args.gc:
+        out = state.kv_tier_gc()
+        print(f"gc dropped {out.get('dropped', 0)} expired entries",
+              file=sys.stderr)
+    res = state.list_kv_tier()
+    entries = res.get("entries") or []
+    if args.json:
+        print(json.dumps(res, indent=2))
+        return
+    # per-entry rows, then totals per tier/node + CP hit counters
+    by_tier: dict[str, dict] = {}
+    by_node: dict[str, int] = {}
+    for e in entries:
+        t = by_tier.setdefault(e.get("tier", "?"),
+                               {"entries": 0, "bytes": 0})
+        t["entries"] += 1
+        t["bytes"] += int(e.get("nbytes") or 0)
+        node = (e.get("node") or "?")[:8]
+        by_node[node] = by_node.get(node, 0) + 1
+        print(json.dumps({
+            "digest": (e.get("digest") or "")[:16],
+            "tier": e.get("tier"), "node": node,
+            "owner": (e.get("owner") or "")[:8],
+            "tokens": e.get("tokens"), "nbytes": e.get("nbytes"),
+            "age_s": round(time.time() - e["ts"], 1)
+            if e.get("ts") else None}))
+    print(f"# {len(entries)} indexed pages", file=sys.stderr)
+    for tier, agg in sorted(by_tier.items()):
+        print(f"#   tier={tier}: {agg['entries']} entries "
+              f"{agg['bytes']} bytes", file=sys.stderr)
+    for node, n in sorted(by_node.items()):
+        print(f"#   node={node}: {n} entries", file=sys.stderr)
+    c = res.get("counters") or {}
+    print(f"# match_calls={c.get('match_calls', 0)} "
+          f"hits={c.get('hits', 0)} misses={c.get('misses', 0)} "
+          f"hit_pages={c.get('hit_pages', 0)}", file=sys.stderr)
+
+
 def _parse_tags(spec: str | None) -> dict | None:
     tags = _parse_labels(spec)
     return tags or None
@@ -479,6 +522,16 @@ def main(argv=None) -> None:
     sp.add_argument("--list", action="store_true",
                     help="list registered capture artifacts and exit")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser(
+        "kvtier",
+        help="list the cluster tiered-KV index (spilled prefix pages)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--gc", action="store_true",
+                    help="drop expired index entries before listing")
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw index document instead of rows")
+    sp.set_defaults(fn=cmd_kvtier)
 
     args = p.parse_args(argv)
     if args.cmd == "submit" and args.entrypoint \

@@ -284,6 +284,9 @@ class ServeController:
                         "prefix_hits", "prefix_misses", "prefix_hit_tokens",
                         "prefix_cached_pages", "prefix_shared_pages",
                         "prefix_evictions",
+                        "spilled_pages", "restored_pages",
+                        "tier_hit_tokens", "tier_bytes_shm",
+                        "tier_bytes_disk",
                         "decode_block_effective", "pending_pipeline_depth",
                         "spec_rounds", "spec_drafted_tokens",
                         "spec_accepted_tokens",

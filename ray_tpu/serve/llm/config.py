@@ -100,6 +100,21 @@ class LLMConfig:
     # longest suffix n-gram used for the lookup (longer match first)
     spec_ngram_max: int = 3
 
+    # Tiered KV cache (serve/llm/kv_tier.py): prefix pages evicted from
+    # the pool spill host-side into the node's shm object plane (backed
+    # by a bounded local disk tier under pressure) and register in a
+    # cluster-wide CP index, so ANY replica — including a cold one —
+    # restores a spilled prefix instead of re-prefilling it. Greedy
+    # outputs stay bit-identical to cold prefill; every tier failure
+    # degrades to a plain cache miss. Requires prefix_cache_enabled.
+    # Default OFF: spilling trades host copies + shm for prefill FLOPs,
+    # which only pays on shared-prefix traffic.
+    kv_tier_enabled: bool = False
+    kv_tier_max_bytes: int = 256 * 1024 * 1024   # shm tier byte cap
+    kv_tier_disk_dir: Optional[str] = None       # None = disk tier off
+    kv_tier_disk_max_bytes: int = 1024 * 1024 * 1024
+    kv_tier_ttl_s: float = 600.0                 # entry lifetime; <=0 = none
+
     # sampling defaults (overridable per request)
     max_tokens: int = 128
     temperature: float = 0.0          # 0 = greedy

@@ -155,8 +155,38 @@ class LLMEngine:
                       "requests": 0, "shed_expired": 0, "compile_s": 0.0,
                       "prefix_hits": 0, "prefix_misses": 0,
                       "prefix_hit_tokens": 0,
+                      "spilled_pages": 0, "restored_pages": 0,
+                      "tier_hit_tokens": 0,
                       "spec_rounds": 0, "spec_drafted_tokens": 0,
                       "spec_accepted_tokens": 0}
+        # Tiered KV cache (kv_tier.py): evicted cached page chains spill
+        # host-side into a shm/disk tier + cluster index instead of dying,
+        # and _admit extends its longest-match search past the local index
+        # into the tier. The allocator hook only CAPTURES evictions and
+        # dispatches one device gather (stream-ordered before any reuse of
+        # the pages); the host copy + object-store put happen later on the
+        # loop, off the admission hot path (_kv_tier_flush).
+        self._kv_tier_on = bool(cfg.kv_tier_enabled) and self._prefix_cache_on
+        self._kv_tier = None
+        self._tier_pending: list = []  # [(dev_k, dev_v, [(page, dig, pos)])]
+        if self._kv_tier_on:
+            from ray_tpu.serve.llm import kv_tier as kvt
+            self._kv_tier = kvt.KVTierStore(
+                max_bytes=cfg.kv_tier_max_bytes,
+                disk_dir=cfg.kv_tier_disk_dir,
+                disk_max_bytes=cfg.kv_tier_disk_max_bytes,
+                ttl_s=cfg.kv_tier_ttl_s,
+                page_size=cfg.page_size)
+            self.allocator.spill_hook = self._spill_capture
+            # restore scatter at ONE fixed shape (max_pages_per_seq,
+            # trash-page padded) — same donated-pool pattern as disagg's
+            # _inject; an eager per-count scatter would compile per
+            # distinct restored-page count
+            self._tier_inject = jax.jit(
+                lambda kv, bk, bv, pages: {
+                    "k": kv["k"].at[:, :, pages].set(bk),
+                    "v": kv["v"].at[:, :, pages].set(bv)},
+                donate_argnums=(0,))
         # Speculative decoding (spec_decode.py + the verify-k program
         # below): host-side n-gram drafts verified k-at-a-time in one
         # fused dispatch. Greedy-only guarantee — non-greedy slots are
@@ -443,6 +473,17 @@ class LLMEngine:
             self._zero_tok = jnp.int32(0)
         toks = self._patch_toks(
             toks, didx, jnp.stack([self._zero_tok] * (trash + 1)))
+        if self._kv_tier_on:
+            # the tier-restore scatter too: its one fixed shape would
+            # otherwise compile on the first tier hit, mid-traffic (an
+            # all-trash-page write of zeros is a no-op)
+            mp = self.max_pages_per_seq
+            zb = jnp.zeros(self.kv["k"].shape[:2] + (mp,)
+                           + self.kv["k"].shape[3:], self.kv["k"].dtype)
+            with self._prof.compile_scope("kv_tier_inject",
+                                          ("kv_tier_inject", mp)):
+                self.kv = self._tier_inject(
+                    self.kv, zb, zb, jnp.zeros((mp,), jnp.int32))
         self._dev_tokens = toks
         self._jax.block_until_ready(toks)
 
@@ -466,6 +507,15 @@ class LLMEngine:
                 self._harvest_one()
         except Exception:  # noqa: BLE001 - device may already be gone
             self._pending.clear()
+        if self._kv_tier is not None:
+            # flush captured spills, then drop the tier's blobs and
+            # retract our cluster-index entries — a clean shutdown must
+            # not leave the index pointing at refs nobody serves
+            try:
+                self._kv_tier_flush()
+            except Exception:  # noqa: BLE001
+                self._tier_pending.clear()
+            self._kv_tier.close()
 
     def submit(self, prompt: str | list[int], *,
                max_tokens: Optional[int] = None,
@@ -657,6 +707,12 @@ class LLMEngine:
                         "prefix_evictions": cs["evicted"],
                         "prefix_hit_pages": cs["hit_pages"],
                         "prefix_inserted_pages": cs["inserted"]})
+        # tier byte gauges are always emitted (0 when the tier is off) so
+        # exporters and the README drift guard see a stable key set; the
+        # spill/restore counters live in self.stats above
+        ts = self._kv_tier.stats() if self._kv_tier is not None else {}
+        out["tier_bytes_shm"] = ts.get("shm_bytes", 0)
+        out["tier_bytes_disk"] = ts.get("disk_bytes", 0)
         return out
 
     # ---- engine loop ---------------------------------------------------
@@ -677,6 +733,11 @@ class LLMEngine:
             # chunk dispatches count as progress: an otherwise-idle engine
             # mid-chunked-prefill must not sleep between chunks
             dispatched = self._step() or chunks > 0
+            if self._kv_tier_on:
+                # spill gathers captured by evictions this pass: their
+                # device->host copies were started at dispatch, so this
+                # is mostly bookkeeping + an object-store put
+                self._kv_tier_flush()
             # Eager harvest: pop every block whose device result already
             # landed (is_ready) — holding computed tokens unharvested just
             # adds their age to TTFT/ITL. The blocking PIPELINE_DEPTH trim
@@ -804,6 +865,13 @@ class LLMEngine:
                     key = "prefix_hits" if matched else "prefix_misses"
                     self.stats[key] += 1
                     self.stats["prefix_hit_tokens"] += req.cached_tokens
+            if self._kv_tier_on:
+                # extend the match past the local index into the KV tier:
+                # restored pages scatter into this request's fresh pages
+                # and the suffix prefill starts past them. Outside the
+                # lock — a remote fetch replaces a whole prefill, but it
+                # must not serialize other submitters.
+                self._kv_tier_restore(req, len(matched))
             suffix = len(req.prompt_tokens) - req.prefill_pos
             if req.prefill_pos > 0 or (self.cfg.prefill_chunk > 0
                                        and suffix > self.cfg.prefill_chunk):
@@ -819,6 +887,106 @@ class LLMEngine:
             else:
                 self._prefill(req)
             admitted += 1
+
+    # ---- tiered KV cache (kv_tier.py) ---------------------------------
+    _SPILL_GATHER_WIDTH = 8  # fixed gather width: one compiled shape
+
+    def _spill_capture(self, evicted) -> None:
+        """Allocator spill hook: runs on the loop thread immediately
+        after an evicting alloc()/free(), BEFORE the caller can dispatch
+        writes that reuse the pages — so the gather dispatched here reads
+        the pre-eviction KV on the ordered device stream. Only the
+        dispatch happens here; the device->host copy is started async and
+        harvested later by _kv_tier_flush, off the admission hot path."""
+        jnp = self._jnp
+        ents = [(p, d, pos) for (p, d, pos) in evicted if pos is not None]
+        if not ents:
+            return
+        w = self._SPILL_GATHER_WIDTH
+        for i in range(0, len(ents), w):
+            batch = ents[i:i + w]
+            # pad the gather index to the fixed width with the trash page
+            # (sliced off host-side) so spill batches of every size share
+            # one compiled gather
+            pidx = jnp.asarray(
+                [p for p, _, _ in batch] + [0] * (w - len(batch)),
+                jnp.int32)
+            bk = jnp.take(self.kv["k"], pidx, axis=2)
+            bv = jnp.take(self.kv["v"], pidx, axis=2)
+            self._start_fetch(bk)
+            self._start_fetch(bv)
+            self._tier_pending.append((bk, bv, batch))
+
+    def _kv_tier_flush(self) -> None:
+        """Harvest captured spill gathers (host copy already in flight)
+        and hand them to the tier store. A failed put degrades to a
+        plain eviction — the pages are long since back on the free
+        list."""
+        if not self._tier_pending:
+            return
+        pend, self._tier_pending = self._tier_pending, []
+        for bk, bv, ents in pend:
+            try:
+                k_np = np.asarray(bk)[:, :, :len(ents)]
+                v_np = np.asarray(bv)[:, :, :len(ents)]
+                n = self._kv_tier.put(
+                    k_np, v_np,
+                    digests=[d.hex() for _, d, _ in ents],
+                    tokens=[(pos + 1) * self.cfg.page_size
+                            for _, _, pos in ents])
+                self.stats["spilled_pages"] += n
+            except Exception:  # noqa: BLE001 - spill is best-effort
+                logger.warning("kv-tier spill put failed; chain evicted "
+                               "without spilling", exc_info=True)
+
+    def _kv_tier_restore(self, req: _Request, m_loc: int) -> int:
+        """Restore tier-held chain pages into this request's freshly
+        allocated pages: local-shm/disk hits load from this process,
+        remote hits fetch through the object plane via the CP index.
+        Returns restored page count; ANY failure degrades to a plain
+        miss (the pages just get prefilled normally)."""
+        try:
+            ps = self.cfg.page_size
+            toks = req.prompt_tokens
+            limit = min((len(toks) - 1) // ps, len(req.pages))
+            if limit <= m_loc:
+                return 0
+            digest = b""
+            digs = []
+            for i in range(limit):
+                digest = self._kvc._chain_digest(
+                    digest, toks[i * ps:(i + 1) * ps])
+                digs.append(digest.hex())
+            t, k_np, v_np = self._kv_tier.fetch_chain(digs, start=m_loc)
+            t = min(t, limit - m_loc)
+            if t <= 0:
+                return 0
+            jnp = self._jnp
+            mp = self.max_pages_per_seq
+            shape = k_np.shape
+            pad = np.zeros(shape[:2] + (mp - t,) + shape[3:], k_np.dtype)
+            pages_vec = jnp.asarray(
+                list(req.pages[m_loc:m_loc + t]) + [0] * (mp - t),
+                jnp.int32)
+            with self._prof.compile_scope(
+                    "kv_tier_inject", ("kv_tier_inject", mp),
+                    mid_traffic=self.stats["requests"] > 0):
+                self.kv = self._tier_inject(
+                    self.kv,
+                    jnp.asarray(np.concatenate([k_np[:, :, :t], pad],
+                                               axis=2)),
+                    jnp.asarray(np.concatenate([v_np[:, :, :t], pad],
+                                               axis=2)),
+                    pages_vec)
+            req.cached_tokens = (m_loc + t) * ps
+            req.prefill_pos = req.cached_tokens
+            self.stats["restored_pages"] += t
+            self.stats["tier_hit_tokens"] += t * ps
+            return t
+        except Exception:  # noqa: BLE001 - restore degrades to a miss
+            logger.warning("kv-tier restore failed; cold prefill instead",
+                           exc_info=True)
+            return 0
 
     def _prefill(self, req: _Request):
         """Dispatch prefill WITHOUT waiting for it: the sampled first token

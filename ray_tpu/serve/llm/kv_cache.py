@@ -27,6 +27,7 @@ Page 0 is RESERVED as the trash page; the allocator never hands it out.
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 from collections import OrderedDict
 
@@ -42,6 +43,8 @@ from ray_tpu.models.llama import (
     rms_norm,
     rope_freqs,
 )
+
+logger = logging.getLogger(__name__)
 
 
 def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int):
@@ -87,6 +90,15 @@ class PageAllocator:
 
     ``cache_pages`` caps how many refcount-zero cached pages are retained
     (0 = bounded only by the pool itself).
+
+    Spilling (serve/llm/kv_tier.py): ``spill_hook``, when set, receives
+    every ``(page, digest, chain_pos)`` evicted during one ``alloc()`` /
+    ``free()`` call — after the allocator lock is released but BEFORE
+    control returns to the caller, i.e. before the caller can dispatch
+    device writes that reuse the freed pages (the hook's gather lands
+    first on the ordered device stream). A raising hook is swallowed:
+    the eviction has already completed, so behavior degrades to a plain
+    free — no page leaks, no deadlock, just no spill.
     """
 
     def __init__(self, num_pages: int, cache_pages: int = 0):
@@ -97,41 +109,62 @@ class PageAllocator:
         self._ref: dict[int, int] = {}          # live page -> refcount
         self._index: dict[bytes, int] = {}      # chain digest -> page
         self._page_key: dict[int, bytes] = {}   # indexed page -> digest
+        self._page_pos: dict[int, int] = {}     # indexed page -> chain pos
         self._lru: OrderedDict[int, None] = OrderedDict()  # ref-0 cached
+        self.spill_hook = None
         self.counters = {"hit_pages": 0, "miss_pages": 0, "evicted": 0,
                          "inserted": 0}
 
     # ---- allocation ----------------------------------------------------
-    def _evict_one_locked(self) -> bool:
+    def _evict_one_locked(self, spilled: list | None = None) -> bool:
         """Drop the least-recently-used refcount-zero cached page back to
-        the free list (its index node dies with it). Lock held."""
+        the free list (its index node dies with it). Lock held. When a
+        spill hook is installed, the page's (page, digest, chain_pos) is
+        appended to ``spilled`` for the post-lock hook call."""
         if not self._lru:
             return False
         page, _ = self._lru.popitem(last=False)
         key = self._page_key.pop(page)
+        pos = self._page_pos.pop(page, None)
         if self._index.get(key) == page:
             del self._index[key]
+        if spilled is not None and self.spill_hook is not None:
+            spilled.append((page, key, pos))
         self._free.append(page)
         self.counters["evicted"] += 1
         return True
 
+    def _fire_spill_hook(self, spilled: list) -> None:
+        hook = self.spill_hook
+        if hook is None or not spilled:
+            return
+        try:
+            hook(spilled)
+        except Exception:  # noqa: BLE001 - spill is best-effort by contract
+            logger.warning(
+                "kv-tier spill hook failed; %d pages evicted without "
+                "spilling", len(spilled), exc_info=True)
+
     def alloc(self, n: int) -> list[int] | None:
         """n fresh pages at refcount 1, evicting cached pages LRU-first
         under pressure; None when free + evictable can't cover n."""
+        spilled: list = []
         with self._lock:
             if len(self._free) + len(self._lru) < n:
                 return None  # can't be satisfied — don't evict for nothing
             while len(self._free) < n:
-                self._evict_one_locked()
+                self._evict_one_locked(spilled)
             out = [self._free.pop() for _ in range(n)]
             for p in out:
                 self._ref[p] = 1
-            return out
+        self._fire_spill_hook(spilled)
+        return out
 
     def free(self, pages: list[int]) -> None:
         """Decref; a page reaching zero parks in the cached LRU if indexed
         (content stays valid for later matches), else rejoins the free
         list. Safe against double-free of already-dead pages."""
+        spilled: list = []
         with self._lock:
             for p in pages:
                 if p == 0:
@@ -151,9 +184,10 @@ class PageAllocator:
                     self._lru.move_to_end(p)
                     while self._cache_cap > 0 \
                             and len(self._lru) > self._cache_cap:
-                        self._evict_one_locked()
+                        self._evict_one_locked(spilled)
                 else:
                     self._free.append(p)
+        self._fire_spill_hook(spilled)
 
     def incref(self, pages: list[int]) -> None:
         with self._lock:
@@ -162,7 +196,11 @@ class PageAllocator:
                     self._ref[p] = self._ref.get(p, 0) + 1
 
     def available(self) -> int:
-        """Pages an alloc() could obtain: free + evictable cached."""
+        """Pages an alloc() could obtain: strictly-free + evictable
+        cached. NOT the same as ``cache_stats()["free_pages"]`` — an
+        evictable page still holds restorable KV content (and, with the
+        kv tier on, spills on eviction); see cache_stats() for the
+        three-way occupancy breakdown."""
         with self._lock:
             return len(self._free) + len(self._lru)
 
@@ -223,14 +261,34 @@ class PageAllocator:
                     continue
                 self._index[digest] = page
                 self._page_key[page] = digest
+                # chain position: the spill path needs each evicted
+                # page's token length ((pos+1) * page_size) to register
+                # it in the cluster index
+                self._page_pos[page] = i
                 added += 1
             self.counters["inserted"] += added
         return added
 
     def cache_stats(self) -> dict:
-        """Snapshot for engine stats / metrics export."""
+        """Snapshot for engine stats / metrics export.
+
+        Three distinct occupancy numbers — dashboards must not conflate
+        them (eviction is non-destructive once spilling is on):
+
+        - ``free_pages``: strictly free — on the free list, content dead,
+          allocation costs nothing.
+        - ``evictable_pages``: refcount-zero but cached — content is
+          live, restorable KV; allocating them evicts (and, with the kv
+          tier on, spills) first.
+        - live/referenced pages: ``num_pages - 1 - free - evictable``
+          (page 0 is the reserved trash page) — pinned by active slots,
+          never evictable.
+
+        ``available()`` = free_pages + evictable_pages.
+        """
         with self._lock:
             return {**self.counters,
+                    "free_pages": len(self._free),
                     "cached_pages": len(self._page_key),
                     "evictable_pages": len(self._lru),
                     "shared_pages": sum(1 for c in self._ref.values()

@@ -1,0 +1,337 @@
+"""Cluster-wide tiered KV cache: spill evicted prefix pages, restore
+anywhere.
+
+PR 3's prefix cache is per-replica: a page chain evicted under pool
+pressure is simply freed, and a cold replica re-prefills prefixes a
+sibling already computed. This module keeps those chains alive in two
+lower tiers and publishes them cluster-wide (Mooncake's KV-cache-centric
+store, CacheGen's cache-across-machines result — see PAPERS.md):
+
+- **shm tier**: spilled page chains are ``put()`` into the node's shm
+  object plane (the same blob layout disagg's KV handoff ships:
+  ``[L, Hkv, pages, page, D]`` per k/v). The store holds the ObjectRef,
+  so the bytes stay pinned in shared memory until demoted or expired.
+  Outside a cluster (unit tests, standalone engines) the tier degrades
+  to an in-process dict with identical accounting.
+- **disk tier**: a bounded local directory backs shm under pressure —
+  the LRU shm blob demotes to disk instead of dying. Disk blobs are
+  local-only: their cluster-index entries lose the object ref, so
+  remote replicas skip them while the owner can still restore.
+- **cluster index**: every spilled page registers a CP KV entry
+  ``kv_tier:<chain-digest-hex>`` -> JSON {owner, node, ref, blob, off,
+  tokens, nbytes, tier, ts, ttl_s}. The chain digest encodes the entire
+  token prefix (kv_cache._chain_digest), so an index hit IS a token
+  match. Entries are retracted when the owning worker or node dies
+  (control_plane worker_died/_on_node_dead, exactly like the
+  metrics-store GC) and lazily on TTL expiry (``ray-tpu kvtier --gc``).
+
+Both caps are byte caps enforced at put time; eviction within a tier is
+LRU; every entry carries a TTL. All failure paths degrade: a failed
+spill leaves eviction a plain free, a failed restore is a plain cache
+miss.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_KEY_PREFIX = "kv_tier:"
+
+
+def _now() -> float:
+    return time.time()
+
+
+class KVTierStore:
+    """Local spill store (shm + disk tiers) plus cluster-index client.
+
+    One instance per engine. All device work stays in the engine — this
+    class only ever sees host numpy blobs. Thread-safe; the engine loop
+    is the only writer, stats/CLI readers may probe concurrently.
+    """
+
+    def __init__(self, max_bytes: int, disk_dir: Optional[str],
+                 disk_max_bytes: int, ttl_s: float, page_size: int):
+        self.max_bytes = int(max_bytes)
+        self.disk_dir = disk_dir
+        self.disk_max_bytes = int(disk_max_bytes)
+        self.ttl_s = float(ttl_s)
+        self.page_size = int(page_size)
+        # distinct from the worker id: several engines (serve replicas,
+        # tests) can share one worker process, and "is this entry mine"
+        # must mean THIS store, while death-GC keys on the worker
+        self.store_id = uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        # blob_id -> record; OrderedDict is the shm-tier LRU (disk-tier
+        # records stay members but carry tier="disk")
+        self._blobs: OrderedDict[str, dict] = OrderedDict()
+        self._by_digest: dict[str, tuple[str, int]] = {}  # digest -> (blob, off)
+        self._shm_bytes = 0
+        self._disk_bytes = 0
+        self.counters = {"put_blobs": 0, "put_pages": 0, "demoted_blobs": 0,
+                         "dropped_blobs": 0, "expired_blobs": 0,
+                         "local_hits": 0, "remote_hits": 0}
+
+    # ---- runtime plumbing ----------------------------------------------
+    @staticmethod
+    def _runtime():
+        from ray_tpu.core import api
+        return api._try_get_runtime()
+
+    def _cp_call(self, method: str, body, timeout: float = 5.0):
+        rt = self._runtime()
+        if rt is None:
+            return None
+        return rt.cp_client.call(method, body, timeout=timeout)
+
+    # ---- spill ----------------------------------------------------------
+    def put(self, k_np: np.ndarray, v_np: np.ndarray,
+            digests: list[str], tokens: list[int]) -> int:
+        """Store one spilled chain batch. ``k_np``/``v_np`` are host
+        arrays shaped [L, Hkv, n, page, D]; ``digests[i]``/``tokens[i]``
+        are page i's chain digest (hex) and its cumulative token length.
+        Returns how many pages were registered (0 when the batch doesn't
+        fit the shm cap at all)."""
+        nbytes = int(k_np.nbytes) + int(v_np.nbytes)
+        if nbytes > self.max_bytes or not digests:
+            return 0
+        blob = {"k": k_np, "v": v_np, "page_size": self.page_size,
+                "digests": list(digests), "tokens": list(tokens)}
+        bid = uuid.uuid4().hex[:16]
+        rt = self._runtime()
+        ref = rt.put(blob) if rt is not None else None
+        rec = {"id": bid, "nbytes": nbytes, "tier": "shm", "ts": _now(),
+               "digests": list(digests), "tokens": list(tokens),
+               "ref": ref, "data": blob if ref is None else None,
+               "path": None}
+        with self._lock:
+            self._expire_locked()
+            while self._shm_bytes + nbytes > self.max_bytes:
+                if not self._demote_oldest_locked():
+                    break
+            self._blobs[bid] = rec
+            self._shm_bytes += nbytes
+            for i, d in enumerate(digests):
+                self._by_digest[d] = (bid, i)
+            self.counters["put_blobs"] += 1
+            self.counters["put_pages"] += len(digests)
+        self._register_cp(rec)
+        return len(digests)
+
+    def _register_cp(self, rec: dict) -> None:
+        """Publish every page of one blob into the CP ``kv_tier:``
+        namespace. Best-effort — index registration must never break
+        serving (an unregistered spill is still locally restorable)."""
+        rt = self._runtime()
+        if rt is None:
+            return
+        try:
+            whex = rt.worker_id.hex()
+            nhex = rt.node_id.hex() if rt.node_id is not None else ""
+            ref_hex = (pickle.dumps(rec["ref"]).hex()
+                       if rec["tier"] == "shm" and rec["ref"] is not None
+                       else None)
+            per_page = rec["nbytes"] // max(1, len(rec["digests"]))
+            for i, d in enumerate(rec["digests"]):
+                entry = {"owner": whex, "node": nhex,
+                         "store": self.store_id, "blob": rec["id"],
+                         "off": i, "tokens": rec["tokens"][i],
+                         "nbytes": per_page, "tier": rec["tier"],
+                         "ts": rec["ts"], "ttl_s": self.ttl_s,
+                         "ref": ref_hex}
+                self._cp_call("kv_put", {
+                    "key": _KEY_PREFIX + d,
+                    "value": json.dumps(entry).encode(),
+                    "overwrite": True})
+        except Exception:
+            logger.debug("kv-tier: CP index registration failed",
+                         exc_info=True)
+
+    def _retract_cp(self, rec: dict) -> None:
+        for d in rec["digests"]:
+            try:
+                self._cp_call("kv_del", {"key": _KEY_PREFIX + d},
+                              timeout=2.0)
+            except Exception:
+                break  # CP gone; worker-death GC will sweep
+
+    # ---- tier maintenance (lock held) -----------------------------------
+    def _expire_locked(self) -> None:
+        if self.ttl_s <= 0:
+            return
+        cutoff = _now() - self.ttl_s
+        dead = [b for b, r in self._blobs.items() if r["ts"] < cutoff]
+        for bid in dead:
+            self._drop_locked(bid, reason="expired")
+
+    def _demote_oldest_locked(self) -> bool:
+        """Move the LRU shm blob down to the disk tier (or drop it when
+        the disk tier is off/full-of-smaller-things)."""
+        oldest = next((b for b, r in self._blobs.items()
+                       if r["tier"] == "shm"), None)
+        if oldest is None:
+            return False
+        rec = self._blobs[oldest]
+        if (self.disk_dir is None
+                or rec["nbytes"] > self.disk_max_bytes):
+            self._drop_locked(oldest, reason="dropped")
+            return True
+        try:
+            blob = self._load_blob_locked(rec)
+            os.makedirs(self.disk_dir, exist_ok=True)
+            path = os.path.join(self.disk_dir, rec["id"] + ".kvt")
+            with open(path, "wb") as f:
+                pickle.dump(blob, f)
+        except Exception:
+            logger.warning("kv-tier: demotion to disk failed; dropping",
+                           exc_info=True)
+            self._drop_locked(oldest, reason="dropped")
+            return True
+        while self._disk_bytes + rec["nbytes"] > self.disk_max_bytes:
+            victim = next((b for b, r in self._blobs.items()
+                           if r["tier"] == "disk"), None)
+            if victim is None:
+                break
+            self._drop_locked(victim, reason="dropped")
+        rec.update(tier="disk", path=path, ref=None, data=None)
+        self._shm_bytes -= rec["nbytes"]
+        self._disk_bytes += rec["nbytes"]
+        self.counters["demoted_blobs"] += 1
+        # remote replicas must stop trying to fetch the gone object ref
+        threading.Thread(target=self._register_cp, args=(rec,),
+                         daemon=True).start()
+        return True
+
+    def _drop_locked(self, bid: str, reason: str) -> None:
+        rec = self._blobs.pop(bid, None)
+        if rec is None:
+            return
+        if rec["tier"] == "shm":
+            self._shm_bytes -= rec["nbytes"]
+        else:
+            self._disk_bytes -= rec["nbytes"]
+            if rec["path"]:
+                try:
+                    os.unlink(rec["path"])
+                except OSError:
+                    pass
+        for d in rec["digests"]:
+            if self._by_digest.get(d, (None,))[0] == bid:
+                del self._by_digest[d]
+        self.counters["%s_blobs" % reason] += 1
+        threading.Thread(target=self._retract_cp, args=(rec,),
+                         daemon=True).start()
+
+    def _load_blob_locked(self, rec: dict) -> dict:
+        if rec["data"] is not None:
+            return rec["data"]
+        if rec["path"] is not None:
+            with open(rec["path"], "rb") as f:
+                return pickle.load(f)
+        rt = self._runtime()
+        if rt is None:
+            raise RuntimeError("kv-tier blob held by ref but no runtime")
+        return rt.get([rec["ref"]], timeout=10.0)[0]
+
+    # ---- restore ---------------------------------------------------------
+    def fetch_chain(self, digests: list[str], start: int):
+        """Longest restorable run of chain pages beginning at ``start``.
+
+        ``digests`` are the prompt's full-page chain digests (hex),
+        position 0 first. Local tiers are probed before the cluster
+        index; a local run and a remote run are never mixed. Returns
+        ``(t, k_np, v_np)`` with the arrays shaped [L, Hkv, t, page, D],
+        or ``(0, None, None)``."""
+        run: list[tuple[str, int]] = []
+        with self._lock:
+            self._expire_locked()
+            i = start
+            while i < len(digests):
+                loc = self._by_digest.get(digests[i])
+                if loc is None:
+                    break
+                run.append(loc)
+                i += 1
+            if run:
+                # touch for LRU recency, then assemble under the lock so
+                # a concurrent demotion can't pull a blob out from under
+                # the reads
+                parts_k, parts_v = [], []
+                blobs: dict[str, dict] = {}
+                for bid, off in run:
+                    if bid not in blobs:
+                        self._blobs.move_to_end(bid)
+                        blobs[bid] = self._load_blob_locked(self._blobs[bid])
+                    parts_k.append(blobs[bid]["k"][:, :, off:off + 1])
+                    parts_v.append(blobs[bid]["v"][:, :, off:off + 1])
+                self.counters["local_hits"] += len(run)
+                return (len(run), np.concatenate(parts_k, axis=2),
+                        np.concatenate(parts_v, axis=2))
+        return self._fetch_remote(digests, start)
+
+    def _fetch_remote(self, digests: list[str], start: int):
+        rt = self._runtime()
+        if rt is None:
+            return 0, None, None
+        resp = self._cp_call("kv_tier_match", {"digests": digests[start:]})
+        raw = (resp or {}).get("entries") or []
+        entries = []
+        for v in raw:
+            try:
+                e = json.loads(v.decode() if isinstance(v, bytes) else v)
+            except (ValueError, AttributeError):
+                break
+            # disk-tier entries are owner-local; our own stale entries
+            # (already missed the local probe above) are unusable too
+            if e.get("tier") != "shm" or not e.get("ref") \
+                    or e.get("store") == self.store_id:
+                break
+            entries.append(e)
+        if not entries:
+            return 0, None, None
+        refs: dict[str, object] = {}
+        for e in entries:
+            if e["ref"] not in refs:
+                refs[e["ref"]] = pickle.loads(bytes.fromhex(e["ref"]))
+        fetched = rt.get(list(refs.values()), timeout=15.0)
+        blobs = dict(zip(refs.keys(), fetched))
+        parts_k, parts_v = [], []
+        for e in entries:
+            blob = blobs[e["ref"]]
+            off = int(e["off"])
+            parts_k.append(blob["k"][:, :, off:off + 1])
+            parts_v.append(blob["v"][:, :, off:off + 1])
+        with self._lock:
+            self.counters["remote_hits"] += len(entries)
+        return (len(entries), np.concatenate(parts_k, axis=2),
+                np.concatenate(parts_v, axis=2))
+
+    # ---- observability / lifecycle --------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            shm = sum(1 for r in self._blobs.values() if r["tier"] == "shm")
+            return {**self.counters,
+                    "shm_bytes": self._shm_bytes,
+                    "disk_bytes": self._disk_bytes,
+                    "blobs_shm": shm,
+                    "blobs_disk": len(self._blobs) - shm,
+                    "indexed_pages": len(self._by_digest)}
+
+    def close(self) -> None:
+        """Drop every blob and retract our index entries (clean engine
+        shutdown; crash cleanup is the CP's worker-death GC)."""
+        with self._lock:
+            for bid in list(self._blobs):
+                self._drop_locked(bid, reason="dropped")

@@ -33,6 +33,9 @@ _EXPORTED_STATS = (
     "prefix_hit_pages", "prefix_cached_pages", "prefix_evictable_pages",
     "prefix_shared_pages", "prefix_evictions", "prefix_inserted_pages",
     "decode_block_effective", "pending_pipeline_depth",
+    # tiered KV cache (ISSUE 7): spill/restore economy + per-tier bytes
+    "spilled_pages", "restored_pages", "tier_hit_tokens",
+    "tier_bytes_shm", "tier_bytes_disk",
     "spec_rounds", "spec_drafted_tokens", "spec_accepted_tokens",
     # introspection scalars (ISSUE 6): compile tracker + memory gauges;
     # None-valued entries (no samples yet / cpu backend) are skipped

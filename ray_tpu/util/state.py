@@ -290,3 +290,19 @@ def save_device_memory_profile(node_id: Optional[str] = None,
     if path:
         body["path"] = path
     return _cp().call("save_device_memory_profile", body, timeout=90.0)
+
+
+def list_kv_tier() -> dict:
+    """Cluster-wide tiered-KV-cache prefix index (serve/llm/kv_tier.py):
+    one entry per spilled page (owner replica/node, tier, token length,
+    bytes) plus the CP-side match/hit counters. The `ray-tpu kvtier` CLI
+    and the dashboard's kvtier table render this."""
+    return _cp().call("kv_tier_index", {}, timeout=10.0) or {
+        "entries": [], "counters": {}}
+
+
+def kv_tier_gc() -> dict:
+    """Drop expired kv_tier index entries (owners retract their own on
+    demotion/shutdown; this sweeps entries whose owner is wedged).
+    Returns {"dropped": n}."""
+    return _cp().call("kv_tier_gc", {}, timeout=30.0) or {"dropped": 0}

@@ -1,0 +1,498 @@
+"""Cluster-wide tiered KV cache (serve/llm/kv_tier.py): spill evicted
+prefix pages to the object plane, restore on any replica via the CP
+prefix index.
+
+Pins the PR's acceptance invariants:
+- evicted refcount-zero cached chains spill through the allocator hook
+  (digest + chain position intact) instead of silently dying;
+- tier-restored completions are token-identical to cold prefill (greedy),
+  both from the local shm/disk tiers and across replicas via the CP
+  index + object plane;
+- EVERY tier failure degrades: a raising spill hook / failed put is a
+  plain free (no leak, no deadlock), a failed restore is a plain miss;
+- byte caps demote shm->disk and bound the disk tier; TTL expires lazily;
+- dead owners' index entries are retracted (worker_died GC) and stale
+  ones swept by kv_tier_gc;
+- kv_tier_enabled=False leaves eviction byte-identical to PR 3 (no hook,
+  no store, zeroed counters).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.llm.kv_cache import PageAllocator, _chain_digest
+from ray_tpu.serve.llm.kv_tier import KVTierStore
+
+
+def _tier_cfg(**kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+
+    # prefix_cache_max_pages=2 makes spilling deterministic: a drained
+    # 5-full-page prompt parks 5 indexed pages and the cap evicts (and
+    # spills) the 3 LRU-oldest — the chain head — at free time.
+    d = dict(model_config=llama.llama_tiny(vocab_size=512),
+             max_batch_size=4, page_size=16, num_pages=64,
+             max_prompt_len=96, max_seq_len=160, max_tokens=8,
+             prefix_cache_max_pages=2, kv_tier_enabled=True)
+    d.update(kw)
+    return LLMConfig(**d)
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog"   # 43 byte-tokens
+LONG = PROMPT + " " + PROMPT                             # 87 -> 5 full pages
+
+_WANT: dict = {}
+
+
+def _want_tokens(prompt, max_tokens=8):
+    """Greedy ground truth from a cache-off, tier-off engine (memoized —
+    engine startup dominates this suite's runtime)."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    key = (prompt, max_tokens)
+    if key not in _WANT:
+        off = LLMEngine(_tier_cfg(kv_tier_enabled=False,
+                                  prefix_cache_enabled=False), rng_seed=0)
+        off.start()
+        try:
+            _WANT[key] = off.generate(prompt, max_tokens=max_tokens,
+                                      temperature=0.0)["tokens"]
+        finally:
+            off.shutdown()
+    return _WANT[key]
+
+
+def _wait(pred, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# allocator: spill hook contract
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_spill_hook_captures_evicted_chain():
+    ps = 4
+    a = PageAllocator(num_pages=16)
+    captured = []
+    a.spill_hook = captured.extend
+    toks = list(range(16))                    # 4 full pages
+    pages = a.alloc(4)
+    a.insert_prefix(toks, pages, ps)
+    a.free(pages)                             # park all 4 (no cap)
+    assert captured == []                     # parking is not eviction
+
+    a.alloc(13)  # 11 free + 4 parked: must evict 2, LRU (chain head) first
+    assert [p for p, _, _ in captured] == pages[:2]
+    assert [pos for _, _, pos in captured] == [0, 1]
+    # digests are the real chain digests of the evicted prefix
+    d0 = _chain_digest(b"", toks[0:4])
+    d1 = _chain_digest(d0, toks[4:8])
+    assert [d for _, d, _ in captured] == [d0, d1]
+    assert a.counters["evicted"] == 2
+
+
+def test_allocator_spill_hook_fires_on_cache_cap_free():
+    ps = 4
+    a = PageAllocator(num_pages=32, cache_pages=2)
+    captured = []
+    a.spill_hook = captured.extend
+    pages = a.alloc(6)
+    a.insert_prefix(list(range(24)), pages, ps)
+    a.free(pages)                             # cap 2: 4 evicted at free time
+    assert len(captured) == 4
+    assert [p for p, _, _ in captured] == pages[:4]
+
+
+def test_allocator_raising_spill_hook_degrades_to_plain_free():
+    """The eviction has already completed when the hook runs: a raising
+    hook loses the spill, nothing else — no page leak, no deadlock, pool
+    accounting identical to a hook-less allocator."""
+    ps = 4
+    a = PageAllocator(num_pages=16)
+    baseline = a.available()
+
+    def boom(spilled):
+        raise RuntimeError("injected spill failure")
+
+    a.spill_hook = boom
+    pages = a.alloc(4)
+    a.insert_prefix(list(range(16)), pages, ps)
+    a.free(pages)
+    got = a.alloc(13)                         # evicts 2 through the hook
+    assert got is not None and len(got) == 13
+    assert a.counters["evicted"] == 2
+    a.free(got)
+    assert a.available() == baseline          # nothing leaked
+    # allocator still fully functional after the failure
+    assert a.alloc(13) is not None
+
+
+def test_cache_stats_free_pages_triplet():
+    """cache_stats() distinguishes strictly-free from evictable; the
+    engine's free_pages stat stays available() (free + evictable) — the
+    invariant test_prefix_cache pins."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    ps = 4
+    a = PageAllocator(num_pages=16)
+    pages = a.alloc(4)
+    a.insert_prefix(list(range(16)), pages, ps)
+    a.free(pages)
+    st = a.cache_stats()
+    assert st["free_pages"] == 11             # 15 usable - 4 parked
+    assert st["evictable_pages"] == 4
+    assert st["free_pages"] + st["evictable_pages"] == a.available()
+
+    eng = LLMEngine(_tier_cfg(), rng_seed=0)
+    assert eng.engine_stats()["free_pages"] == eng.allocator.available()
+
+
+# ---------------------------------------------------------------------------
+# KVTierStore: shm/disk tiers, caps, TTL (no runtime -> in-process tier)
+# ---------------------------------------------------------------------------
+
+
+def _blob(n_pages, seed=0):
+    """[L, Hkv, n, page, D] k/v pair + hex chain digests + token lengths."""
+    rng = np.random.default_rng(seed)
+    shape = (2, 2, n_pages, 4, 8)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    digest = b"" if seed == 0 else b"seed%d" % seed
+    digs = []
+    for i in range(n_pages):
+        digest = _chain_digest(digest, [seed * 100 + i])
+        digs.append(digest.hex())
+    return k, v, digs, [(i + 1) * 4 for i in range(n_pages)]
+
+
+def test_store_put_fetch_roundtrip_and_partial_start():
+    s = KVTierStore(max_bytes=1 << 20, disk_dir=None,
+                    disk_max_bytes=0, ttl_s=600.0, page_size=4)
+    k, v, digs, toks = _blob(3)
+    assert s.put(k, v, digs, toks) == 3
+    t, gk, gv = s.fetch_chain(digs, start=0)
+    assert t == 3
+    np.testing.assert_array_equal(gk, k)
+    np.testing.assert_array_equal(gv, v)
+    # restore composing with a local prefix hit: start past page 0
+    t, gk, gv = s.fetch_chain(digs, start=1)
+    assert t == 2
+    np.testing.assert_array_equal(gk, k[:, :, 1:])
+    # unknown chain head -> no run
+    assert s.fetch_chain(["ff" * 16] + digs, start=0)[0] == 0
+    assert s.counters["local_hits"] == 5
+    assert s.stats()["indexed_pages"] == 3
+
+
+def test_store_shm_cap_demotes_to_disk(tmp_path):
+    k, v, digs, toks = _blob(3, seed=1)
+    nbytes = k.nbytes + v.nbytes
+    s = KVTierStore(max_bytes=nbytes, disk_dir=str(tmp_path),
+                    disk_max_bytes=10 * nbytes, ttl_s=600.0, page_size=4)
+    assert s.put(k, v, digs, toks) == 3
+    k2, v2, digs2, toks2 = _blob(3, seed=2)
+    assert s.put(k2, v2, digs2, toks2) == 3   # cap: blob 1 demotes to disk
+    st = s.stats()
+    assert st["demoted_blobs"] == 1
+    assert st["blobs_disk"] == 1 and st["blobs_shm"] == 1
+    assert st["shm_bytes"] == nbytes and st["disk_bytes"] == nbytes
+    assert list(tmp_path.glob("*.kvt"))
+    # the demoted chain is still restorable (loads from disk)
+    t, gk, _gv = s.fetch_chain(digs, start=0)
+    assert t == 3
+    np.testing.assert_array_equal(gk, k)
+
+
+def test_store_disk_cap_drops_lru(tmp_path):
+    k, v, digs, toks = _blob(3, seed=1)
+    nbytes = k.nbytes + v.nbytes
+    # disk holds exactly one blob: demoting a second must drop the first
+    s = KVTierStore(max_bytes=nbytes, disk_dir=str(tmp_path),
+                    disk_max_bytes=nbytes, ttl_s=600.0, page_size=4)
+    blobs = [_blob(3, seed=i) for i in (1, 2, 3)]
+    for bk, bv, bd, bt in blobs:
+        assert s.put(bk, bv, bd, bt) == 3
+    st = s.stats()
+    assert st["demoted_blobs"] == 2           # blobs 1 and 2 went down
+    assert st["dropped_blobs"] == 1           # blob 1 fell off the disk cap
+    assert st["blobs_disk"] == 1 and st["disk_bytes"] == nbytes
+    assert len(list(tmp_path.glob("*.kvt"))) == 1
+    assert s.fetch_chain(blobs[0][2], start=0)[0] == 0   # gone
+    assert s.fetch_chain(blobs[1][2], start=0)[0] == 3   # on disk
+    assert s.fetch_chain(blobs[2][2], start=0)[0] == 3   # in shm
+
+
+def test_store_ttl_expiry():
+    s = KVTierStore(max_bytes=1 << 20, disk_dir=None,
+                    disk_max_bytes=0, ttl_s=0.05, page_size=4)
+    k, v, digs, toks = _blob(2)
+    assert s.put(k, v, digs, toks) == 2
+    time.sleep(0.1)
+    assert s.fetch_chain(digs, start=0)[0] == 0   # lazy expiry at probe
+    st = s.stats()
+    assert st["expired_blobs"] == 1
+    assert st["shm_bytes"] == 0 and st["indexed_pages"] == 0
+
+
+def test_store_oversized_put_refused():
+    s = KVTierStore(max_bytes=64, disk_dir=None,
+                    disk_max_bytes=0, ttl_s=600.0, page_size=4)
+    k, v, digs, toks = _blob(2)
+    assert k.nbytes + v.nbytes > 64
+    assert s.put(k, v, digs, toks) == 0
+    assert s.stats()["put_blobs"] == 0
+    assert s.fetch_chain(digs, start=0)[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: spill on evict, restore identity, failure degradation
+# ---------------------------------------------------------------------------
+
+
+def test_engine_spill_on_evict_populates_tier():
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_tier_cfg(), rng_seed=0)
+    eng.start()
+    try:
+        out = eng.generate(LONG, temperature=0.0)
+        assert out["error"] is None
+        # free parks 5 indexed pages; cap 2 evicts 3 through the hook;
+        # the loop's next pass flushes the captured gathers to the store
+        assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 3)
+        st = eng.engine_stats()
+        assert st["tier_bytes_shm"] > 0
+        assert eng._kv_tier.stats()["put_pages"] >= 3
+        assert eng.allocator.counters["evicted"] >= 3
+    finally:
+        eng.shutdown()
+
+
+def test_engine_local_restore_tokens_identical_to_cold():
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG)
+    eng = LLMEngine(_tier_cfg(), rng_seed=0)
+    eng.start()
+    try:
+        cold = eng.generate(LONG, temperature=0.0)["tokens"]
+        assert cold == want
+        assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 3)
+        # chain head was evicted -> local match_prefix misses at page 0;
+        # the tier restore brings the spilled head back zero-prefill
+        hot = eng.generate(LONG, temperature=0.0)["tokens"]
+        assert hot == want
+        st = eng.engine_stats()
+        assert st["restored_pages"] >= 3
+        assert st["tier_hit_tokens"] >= 3 * 16
+        assert eng._kv_tier.counters["local_hits"] >= 3
+    finally:
+        eng.shutdown()
+
+
+def test_engine_restore_failure_degrades_to_miss():
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG)
+    eng = LLMEngine(_tier_cfg(), rng_seed=0)
+    eng.start()
+    try:
+        assert eng.generate(LONG, temperature=0.0)["tokens"] == want
+        assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 3)
+
+        def boom(digests, start):
+            raise RuntimeError("injected restore failure")
+
+        eng._kv_tier.fetch_chain = boom
+        # plain cold prefill, same tokens, engine keeps serving
+        assert eng.generate(LONG, temperature=0.0)["tokens"] == want
+        assert eng.engine_stats()["restored_pages"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_engine_failed_spill_put_falls_back_to_plain_free():
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG)
+    cfg = _tier_cfg()
+    eng = LLMEngine(cfg, rng_seed=0)
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected put failure")
+
+    eng._kv_tier.put = boom
+    eng.start()
+    try:
+        assert eng.generate(LONG, temperature=0.0)["tokens"] == want
+        # evictions happened but every spill put failed: no tier pages, no
+        # deadlock, and the pool fully recycles (free_pages == available())
+        assert _wait(lambda: eng.allocator.counters["evicted"] >= 3)
+        assert eng.generate(LONG, temperature=0.0)["tokens"] == want
+        assert _wait(lambda: not eng._tier_pending)
+        st = eng.engine_stats()
+        assert st["spilled_pages"] == 0
+        assert st["tier_bytes_shm"] == 0
+        assert st["active_slots"] == 0
+        assert st["free_pages"] == cfg.num_pages - 1
+    finally:
+        eng.shutdown()
+
+
+def test_kv_tier_default_off_is_inert():
+    """kv_tier_enabled=False must leave eviction byte-identical to PR 3:
+    no hook installed, no store constructed, counters stay zero (and the
+    tier byte gauges still export as 0 for a stable stats key set)."""
+    from ray_tpu.serve.llm import LLMConfig, LLMEngine
+
+    assert LLMConfig().kv_tier_enabled is False   # default OFF
+    eng = LLMEngine(_tier_cfg(kv_tier_enabled=False), rng_seed=0)
+    assert eng._kv_tier is None
+    assert eng.allocator.spill_hook is None
+    st = eng.engine_stats()
+    assert st["spilled_pages"] == 0 and st["restored_pages"] == 0
+    assert st["tier_hit_tokens"] == 0
+    assert st["tier_bytes_shm"] == 0 and st["tier_bytes_disk"] == 0
+    # disagg-style prefix-off config can't spill either (tier needs it)
+    eng2 = LLMEngine(_tier_cfg(prefix_cache_enabled=False), rng_seed=0)
+    assert eng2._kv_tier is None and not eng2._kv_tier_on
+
+
+# ---------------------------------------------------------------------------
+# cluster: CP index, cross-replica restore, death GC
+# (keep these LAST: the module-scoped runtime stays up once started, and
+# the local-tier tests above pin the no-runtime in-process store path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def kv_cluster(ray_start_module):
+    yield ray_start_module
+
+
+def test_cross_replica_restore_via_cp_index(kv_cluster):
+    """Replica B (cold engine, empty local tier) restores a prefix
+    replica A spilled: CP index match -> object-plane fetch -> scatter —
+    token-identical to cold prefill."""
+    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.util import state
+
+    want = _want_tokens(LONG)
+    a = LLMEngine(_tier_cfg(), rng_seed=0)
+    a.start()
+    b = None
+    try:
+        assert a.generate(LONG, temperature=0.0)["tokens"] == want
+        assert _wait(lambda: a.engine_stats()["spilled_pages"] >= 3)
+        assert any(e["tier"] == "shm"
+                   for e in state.list_kv_tier()["entries"])
+
+        b = LLMEngine(_tier_cfg(), rng_seed=0)
+        b.start()
+        assert b.generate(LONG, temperature=0.0)["tokens"] == want
+        st = b.engine_stats()
+        assert st["restored_pages"] >= 3
+        assert st["tier_hit_tokens"] >= 3 * 16
+        assert b._kv_tier.counters["remote_hits"] >= 3
+        assert state.list_kv_tier()["counters"]["hits"] >= 1
+    finally:
+        a.shutdown()
+        if b is not None:
+            b.shutdown()
+
+
+def test_dead_worker_retracts_index_entries(kv_cluster):
+    """worker_died drops every kv_tier: entry the dead worker owned —
+    same GC shape as the metrics store — so replicas miss instead of
+    hanging on a dead owner's object refs."""
+    from ray_tpu.core import api
+    from ray_tpu.util import state
+
+    cp = api._get_runtime().cp_client
+    entry = {"owner": "deadbeefcafe", "node": "", "store": "x", "blob": "b",
+             "off": 0, "tokens": 16, "nbytes": 1024, "tier": "shm",
+             "ts": time.time(), "ttl_s": 600.0, "ref": None}
+    cp.call("kv_put", {"key": "kv_tier:" + "ab" * 16,
+                       "value": json.dumps(entry).encode(),
+                       "overwrite": True})
+    assert any(e["owner"] == "deadbeefcafe"
+               for e in state.list_kv_tier()["entries"])
+
+    cp.call("worker_died", {"worker_id": "deadbeefcafe",
+                            "reason": "test kill"})
+    assert not any(e["owner"] == "deadbeefcafe"
+                   for e in state.list_kv_tier()["entries"])
+
+
+def test_kv_tier_gc_and_match_counters(kv_cluster):
+    from ray_tpu.core import api
+    from ray_tpu.util import state
+
+    cp = api._get_runtime().cp_client
+    stale = {"owner": "feed01", "node": "", "store": "x", "blob": "b",
+             "off": 0, "tokens": 16, "nbytes": 1024, "tier": "shm",
+             "ts": time.time() - 120, "ttl_s": 1.0, "ref": None}
+    cp.call("kv_put", {"key": "kv_tier:" + "cd" * 16,
+                       "value": json.dumps(stale).encode(),
+                       "overwrite": True})
+    assert state.kv_tier_gc()["dropped"] >= 1
+    assert not any(e.get("owner") == "feed01"
+                   for e in state.list_kv_tier()["entries"])
+
+    before = state.list_kv_tier()["counters"]["match_calls"]
+    assert cp.call("kv_tier_match",
+                   {"digests": ["ff" * 16]}) == {"entries": []}
+    after = state.list_kv_tier()["counters"]
+    assert after["match_calls"] == before + 1
+    assert after["misses"] >= 1
+
+
+@pytest.mark.slow
+def test_two_replica_cross_restore_stress(kv_cluster):
+    """Sustained shared-prefix traffic on replica A, then the same
+    workload on a cold replica B: every completion must match A's, B must
+    restore through the tier, and both pools must drain to baseline."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    templates = [f"ctx{t} " + "q" * 70 + " " for t in range(2)]
+    prompts = [templates[i % 2] + f"u{i:02d}" for i in range(8)]
+
+    cfg = _tier_cfg()
+    a = LLMEngine(cfg, rng_seed=0)
+    a.start()
+    b = None
+    try:
+        want = {}
+        for p in prompts:
+            out = a.generate(p, max_tokens=6, temperature=0.0)
+            assert out["error"] is None
+            want[p] = out["tokens"]
+        assert _wait(lambda: a.engine_stats()["spilled_pages"] >= 1)
+
+        b = LLMEngine(cfg, rng_seed=0)
+        b.start()
+        ids = [b.submit(p, max_tokens=6, temperature=0.0) for p in prompts]
+        for p, rid in zip(prompts, ids):
+            out = b.result(rid, timeout=180.0)
+            assert out["error"] is None, out
+            assert out["tokens"] == want[p]
+        stb = b.engine_stats()
+        assert stb["restored_pages"] >= 1     # tier actually restored
+        for eng in (a, b):
+            assert _wait(lambda: eng.engine_stats()["active_slots"] == 0)
+            assert eng.engine_stats()["free_pages"] == cfg.num_pages - 1
+    finally:
+        a.shutdown()
+        if b is not None:
+            b.shutdown()

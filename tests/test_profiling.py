@@ -232,7 +232,7 @@ def test_readme_engine_stats_table_matches_live_keys():
     engine-telemetry table, and every documented key must exist — with
     prefix cache, spec decoding, and profiling all on."""
     eng = _mk_engine(prefix_cache_enabled=True, spec_decode_enabled=True,
-                     spec_draft_len=2)
+                     spec_draft_len=2, kv_tier_enabled=True)
     try:
         eng.generate("drift guard prompt one two three", max_tokens=6)
         live = set(eng.engine_stats().keys())
