@@ -376,10 +376,15 @@ class ControlPlane:
             return [k for k in self._kv if k.startswith(prefix)]
 
     # ---- kv tier (serve/llm/kv_tier.py cluster prefix index) ----------
-    # One kv_tier:<chain-digest-hex> entry per spilled KV page; values are
-    # JSON dicts carrying {owner, node, store, ref, blob, off, tokens,
-    # nbytes, tier, ts, ttl_s}. Entries die with their owning worker/node
-    # (same GC shape as the metrics store) or by TTL (_h_kv_tier_gc).
+    # One kv_tier:<ns>:<chain-digest-hex> entry per spilled KV page (the
+    # ns segment is the owner's model-identity hash — replicas serving
+    # different models never see each other's pages); values are JSON
+    # dicts carrying {owner, node, store, ref, blob, off, tokens, nbytes,
+    # tier, ts, ttl_s, ns}. Entries die with their owning worker/node
+    # (same GC shape as the metrics store), by owner retraction
+    # (_h_kv_tier_del — compare-and-delete on (store, blob) so a
+    # re-spilled digest's newer entry survives its old blob's drop), or
+    # by TTL (_h_kv_tier_gc).
 
     @staticmethod
     def _kv_tier_entry(value):
@@ -396,8 +401,10 @@ class ControlPlane:
         round trip for the whole chain probe instead of one kv_get per
         page)."""
         digests = body.get("digests") or []
+        ns = body.get("ns") or ""
+        pre = _KV_TIER_PREFIX + (ns + ":" if ns else "")
         with self._lock:
-            vals = [self._kv.get(_KV_TIER_PREFIX + d) for d in digests]
+            vals = [self._kv.get(pre + d) for d in digests]
             run = 0
             for v in vals:
                 if v is None:
@@ -411,6 +418,26 @@ class ControlPlane:
             else:
                 c["misses"] += 1
             return {"entries": vals[:run]}
+
+    def _h_kv_tier_del(self, body):
+        """Retract one index entry, conditionally: when the caller sends
+        (store, blob), the key is only dropped if the stored entry still
+        carries them — a digest re-spilled into a newer blob keeps its
+        fresh registration when the OLD blob's retraction arrives late.
+        Unparseable entries always drop."""
+        key = body["key"]
+        with self._lock:
+            cur = self._kv_tier_entry(self._kv.get(key)) \
+                if key in self._kv else None
+            if key in self._kv and cur is not None \
+                    and body.get("blob") is not None:
+                if (cur.get("store") != body.get("store")
+                        or cur.get("blob") != body.get("blob")):
+                    return {"deleted": False}
+            if self._kv.pop(key, None) is not None:
+                self._store.delete("kv", key.encode())
+                return {"deleted": True}
+            return {"deleted": False}
 
     def _h_kv_tier_index(self, body):
         """Whole-index dump for `ray-tpu kvtier` / the dashboard table:
@@ -426,7 +453,12 @@ class ControlPlane:
             if e is None:
                 continue
             e.pop("ref", None)
-            e["digest"] = k[len(_KV_TIER_PREFIX):]
+            # key is kv_tier:[<ns>:]<digest>; un-namespaced keys predate
+            # the model-identity scoping (and appear in tests)
+            ns, _, dig = k[len(_KV_TIER_PREFIX):].rpartition(":")
+            e["digest"] = dig
+            if ns:
+                e.setdefault("ns", ns)
             entries.append(e)
         entries.sort(key=lambda e: (e.get("owner", ""), e.get("blob", ""),
                                     e.get("off", 0)))

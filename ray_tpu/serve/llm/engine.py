@@ -23,6 +23,7 @@ process; within it one thread drives the device). `submit()` / `drain()` /
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import logging
 import threading
@@ -171,12 +172,26 @@ class LLMEngine:
         self._tier_pending: list = []  # [(dev_k, dev_v, [(page, dig, pos)])]
         if self._kv_tier_on:
             from ray_tpu.serve.llm import kv_tier as kvt
+            # cluster-index namespace: a chain digest encodes the token
+            # prefix, NOT which model computed the KV — two architecturally
+            # identical models would cross-restore each other's pages and
+            # silently decode garbage. Scope the index to everything that
+            # makes KV bytes interchangeable: model id, weights (checkpoint
+            # path, or the init seed for random weights), architecture
+            # config, KV dtype, page size.
+            ident = "|".join([
+                str(cfg.model_id),
+                str(cfg.checkpoint_path or f"seed:{rng_seed}"),
+                repr(self.model_cfg),
+                str(cfg.page_size),
+                str(self.kv["k"].dtype)])
             self._kv_tier = kvt.KVTierStore(
                 max_bytes=cfg.kv_tier_max_bytes,
                 disk_dir=cfg.kv_tier_disk_dir,
                 disk_max_bytes=cfg.kv_tier_disk_max_bytes,
                 ttl_s=cfg.kv_tier_ttl_s,
-                page_size=cfg.page_size)
+                page_size=cfg.page_size,
+                namespace=hashlib.sha256(ident.encode()).hexdigest()[:16])
             self.allocator.spill_hook = self._spill_capture
             # restore scatter at ONE fixed shape (max_pages_per_seq,
             # trash-page padded) — same donated-pool pattern as disagg's
@@ -870,7 +885,12 @@ class LLMEngine:
                 # restored pages scatter into this request's fresh pages
                 # and the suffix prefill starts past them. Outside the
                 # lock — a remote fetch replaces a whole prefill, but it
-                # must not serialize other submitters.
+                # must not serialize other submitters. The fetch itself
+                # runs on this loop thread, so the tier bounds every
+                # blocking load to ~2s (kv_tier._REMOTE_FETCH_TIMEOUT_S):
+                # a dead peer or stale index entry costs at most one
+                # short stall before degrading to a plain miss, never a
+                # multi-second freeze of admission + active decodes.
                 self._kv_tier_restore(req, len(matched))
             suffix = len(req.prompt_tokens) - req.prefill_pos
             if req.prefill_pos > 0 or (self.cfg.prefill_chunk > 0

@@ -18,10 +18,13 @@ store, CacheGen's cache-across-machines result — see PAPERS.md):
   local-only: their cluster-index entries lose the object ref, so
   remote replicas skip them while the owner can still restore.
 - **cluster index**: every spilled page registers a CP KV entry
-  ``kv_tier:<chain-digest-hex>`` -> JSON {owner, node, ref, blob, off,
-  tokens, nbytes, tier, ts, ttl_s}. The chain digest encodes the entire
-  token prefix (kv_cache._chain_digest), so an index hit IS a token
-  match. Entries are retracted when the owning worker or node dies
+  ``kv_tier:<ns>:<chain-digest-hex>`` -> JSON {owner, node, store,
+  blob, off, tokens, nbytes, tier, ts, ttl_s, ref, ns}. ``ns`` is a
+  model-identity namespace (the engine hashes model id, checkpoint,
+  architecture config, KV dtype and page size): two replicas only see
+  each other's entries when their KV bytes are actually interchangeable
+  — a digest alone encodes the token prefix, not which model produced
+  the KV. Entries are retracted when the owning worker or node dies
   (control_plane worker_died/_on_node_dead, exactly like the
   metrics-store GC) and lazily on TTL expiry (``ray-tpu kvtier --gc``).
 
@@ -29,6 +32,15 @@ Both caps are byte caps enforced at put time; eviction within a tier is
 LRU; every entry carries a TTL. All failure paths degrade: a failed
 spill leaves eviction a plain free, a failed restore is a plain cache
 miss.
+
+Concurrency: ``self._lock`` guards only in-memory bookkeeping — never
+I/O. Disk writes (demotion), disk reads and object-plane gets (restore)
+run on snapshots taken under the lock, so a slow tier never serializes
+concurrent spills, probes, or stats readers. All cluster-index traffic
+(register on put/demote, retract on drop) flows through ONE background
+publisher thread fed by an ordered queue: snapshots are enqueued under
+the lock in mutation order, so a retract can never race past the
+register it supersedes.
 """
 
 from __future__ import annotations
@@ -37,6 +49,7 @@ import json
 import logging
 import os
 import pickle
+import queue
 import threading
 import time
 import uuid
@@ -49,6 +62,17 @@ logger = logging.getLogger(__name__)
 
 _KEY_PREFIX = "kv_tier:"
 
+# Restore-path fetch budgets. A restore replaces (part of) a prefill, so
+# it only pays while it's cheaper than recomputing: a dead peer or stale
+# index entry must degrade to a plain miss in O(prefill) time, not stall
+# the engine loop (and every active decode behind it) for tens of
+# seconds. Sized to replace-a-prefill economics.
+_REMOTE_FETCH_TIMEOUT_S = 2.0   # object-plane get of a peer's blob
+_LOCAL_REF_TIMEOUT_S = 2.0      # object-plane get of our own shm blob
+
+# idle exit for the lazily-started index-publisher thread
+_PUB_IDLE_EXIT_S = 5.0
+
 
 def _now() -> float:
     return time.time()
@@ -60,15 +84,22 @@ class KVTierStore:
     One instance per engine. All device work stays in the engine — this
     class only ever sees host numpy blobs. Thread-safe; the engine loop
     is the only writer, stats/CLI readers may probe concurrently.
+
+    ``namespace`` scopes the cluster index to one model identity; the
+    engine passes a hash of (model id, checkpoint, architecture, KV
+    dtype, page size). Empty namespace (unit tests, standalone stores)
+    means un-scoped keys.
     """
 
     def __init__(self, max_bytes: int, disk_dir: Optional[str],
-                 disk_max_bytes: int, ttl_s: float, page_size: int):
+                 disk_max_bytes: int, ttl_s: float, page_size: int,
+                 namespace: str = ""):
         self.max_bytes = int(max_bytes)
         self.disk_dir = disk_dir
         self.disk_max_bytes = int(disk_max_bytes)
         self.ttl_s = float(ttl_s)
         self.page_size = int(page_size)
+        self.namespace = str(namespace)
         # distinct from the worker id: several engines (serve replicas,
         # tests) can share one worker process, and "is this entry mine"
         # must mean THIS store, while death-GC keys on the worker
@@ -83,6 +114,9 @@ class KVTierStore:
         self.counters = {"put_blobs": 0, "put_pages": 0, "demoted_blobs": 0,
                          "dropped_blobs": 0, "expired_blobs": 0,
                          "local_hits": 0, "remote_hits": 0}
+        # ordered cluster-index publisher (see module docstring)
+        self._pub_q: queue.Queue = queue.Queue()
+        self._pub_thread: Optional[threading.Thread] = None
 
     # ---- runtime plumbing ----------------------------------------------
     @staticmethod
@@ -95,6 +129,11 @@ class KVTierStore:
         if rt is None:
             return None
         return rt.cp_client.call(method, body, timeout=timeout)
+
+    def _key(self, digest_hex: str) -> str:
+        if self.namespace:
+            return _KEY_PREFIX + self.namespace + ":" + digest_hex
+        return _KEY_PREFIX + digest_hex
 
     # ---- spill ----------------------------------------------------------
     def put(self, k_np: np.ndarray, v_np: np.ndarray,
@@ -118,19 +157,60 @@ class KVTierStore:
                "path": None}
         with self._lock:
             self._expire_locked()
-            while self._shm_bytes + nbytes > self.max_bytes:
-                if not self._demote_oldest_locked():
-                    break
+        # demotion does disk I/O, so it runs its own lock/unlock cycles
+        self._make_room(nbytes)
+        with self._lock:
             self._blobs[bid] = rec
             self._shm_bytes += nbytes
             for i, d in enumerate(digests):
                 self._by_digest[d] = (bid, i)
             self.counters["put_blobs"] += 1
             self.counters["put_pages"] += len(digests)
-        self._register_cp(rec)
+            self._pub_enqueue_locked("register", rec)
         return len(digests)
 
-    def _register_cp(self, rec: dict) -> None:
+    # ---- cluster-index publisher ----------------------------------------
+    def _pub_enqueue_locked(self, op: str, rec: dict) -> None:
+        """Queue one register/retract for the publisher thread. Caller
+        holds the lock: the snapshot taken HERE is what the thread sends,
+        so it never reads rec fields that a later demotion/drop mutates,
+        and queue order == mutation order (a retract can't overtake the
+        register it supersedes)."""
+        snap = {"id": rec["id"], "nbytes": rec["nbytes"],
+                "tier": rec["tier"], "ts": rec["ts"],
+                "digests": list(rec["digests"]),
+                "tokens": list(rec["tokens"]), "ref": rec["ref"]}
+        self._pub_q.put((op, snap))
+        t = self._pub_thread
+        if t is None or not t.is_alive():
+            t = threading.Thread(target=self._pub_loop, daemon=True,
+                                 name="kv-tier-pub")
+            self._pub_thread = t
+            t.start()
+
+    def _pub_loop(self) -> None:
+        while True:
+            try:
+                op, snap = self._pub_q.get(timeout=_PUB_IDLE_EXIT_S)
+            except queue.Empty:
+                # exit decision under the lock so an enqueuer can't slip
+                # an item in between the emptiness check and the return
+                with self._lock:
+                    if self._pub_q.empty():
+                        self._pub_thread = None
+                        return
+                continue
+            if op is None:  # close() sentinel
+                return
+            try:
+                if op == "register":
+                    self._register_cp(snap)
+                else:
+                    self._retract_cp(snap)
+            except Exception:
+                logger.debug("kv-tier: index %s failed", op, exc_info=True)
+
+    def _register_cp(self, snap: dict) -> None:
         """Publish every page of one blob into the CP ``kv_tier:``
         namespace. Best-effort — index registration must never break
         serving (an unregistered spill is still locally restorable)."""
@@ -140,34 +220,41 @@ class KVTierStore:
         try:
             whex = rt.worker_id.hex()
             nhex = rt.node_id.hex() if rt.node_id is not None else ""
-            ref_hex = (pickle.dumps(rec["ref"]).hex()
-                       if rec["tier"] == "shm" and rec["ref"] is not None
+            ref_hex = (pickle.dumps(snap["ref"]).hex()
+                       if snap["tier"] == "shm" and snap["ref"] is not None
                        else None)
-            per_page = rec["nbytes"] // max(1, len(rec["digests"]))
-            for i, d in enumerate(rec["digests"]):
+            per_page = snap["nbytes"] // max(1, len(snap["digests"]))
+            for i, d in enumerate(snap["digests"]):
                 entry = {"owner": whex, "node": nhex,
-                         "store": self.store_id, "blob": rec["id"],
-                         "off": i, "tokens": rec["tokens"][i],
-                         "nbytes": per_page, "tier": rec["tier"],
-                         "ts": rec["ts"], "ttl_s": self.ttl_s,
-                         "ref": ref_hex}
+                         "store": self.store_id, "blob": snap["id"],
+                         "off": i, "tokens": snap["tokens"][i],
+                         "nbytes": per_page, "tier": snap["tier"],
+                         "ts": snap["ts"], "ttl_s": self.ttl_s,
+                         "ref": ref_hex, "ns": self.namespace}
                 self._cp_call("kv_put", {
-                    "key": _KEY_PREFIX + d,
+                    "key": self._key(d),
                     "value": json.dumps(entry).encode(),
                     "overwrite": True})
         except Exception:
             logger.debug("kv-tier: CP index registration failed",
                          exc_info=True)
 
-    def _retract_cp(self, rec: dict) -> None:
-        for d in rec["digests"]:
+    def _retract_cp(self, snap: dict) -> None:
+        """Compare-and-delete our own index entries. The CP only drops a
+        key when its entry still carries OUR (store, blob) — when the
+        digest was re-spilled into a newer blob, the newer registration
+        survives (same guard _drop_locked applies to _by_digest). A
+        transient CP failure skips just that digest: the TTL sweep and
+        worker-death GC collect what we miss."""
+        for d in snap["digests"]:
             try:
-                self._cp_call("kv_del", {"key": _KEY_PREFIX + d},
-                              timeout=2.0)
+                self._cp_call("kv_tier_del", {
+                    "key": self._key(d), "store": self.store_id,
+                    "blob": snap["id"]}, timeout=2.0)
             except Exception:
-                break  # CP gone; worker-death GC will sweep
+                continue
 
-    # ---- tier maintenance (lock held) -----------------------------------
+    # ---- tier maintenance ------------------------------------------------
     def _expire_locked(self) -> None:
         if self.ttl_s <= 0:
             return
@@ -176,43 +263,70 @@ class KVTierStore:
         for bid in dead:
             self._drop_locked(bid, reason="expired")
 
-    def _demote_oldest_locked(self) -> bool:
-        """Move the LRU shm blob down to the disk tier (or drop it when
-        the disk tier is off/full-of-smaller-things)."""
-        oldest = next((b for b, r in self._blobs.items()
-                       if r["tier"] == "shm"), None)
-        if oldest is None:
-            return False
-        rec = self._blobs[oldest]
-        if (self.disk_dir is None
-                or rec["nbytes"] > self.disk_max_bytes):
-            self._drop_locked(oldest, reason="dropped")
-            return True
-        try:
-            blob = self._load_blob_locked(rec)
-            os.makedirs(self.disk_dir, exist_ok=True)
-            path = os.path.join(self.disk_dir, rec["id"] + ".kvt")
-            with open(path, "wb") as f:
-                pickle.dump(blob, f)
-        except Exception:
-            logger.warning("kv-tier: demotion to disk failed; dropping",
-                           exc_info=True)
-            self._drop_locked(oldest, reason="dropped")
-            return True
-        while self._disk_bytes + rec["nbytes"] > self.disk_max_bytes:
-            victim = next((b for b, r in self._blobs.items()
-                           if r["tier"] == "disk"), None)
-            if victim is None:
-                break
-            self._drop_locked(victim, reason="dropped")
-        rec.update(tier="disk", path=path, ref=None, data=None)
-        self._shm_bytes -= rec["nbytes"]
-        self._disk_bytes += rec["nbytes"]
-        self.counters["demoted_blobs"] += 1
-        # remote replicas must stop trying to fetch the gone object ref
-        threading.Thread(target=self._register_cp, args=(rec,),
-                         daemon=True).start()
-        return True
+    def _make_room(self, nbytes: int) -> None:
+        """Demote (or drop) LRU shm blobs until ``nbytes`` fits the shm
+        cap. The disk write is staged OUTSIDE the lock — the victim is
+        marked "demoting" so concurrent callers skip it, and the tier
+        flip (accounting + re-registration) happens under the lock only
+        once the bytes are safely on disk. When nothing is demotable the
+        caller inserts over-cap, same best-effort as a failed demotion
+        (the engine loop is the only writer)."""
+        while True:
+            with self._lock:
+                if self._shm_bytes + nbytes <= self.max_bytes:
+                    return
+                oldest = next((b for b, r in self._blobs.items()
+                               if r["tier"] == "shm"
+                               and not r.get("demoting")), None)
+                if oldest is None:
+                    return
+                rec = self._blobs[oldest]
+                if (self.disk_dir is None
+                        or rec["nbytes"] > self.disk_max_bytes):
+                    self._drop_locked(oldest, reason="dropped")
+                    continue
+                rec["demoting"] = True
+                handle = {"data": rec["data"], "path": rec["path"],
+                          "ref": rec["ref"]}
+            path: Optional[str] = None
+            try:
+                blob = self._load_handle(handle)
+                os.makedirs(self.disk_dir, exist_ok=True)
+                path = os.path.join(self.disk_dir, rec["id"] + ".kvt")
+                with open(path, "wb") as f:
+                    pickle.dump(blob, f)
+            except Exception:
+                logger.warning("kv-tier: demotion to disk failed; dropping",
+                               exc_info=True)
+                path = None
+            with self._lock:
+                rec.pop("demoting", None)
+                live = rec["id"] in self._blobs
+                if live and path is not None:
+                    while self._disk_bytes + rec["nbytes"] \
+                            > self.disk_max_bytes:
+                        victim = next((b for b, r in self._blobs.items()
+                                       if r["tier"] == "disk"), None)
+                        if victim is None:
+                            break
+                        self._drop_locked(victim, reason="dropped")
+                    rec.update(tier="disk", path=path, ref=None, data=None)
+                    self._shm_bytes -= rec["nbytes"]
+                    self._disk_bytes += rec["nbytes"]
+                    self.counters["demoted_blobs"] += 1
+                    # remote replicas must stop trying to fetch the gone
+                    # object ref — re-register (queue order keeps this
+                    # behind any earlier retract of the same digests)
+                    self._pub_enqueue_locked("register", rec)
+                    path = None
+                elif live:
+                    self._drop_locked(rec["id"], reason="dropped")
+            if path is not None:
+                # blob was dropped while we wrote: the file is an orphan
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     def _drop_locked(self, bid: str, reason: str) -> None:
         rec = self._blobs.pop(bid, None)
@@ -231,19 +345,21 @@ class KVTierStore:
             if self._by_digest.get(d, (None,))[0] == bid:
                 del self._by_digest[d]
         self.counters["%s_blobs" % reason] += 1
-        threading.Thread(target=self._retract_cp, args=(rec,),
-                         daemon=True).start()
+        self._pub_enqueue_locked("retract", rec)
 
-    def _load_blob_locked(self, rec: dict) -> dict:
-        if rec["data"] is not None:
-            return rec["data"]
-        if rec["path"] is not None:
-            with open(rec["path"], "rb") as f:
+    def _load_handle(self, handle: dict) -> dict:
+        """Materialize a blob from a snapshot taken under the lock. Runs
+        WITHOUT the lock — disk reads and object-plane gets must never
+        serialize other store users."""
+        if handle["data"] is not None:
+            return handle["data"]
+        if handle["path"] is not None:
+            with open(handle["path"], "rb") as f:
                 return pickle.load(f)
         rt = self._runtime()
         if rt is None:
             raise RuntimeError("kv-tier blob held by ref but no runtime")
-        return rt.get([rec["ref"]], timeout=10.0)[0]
+        return rt.get([handle["ref"]], timeout=_LOCAL_REF_TIMEOUT_S)[0]
 
     # ---- restore ---------------------------------------------------------
     def fetch_chain(self, digests: list[str], start: int):
@@ -255,6 +371,7 @@ class KVTierStore:
         ``(t, k_np, v_np)`` with the arrays shaped [L, Hkv, t, page, D],
         or ``(0, None, None)``."""
         run: list[tuple[str, int]] = []
+        handles: dict[str, dict] = {}
         with self._lock:
             self._expire_locked()
             i = start
@@ -264,28 +381,41 @@ class KVTierStore:
                     break
                 run.append(loc)
                 i += 1
-            if run:
-                # touch for LRU recency, then assemble under the lock so
-                # a concurrent demotion can't pull a blob out from under
-                # the reads
-                parts_k, parts_v = [], []
-                blobs: dict[str, dict] = {}
-                for bid, off in run:
-                    if bid not in blobs:
-                        self._blobs.move_to_end(bid)
-                        blobs[bid] = self._load_blob_locked(self._blobs[bid])
-                    parts_k.append(blobs[bid]["k"][:, :, off:off + 1])
-                    parts_v.append(blobs[bid]["v"][:, :, off:off + 1])
-                self.counters["local_hits"] += len(run)
+            # touch for LRU recency and snapshot each blob's load handle
+            # under the lock; the actual disk/ref loads happen below,
+            # lock released
+            for bid, _off in run:
+                if bid not in handles:
+                    self._blobs.move_to_end(bid)
+                    rec = self._blobs[bid]
+                    handles[bid] = {"data": rec["data"],
+                                    "path": rec["path"], "ref": rec["ref"]}
+        if run:
+            try:
+                blobs = {bid: self._load_handle(h)
+                         for bid, h in handles.items()}
+                parts_k = [blobs[bid]["k"][:, :, off:off + 1]
+                           for bid, off in run]
+                parts_v = [blobs[bid]["v"][:, :, off:off + 1]
+                           for bid, off in run]
+                with self._lock:
+                    self.counters["local_hits"] += len(run)
                 return (len(run), np.concatenate(parts_k, axis=2),
                         np.concatenate(parts_v, axis=2))
+            except Exception:
+                # the blob moved (dropped/demoted, ref freed, file gone)
+                # between snapshot and load: treat as a local miss and
+                # fall through to the cluster probe
+                logger.debug("kv-tier: local chain load failed",
+                             exc_info=True)
         return self._fetch_remote(digests, start)
 
     def _fetch_remote(self, digests: list[str], start: int):
         rt = self._runtime()
         if rt is None:
             return 0, None, None
-        resp = self._cp_call("kv_tier_match", {"digests": digests[start:]})
+        resp = self._cp_call("kv_tier_match", {"digests": digests[start:],
+                                               "ns": self.namespace})
         raw = (resp or {}).get("entries") or []
         entries = []
         for v in raw:
@@ -294,9 +424,12 @@ class KVTierStore:
             except (ValueError, AttributeError):
                 break
             # disk-tier entries are owner-local; our own stale entries
-            # (already missed the local probe above) are unusable too
+            # (already missed the local probe above) are unusable too;
+            # a namespace mismatch (pre-namespace entry, hash collision)
+            # would hand us another model's KV
             if e.get("tier") != "shm" or not e.get("ref") \
-                    or e.get("store") == self.store_id:
+                    or e.get("store") == self.store_id \
+                    or e.get("ns", "") != self.namespace:
                 break
             entries.append(e)
         if not entries:
@@ -305,7 +438,8 @@ class KVTierStore:
         for e in entries:
             if e["ref"] not in refs:
                 refs[e["ref"]] = pickle.loads(bytes.fromhex(e["ref"]))
-        fetched = rt.get(list(refs.values()), timeout=15.0)
+        fetched = rt.get(list(refs.values()),
+                         timeout=_REMOTE_FETCH_TIMEOUT_S)
         blobs = dict(zip(refs.keys(), fetched))
         parts_k, parts_v = [], []
         for e in entries:
@@ -335,3 +469,7 @@ class KVTierStore:
         with self._lock:
             for bid in list(self._blobs):
                 self._drop_locked(bid, reason="dropped")
+            t = self._pub_thread
+            self._pub_q.put((None, None))  # drains behind the retracts
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
