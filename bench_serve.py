@@ -65,6 +65,220 @@ def _post_stream(url: str, payload: dict, timeout: float = 600.0) -> dict:
             "engine": last.get("ray_tpu") or {}}
 
 
+def _chaos_scenario(name, events, duration_s, min_rate, *, seed,
+                    request_timeout_s, grace_s):
+    """One chaos scenario: fresh 3-node cluster (controller pinned to
+    node0), a 2-replica echo app, sustained proxy traffic while a seeded
+    FaultSchedule fires, then hard SLO asserts. Returns the result row
+    merged into SERVE_BENCH.json's extra.chaos_suite."""
+    import threading
+    import urllib.error
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.config import get_config
+    from ray_tpu.util.chaos import FaultSchedule
+
+    try:
+        serve.shutdown()
+        ray_tpu.shutdown()
+    except Exception:  # noqa: BLE001 — nothing was up
+        pass
+    # the in-process CP reads the live Config singleton: tighten node-death
+    # detection BEFORE the cluster starts
+    cfg = get_config()
+    cfg.health_check_period_s = 0.2
+    cfg.health_check_failure_threshold = 3
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # node0: controller home, never a victim
+    ray_tpu.init(address=cluster.address, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+    })
+    try:
+        # pin the controller to node0 by creating it while node0 is the
+        # only node, THEN add the replica-bearing nodes
+        from ray_tpu.serve.controller import get_or_create_controller
+        ctl = get_or_create_controller()
+        ray_tpu.get(ctl.status.remote(), timeout=60)
+        cluster.add_node(num_cpus=3)
+        cluster.add_node(num_cpus=3)
+
+        @serve.deployment(num_replicas=2, health_check_period_s=0.2,
+                          health_check_failure_threshold=3,
+                          request_timeout_s=request_timeout_s)
+        def chaos_echo(payload):
+            time.sleep(0.02)
+            return {"ok": True}
+
+        serve.run(chaos_echo.bind(), name=f"chaos-{name}",
+                  route_prefix="/chaos")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}"
+
+        # warm up until the app actually serves; the measured window must
+        # not charge cold-start failures against the fault's SLO
+        warm_deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                if urllib.request.urlopen(
+                        urllib.request.Request(f"{base}/chaos", data=b"{}"),
+                        timeout=request_timeout_s).status == 200:
+                    break
+            except Exception:  # noqa: BLE001 — still starting
+                if time.monotonic() > warm_deadline:
+                    raise
+                time.sleep(0.2)
+
+        results = []  # (ok, elapsed_s, detail)
+        results_lock = threading.Lock()
+        stop_traffic = threading.Event()
+        t_start = time.monotonic()
+
+        def one_request():
+            t0 = time.monotonic()
+            try:
+                resp = urllib.request.urlopen(
+                    urllib.request.Request(f"{base}/chaos", data=b"{}"),
+                    timeout=request_timeout_s + grace_s)
+                ok = resp.status == 200 and \
+                    json.loads(resp.read())["ok"] is True
+                detail = f"http {resp.status}"
+            except urllib.error.HTTPError as e:
+                ok, detail = False, f"http {e.code}: {e.read()[:200]!r}"
+            except Exception as e:  # noqa: BLE001 — failure is data here
+                ok, detail = False, repr(e)[:200]
+            with results_lock:
+                results.append((ok, time.monotonic() - t0,
+                                f"@{t0 - t_start:.1f}s {detail}"))
+
+        def traffic():
+            while not stop_traffic.is_set():
+                one_request()
+                time.sleep(0.02)
+
+        sched = FaultSchedule(cluster, events, seed=seed)
+        with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [pool.submit(traffic) for _ in range(4)]
+            sched.start()
+            time.sleep(duration_s)
+            stop_traffic.set()
+            for f in futs:
+                f.result(timeout=request_timeout_s + grace_s + 10)
+        report = sched.stop()
+
+        total = len(results)
+        succ = sum(1 for ok, _, _ in results if ok)
+        rate = (succ / total) if total else 0.0
+        slow = [round(t, 2) for ok, t, _ in results
+                if ok and t > request_timeout_s + grace_s]
+        failures = [d for ok, _, d in results if not ok]
+        row = {
+            "scenario": name,
+            "events": report,
+            "requests": total,
+            "succeeded": succ,
+            "success_rate": round(rate, 4),
+            "min_success_rate": min_rate,
+            "slow_over_deadline": len(slow),
+        }
+        if len(report) < len(events) or not all(e["ok"] for e in report):
+            print(json.dumps({"chaos_scenario": row}))
+            raise SystemExit(
+                f"chaos suite [{name}]: fault injection itself failed "
+                f"({report!r}) — nothing was exercised, refusing to "
+                f"report an SLO for it")
+        if total < 100:
+            print(json.dumps({"chaos_scenario": row}))
+            raise SystemExit(
+                f"chaos suite [{name}]: only {total} requests generated — "
+                f"not enough traffic to make the SLO meaningful")
+        if rate < min_rate:
+            try:
+                dbg = urllib.request.urlopen(
+                    f"{base}/-/stats", timeout=10).read().decode()
+            except Exception as e:  # noqa: BLE001
+                dbg = repr(e)
+            print(json.dumps({"chaos_scenario": row}))
+            raise SystemExit(
+                f"chaos suite [{name}]: success rate {rate:.4f} "
+                f"({succ}/{total}) below the {min_rate} SLO; failures: "
+                f"{failures[:10]}; server stats: {dbg}")
+        if slow:
+            print(json.dumps({"chaos_scenario": row}))
+            raise SystemExit(
+                f"chaos suite [{name}]: successful responses exceeded "
+                f"deadline+grace: {slow}")
+        try:
+            stats = json.loads(urllib.request.urlopen(
+                f"{base}/-/stats", timeout=10).read())
+            row["degraded_at_end"] = bool(stats.get("degraded"))
+        except Exception:  # noqa: BLE001 — informational only
+            row["degraded_at_end"] = None
+        return row
+    finally:
+        for teardown in (serve.shutdown, ray_tpu.shutdown, cluster.shutdown):
+            try:
+                teardown()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+
+def _run_chaos_suite(args):
+    """--chaos-suite: the deterministic multi-fault serve suite. Four
+    seeded FaultSchedule scenarios — worker kill, node kill, graceful node
+    drain, CP restart — each driving sustained HTTP traffic through a
+    fresh multi-node cluster with hard per-scenario SLO asserts:
+
+      worker_kill / node_kill   >= 99% success (retries + ejection absorb)
+      node_drain                100% success — drain drops ZERO in-flight
+      cp_restart                100% success — the data plane never
+                                touches the CP on the hot path
+
+    plus, for every scenario, no successful response past deadline+grace.
+    The result merges into --out under extra.chaos_suite."""
+    import os
+
+    request_timeout_s = 15.0
+    grace_s = 3.0
+    scenarios = [
+        ("worker_kill",
+         [(2.0, "worker_kill", {"spare_actors": False})], 12.0, 0.99),
+        ("node_kill", [(2.0, "node_kill", {})], 16.0, 0.99),
+        ("node_drain", [(2.0, "node_drain", {"wait": True})], 16.0, 1.0),
+        ("cp_restart", [(2.0, "cp_restart", {"down_s": 1.5})], 10.0, 1.0),
+    ]
+
+    rows = []
+    for name, events, duration_s, min_rate in scenarios:
+        print(f"# chaos scenario: {name}", flush=True)
+        rows.append(_chaos_scenario(
+            name, events, duration_s, min_rate, seed=args.chaos_seed,
+            request_timeout_s=request_timeout_s, grace_s=grace_s))
+
+    chaos_suite = {
+        "seed": args.chaos_seed,
+        "request_timeout_s": request_timeout_s,
+        "grace_s": grace_s,
+        "scenarios": rows,
+    }
+    # merge into --out WITHOUT clobbering earlier headline rows
+    merged = {"metric": "serve_chaos_suite", "value": len(rows),
+              "unit": "scenarios", "extra": {"chaos_suite": chaos_suite}}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                merged = json.load(f)
+            merged.setdefault("extra", {})["chaos_suite"] = chaos_suite
+        except ValueError:
+            pass
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    print(json.dumps({"chaos_suite": chaos_suite}))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=64)
@@ -103,12 +317,26 @@ def main():
                          "headline point with metrics_enabled=False on a "
                          "fresh cluster and assert the p50 TTFT delta is "
                          "within noise (ISSUE 4 overhead bound)")
+    ap.add_argument("--chaos-suite", action="store_true",
+                    help="run the deterministic multi-fault chaos suite "
+                         "(worker kill, node kill, node drain, CP restart) "
+                         "against a plain serve app with hard SLO asserts; "
+                         "merges into --out under extra.chaos_suite and "
+                         "skips the LLM bench")
+    ap.add_argument("--chaos-seed", type=int, default=7,
+                    help="seed for the chaos suite's FaultSchedules")
     ap.add_argument("--out", default="SERVE_BENCH.json",
                     help="JSON file the shared-prefix result merges into")
     ap.add_argument("--no-preflight", action="store_true",
                     help="skip the serve-LLM smoke tests before benching")
     args = ap.parse_args()
     args.shared_prefix = args.shared_prefix or args.curve
+
+    if args.chaos_suite:
+        # the chaos suite is a robustness harness, not a perf number: it
+        # runs a plain (non-LLM) app, so the LLM preflight doesn't apply
+        _run_chaos_suite(args)
+        return
 
     # Preflight: a perf number from a broken engine is worse than no
     # number. The smoke tests run tiny-on-CPU in a subprocess so the

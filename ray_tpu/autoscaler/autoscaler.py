@@ -45,6 +45,10 @@ class Autoscaler:
         self._cfg = config
         self._stopped = threading.Event()
         self._idle_since: dict[str, float] = {}
+        # provider nodes mid-drain: name -> drain-started monotonic ts.
+        # Scale-down is two-phase (drain, THEN terminate) — the VM is only
+        # released once every host finished draining or the deadline passed
+        self._draining: dict[str, float] = {}
         # boots older than this stop counting against demand (the node may
         # have failed — allow a replacement); the instance manager is the
         # single source of what is booting (ALLOCATED instances)
@@ -168,9 +172,36 @@ class Autoscaler:
 
         # scale down: provider nodes whose EVERY host is idle (full
         # availability) past the timeout — a slice terminates whole or not
-        # at all
+        # at all. Two-phase (reference v2 drain-before-terminate): ask the
+        # CP to DRAIN each host (in-flight leases finish, primary objects
+        # migrate to a survivor), then release the VM only once every host
+        # has finished draining (deregistered) — or the drain deadline plus
+        # grace passed, so a wedged host cannot leak the instance forever.
+        from ray_tpu.core.config import get_config as _get_config
+        drain_limit_s = _get_config().drain_deadline_s + 30.0
         for name in list(self._provider.non_terminated_nodes()):
             nodes = cp_nodes_for(name)
+            if name in self._draining:
+                still = [n for n in nodes
+                         if n.get("state", "ALIVE") in ("ALIVE", "DRAINING")]
+                if still and now - self._draining[name] < drain_limit_s:
+                    continue  # hosts still running in-flight work
+                # count at decision time (same as num_launched): providers
+                # drop the node from non_terminated_nodes() DURING the
+                # call, so a post-call increment lets an observer see the
+                # node gone with the counter still short. A failed call
+                # (gcloud flake) must not inflate the counter — roll back
+                # and retry next reconcile.
+                self.num_terminated += 1
+                if not self.instance_manager.begin_terminate(
+                        name, "drained after idle timeout"):
+                    self.num_terminated -= 1
+                    logger.warning(
+                        "terminate_node(%s) failed; will retry", name)
+                    continue
+                self._draining.pop(name, None)
+                self._idle_since.pop(name, None)
+                continue
             # a partially-registered slice is BOOTING, not idle: host 0 can
             # register minutes before host N on real TPU slices, and
             # draining it would churn launch/terminate forever while the
@@ -185,28 +216,19 @@ class Autoscaler:
             over_min = len(self._provider.non_terminated_nodes()) \
                 > self._cfg.min_workers
             if over_min and now - first >= self._cfg.idle_timeout_s:
-                logger.info("autoscaler terminating idle node %s", name)
+                logger.info("autoscaler draining idle node %s", name)
+                any_drain = False
                 for node in nodes:
                     try:
                         self._cp.call(
                             "drain_node",
-                            {"node_id": node["node_id"]}, timeout=10.0)
-                    except Exception:  # noqa: BLE001
+                            {"node_id": node["node_id"],
+                             "reason": "autoscaler scale-down"}, timeout=10.0)
+                        any_drain = True
+                    except Exception:  # noqa: BLE001 — retry next reconcile
                         pass
-                # count at decision time (same as num_launched): providers
-                # drop the node from non_terminated_nodes() DURING the
-                # call, so a post-call increment lets an observer see the
-                # node gone with the counter still short. A failed call
-                # (gcloud flake) must not inflate the counter or drop the
-                # idle clock — roll both back and retry next reconcile.
-                self.num_terminated += 1
-                if not self.instance_manager.begin_terminate(
-                        name, "idle past timeout"):
-                    self.num_terminated -= 1
-                    logger.warning(
-                        "terminate_node(%s) failed; will retry", name)
-                    continue
-                self._idle_since.pop(name, None)
+                if any_drain:
+                    self._draining[name] = now
 
     def _loop(self) -> None:
         while not self._stopped.is_set():

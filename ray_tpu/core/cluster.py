@@ -73,12 +73,16 @@ class Cluster:
 
     def remove_node(self, agent: NodeAgent, graceful: bool = False):
         """Kill a node (ref: cluster_utils.py:286). Non-graceful stops the
-        agent cold so health checks must detect the death."""
+        agent cold so health checks must detect the death. Graceful runs
+        the full drain protocol — BLOCKING until in-flight leases finished
+        and primary objects migrated — before stopping the agent."""
         if agent in self.nodes:
             self.nodes.remove(agent)
         if graceful:
             try:
-                self.control_plane._h_drain_node({"node_id": agent.node_id})
+                self.control_plane._h_drain_node(
+                    {"node_id": agent.node_id, "wait": True,
+                     "reason": "cluster.remove_node"})
             except Exception:
                 pass
         agent.stop()

@@ -89,6 +89,11 @@ class Config:
     # gauges; many-node single-host harnesses (scale tests: 50+ in-process
     # agents) raise this so heartbeat CPU doesn't crowd out the workload.
     agent_heartbeat_interval_s: float = 1.0
+    # Graceful drain (ref: node_manager.proto:448 DrainRaylet): how long a
+    # DRAINING node may run in-flight leases to completion before the CP
+    # finalizes the drain anyway. In-flight work past the deadline is lost
+    # (the same as a kill), so size it to the workload's task length.
+    drain_deadline_s: float = 30.0
 
     # --- watchdog ---
     # get()/wait() called with no explicit timeout raise GetTimeoutError
@@ -163,6 +168,15 @@ class Config:
     # pushing delta snapshots on this period (plus once on clean shutdown).
     metrics_enabled: bool = True
     metrics_flush_interval_s: float = 10.0
+    # CP-outage tolerance: delta snapshots that fail to publish are kept
+    # (original timestamps) and folded into the next flush instead of
+    # dropped. Bounded: past this many unsent payloads the OLDEST drops
+    # first. At the default 10s flush period, 32 payloads ≈ 5 minutes of
+    # CP outage with zero counter loss.
+    metrics_flush_buffer_max: int = 32
+    # Same for the trace flusher: spans whose report_spans RPC failed are
+    # re-queued at the buffer head, bounded to this many spans.
+    trace_flush_buffer_max: int = 4096
     # CP time-series retention: points older than the window are evicted;
     # a series past the point cap is downsampled (every other point of its
     # older half dropped) instead of hard-truncated.

@@ -20,6 +20,7 @@ import enum
 import logging
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 
 from ray_tpu.core.config import get_config
@@ -98,6 +99,13 @@ class _Node:
     missed_health_checks: int = 0
     metrics: dict | None = None  # last heartbeat's system gauges
     res_version: int = 0  # last applied resource-view version (RaySyncer)
+    # ALIVE → DRAINING → DRAINED | DEAD (ref: node_manager.proto:448
+    # DrainRaylet + autoscaler DrainNode). DRAINING keeps view.alive True
+    # (the node still heartbeats and finishes in-flight work) but the
+    # schedulers exclude it; DRAINED/DEAD both imply view.alive False and
+    # differ only in why.
+    state: str = "ALIVE"
+    draining_since: float | None = None
 
 
 class ControlPlane:
@@ -116,6 +124,14 @@ class ControlPlane:
         self._sub_strikes: dict[tuple, int] = {}  # (channel, addr) -> fails
         self._chan_seq: dict[str, int] = {}       # pubsub sequence numbers
         self._chan_log: dict[str, list] = {}      # bounded history for poll
+        # pubsub epoch: fresh per CP instance, rides every subscribe reply
+        # and poll result. Subscribers that observe it change know the CP
+        # restarted (all subscriptions + seq state gone) and re-subscribe +
+        # reconcile missed death events (the NotifyGCSRestart analog for
+        # the pubsub plane).
+        self._epoch = uuid.uuid4().hex
+        # in-flight graceful drains: node_id -> finisher thread
+        self._drain_threads: dict[NodeID, threading.Thread] = {}
         # DEDICATED pubsub lock (never the CP's global lock: parked/cycling
         # long-poll threads would starve every other CP operation).
         # Subscribe registration, target snapshot and seq assignment are all
@@ -166,7 +182,7 @@ class ControlPlane:
             self._handle, host=host, port=port, name="controlplane",
             blocking_methods={"resolve_actor", "pg_ready", "get_actor_by_name", "pubsub_poll",
                               "profiling_start", "profiling_stop",
-                              "save_device_memory_profile"},
+                              "save_device_memory_profile", "drain_node"},
             pool_size=16)
         self.addr = self._server.addr
         self._sched_thread = threading.Thread(
@@ -264,15 +280,26 @@ class ControlPlane:
         node_manager.proto:406)."""
         with self._lock:
             node = self._nodes.get(body["node_id"])
-            if node is None or not node.view.alive:
+            if node is None:
+                return {"known": False}
+            if not node.view.alive:
+                # a DRAINED node must NOT be told to re-register — that
+                # would resurrect it as ALIVE while the provider is about
+                # to reclaim the VM (the deferred-terminate window). Any
+                # other dead node re-registers (CP-restart analog).
+                if node.state == "DRAINED":
+                    return {"known": True, "state": "DRAINED"}
                 return {"known": False}
             if self._fresher(node, body):
                 node.view.available = dict(body["available"])
             node.missed_health_checks = 0
             if body.get("metrics"):
                 node.metrics = body["metrics"]
+            state = node.state
         self._wake_scheduler()
-        return {"known": True}
+        # the reply carries the node's CP-side state so a DRAINING node
+        # whose drain notify was lost still learns to stop taking leases
+        return {"known": True, "state": state}
 
     def _h_get_node_metrics(self, body):
         """Raw per-node heartbeat gauges for the dashboard's drill-down and
@@ -280,6 +307,7 @@ class ControlPlane:
         gauges as text; this is the JSON view)."""
         with self._lock:
             return [{"node_id": n.view.node_id, "alive": n.view.alive,
+                     "state": n.state,
                      "resources": dict(n.view.total),
                      "available": dict(n.view.available),
                      "metrics": dict(getattr(n, "metrics", None) or {})}
@@ -289,6 +317,7 @@ class ControlPlane:
         with self._lock:
             return [
                 {"node_id": n.view.node_id, "addr": n.view.addr, "alive": n.view.alive,
+                 "state": n.state, "draining_since": n.draining_since,
                  "resources": dict(n.view.total), "available": dict(n.view.available),
                  "labels": dict(n.view.labels)}
                 for n in self._nodes.values()]
@@ -312,9 +341,105 @@ class ControlPlane:
         return {"actor_shapes": actor_shapes, "bundle_shapes": bundle_shapes}
 
     def _h_drain_node(self, body):
-        """(ref: node_manager.proto:448 DrainRaylet)"""
-        self._on_node_dead(body["node_id"], "drained")
-        return {"ok": True}
+        """Graceful drain (ref: node_manager.proto:448 DrainRaylet, the
+        autoscaler's DrainNode): flip ALIVE→DRAINING immediately (the
+        schedulers stop placing there, the agent stops granting leases),
+        then a background finisher lets in-flight leases run to completion
+        under drain_deadline_s, migrates primary objects owned only by the
+        draining node to a survivor, and finalizes DRAINING→DRAINED.
+        Idempotent; body: {node_id, wait?, reason?}. Registered in
+        blocking_methods so wait=True never parks the shared handler pool."""
+        node_id = body["node_id"]
+        reason = body.get("reason") or "drain requested"
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return {"ok": False, "error": "unknown node"}
+            if not node.view.alive:
+                return {"ok": True, "state": node.state}
+            started = node.state == "ALIVE"
+            if started:
+                node.state = "DRAINING"
+                node.draining_since = time.time()
+                finisher = threading.Thread(
+                    target=self._finish_drain, args=(node_id,),
+                    name="cp-drain", daemon=True)
+                self._drain_threads[node_id] = finisher
+            else:
+                finisher = self._drain_threads.get(node_id)
+            addr = node.view.addr
+        if started:
+            logger.info("draining node %s: %s", node_id.hex()[:8], reason)
+            # tell the agent directly (fast path; the heartbeat reply's
+            # `state` field covers a lost notify) and the subscribers (the
+            # serve controller pre-starts replacement replicas on this)
+            try:
+                self._pool.get(addr).notify("drain", {"reason": reason})
+            except Exception:  # noqa: BLE001 - heartbeat will deliver it
+                pass
+            self._publish("node", {"event": "draining", "node_id": node_id})
+            finisher.start()
+        if body.get("wait") and finisher is not None:
+            finisher.join(timeout=get_config().drain_deadline_s + 30.0)
+        with self._lock:
+            node = self._nodes.get(node_id)
+            state = node.state if node is not None else "DEAD"
+        return {"ok": True, "state": state}
+
+    def _finish_drain(self, node_id: NodeID):
+        """Drain finisher: poll the agent until its in-flight leases hit
+        zero (or drain_deadline_s elapses — work past the deadline is lost
+        exactly as a kill would lose it), re-home its primary objects, then
+        mark the node DRAINED through the normal dead-node path (actor
+        failover, metric/kv-tier retraction, death publish)."""
+        cfg = get_config()
+        deadline = time.monotonic() + cfg.drain_deadline_s
+        with self._lock:
+            node = self._nodes.get(node_id)
+            addr = node.view.addr if node is not None else None
+        if addr is not None:
+            agent = self._pool.get(addr)
+            while not self._stopped.is_set():
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                    if node is None or not node.view.alive \
+                            or node.state != "DRAINING":
+                        self._drain_threads.pop(node_id, None)
+                        return  # died / re-registered mid-drain
+                if time.monotonic() >= deadline:
+                    logger.warning(
+                        "drain deadline (%.0fs) reached for node %s with "
+                        "work in flight", cfg.drain_deadline_s,
+                        node_id.hex()[:8])
+                    break
+                try:
+                    st = agent.call("drain_status", None, timeout=5.0)
+                except Exception:  # noqa: BLE001 - agent gone: finalize
+                    break
+                if st and st.get("inflight_leases", 0) == 0 \
+                        and st.get("busy_workers", 0) == 0:
+                    break
+                time.sleep(0.25)
+            # re-home primary objects whose only copy lives on the
+            # draining node: the agent pushes them to a surviving peer so
+            # gets after the drain need no lineage reconstruction
+            with self._lock:
+                target = next(
+                    ((n.view.addr, n.view.node_id)
+                     for n in self._nodes.values()
+                     if n.view.alive and n.state == "ALIVE"
+                     and n.view.node_id != node_id), None)
+            if target is not None:
+                try:
+                    agent.call("drain_objects",
+                               {"target_addr": target[0],
+                                "target_node_id": target[1]},
+                               timeout=max(10.0, cfg.drain_deadline_s))
+                except Exception:  # noqa: BLE001 - degrade to lineage
+                    pass
+        self._on_node_dead(node_id, "drained")
+        with self._lock:
+            self._drain_threads.pop(node_id, None)
 
     # ---- jobs ---------------------------------------------------------
     def _h_register_job(self, body):
@@ -502,7 +627,7 @@ class ControlPlane:
         with self._pub_cv:
             self._subs.setdefault(body["channel"], set()).add(tuple(body["addr"]))
             seq = self._chan_seq.get(body["channel"], 0)
-        return {"ok": True, "seq": seq}
+        return {"ok": True, "seq": seq, "epoch": self._epoch}
 
     def _gc_channels_locked(self):
         """Bound channel bookkeeping: per-actor channels would otherwise
@@ -526,8 +651,10 @@ class ControlPlane:
         at the subscriber."""
         channels: dict = body.get("channels", {})
         deadline = time.monotonic() + min(float(body.get("timeout", 30.0)), 60.0)
+        # every reply (fresh messages, timeout, shutdown) carries the CP's
+        # pubsub epoch so pollers detect a restart even on quiet channels
         while not self._stopped.is_set():
-            out = {}
+            out = {"__epoch": self._epoch}
             with self._pub_cv:
                 for ch, last in channels.items():
                     log = self._chan_log.get(ch)
@@ -536,13 +663,13 @@ class ControlPlane:
                     fresh = [(seq, msg) for seq, msg in log if seq > last]
                     if fresh:
                         out[ch] = fresh
-                if out:
+                if len(out) > 1:
                     return out
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return {}
+                    return out
                 self._pub_cv.wait(min(remaining, 1.0))
-        return {}
+        return {"__epoch": self._epoch}
 
     def _h_unsubscribe(self, body):
         with self._pub_cv:
@@ -858,6 +985,10 @@ class ControlPlane:
             {"name": "ray_tpu_nodes_total", "kind": "gauge",
              "description": "registered nodes", "tag_keys": [],
              "series": [{"tags": [], "value": len(nodes)}]},
+            {"name": "ray_tpu_nodes_draining", "kind": "gauge",
+             "description": "nodes mid graceful drain", "tag_keys": [],
+             "series": [{"tags": [], "value": sum(
+                 1 for n in nodes if n.state == "DRAINING")}]},
             {"name": "ray_tpu_actors", "kind": "gauge",
              "description": "actors by state", "tag_keys": ["state"],
              "series": [{"tags": [s], "value": c} for s, c in
@@ -1218,8 +1349,12 @@ class ControlPlane:
                     self._wake.wait(timeout=0.2)
 
     def _alive_views(self) -> list[NodeView]:
+        """Placement candidates: ALIVE only — a DRAINING node still
+        heartbeats (view.alive stays True) but must not receive new actors
+        or placement-group bundles."""
         with self._lock:
-            return [n.view for n in self._nodes.values() if n.view.alive]
+            return [n.view for n in self._nodes.values()
+                    if n.view.alive and n.state == "ALIVE"]
 
     def _schedule_pending_actors(self) -> bool:
         """Async fan-out actor placement (ref:
@@ -1532,6 +1667,7 @@ class ControlPlane:
             if node is None or not node.view.alive:
                 return
             node.view.alive = False
+            node.state = "DRAINED" if reason == "drained" else "DEAD"
             victims = [i.actor_id for i in self._actors.values()
                        if i.node_id == node_id and i.state == ActorState.ALIVE]
             # placements whose lease RPC targeted the dead node will never

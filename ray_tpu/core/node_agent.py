@@ -168,11 +168,17 @@ class NodeAgent:
         self._pull_inflight_bytes = 0
         self._pulls_in_progress: dict = {}  # ObjectID -> Event (single-flight)
         self._stopped = threading.Event()
+        # graceful drain (ref: node_manager.proto:448 DrainRaylet): a
+        # draining agent refuses new leases (redirecting where possible)
+        # but lets in-flight ones finish; set by the CP's drain notify or
+        # learned from the heartbeat reply's `state` field.
+        self._draining = False
         self._res_version = 0  # versioned resource-view sync (RaySyncer)
         self._server = RpcServer(
             self._handle, host=host, port=port, name="nodeagent",
             blocking_methods={"lease_worker", "pull_object",
-                              "wait_object_local", "channel_push"},
+                              "wait_object_local", "channel_push",
+                              "drain_objects"},
             pool_size=16)
         self.addr = self._server.addr
         self._register_with_cp()
@@ -182,9 +188,13 @@ class NodeAgent:
         # component to start it wins; `stop_flusher` is owner-checked).
         self._metrics_flusher = None
         if cfg.metrics_enabled:
+            # acknowledged call, not a one-way notify: a flush into a CP
+            # that just died can land in the kernel buffer and vanish —
+            # the reply makes the failure visible so the flusher's outage
+            # backlog keeps the payload for re-send
             self._metrics_flusher = _metrics.start_flusher(
-                lambda p: self._pool.get(self.cp_addr).notify(
-                    "metrics_report", p),
+                lambda p: self._pool.get(self.cp_addr).call(
+                    "metrics_report", p, timeout=10.0),
                 source=f"node:{self.node_id.hex()}",
                 node_id=self.node_id.hex())
         self._memory_monitor = None
@@ -221,6 +231,9 @@ class NodeAgent:
             {"node_id": self.node_id, "addr": self.addr,
              "resources": self.resources_total, "labels": self.labels},
             timeout=get_config().rpc_connect_timeout_s)
+        # a (re-)registered node is ALIVE CP-side; a drain that was in
+        # flight across a CP restart is forgotten by both ends together
+        self._draining = False
 
     def _report_resources(self):
         """Versioned resource report (ref: RaySyncer versioned views,
@@ -248,6 +261,62 @@ class NodeAgent:
 
     def _h_ping(self, body):
         return {"ok": True}
+
+    # ---- graceful drain (ref: node_manager.proto:448 DrainRaylet) ------
+    def _h_drain(self, body):
+        """CP tells us we are DRAINING: stop granting leases (waiters wake
+        and redirect/refuse) but let in-flight work run to completion —
+        the CP's drain finisher polls drain_status until we are idle."""
+        self._draining = True
+        with self._lock:
+            self._lease_cv.notify_all()
+        return {"ok": True}
+
+    def _h_drain_status(self, body):
+        """Drain progress for the CP finisher and `ray-tpu status`."""
+        with self._lock:
+            return {"draining": self._draining,
+                    "inflight_leases": len(self._leases),
+                    "busy_workers": sum(
+                        1 for w in self._workers.values() if w.busy)}
+
+    def _h_drain_objects(self, body):
+        """Re-home primary copies: every sealed object this store holds for
+        a live owner is pulled BY the target node (chunked, admission-
+        controlled — the same path as any remote read), then the owner is
+        told the copy moved so later gets resolve to the survivor instead
+        of a gone node. Blocking method: migration streams real bytes."""
+        target_addr = tuple(body["target_addr"])
+        target_node = body.get("target_node_id")
+        target = self._pool.get(target_addr)
+        with self._lock:
+            owned = dict(self._object_owners)
+        moved = failed = 0
+        for oid, owner in owned.items():
+            if self._stopped.is_set():
+                break
+            if not self.store.contains(oid):
+                continue
+            try:
+                r = target.call(
+                    "pull_object",
+                    {"object_id": oid, "from_addr": self.addr,
+                     "owner_addr": owner}, timeout=120.0)
+            except Exception:  # noqa: BLE001 - count and keep going
+                r = None
+            if not (r and r.get("ok")):
+                failed += 1
+                continue
+            moved += 1
+            if owner is not None and target_node is not None:
+                try:
+                    self._pool.get(tuple(owner)).notify(
+                        "object_moved",
+                        {"object_id": oid, "node_id": target_node,
+                         "from_node_id": self.node_id})
+                except Exception:  # noqa: BLE001 - owner may be gone
+                    pass
+        return {"ok": True, "moved": moved, "failed": failed}
 
     # ---- cross-node mutable channels (ref: node_manager.proto:509-512
     # RegisterMutableObject/PushMutableObject) -------------------------
@@ -675,6 +744,16 @@ class NodeAgent:
         spawned_wid = None  # THIS lease's spawn (reap is per-lease)
         try:
             while not self._stopped.is_set():
+                if self._draining:
+                    # draining nodes take no new work: spill the request to
+                    # a peer when possible, refuse otherwise (the caller
+                    # retries through the CP, whose view excludes us)
+                    if pg_id is None:
+                        target = self._find_remote_node(resources)
+                        if target is not None:
+                            _SPILLBACK_COUNTER.inc()
+                            return {"granted": False, "redirect": target}
+                    return {"granted": False, "draining": True}
                 need_spawn = False
                 try_redirect = False
                 evict_proc = None
@@ -845,7 +924,8 @@ class NodeAgent:
         except Exception:
             return None
         for n in nodes:
-            if n["node_id"] == self.node_id or not n["alive"]:
+            if n["node_id"] == self.node_id or not n["alive"] \
+                    or n.get("state", "ALIVE") != "ALIVE":
                 continue
             if fits(n["available"], resources):
                 return tuple(n["addr"])
@@ -1095,6 +1175,12 @@ class NodeAgent:
                         logger.info("control plane lost this node "
                                     "(restart?); re-registering")
                         self._register_with_cp()
+                    elif r is not None \
+                            and r.get("state") in ("DRAINING", "DRAINED") \
+                            and not self._draining:
+                        # the CP's drain notify was lost: the heartbeat
+                        # reply is the backstop delivery channel
+                        self._h_drain({})
                 except Exception:
                     pass
             if self._memory_monitor is not None:

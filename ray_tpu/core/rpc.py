@@ -62,14 +62,21 @@ class ConnectionLost(RpcError):
 
 
 class _Chaos:
-    """Deterministic RPC fault injection (ref: rpc_chaos.h:13-19)."""
+    """Deterministic RPC fault injection (ref: rpc_chaos.h:13-19).
+
+    Spec: ``method:prob_req[:prob_resp[:delay_s]],...`` — drop requests /
+    responses with the given probabilities, and/or stall every matched
+    handler by ``delay_s`` (the FaultSchedule rpc_delay event)."""
 
     def __init__(self, spec: str):
-        self.rules: dict[str, tuple[float, float]] = {}
+        self.rules: dict[str, tuple[float, float, float]] = {}
         self.rng = random.Random(0xC0FFEE)
         for item in filter(None, (spec or "").split(",")):
             parts = item.split(":")
-            self.rules[parts[0]] = (float(parts[1]), float(parts[2]) if len(parts) > 2 else 0.0)
+            self.rules[parts[0]] = (
+                float(parts[1]),
+                float(parts[2]) if len(parts) > 2 else 0.0,
+                float(parts[3]) if len(parts) > 3 else 0.0)
 
     def drop_request(self, method: str) -> bool:
         r = self.rules.get(method) or self.rules.get("*")
@@ -78,6 +85,10 @@ class _Chaos:
     def drop_response(self, method: str) -> bool:
         r = self.rules.get(method) or self.rules.get("*")
         return bool(r) and self.rng.random() < r[1]
+
+    def delay_for(self, method: str) -> float:
+        r = self.rules.get(method) or self.rules.get("*")
+        return r[2] if r else 0.0
 
 
 def _chaos() -> _Chaos:
@@ -342,6 +353,9 @@ class RpcServer:
     def _timed_handler(self, method, body, peer):
         """Handler invocation under the per-method latency histogram and
         in-flight gauge (both socket and loopback dispatch paths)."""
+        delay = _chaos().delay_for(method)
+        if delay > 0:  # chaos rpc_delay: stall on the handler thread
+            time.sleep(delay)
         _RPC_INFLIGHT.inc(tags={"method": method})
         t0 = time.monotonic()
         try:

@@ -340,9 +340,11 @@ class NormalTaskSubmitter:
                 if reply.get("redirect"):
                     agent_addr = tuple(reply["redirect"])
                     continue
-                if reply.get("busy"):
-                    # cluster saturated for this shape right now: back off so
-                    # the retry loop doesn't hot-spin, then let _pump decide
+                if reply.get("busy") or reply.get("draining"):
+                    # cluster saturated for this shape right now (or the
+                    # target node is draining with nowhere to spill): back
+                    # off so the retry loop doesn't hot-spin, then let
+                    # _pump decide
                     with self._lock:
                         st_b = self._shapes.get(key)
                         if st_b is not None:

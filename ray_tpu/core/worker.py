@@ -296,6 +296,14 @@ class WorkerRuntime:
         self._pubsub_lock = threading.Lock()
         self._pubsub_dispatch_locks: dict[str, threading.Lock] = {}
         self._pubsub_poll_started = False
+        # CP pubsub epoch (changes on CP restart): the recovery poll
+        # watches it and re-issues every subscription + reconciles missed
+        # death events when it moves (subscriptions live only in CP memory)
+        self._pubsub_epoch: str | None = None
+        # node-death reconciliation state: NodeIDs we believe alive, fed by
+        # "node" channel dispatches; on a CP restart, nodes that vanished
+        # from the replayed table get a synthesized "dead" event
+        self._known_alive_nodes: set = set()
         # app-level channel subscribers (e.g. the Serve controller watching
         # CP "node" death events); called on the dispatch thread
         self._pubsub_handlers: dict[str, list] = {}
@@ -331,19 +339,24 @@ class WorkerRuntime:
         self._early_send_failures: dict[tuple, float] = {}  # addr -> ts
         self._driver_task_id = TaskID.for_driver(job_id)
         self.task_events: list[dict] = []  # flushed to CP (TaskEventBuffer)
-        # span sink: finished spans batch to the CP trace store over the
-        # same notify path as task events (observability/tracing.py)
+        # span sink: finished spans batch to the CP trace store. An
+        # ACKNOWLEDGED call, not a one-way notify: a send into a CP that
+        # just died can "succeed" into the kernel buffer and vanish — the
+        # call surfaces the failure so tracing.flush() re-queues the spans
         tracing.register_flusher(
-            lambda spans: self.cp_client.notify(
-                "report_spans", {"spans": spans}))
+            lambda spans: self.cp_client.call(
+                "report_spans", {"spans": spans}, timeout=10.0))
         # metrics auto-flush (ISSUE 4): every worker/driver pushes delta
         # snapshots to the CP time-series store; the handle is None when a
         # co-resident component (the head process's CP) started it first.
+        # Acknowledged for the same reason — an undetected drop would lose
+        # the already-advanced delta baselines for good.
         self._metrics_flusher = None
         if get_config().metrics_enabled:
             from ray_tpu.util import metrics as _metrics
             self._metrics_flusher = _metrics.start_flusher(
-                lambda p: self.cp_client.notify("metrics_report", p),
+                lambda p: self.cp_client.call("metrics_report", p,
+                                              timeout=10.0),
                 source=self.worker_id.hex(),
                 node_id=self.node_id.hex() if self.node_id else None)
         self._server = RpcServer(
@@ -1181,6 +1194,17 @@ class WorkerRuntime:
             self.task_manager.reconstruct_object(oid)
         return {"ok": True}
 
+    def _h_object_moved(self, body):
+        """A draining node re-homed our primary copy to a survivor: add the
+        new location FIRST, then retire the old one — the reverse order
+        would leave a window with no locations where a racing get falls
+        back to lineage reconstruction for an object that still exists."""
+        oid = body["object_id"]
+        self.memory_store.put_location(oid, body["node_id"])
+        if body.get("from_node_id") is not None:
+            self.memory_store.remove_location(oid, body["from_node_id"])
+        return {"ok": True}
+
     def _h_pubsub(self, body):
         channel, msg = body["channel"], body["msg"]
         if isinstance(msg, dict) and "__seq" in msg:
@@ -1223,6 +1247,16 @@ class WorkerRuntime:
         self._subscribe_channel(channel)
 
     def _dispatch_pubsub(self, channel: str, msg):
+        if channel == "node" and isinstance(msg, dict):
+            # liveness bookkeeping for CP-restart reconciliation: what we
+            # have heard is what we can detect going silent
+            nid = msg.get("node_id")
+            if nid is not None:
+                with self._pubsub_lock:
+                    if msg.get("event") == "alive":
+                        self._known_alive_nodes.add(nid)
+                    elif msg.get("event") == "dead":
+                        self._known_alive_nodes.discard(nid)
         with self._pubsub_lock:
             handlers = list(self._pubsub_handlers.get(channel, ()))
         for cb in handlers:
@@ -1282,8 +1316,21 @@ class WorkerRuntime:
         with self._pubsub_lock:
             self._pubsub_seen.setdefault(
                 channel, (reply or {}).get("seq", 0))
+            if reply and reply.get("epoch") and self._pubsub_epoch is None:
+                self._pubsub_epoch = reply["epoch"]
             start = not self._pubsub_poll_started
             self._pubsub_poll_started = True
+        if channel == "node" and not self._known_alive_nodes:
+            # seed liveness bookkeeping with the current membership —
+            # nodes that pre-date this subscription must also be
+            # reconcilable after a CP restart
+            try:
+                nodes = self.cp_client.call("get_nodes", None, timeout=2.0)
+                with self._pubsub_lock:
+                    self._known_alive_nodes.update(
+                        n["node_id"] for n in nodes or () if n["alive"])
+            except Exception:  # noqa: BLE001 - events will fill it in
+                pass
         if start:
             threading.Thread(target=self._pubsub_recovery_loop,
                              name=f"{self.mode}-pubsub-poll",
@@ -1304,7 +1351,22 @@ class WorkerRuntime:
             except Exception:
                 time.sleep(1.0)
                 continue
-            for channel, entries in (out or {}).items():
+            out = dict(out or {})
+            epoch = out.pop("__epoch", None)
+            if epoch is not None:
+                with self._pubsub_lock:
+                    first = self._pubsub_epoch is None
+                    changed = (not first) and epoch != self._pubsub_epoch
+                    if first:
+                        self._pubsub_epoch = epoch
+                if changed:
+                    # the CP restarted: all our subscriptions and the old
+                    # seq numbering are gone. Re-subscribe everything,
+                    # rewind watermarks, reconcile missed deaths — then
+                    # poll again from scratch (`out` predates the rewind).
+                    self._on_cp_restarted(epoch)
+                    continue
+            for channel, entries in out.items():
                 for seq, msg in sorted(entries):
                     with self._pubsub_order_lock(channel):
                         with self._pubsub_lock:
@@ -1315,6 +1377,71 @@ class WorkerRuntime:
                             self._dispatch_pubsub(channel, msg)
                         except Exception:  # noqa: BLE001 keep the loop alive
                             logger.exception("pubsub recovery dispatch failed")
+
+    def _on_cp_restarted(self, epoch: str) -> None:
+        """The pubsub epoch moved: the CP restarted and forgot every
+        subscription (they live only in CP memory) and every channel's
+        sequence numbering. Re-issue all subscriptions, rewind the poll
+        watermarks to 0 (the new CP's bounded log replays in full), and
+        reconcile death events that happened while the CP was down: a node
+        or actor that died mid-outage published nothing we could hear, so
+        its absence from the replayed tables IS the death notification."""
+        with self._pubsub_lock:
+            self._pubsub_epoch = epoch
+            channels = list(self._pubsub_seen)
+        logger.info("control plane restarted (pubsub epoch %s): "
+                    "re-subscribing %d channel(s)", epoch[:8], len(channels))
+        for channel in channels:
+            try:
+                self.cp_client.call(
+                    "subscribe", {"channel": channel, "addr": self.addr},
+                    timeout=2.0)
+            except Exception:  # noqa: BLE001 - next epoch check retries
+                pass
+            with self._pubsub_lock:
+                if channel in self._pubsub_seen:
+                    self._pubsub_seen[channel] = 0
+        self._reconcile_missed_deaths()
+
+    def _reconcile_missed_deaths(self) -> None:
+        """Synthesize the death events a CP outage swallowed, from the
+        replayed tables: nodes we believed alive that are gone or not
+        alive in get_nodes, and subscribed actors the replayed actor table
+        reports DEAD. Synthetic events flow through the normal dispatch
+        path, so serve controllers/submitters react exactly as if the
+        original publish had arrived."""
+        with self._pubsub_lock:
+            watch_nodes = "node" in self._pubsub_seen
+            known = set(self._known_alive_nodes)
+        if watch_nodes and known:
+            try:
+                nodes = self.cp_client.call("get_nodes", None, timeout=5.0)
+            except Exception:  # noqa: BLE001 - reconcile on next restart
+                nodes = None
+            if nodes is not None:
+                alive = {n["node_id"] for n in nodes if n["alive"]}
+                for nid in known - alive:
+                    logger.info("reconciled missed node death: %s",
+                                nid.hex()[:8])
+                    self._dispatch_pubsub(
+                        "node", {"event": "dead", "node_id": nid})
+        doomed = []
+        if self._subscribed_actors:
+            try:
+                actors = self.cp_client.call("list_actors", None,
+                                             timeout=5.0)
+            except Exception:  # noqa: BLE001
+                actors = None
+            if actors is not None:
+                states = {a["actor_id"]: a for a in actors}
+                for aid in list(self._subscribed_actors):
+                    info = states.get(aid)
+                    if info is not None and info.get("state") == "DEAD":
+                        doomed.append((aid, info.get("death_cause") or
+                                       "died during control plane outage"))
+        for aid, reason in doomed:
+            self._dispatch_pubsub(f"actor:{aid.hex()}",
+                                  {"state": "DEAD", "reason": reason})
 
     def _h_cancel_task(self, body):
         """(ref: core_worker.proto:540 CancelTask)"""

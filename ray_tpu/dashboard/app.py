@@ -350,6 +350,9 @@ class _Timeseries:
                 sample = {
                     "ts": _time.time(),
                     "nodes_alive": len(alive),
+                    "nodes_draining": sum(
+                        1 for n in alive
+                        if n.get("state") == "DRAINING"),
                     "cpu_percent_avg": round(sum(cpu) / len(cpu), 2)
                     if cpu else None,
                     "memory_percent_avg": round(sum(mem) / len(mem), 2)

@@ -223,7 +223,17 @@ def flush() -> None:
     try:
         cb(spans)
     except Exception:  # noqa: BLE001 — tracing must never break the app
-        pass
+        # sink unreachable (e.g. CP outage): keep the spans for the next
+        # flush instead of losing the trace tail. Re-inserted at the front
+        # so export order stays chronological; bounded so a long outage
+        # can't grow the buffer without limit (oldest spans dropped first).
+        try:
+            cap = max(1, int(_cfg().trace_flush_buffer_max))
+        except Exception:  # noqa: BLE001 — config may be mid-teardown
+            cap = 4096
+        with _buffer_lock:
+            _buffer[:0] = spans
+            del _buffer[:-cap]
 
 
 def _reset_for_tests() -> None:

@@ -9,6 +9,7 @@ Usage:
     python -m ray_tpu start --head [--port 6380] [--num-cpus 8] [--store-path p]
     python -m ray_tpu start --address host:port      # join as a worker node
     python -m ray_tpu status [--address host:port]
+    python -m ray_tpu drain NODE_ID [--no-wait]    # graceful node drain
     python -m ray_tpu submit [--address ...] -- python my_script.py
     python -m ray_tpu jobs [--address ...]
     python -m ray_tpu logs JOB_ID [--address ...]
@@ -209,8 +210,16 @@ def cmd_status(args) -> None:
     nodes = ray_tpu.nodes()
     print(f"nodes: {len(nodes)}")
     for n in nodes:
-        live = "ALIVE" if n["alive"] else "DEAD"
-        print(f"  {n['node_id'].hex()[:8]} {live} at {n['addr']} "
+        # the CP-side state machine (ALIVE/DRAINING/DRAINED/DEAD); older
+        # CPs only report the alive bit
+        st = n.get("state") or ("ALIVE" if n["alive"] else "DEAD")
+        progress = ""
+        if st == "DRAINING" and n.get("draining_since"):
+            from ray_tpu.core.config import get_config
+            elapsed = time.time() - n["draining_since"]
+            progress = (f" (draining {elapsed:.0f}s/"
+                        f"{get_config().drain_deadline_s:.0f}s)")
+        print(f"  {n['node_id'].hex()[:8]} {st}{progress} at {n['addr']} "
               f"resources={n['resources']} available={n['available']}")
     actors = state.list_actors()
     by_state: dict[str, int] = {}
@@ -220,6 +229,24 @@ def cmd_status(args) -> None:
     pgs = state.list_placement_groups()
     print(f"placement groups: {len(pgs)}")
     ray_tpu.shutdown()
+
+
+def cmd_drain(args) -> None:
+    """Gracefully drain a node instead of killing it: stop new leases, let
+    in-flight work finish, migrate primary objects, then deregister."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_read_address(args.address))
+    try:
+        out = state.drain_node(args.node_id, wait=not args.no_wait,
+                               reason="ray-tpu drain CLI")
+    except ValueError as e:
+        raise SystemExit(str(e))
+    print(f"drain {args.node_id}: state={out.get('state')}")
+    ray_tpu.shutdown()
+    if not out.get("ok"):
+        raise SystemExit(out.get("error") or "drain failed")
 
 
 def cmd_submit(args) -> None:
@@ -443,6 +470,15 @@ def main(argv=None) -> None:
     sp = sub.add_parser("status", help="cluster summary")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "drain", help="gracefully drain a node (in-flight work finishes, "
+                      "objects migrate) instead of killing it")
+    sp.add_argument("node_id", help="node id (hex prefix ok)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--no-wait", action="store_true",
+                    help="request the drain and return immediately")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("submit", help="run an entrypoint as a managed job")
     sp.add_argument("--address", default=None)

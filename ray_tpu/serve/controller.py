@@ -41,6 +41,12 @@ class _DeploymentState:
         # (the reference's STARTING state) — requests must never queue
         # behind actor creation
         self.starting: list = []
+        # replicas on a DRAINING node: still routable (their node keeps
+        # running in-flight + new work until the drain deadline) but no
+        # longer counted toward target, so reconcile pre-starts
+        # replacements. Retired — table flip FIRST, then graceful stop —
+        # only once enough replacements are ready.
+        self.draining: list = []
         self.version = 0
         self.target = config.target_replicas()
         # consecutive failed health checks per replica (actor id hex) — a
@@ -66,6 +72,7 @@ class ServeController:
         # node-death pubsub: the handler runs on the hosting worker's pubsub
         # dispatch thread; the control loop drains this on its own cadence
         self._dead_nodes: list = []
+        self._draining_nodes: list = []
         self._dead_nodes_lock = threading.Lock()
         self._node_sub_done = False
 
@@ -91,11 +98,18 @@ class ServeController:
             logger.exception("serve controller: node pubsub wiring failed")
 
     def _on_node_event(self, msg):
-        if isinstance(msg, dict) and msg.get("event") == "dead":
-            node_id = msg.get("node_id")
-            hexed = node_id.hex() if hasattr(node_id, "hex") else str(node_id)
-            with self._dead_nodes_lock:
+        if not isinstance(msg, dict):
+            return
+        event = msg.get("event")
+        if event not in ("dead", "draining"):
+            return
+        node_id = msg.get("node_id")
+        hexed = node_id.hex() if hasattr(node_id, "hex") else str(node_id)
+        with self._dead_nodes_lock:
+            if event == "dead":
                 self._dead_nodes.append(hexed)
+            else:
+                self._draining_nodes.append(hexed)
 
     def _notify_change(self):
         ev = getattr(self, "_change_event", None)
@@ -121,6 +135,7 @@ class ServeController:
             if existing is not None:
                 state.replicas = existing.replicas
                 state.starting = existing.starting
+                state.draining = existing.draining
                 state.version = existing.version + 1
                 # config change with same code → reconfigure in place
                 if d["config"].user_config is not None:
@@ -164,7 +179,7 @@ class ServeController:
             except Exception:  # noqa: BLE001
                 pass
         state.starting = []
-        for r in state.replicas:
+        for r in state.replicas + state.draining:
             try:
                 await asyncio.wait_for(
                     _as_future(r.prepare_for_shutdown.remote(
@@ -177,6 +192,7 @@ class ServeController:
             except Exception:  # noqa: BLE001
                 pass
         state.replicas = []
+        state.draining = []
 
     # ---- introspection -------------------------------------------------
     async def get_routing_table(self, app_name: str) -> dict:
@@ -184,7 +200,10 @@ class ServeController:
         out = {}
         for state in self._deployments.values():
             if state.app == app_name:
-                out[state.name] = (list(state.replicas), state.version)
+                # draining replicas stay routable until replacements are
+                # ready — the table never shrinks below target mid-drain
+                out[state.name] = (list(state.replicas) + list(state.draining),
+                                   state.version)
         return out
 
     async def poll_routing_table(self, app_name: str,
@@ -254,6 +273,7 @@ class ServeController:
         return {
             state.full_name(): {
                 "replicas": len(state.replicas),
+                "draining": len(state.draining),
                 "target": state.target,
                 "version": state.version,
                 "app": state.app,
@@ -323,6 +343,7 @@ class ServeController:
                 "app": state.app,
                 "replicas": len(state.replicas),
                 "starting": len(state.starting),
+                "draining": len(state.draining),
                 "target": state.target,
                 "version": state.version,
                 "queue_lens": qlens,
@@ -361,7 +382,7 @@ class ServeController:
     async def shutdown(self) -> bool:
         self._stopped = True
         for state in self._deployments.values():
-            for r in state.replicas + state.starting:
+            for r in state.replicas + state.starting + state.draining:
                 try:
                     ray_tpu.kill(r)
                 except Exception:  # noqa: BLE001
@@ -424,6 +445,21 @@ class ServeController:
                 state.replicas = keep
                 state.version += 1
                 self._notify_change()
+            # a draining node that died (deadline hit, or crashed mid-drain)
+            # takes its still-routable replicas with it
+            left = [r for r in state.draining
+                    if self._replica_key(r) not in on_dead_nodes]
+            if len(left) != len(state.draining):
+                for r in state.draining:
+                    if self._replica_key(r) in on_dead_nodes:
+                        state.health_fails.pop(self._replica_key(r), None)
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:  # noqa: BLE001
+                            pass
+                state.draining = left
+                state.version += 1
+                self._notify_change()
             # a STARTING replica on a dead node will never become ready
             still = [r for r in state.starting
                      if self._replica_key(r) not in on_dead_nodes]
@@ -436,8 +472,63 @@ class ServeController:
                             pass
                 state.starting = still
 
+    async def _move_replicas_on_draining_nodes(self):
+        """Drain node-DRAINING events: replicas on those nodes move
+        replicas → draining. They stay in the routing table (the node keeps
+        serving until its drain deadline) but stop counting toward target,
+        so the scale-up pass pre-starts replacements elsewhere this same
+        tick — the table is only flipped away from them once the
+        replacements are ready (ref: DrainRaylet + deployment_state
+        graceful replacement)."""
+        with self._dead_nodes_lock:
+            draining, self._draining_nodes = list(self._draining_nodes), []
+        if not draining:
+            return
+        draining_set = set(draining)
+
+        def _list_actors_blocking():
+            from ray_tpu.util import state as state_api
+            return state_api.list_actors(limit=100000)
+
+        try:
+            actors = await asyncio.get_event_loop().run_in_executor(
+                None, _list_actors_blocking)
+        except Exception:  # noqa: BLE001 — CP briefly away; retry next event
+            logger.exception("list_actors failed while handling node drain")
+            with self._dead_nodes_lock:
+                self._draining_nodes.extend(draining)
+            return
+        on_draining = {a["actor_id"] for a in actors
+                       if a.get("node_id") in draining_set}
+        for state in self._deployments.values():
+            moving = [r for r in state.replicas
+                      if self._replica_key(r) in on_draining]
+            if moving:
+                logger.warning(
+                    "%s: %d replica(s) on draining node(s) %s — pre-starting "
+                    "replacements before retiring them",
+                    state.full_name(), len(moving),
+                    [n[:8] for n in draining_set])
+                state.replicas = [r for r in state.replicas
+                                  if self._replica_key(r) not in on_draining]
+                state.draining.extend(moving)
+                # no version bump: the routing table still contains them
+            # STARTING replicas on a draining node would come up on a node
+            # about to disappear — kill now, scale-up re-places them
+            doomed = [r for r in state.starting
+                      if self._replica_key(r) in on_draining]
+            if doomed:
+                state.starting = [r for r in state.starting
+                                  if self._replica_key(r) not in on_draining]
+                for r in doomed:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
+
     async def _reconcile_once(self):
         await self._drop_replicas_on_dead_nodes()
+        await self._move_replicas_on_draining_nodes()
         for state in list(self._deployments.values()):
             # readiness: a freshly created replica becomes routable only
             # after its first successful health check (the reference's
@@ -489,6 +580,61 @@ class ServeController:
                 state.replicas = alive
                 state.version += 1
                 self._notify_change()
+
+            # draining replicas are still routable, so they get the same
+            # health policy — one that dies mid-drain must leave the table
+            if state.draining:
+                keep_draining = []
+                for r in state.draining:
+                    key = self._replica_key(r)
+                    try:
+                        await asyncio.wait_for(_as_future(
+                            r.check_health.remote(),
+                            timeout=state.config.health_check_timeout_s),
+                            state.config.health_check_timeout_s + 1.0)
+                        state.health_fails.pop(key, None)
+                        keep_draining.append(r)
+                    except Exception:  # noqa: BLE001
+                        fails = state.health_fails.get(key, 0) + 1
+                        state.health_fails[key] = fails
+                        if fails < threshold:
+                            keep_draining.append(r)
+                            continue
+                        state.health_fails.pop(key, None)
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:  # noqa: BLE001
+                            pass
+                if len(keep_draining) != len(state.draining):
+                    state.draining = keep_draining
+                    state.version += 1
+                    self._notify_change()
+
+            # retire draining replicas once enough replacements are READY:
+            # flip the routing table first (version bump → routers/proxies
+            # long-poll the new set), THEN stop the old replicas gracefully
+            # so their in-flight requests complete — a drain drops zero
+            # requests (ISSUE acceptance)
+            if state.draining and len(state.replicas) >= state.target:
+                retired, state.draining = list(state.draining), []
+                state.version += 1
+                self._notify_change()
+                logger.info("%s: retiring %d drained replica(s) — "
+                            "replacements are serving", state.full_name(),
+                            len(retired))
+                for r in retired:
+                    state.health_fails.pop(self._replica_key(r), None)
+                    try:
+                        await asyncio.wait_for(_as_future(
+                            r.prepare_for_shutdown.remote(
+                                state.config.graceful_shutdown_timeout_s)),
+                            state.config.graceful_shutdown_timeout_s + 5.0)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
 
             # autoscaling
             asc = state.config.autoscaling_config

@@ -71,6 +71,13 @@ class HTTPProxy:
         self._max_inflight = (max_inflight if max_inflight is not None
                               else get_config().proxy_max_inflight)
         self._inflight = 0
+        # route-table cache (tentpole b): one controller RPC per TTL, not
+        # per request — and during a controller/CP outage the proxy serves
+        # from the last good table (DEGRADED) instead of 500ing traffic
+        self._routes_cache: Optional[dict] = None
+        self._routes_cache_ts = 0.0
+        self._routes_ttl_s = 2.0
+        self._routes_degraded = False
         # mutated only on the proxy event loop — no lock needed
         self.stats = {"ok": 0, "errors": 0, "shed_expired": 0,
                       "shed_overload": 0, "deadline_exceeded": 0,
@@ -138,8 +145,28 @@ class HTTPProxy:
                   "status": str(status)})
 
     # ---- request path --------------------------------------------------
+    async def _get_routes(self) -> dict:
+        """Controller route table behind a small TTL cache. On fetch
+        failure the STALE table is served and the proxy flags itself
+        degraded — a CP/controller outage must not fail routable traffic."""
+        now = time.monotonic()
+        if self._routes_cache is not None \
+                and now - self._routes_cache_ts < self._routes_ttl_s:
+            return self._routes_cache
+        try:
+            routes = await _aget(self._controller.get_http_routes.remote())
+        except Exception:  # noqa: BLE001 — degraded: stale table stands
+            if self._routes_cache is not None:
+                self._routes_degraded = True
+                return self._routes_cache
+            raise
+        self._routes_cache = routes
+        self._routes_cache_ts = now
+        self._routes_degraded = False
+        return routes
+
     async def _resolve_route(self, path: str):
-        routes = await _aget(self._controller.get_http_routes.remote())
+        routes = await self._get_routes()
         best = None
         for prefix, target in routes.items():
             if prefix is None:
@@ -198,8 +225,10 @@ class HTTPProxy:
                 self._req_timeout[key] = ray_tpu.get(
                     self._controller.get_request_timeout.remote(
                         app_name, deployment), timeout=5.0)
-            except Exception:  # noqa: BLE001 — older controller: global flag
-                self._req_timeout[key] = None
+            except Exception:  # noqa: BLE001 — controller away: fall back to
+                # the global flag for THIS request but don't poison the
+                # cache — the real value is fetched once the CP is back
+                return None
         return self._req_timeout[key]
 
     async def _handle(self, request):
@@ -207,7 +236,7 @@ class HTTPProxy:
 
         path = "/" + request.match_info.get("tail", "")
         if path == "/-/routes":
-            routes = await _aget(self._controller.get_http_routes.remote())
+            routes = await self._get_routes()
             return web.json_response(
                 {p: f"{a}#{d}" for p, (a, d) in routes.items()})
         if path == "/-/healthz":
@@ -216,6 +245,10 @@ class HTTPProxy:
             out = dict(self.stats, inflight=self._inflight)
             out["routers"] = {app: r.stats_snapshot()
                               for app, r in self._routers.items()}
+            # degraded = proxy serving stale routes OR any router serving
+            # from a cached table because the control plane is unreachable
+            out["degraded"] = self._routes_degraded or any(
+                r["degraded"] for r in out["routers"].values())
             return web.json_response(out)
 
         resolved = await self._resolve_route(path)

@@ -249,6 +249,12 @@ class Router:
         self._stats_lock = threading.Lock()
         self.stats = {"requests": 0, "retries": 0, "retries_denied": 0,
                       "deadline_exceeded": 0}
+        # DEGRADED mode (tentpole b): the controller (or the CP under it)
+        # is unreachable, so the router keeps serving from its cached
+        # routing tables instead of failing requests. Flag + since-ts are
+        # surfaced via stats_snapshot for the proxy /-/stats and tests.
+        self._degraded = False
+        self._degraded_since: Optional[float] = None
         self._poll_thread = threading.Thread(
             target=self._long_poll_loop, name=f"router-poll-{app_name}",
             daemon=True)
@@ -258,9 +264,22 @@ class Router:
         with self._stats_lock:
             self.stats[key] += n
 
+    def _set_degraded(self, degraded: bool) -> None:
+        with self._stats_lock:
+            if degraded and not self._degraded:
+                self._degraded = True
+                self._degraded_since = time.monotonic()
+            elif not degraded and self._degraded:
+                self._degraded = False
+                self._degraded_since = None
+
     def stats_snapshot(self) -> dict:
         with self._stats_lock:
             out = dict(self.stats)
+            out["degraded"] = self._degraded
+            out["degraded_for_s"] = (
+                time.monotonic() - self._degraded_since
+                if self._degraded_since is not None else 0.0)
         out["retry_budget"] = self._budget.balance()
         with self._lock:
             out["ejections"] = sum(rs.ejections for rs in self._sets.values())
@@ -289,9 +308,13 @@ class Router:
                 table = ray_tpu.get(
                     self._controller.poll_routing_table.remote(
                         self._app, known, 30.0), timeout=40.0)
-            except Exception:  # noqa: BLE001 - controller briefly away
+            except Exception:  # noqa: BLE001 - controller/CP briefly away:
+                # DEGRADED — keep routing from the cached tables; requests
+                # must not fail just because the control plane blinked
+                self._set_degraded(True)
                 time.sleep(0.5)
                 continue
+            self._set_degraded(False)
             if table:
                 self._apply_table(table)
 
@@ -303,10 +326,17 @@ class Router:
             rs = self._sets.setdefault(deployment, ReplicaSet(self.config))
             if rs.replicas and not force:
                 return rs
-        # cold start / forced: one synchronous fetch
-        table = ray_tpu.get(self._controller.get_routing_table.remote(
-            self._app), timeout=10.0)
-        self._apply_table(table)
+        # cold start / forced: one synchronous fetch. During a controller /
+        # CP outage this fails — serve from whatever table we already have
+        # (degraded) rather than failing the request.
+        try:
+            table = ray_tpu.get(self._controller.get_routing_table.remote(
+                self._app), timeout=10.0)
+        except Exception:  # noqa: BLE001 — degraded: cached table stands
+            self._set_degraded(True)
+        else:
+            self._set_degraded(False)
+            self._apply_table(table)
         with self._lock:
             return self._sets.setdefault(deployment, ReplicaSet(self.config))
 

@@ -145,3 +145,160 @@ def run_with_chaos(workload, *, killer) -> tuple:
     finally:
         report = killer.stop()
     return result, report
+
+
+class FaultSchedule:
+    """Deterministic timed fault injection: a seeded schedule of cluster
+    faults fired at fixed offsets from start() (reference: the release
+    chaos tests' resource killers, made reproducible — same seed + same
+    schedule = same victims in the same order).
+
+        sched = FaultSchedule(cluster, [
+            (1.0, "worker_kill", {}),
+            (2.5, "node_kill", {}),
+            (4.0, "node_drain", {"wait": True}),
+            (5.0, "cp_restart", {"down_s": 1.0}),
+            (6.0, "rpc_delay", {"spec": "*:0:0:0.05", "duration_s": 2.0}),
+        ], seed=7)
+        sched.start()
+        ...  # drive traffic
+        sched.join()
+        print(sched.report)
+
+    Event kinds:
+      worker_kill  kill one random non-actor (or any, spare_actors=False)
+                   worker process
+      node_kill    hard-stop a random non-head node agent
+      node_drain   graceful drain of a random non-head node (the full
+                   protocol: no new leases, in-flight completes, objects
+                   migrate); {"wait": True} blocks until drained
+      cp_restart   kill the control plane, wait {"down_s"}, restart it on
+                   the same address
+      rpc_delay    stall matched RPC handlers via testing_rpc_failure
+                   ({"spec": "*:0:0:DELAY", "duration_s": S})
+      rpc_drop     drop matched RPCs ({"spec": "*:PROB", "duration_s": S})
+
+    Every event appends {"t", "kind", "ok", "detail"} to `report`."""
+
+    KINDS = ("worker_kill", "node_kill", "node_drain", "cp_restart",
+             "rpc_delay", "rpc_drop")
+
+    def __init__(self, cluster, events, *, seed: int = 0):
+        for _, kind, _kw in events:
+            if kind not in self.KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}")
+        self._cluster = cluster
+        self._events = sorted(events, key=lambda e: e[0])
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.report: list[dict] = []
+
+    # ---- event implementations ----------------------------------------
+    def _do_worker_kill(self, kw) -> str:
+        spare_actors = bool(kw.get("spare_actors", False))
+        victims = []
+        for agent in self._cluster.nodes:
+            with agent._lock:
+                for info in agent._workers.values():
+                    if info.proc is None or info.proc.poll() is not None:
+                        continue
+                    if spare_actors and info.actor_id is not None:
+                        continue
+                    victims.append(info.proc)
+        if not victims:
+            return "no victim workers"
+        victim = self._rng.choice(victims)
+        victim.kill()
+        return f"killed worker pid={victim.pid}"
+
+    def _pick_node(self, kw):
+        idx = kw.get("node_index")
+        if idx is not None:
+            return self._cluster.nodes[idx]
+        candidates = self._cluster.nodes[1:]  # never the head-ish node 0
+        if not candidates:
+            raise RuntimeError("no non-head nodes to target")
+        return self._rng.choice(candidates)
+
+    def _do_node_kill(self, kw) -> str:
+        agent = self._pick_node(kw)
+        nid = agent.node_id.hex()[:8]
+        self._cluster.remove_node(agent, graceful=False)
+        return f"killed node {nid}"
+
+    def _do_node_drain(self, kw) -> str:
+        agent = self._pick_node(kw)
+        nid = agent.node_id.hex()[:8]
+        if kw.get("wait", True):
+            # full blocking protocol, then stop the drained agent
+            self._cluster.remove_node(agent, graceful=True)
+            return f"drained node {nid}"
+        self._cluster.control_plane._h_drain_node(
+            {"node_id": agent.node_id, "reason": "chaos"})
+        return f"draining node {nid} (async)"
+
+    def _do_cp_restart(self, kw) -> str:
+        down_s = float(kw.get("down_s", 1.0))
+        addr = self._cluster.kill_control_plane()
+        self._stop.wait(down_s)
+        self._cluster.restart_control_plane(addr)
+        return f"cp restarted after {down_s}s at {addr[0]}:{addr[1]}"
+
+    def _rpc_fault(self, kw, default_spec: str) -> str:
+        from ray_tpu.core.config import get_config
+        spec = kw.get("spec", default_spec)
+        duration_s = float(kw.get("duration_s", 1.0))
+        cfg = get_config()
+        prev = cfg.testing_rpc_failure
+        cfg.testing_rpc_failure = spec
+        try:
+            self._stop.wait(duration_s)
+        finally:
+            cfg.testing_rpc_failure = prev
+        return f"rpc fault {spec!r} for {duration_s}s"
+
+    def _do_rpc_delay(self, kw) -> str:
+        return self._rpc_fault(kw, "*:0:0:0.05")
+
+    def _do_rpc_drop(self, kw) -> str:
+        return self._rpc_fault(kw, "*:0.3")
+
+    # ---- driver --------------------------------------------------------
+    def _loop(self):
+        t0 = time.monotonic()
+        for offset, kind, kw in self._events:
+            delay = t0 + offset - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            entry = {"t": offset, "kind": kind}
+            try:
+                entry["detail"] = getattr(self, "_do_" + kind)(
+                    dict(kw or {}))
+                entry["ok"] = True
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                entry["detail"] = repr(e)
+                entry["ok"] = False
+            self.report.append(entry)
+
+    def start(self) -> "FaultSchedule":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="chaos-schedule", daemon=True)
+            self._thread.start()
+        return self
+
+    def join(self, timeout: float | None = None) -> list[dict]:
+        """Wait for the schedule to finish firing; returns the report."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self.report
+
+    def stop(self) -> list[dict]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        return self.report

@@ -394,8 +394,13 @@ def snapshot_deltas() -> list[dict]:
 class MetricsFlusher:
     """Background delta flusher — the per-process metrics agent (reference:
     dashboard agent / OpenCensus exporter loop). ``send(payload)`` delivers
-    one snapshot to the CP's `metrics_report`; failures are swallowed
-    (observability must never take a worker down)."""
+    one snapshot to the CP's `metrics_report`; failures never take a worker
+    down. A failed payload is NOT dropped — `snapshot_deltas` advances the
+    registry baselines at snapshot time, so a drop would lose those counter
+    increments permanently. Instead it queues (original timestamp kept) and
+    re-sends ahead of fresh snapshots once the CP is reachable again,
+    bounded by `metrics_flush_buffer_max` with oldest-first eviction — a
+    ≤buffer-sized CP outage leaves no gap in the time series."""
 
     def __init__(self, send, source: str, interval_s: float = 10.0,
                  node_id: Optional[str] = None):
@@ -405,6 +410,7 @@ class MetricsFlusher:
         self.interval_s = max(0.05, float(interval_s))
         self._stop = threading.Event()
         self._flush_lock = threading.Lock()
+        self._backlog: list[dict] = []  # unsent payloads, oldest first
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "MetricsFlusher":
@@ -422,14 +428,29 @@ class MetricsFlusher:
     def flush(self) -> None:
         with self._flush_lock:
             mets = snapshot_deltas()
-            if not mets:
+            if mets:
+                self._backlog.append(
+                    {"source": self.source, "node_id": self.node_id,
+                     "ts": time.time(), "metrics": mets})
+            if not self._backlog:
                 return
-            payload = {"source": self.source, "node_id": self.node_id,
-                       "ts": time.time(), "metrics": mets}
+            # bound the outage buffer: drop the OLDEST payloads first (the
+            # freshest snapshot is the one a recovering CP needs most)
             try:
-                self._send(payload)
-            except Exception:  # noqa: BLE001 — flush is best-effort
-                pass
+                from ray_tpu.core.config import get_config
+                cap = max(1, int(get_config().metrics_flush_buffer_max))
+            except Exception:  # noqa: BLE001 — config mid-teardown
+                cap = 32
+            del self._backlog[:-cap]
+            # oldest first so the CP's cumulative accumulators and
+            # retention windows see points in timestamp order; stop at the
+            # first failure — later payloads would arrive out of order
+            while self._backlog:
+                try:
+                    self._send(self._backlog[0])
+                except Exception:  # noqa: BLE001 — retry next interval
+                    break
+                self._backlog.pop(0)
 
     @property
     def alive(self) -> bool:

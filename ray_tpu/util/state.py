@@ -25,6 +25,29 @@ def list_nodes() -> list[dict]:
     return ray_tpu.nodes()
 
 
+def drain_node(node_id, wait: bool = False, reason: str = "") -> dict:
+    """Gracefully drain one node (the DrainRaylet analog): the node stops
+    taking new leases, in-flight work runs to completion (bounded by
+    `drain_deadline_s`), primary objects migrate to a survivor, then the
+    node deregisters as DRAINED. `wait=True` blocks until the drain
+    finishes. `node_id` may be a NodeID or its hex string (prefix ok)."""
+    cp = _cp()
+    if isinstance(node_id, str):
+        matches = [n["node_id"] for n in cp.call("get_nodes", None)
+                   if n["node_id"].hex().startswith(node_id)]
+        if not matches:
+            raise ValueError(f"no node matching {node_id!r}")
+        if len(matches) > 1:
+            raise ValueError(f"ambiguous node id prefix {node_id!r}")
+        node_id = matches[0]
+    from ray_tpu.core.config import get_config
+    body: dict[str, Any] = {"node_id": node_id, "wait": wait}
+    if reason:
+        body["reason"] = reason
+    timeout = (get_config().drain_deadline_s + 60.0) if wait else 10.0
+    return cp.call("drain_node", body, timeout=timeout)
+
+
 def list_actors(filters: Optional[list] = None, limit: int = 1000) -> list[dict]:
     out = _cp().call("list_actors", {"limit": limit})
     for a in out:

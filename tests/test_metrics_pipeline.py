@@ -221,3 +221,127 @@ def test_detailed_status_percentiles_from_fake_clock(metrics_cluster):
     for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
         assert lat[key] == pytest.approx(expect[q] * 1000.0, rel=0.10), key
     serve.delete("papp")
+
+
+def test_flusher_buffers_across_send_outage(monkeypatch):
+    """Satellite: a CP outage must not tear a hole in the time series.
+    `snapshot_deltas` advances the registry baselines at snapshot time, so
+    a dropped payload would lose those counter increments permanently.
+    While the sink fails, each flush queues its payload with the ORIGINAL
+    timestamp; on recovery everything is delivered oldest-first; the
+    buffer is bounded by `metrics_flush_buffer_max` (oldest evicted)."""
+    from ray_tpu.core.config import get_config
+    from ray_tpu.util import metrics as um
+
+    fake = [{"name": "x", "kind": "counter", "description": "",
+             "tag_keys": [], "series": [{"tags": [], "delta": 1.0}]}]
+    monkeypatch.setattr(um, "snapshot_deltas", lambda: [dict(d) for d in fake])
+
+    sent, down = [], [True]
+
+    def send(payload):
+        if down[0]:
+            raise ConnectionError("cp down")
+        sent.append(payload)
+
+    f = um.MetricsFlusher(send, source="unit", interval_s=999.0)
+    tss = []
+    for _ in range(5):
+        f.flush()
+        tss.append(f._backlog[-1]["ts"])
+        time.sleep(0.01)
+    assert sent == [] and len(f._backlog) == 5
+
+    down[0] = False
+    f.flush()  # recovery: backlog + the fresh snapshot all deliver
+    assert len(sent) == 6 and not f._backlog
+    # original timestamps preserved, oldest first — the store back-fills
+    # the outage window instead of showing a gap
+    assert [p["ts"] for p in sent[:5]] == tss == sorted(tss)
+    total = sum(s["delta"] for p in sent
+                for md in p["metrics"] for s in md["series"])
+    assert total == 6.0  # every increment arrived exactly once
+
+    # bounded: oldest payloads evicted beyond metrics_flush_buffer_max
+    monkeypatch.setattr(get_config(), "metrics_flush_buffer_max", 3)
+    down[0] = True
+    for _ in range(6):
+        f.flush()
+        time.sleep(0.01)
+    assert len(f._backlog) == 3  # cap trims oldest before each send pass
+    down[0] = False
+    f.flush()  # fresh snapshot joins, cap trims to 3 again, all deliver
+    assert not f._backlog
+    kept = sent[6:]
+    assert len(kept) == 3
+    assert [p["ts"] for p in kept] == sorted(p["ts"] for p in kept)
+
+
+def test_metrics_no_gap_across_cp_outage():
+    """Integration: a WORKER keeps incrementing a counter while the CP is
+    down; its flusher buffers each interval's delta with the ORIGINAL
+    timestamp and back-fills the store after the restart — the queried
+    series has points INSIDE the outage window, not a hole. (The head
+    process's own flusher is CP-owned and restarts with it; the buffering
+    path under test is the cross-process worker/agent one.)"""
+    from ray_tpu.core.cluster import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, _system_config={
+        "metrics_flush_interval_s": 0.2,
+    })
+    try:
+        @ray_tpu.remote
+        class Prober:
+            def __init__(self):
+                from ray_tpu.util.metrics import Counter
+                self.c = Counter("ft_outage_probe_total", "outage probe")
+
+            def bump(self):
+                self.c.inc()
+                return True
+
+        p = Prober.remote()
+        assert ray_tpu.get(p.bump.remote(), timeout=60)
+        # the worker's flusher is live once the series reaches the store
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if state.query_metrics("ft_outage_probe_total") is not None:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("probe series never reached the CP store")
+
+        t_kill = time.time()
+        addr = cluster.kill_control_plane()
+        # ~1.5s outage; actor calls ride established data-plane channels,
+        # and every 0.2s the worker flusher buffers a failed payload
+        stop = time.time() + 1.5
+        while time.time() < stop:
+            ray_tpu.get(p.bump.remote(), timeout=30)
+            time.sleep(0.1)
+        cluster.restart_control_plane(addr)
+        t_restart = time.time()
+
+        ray_tpu.get(p.bump.remote(), timeout=30)
+        deadline = time.monotonic() + 30.0
+        pts = []
+        while time.monotonic() < deadline:
+            try:
+                q = state.query_metrics("ft_outage_probe_total")
+            except Exception:  # noqa: BLE001 — CP client reconnecting
+                q = None
+            pts = [p_ for s in (q or {}).get("series", ())
+                   for p_ in s["points"]]
+            if sum(1 for ts, _ in pts if t_kill <= ts <= t_restart) >= 3:
+                break
+            time.sleep(0.3)
+        inside = [p_ for p_ in pts if t_kill <= p_[0] <= t_restart]
+        assert len(inside) >= 3, (
+            f"no back-filled points inside the {t_restart - t_kill:.1f}s "
+            f"outage window — buffered worker flushes were dropped: {pts}")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -684,3 +684,187 @@ def test_serve_survives_node_death_under_traffic():
         serve.shutdown()
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_controller_replaces_replicas_after_cp_restart():
+    """Pubsub resubscription regression: subscriptions live only in CP
+    memory, so after a CP restart every subscriber must re-issue them on
+    the new epoch and reconcile missed death events. Restart the CP, THEN
+    kill a replica-bearing node: the serve controller must still hear
+    about the death and replace the lost replicas — before the fix it
+    silently never received another node event."""
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.config import get_config
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    cfg = get_config()
+    cfg.health_check_period_s = 0.2
+    cfg.health_check_failure_threshold = 3
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # node0: controller home
+    ray_tpu.init(address=cluster.address, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+    })
+    try:
+        from ray_tpu.serve.controller import get_or_create_controller
+        ctl = get_or_create_controller()
+        ray_tpu.get(ctl.status.remote(), timeout=60)
+        victim = cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+
+        @serve.deployment(num_replicas=2, health_check_period_s=0.2,
+                          health_check_failure_threshold=3)
+        def echo(payload):
+            return {"ok": True}
+
+        serve.run(echo.bind(), name="resub", route_prefix="/resub")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}"
+        assert urllib.request.urlopen(
+            urllib.request.Request(f"{base}/resub", data=b"{}"),
+            timeout=30).status == 200
+
+        # ---- CP restart: the controller's subscription dies with it ----
+        addr = cluster.kill_control_plane()
+        time.sleep(0.5)
+        cluster.restart_control_plane(addr)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                if sum(1 for n in ray_tpu.nodes() if n["alive"]) >= 3:
+                    break
+            except Exception:  # noqa: BLE001 — CP client reconnecting
+                pass
+            time.sleep(0.2)
+        else:
+            raise AssertionError("agents never re-registered after restart")
+        # let subscribers finish their epoch-change resubscription
+        time.sleep(1.0)
+
+        # ---- now kill a replica-bearing node ----
+        cluster.remove_node(victim, graceful=False)
+
+        deadline = time.monotonic() + 60.0
+        last = None
+        while time.monotonic() < deadline:
+            last = ray_tpu.get(ctl.status.remote(), timeout=30)
+            dep = last.get("resub#echo") or {}
+            if dep.get("replicas") == 2 and not dep.get("draining"):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                f"controller never replaced replicas lost with the node "
+                f"after a CP restart (resubscription broken?): {last}")
+
+        # the replacement replicas actually serve
+        assert urllib.request.urlopen(
+            urllib.request.Request(f"{base}/resub", data=b"{}"),
+            timeout=30).status == 200
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_faultschedule_multifault_serve_slo():
+    """Deterministic multi-fault chaos: ONE seeded FaultSchedule stacks an
+    RPC slowdown, a worker kill, a graceful drain, and a CP restart under
+    sustained proxy traffic; >= 99% of requests succeed, every event fires,
+    and no successful response exceeds deadline+grace."""
+    import concurrent.futures
+
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.config import get_config
+    from ray_tpu.util.chaos import FaultSchedule
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    cfg = get_config()
+    cfg.health_check_period_s = 0.2
+    cfg.health_check_failure_threshold = 3
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # node0: controller home, never a victim
+    ray_tpu.init(address=cluster.address, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+    })
+    try:
+        from ray_tpu.serve.controller import get_or_create_controller
+        ctl = get_or_create_controller()
+        ray_tpu.get(ctl.status.remote(), timeout=60)
+        cluster.add_node(num_cpus=3)
+        cluster.add_node(num_cpus=3)
+
+        REQUEST_TIMEOUT_S = 15.0
+        GRACE_S = 3.0
+
+        @serve.deployment(num_replicas=2, health_check_period_s=0.2,
+                          health_check_failure_threshold=3,
+                          request_timeout_s=REQUEST_TIMEOUT_S)
+        def work(payload):
+            time.sleep(0.02)
+            return {"ok": True}
+
+        serve.run(work.bind(), name="mfapp", route_prefix="/mf")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}"
+
+        results = []
+        results_lock = threading.Lock()
+        stop_traffic = threading.Event()
+
+        def traffic():
+            while not stop_traffic.is_set():
+                t0 = time.monotonic()
+                try:
+                    resp = urllib.request.urlopen(
+                        urllib.request.Request(f"{base}/mf", data=b"{}"),
+                        timeout=REQUEST_TIMEOUT_S + GRACE_S)
+                    ok = resp.status == 200 and \
+                        json.loads(resp.read())["ok"] is True
+                    detail = f"http {resp.status}"
+                except Exception as e:  # noqa: BLE001 — failure is data
+                    ok, detail = False, repr(e)[:200]
+                with results_lock:
+                    results.append((ok, time.monotonic() - t0, detail))
+                time.sleep(0.02)
+
+        sched = FaultSchedule(cluster, [
+            (1.0, "rpc_delay", {"spec": "*:0:0:0.02", "duration_s": 2.0}),
+            (2.0, "worker_kill", {"spare_actors": False}),
+            (4.0, "node_drain", {"wait": True}),
+            (9.0, "cp_restart", {"down_s": 1.0}),
+        ], seed=11)
+        with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [pool.submit(traffic) for _ in range(4)]
+            sched.start()
+            time.sleep(16.0)
+            stop_traffic.set()
+            for f in futs:
+                f.result(timeout=REQUEST_TIMEOUT_S + GRACE_S + 10)
+        report = sched.stop()
+
+        assert len(report) == 4 and all(e["ok"] for e in report), report
+        total = len(results)
+        succ = sum(1 for ok, _, _ in results if ok)
+        assert total >= 100, f"not enough traffic generated: {total}"
+        rate = succ / total
+        failures = [d for ok, _, d in results if not ok]
+        assert rate >= 0.99, (
+            f"success rate {rate:.3f} ({succ}/{total}) under the "
+            f"multi-fault schedule; failures: {failures[:10]}; "
+            f"events: {report}")
+        slow = [t for ok, t, _ in results
+                if ok and t > REQUEST_TIMEOUT_S + GRACE_S]
+        assert not slow, f"successful responses exceeded deadline+grace: {slow}"
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -313,3 +313,40 @@ def test_multiprocessing_pool_semantics(ray_start_regular):
     pool.close()
     pool.join()  # must wait for the map, not kill it
     assert ar.get(timeout=60) == [x * x for x in range(8)]
+
+
+def test_faultschedule_validates_and_fires_rpc_faults():
+    """FaultSchedule unit semantics (no cluster needed): unknown kinds are
+    rejected up front; rpc_delay flips `testing_rpc_failure` for its
+    duration and RESTORES the previous value; the report records each
+    event with its offset."""
+    import time as _time
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.util.chaos import FaultSchedule
+
+    with pytest.raises(ValueError):
+        FaultSchedule(None, [(0.0, "bogus_kind", {})])
+
+    cfg = get_config()
+    prev = cfg.testing_rpc_failure
+    sched = FaultSchedule(None, [
+        (0.05, "rpc_delay", {"spec": "*:0:0:0.01", "duration_s": 0.4}),
+    ], seed=1)
+    sched.start()
+    _time.sleep(0.25)
+    assert cfg.testing_rpc_failure == "*:0:0:0.01"  # fault window active
+    report = sched.join(timeout=10.0)
+    assert cfg.testing_rpc_failure == prev          # restored after window
+    assert len(report) == 1
+    assert report[0]["kind"] == "rpc_delay"
+    assert report[0]["ok"] is True
+    assert report[0]["t"] == 0.05
+
+    # stop() mid-schedule cancels pending events (deterministic teardown)
+    sched2 = FaultSchedule(None, [
+        (30.0, "rpc_drop", {"spec": "*:1.0", "duration_s": 1.0}),
+    ], seed=2)
+    sched2.start()
+    assert sched2.stop() == []
+    assert cfg.testing_rpc_failure == prev
