@@ -346,6 +346,16 @@ def main():
         import subprocess
         import sys
         repo = os.path.dirname(os.path.abspath(__file__))
+        # graftlint first: it is ~2s and catches the exact bug classes
+        # (host syncs in the decode path, RPCs under locks) that turn a
+        # bench run into a misleading number
+        rc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "lint"],
+            cwd=repo, env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
+        if rc != 0:
+            sys.exit(f"preflight failed: ray-tpu lint exited {rc} — fix "
+                     f"the findings, pragma the sites, or regenerate the "
+                     f"baseline (--no-preflight to override)")
         preflight_tests = ["tests/test_serve_llm.py"]
         if args.spec_ab:
             preflight_tests.append("tests/test_spec_decode.py")

@@ -27,7 +27,7 @@ _lib = None
 _build_error = None
 
 
-def ensure_built():
+def ensure_built():  # graftlint: disable=lock-discipline — the build lock's purpose IS to serialize the one-time g++ build
     """Compile the native library if needed; returns the .so path or None."""
     global _build_error
     with _lock:

@@ -1,0 +1,26 @@
+"""graftlint — AST-based concurrency/JAX-hygiene analysis for ray_tpu.
+
+A self-contained static-analysis framework (pure ``ast``, no imports of
+analyzed code, JAX-free) with a pass registry, an intraprocedural
+lock-context/call-graph model, and a committed findings baseline that
+the tier-1 suite enforces. See ``ray_tpu/analysis/core.py`` for the
+design notes and pragma syntax, README "Static analysis" for the pass
+catalogue, and ``ray-tpu lint`` for the CLI.
+
+Passes (package sweep): lock-discipline, rpc-ack, host-sync,
+jit-hygiene, unbounded-growth. Tests-scoped: tier1-marks (the migrated
+tier-1 drift guard).
+"""
+
+from ray_tpu.analysis.baseline import (baseline_path, diff as baseline_diff,
+                                       load as load_baseline,
+                                       save as save_baseline)
+from ray_tpu.analysis.core import (Finding, ModuleSource, Pass, all_passes,
+                                   default_passes, package_dir, register,
+                                   repo_root, run_passes)
+
+__all__ = [
+    "Finding", "ModuleSource", "Pass", "all_passes", "default_passes",
+    "register", "run_passes", "package_dir", "repo_root",
+    "baseline_path", "baseline_diff", "load_baseline", "save_baseline",
+]

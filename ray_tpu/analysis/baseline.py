@@ -1,0 +1,74 @@
+"""graftlint findings baseline: load / regenerate / drift-check.
+
+The committed baseline (``GRAFTLINT_BASELINE.json`` at the repo root)
+is the set of accepted findings, each with a one-line written
+justification. The tier-1 gate compares a fresh full-package run against
+it EXACTLY: a new un-baselined finding fails, and so does a stale entry
+whose finding no longer exists (a fixed finding must leave the baseline
+with the fix, or the file rots into an allowlist nobody trusts).
+
+Keys are line-number-free (``pass::path::symbol::tag``) so unrelated
+edits don't churn the file; regeneration (``ray-tpu lint --baseline``)
+is deterministic — sorted keys, existing justifications preserved, new
+entries get an empty justification that a reviewer must fill.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional
+
+from ray_tpu.analysis.core import Finding, repo_root
+
+BASELINE_NAME = "GRAFTLINT_BASELINE.json"
+_VERSION = 1
+
+
+def baseline_path(explicit: Optional[str] = None) -> str:
+    return explicit or os.path.join(repo_root(), BASELINE_NAME)
+
+
+def load(path: Optional[str] = None) -> dict[str, str]:
+    """{finding_key: justification}; empty when no baseline exists."""
+    p = baseline_path(path)
+    if not os.path.exists(p):
+        return {}
+    with open(p, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries", {})
+    if not isinstance(entries, dict):
+        raise ValueError(f"{p}: entries must be a key->justification map")
+    return dict(entries)
+
+
+def save(findings: Iterable[Finding], path: Optional[str] = None,
+         previous: Optional[dict[str, str]] = None) -> str:
+    """Write the baseline for ``findings``, keeping justifications of
+    surviving entries from ``previous`` (default: the current file)."""
+    p = baseline_path(path)
+    if previous is None:
+        previous = load(p) if os.path.exists(p) else {}
+    entries = {f.key: previous.get(f.key, "") for f in findings}
+    doc = {
+        "version": _VERSION,
+        "tool": "graftlint (ray-tpu lint --baseline)",
+        "note": ("accepted findings; each entry carries a one-line "
+                 "justification. The tier-1 gate fails on new findings "
+                 "AND on stale entries — fixes must prune their entry."),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return p
+
+
+def diff(findings: Iterable[Finding], path: Optional[str] = None,
+         ) -> tuple[list[Finding], list[str]]:
+    """(new_findings, stale_keys) of ``findings`` vs the baseline."""
+    base = load(path)
+    found_keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in base]
+    stale = sorted(k for k in base if k not in found_keys)
+    return new, stale

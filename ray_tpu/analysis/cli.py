@@ -1,0 +1,91 @@
+"""`ray-tpu lint` implementation (kept apart from scripts/cli.py so the
+analyzer is importable without argparse plumbing, and vice versa).
+
+Exit status: 0 when the run matches the committed baseline exactly;
+1 on any new finding or stale baseline entry. ``--baseline`` rewrites
+the baseline from the current run (deterministic; keeps justifications
+of surviving entries) and exits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+from ray_tpu.analysis import baseline as baseline_mod
+from ray_tpu.analysis.core import (all_passes, default_passes, package_dir,
+                                   repo_root, run_passes)
+
+
+def lint(paths: Optional[list[str]] = None, json_out: bool = False,
+         write_baseline: bool = False, baseline_file: Optional[str] = None,
+         include_tests: bool = False, out=None) -> int:
+    out = out or sys.stdout
+    passes = default_passes()
+    parse_errors: list[str] = []
+    on_error = lambda path, e: parse_errors.append(f"{path}: {e}")  # noqa: E731
+    findings = run_passes(paths or [package_dir()], passes=passes,
+                          on_error=on_error)
+    if include_tests:
+        # tests-scoped passes (tier1-marks) analyze test files, not the
+        # package; the package passes deliberately skip test code (tests
+        # accumulate state and fire one-way notifies on purpose)
+        tests_passes = [p for p in all_passes().values()
+                        if p.scope == "tests"]
+        tests_dir = os.path.join(repo_root(), "tests")
+        if tests_passes and os.path.isdir(tests_dir):
+            passes = passes + tests_passes
+            findings = sorted(
+                findings + run_passes([tests_dir], passes=tests_passes,
+                                      on_error=on_error),
+                key=lambda f: (f.path, f.line, f.pass_id, f.tag))
+
+    if write_baseline:
+        p = baseline_mod.save(findings, baseline_file)
+        if json_out:
+            json.dump({"baseline": p, "entries": len(findings)}, out)
+            out.write("\n")
+        else:
+            out.write(f"wrote {len(findings)} entries to {p}\n")
+            missing = [f.key for f in findings
+                       if not baseline_mod.load(p).get(f.key)]
+            if missing:
+                out.write(f"  ({len(missing)} entries need a justification "
+                          f"— edit the file)\n")
+        return 0
+
+    new, stale = baseline_mod.diff(findings, baseline_file)
+    base = baseline_mod.load(baseline_file)
+    if json_out:
+        json.dump({
+            "findings": [f.to_dict() | {"baselined": f.key in base}
+                         for f in findings],
+            "new": [f.to_dict() for f in new],
+            "stale_baseline_keys": stale,
+            "parse_errors": parse_errors,
+            "passes": sorted(p.id for p in passes),
+        }, out, indent=2)
+        out.write("\n")
+    else:
+        for f in findings:
+            mark = " [baselined]" if f.key in base else ""
+            out.write(f.format() + mark + "\n")
+        for err in parse_errors:
+            out.write(f"parse error: {err}\n")
+        out.write(f"{len(findings)} finding(s): {len(new)} new, "
+                  f"{len(findings) - len(new)} baselined; "
+                  f"{len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'}\n")
+        if new:
+            out.write("new findings — fix them, pragma the site, or "
+                      "`ray-tpu lint --baseline` + justify:\n")
+            for f in new:
+                out.write(f"  {f.key}\n")
+        if stale:
+            out.write("stale baseline entries (finding no longer exists "
+                      "— prune via `ray-tpu lint --baseline`):\n")
+            for k in stale:
+                out.write(f"  {k}\n")
+    return 1 if (new or stale or parse_errors) else 0

@@ -1,0 +1,263 @@
+"""graftlint core: findings, pragmas, the pass registry, and the runner.
+
+The reference runtime keeps its heavily threaded C++ core honest with
+TSan/ASan wiring in the build; this package is the Python/JAX analog — a
+pure-`ast` static analyzer for the bug classes this codebase has actually
+shipped (see ISSUE 9): blocking I/O under locks, fire-and-forget RPC on
+delivery-dependent paths, host syncs in engine hot paths, jit-boundary
+drift, and unbounded handler-fed containers.
+
+Design constraints, in order:
+
+- **No imports of analyzed code.** Everything is `ast.parse` over file
+  text — the tier-1 gate runs the full package in well under its 15 s
+  budget, JAX-free, on any CPU box.
+- **Low noise over high recall.** Every pass models *this* codebase's
+  idioms (``with self._lock:``, ``RpcClient.notify``, ``_h_*`` handlers)
+  and offers a per-site escape hatch: a ``# graftlint:`` pragma on the
+  offending line (or the line above it, or the enclosing ``def``) plus a
+  committed baseline with per-finding justifications.
+- **Deterministic output.** Findings sort by (path, line, pass id);
+  baseline keys omit line numbers so unrelated edits don't churn them.
+
+Pragma syntax (comment anywhere on the relevant line)::
+
+    # graftlint: fire-and-forget                 (alias for disable=rpc-ack)
+    # graftlint: disable=lock-discipline
+    # graftlint: disable=host-sync,jit-hygiene
+    # graftlint: disable                          (all passes; avoid)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Iterable, Optional
+
+# tokens a pragma may carry; aliases map onto pass ids
+_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*([A-Za-z0-9_,=\- ]+)")
+_PRAGMA_ALIASES = {"fire-and-forget": "rpc-ack"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit.
+
+    ``path`` is repo-relative; ``symbol`` is the enclosing qualname
+    (``Class.method`` / ``function`` / ``<module>``); ``tag`` is a short
+    stable token naming the offending operation — the baseline key is
+    built from (pass_id, path, symbol, tag) so line drift from unrelated
+    edits never invalidates a baselined entry.
+    """
+
+    pass_id: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+    hint: str
+    tag: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_id}::{self.path}::{self.symbol}::{self.tag}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_id}] "
+                f"{self.symbol}: {self.message} (fix: {self.hint})")
+
+    def to_dict(self) -> dict:
+        return {"pass": self.pass_id, "file": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "hint": self.hint, "key": self.key}
+
+
+class ModuleSource:
+    """One parsed file: tree, raw lines, and the pragma map."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        # line -> set of disabled pass ids ("*" disables everything)
+        self.pragmas: dict[int, set[str]] = {}
+        for i, line in enumerate(text.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            tags: set[str] = set()
+            for raw in re.split(r"[,\s]+", m.group(1).strip()):
+                if not raw:
+                    continue
+                if raw == "disable":
+                    tags.add("*")
+                elif raw.startswith("disable="):
+                    tags.update(t for t in raw[len("disable="):].split(",")
+                                if t)
+                else:
+                    tags.add(_PRAGMA_ALIASES.get(raw, raw))
+            self.pragmas[i] = tags
+
+    def suppressed(self, pass_id: str, *lines: int) -> bool:
+        """True when any of ``lines`` (or the line just above the first)
+        carries a pragma disabling ``pass_id``."""
+        candidates = set(lines)
+        if lines:
+            candidates.add(lines[0] - 1)
+        for ln in candidates:
+            tags = self.pragmas.get(ln)
+            if tags and ("*" in tags or pass_id in tags):
+                return True
+        return False
+
+
+class Pass:
+    """Base class: subclasses set ``id``/``title``/``hint`` and implement
+    ``run``. ``scope`` controls membership in the default package sweep —
+    "package" passes run over ``ray_tpu/``; "tests" passes (the tier-1
+    mark guard) only run when explicitly requested."""
+
+    id: str = ""
+    title: str = ""
+    hint: str = ""
+    scope: str = "package"
+
+    def run(self, module: ModuleSource) -> list[Finding]:
+        raise NotImplementedError
+
+    # -- helpers shared by every pass -----------------------------------
+    def emit(self, module: ModuleSource, node: ast.AST, symbol: str,
+             message: str, tag: str, hint: Optional[str] = None,
+             extra_pragma_lines: Iterable[int] = ()) -> Optional[Finding]:
+        line = getattr(node, "lineno", 1)
+        if module.suppressed(self.id, line, *extra_pragma_lines):
+            return None
+        return Finding(self.id, module.relpath, line, symbol, message,
+                       hint if hint is not None else self.hint, tag)
+
+
+_REGISTRY: dict[str, Pass] = {}
+
+
+def register(pass_cls: type) -> type:
+    """Class decorator: instantiate and add to the registry (import of a
+    pass module is what makes its passes available)."""
+    inst = pass_cls()
+    if not inst.id:
+        raise ValueError(f"{pass_cls.__name__} has no id")
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate pass id {inst.id!r}")
+    _REGISTRY[inst.id] = inst
+    return pass_cls
+
+
+def all_passes() -> dict[str, Pass]:
+    _load_builtin_passes()
+    return dict(_REGISTRY)
+
+
+def default_passes() -> list[Pass]:
+    """The package-sweep set (everything except tests-scoped passes)."""
+    return [p for p in all_passes().values() if p.scope == "package"]
+
+
+_loaded = False
+
+
+def _load_builtin_passes() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    # imports register via the @register decorator
+    from ray_tpu.analysis import (passes_concurrency, passes_growth,  # noqa: F401
+                                  passes_jax, passes_tests)
+
+
+def qualname_of(stack: list[ast.AST]) -> str:
+    parts = [n.name for n in stack
+             if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                               ast.AsyncFunctionDef))]
+    return ".".join(parts) if parts else "<module>"
+
+
+def iter_functions(tree: ast.AST):
+    """Yield (func_node, qualname, class_node_or_None) for every function
+    in the module, including nested ones."""
+    out = []
+
+    def walk(node, stack, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, qualname_of(stack + [child]), cls))
+                walk(child, stack + [child], cls)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, stack + [child], child)
+            else:
+                walk(child, stack, cls)
+
+    walk(tree, [], None)
+    return out
+
+
+def repo_root() -> str:
+    """Parent directory of the ray_tpu package (the repo checkout)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(here)
+
+
+def package_dir() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def iter_source_files(paths: Iterable[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    files.append(os.path.abspath(os.path.join(dirpath, fn)))
+    return sorted(set(files))
+
+
+def run_passes(paths: Optional[Iterable[str]] = None,
+               passes: Optional[Iterable[Pass]] = None,
+               rel_to: Optional[str] = None,
+               on_error: Optional[Callable[[str, Exception], None]] = None,
+               ) -> list[Finding]:
+    """Run ``passes`` (default: the package set) over every ``.py`` file
+    under ``paths`` (default: the installed ray_tpu package). Unparseable
+    files are reported through ``on_error`` and skipped — the linter must
+    not die on a half-written file."""
+    if paths is None:
+        paths = [package_dir()]
+    if passes is None:
+        passes = default_passes()
+    else:
+        passes = list(passes)
+        _load_builtin_passes()
+    if rel_to is None:
+        rel_to = repo_root()
+    findings: list[Finding] = []
+    for path in iter_source_files(paths):
+        try:
+            text = open(path, encoding="utf-8").read()
+            rel = os.path.relpath(path, rel_to)
+            if rel.startswith(".."):
+                rel = path
+            module = ModuleSource(path, rel.replace(os.sep, "/"), text)
+        except (OSError, SyntaxError, ValueError) as e:
+            if on_error is not None:
+                on_error(path, e)
+            continue
+        for p in passes:
+            findings.extend(f for f in p.run(module) if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id, f.tag))
+    return findings

@@ -1,0 +1,299 @@
+"""Intraprocedural lock-context + may-block model for graftlint.
+
+A deliberately small model of this codebase's concurrency idioms:
+
+- Locks are attributes/names whose terminal identifier looks lock-ish
+  (``_lock``, ``_flush_lock``, ``registry_lock``, ``_pub_cv`` …). A lock
+  is *held* inside ``with self._lock:`` bodies and between
+  ``X.acquire()`` / ``X.release()`` statements in the same suite.
+- Blocking operations are the ones this runtime's PRs have actually been
+  burned by: RPC (`.call` / `.call_with_retry` / `.notify` — the notify
+  socket write does a lazy connect, PR 2's 10 s wedge), object-plane and
+  socket sends/recvs, file ``open()``, ``subprocess.*``, ``time.sleep``,
+  and ``Event.wait``-style waits. ``Condition`` waits on the held lock's
+  own condition variable are the sanctioned sleep-holding-lock pattern
+  and are exempt (receiver names matching cv/cond, or the held context
+  expression itself).
+- A one-level-deep (transitively propagated) call graph per class: a
+  method *may block* if it contains a direct blocking op or calls a
+  sibling method that may block. The lock pass flags `self._foo()` under
+  a held lock when `_foo` may block, naming the underlying operation.
+
+Heuristics over soundness: nested function/lambda bodies are skipped
+(they execute later, not under the lock), aliasing is not tracked, and
+cross-class calls are out of scope. The payoff is near-zero noise on
+this codebase; escape hatches are the pragma and the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+LOCK_NAME_RE = re.compile(r"(lock|mutex)s?$|(^|_)(cv|cond)$", re.I)
+_CV_RE = re.compile(r"(^|_)(cv|cond)", re.I)
+
+# attribute names whose call is treated as blocking I/O
+BLOCKING_ATTRS = {
+    "call": "RPC call",
+    "call_with_retry": "RPC call_with_retry",
+    "notify": "RPC notify (socket write + lazy connect)",
+    "send": "socket/pipe send",
+    "sendall": "socket sendall",
+    "_send": "injected send callable",
+    "recv": "socket recv",
+    "recv_into": "socket recv_into",
+    "connect": "socket connect",
+    "accept": "socket accept",
+    "communicate": "subprocess communicate",
+    "check_output": "subprocess check_output",
+    "check_call": "subprocess check_call",
+    "urlopen": "urllib urlopen",
+}
+
+
+def expr_tail(node: ast.AST) -> Optional[str]:
+    """Terminal identifier of a Name/Attribute chain (``self._pub_cv`` ->
+    ``_pub_cv``), else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def expr_repr(node: ast.AST) -> str:
+    """Dotted best-effort rendering for messages (``self._lock``)."""
+    if isinstance(node, ast.Attribute):
+        return f"{expr_repr(node.value)}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    return "<expr>"
+
+
+def is_lockish(node: ast.AST) -> bool:
+    tail = expr_tail(node)
+    return bool(tail and LOCK_NAME_RE.search(tail))
+
+
+def _is_cv_receiver(node: ast.AST, held: list[str]) -> bool:
+    tail = expr_tail(node)
+    if tail and _CV_RE.search(tail):
+        return True
+    return expr_repr(node) in held
+
+
+def blocking_reason(call: ast.Call, held: list[str]) -> Optional[tuple[str, str]]:
+    """(tag, description) when ``call`` is a blocking operation, else
+    None. ``held`` is the list of currently held lock expression reprs
+    (used to sanction Condition.wait on the held lock)."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return "open", "file open()"
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    attr = fn.attr
+    if attr == "sleep" and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "time":
+        return "time.sleep", "time.sleep()"
+    if isinstance(fn.value, ast.Name) and fn.value.id == "subprocess":
+        return f"subprocess.{attr}", f"subprocess.{attr}()"
+    if attr in ("wait", "wait_for"):
+        if _is_cv_receiver(fn.value, held):
+            return None  # Condition.wait releases the held lock
+        return f"{attr}", f"{expr_repr(fn.value)}.{attr}() " \
+                          f"(Event/process-style wait holds the lock)"
+    if attr == "notify":
+        # Condition.notify() (no args, or a cv-named/held receiver) is the
+        # sanctioned wake-under-lock; RPC notify(method, body) is a socket
+        # write with a lazy connect that can stall seconds on a dead peer
+        if not call.args or _is_cv_receiver(fn.value, held):
+            return None
+        return "notify", f"{expr_repr(fn.value)}.notify() " \
+                         f"({BLOCKING_ATTRS['notify']})"
+    if attr in BLOCKING_ATTRS:
+        # str.join-style false positives: constant receivers never block
+        if isinstance(fn.value, ast.Constant):
+            return None
+        return attr, f"{expr_repr(fn.value)}.{attr}() ({BLOCKING_ATTRS[attr]})"
+    return None
+
+
+def _iter_executed(node: ast.AST):
+    """Child nodes executed inline — skips nested function/lambda/class
+    bodies (those run later, outside the current lock context)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+
+
+def direct_blocking_ops(fn: ast.AST) -> list[tuple[ast.Call, str, str]]:
+    """Every blocking op executed inline anywhere in ``fn`` (regardless
+    of lock state) as (call_node, tag, description)."""
+    out = []
+
+    def walk(node):
+        for child in _iter_executed(node):
+            if isinstance(child, ast.Call):
+                reason = blocking_reason(child, held=[])
+                if reason is not None:
+                    out.append((child, reason[0], reason[1]))
+            walk(child)
+
+    walk(fn)
+    return out
+
+
+def self_calls(fn: ast.AST) -> set[str]:
+    """Names of ``self._x(...)`` methods invoked inline in ``fn``."""
+    out: set[str] = set()
+
+    def walk(node):
+        for child in _iter_executed(node):
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and isinstance(child.func.value, ast.Name) \
+                    and child.func.value.id == "self":
+                out.add(child.func.attr)
+            walk(child)
+
+    walk(fn)
+    return out
+
+
+class ClassModel:
+    """Per-class method map + may-block fixpoint."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.node = cls
+        self.methods: dict[str, ast.AST] = {}
+        for child in cls.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[child.name] = child
+        # method -> (tag, description-of-why) for may-block methods
+        self.may_block: dict[str, tuple[str, str]] = {}
+        for name, fn in self.methods.items():
+            ops = direct_blocking_ops(fn)
+            if ops:
+                _, tag, desc = ops[0]
+                self.may_block[name] = (tag, desc)
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in self.methods.items():
+                if name in self.may_block:
+                    continue
+                for callee in self_calls(fn):
+                    if callee in self.may_block:
+                        tag, desc = self.may_block[callee]
+                        self.may_block[name] = (
+                            tag, f"calls self.{callee}() which does {desc}")
+                        changed = True
+                        break
+
+
+class LockWalker:
+    """Walk one function flagging blocking ops while a lock is held.
+
+    ``on_violation(call_node, tag, description, lock_repr)`` fires for
+    direct blocking ops and for ``self._m()`` calls whose target may
+    block (per the enclosing ClassModel).
+    """
+
+    def __init__(self, model: Optional[ClassModel], fn_name: str,
+                 on_violation):
+        self.model = model
+        self.fn_name = fn_name
+        self.on_violation = on_violation
+
+    def walk_function(self, fn: ast.AST) -> None:
+        self._walk_body(list(ast.iter_child_nodes(fn)), held=[])
+
+    # -- internals -------------------------------------------------------
+    def _walk_body(self, stmts, held: list[str]) -> None:
+        acquired: list[str] = []
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            # X.acquire() / X.release() statement tracking within a suite
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call) \
+                    and isinstance(stmt.value.func, ast.Attribute) \
+                    and stmt.value.func.attr in ("acquire", "release"):
+                rep = expr_repr(stmt.value.func.value)
+                if stmt.value.func.attr == "acquire":
+                    acquired.append(rep)
+                elif rep in acquired:
+                    acquired.remove(rep)
+                elif rep in held:
+                    # released a lock taken by an enclosing suite: treat
+                    # the rest of this suite as lock-free for it
+                    held = [h for h in held if h != rep]
+                continue
+            cur = held + acquired
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                body_held = list(cur)
+                for item in stmt.items:
+                    ctx = item.context_expr
+                    target = ctx.func if isinstance(ctx, ast.Call) else ctx
+                    if is_lockish(target):
+                        body_held.append(expr_repr(target))
+                    else:
+                        self._check_expr(ctx, cur)
+                self._walk_body(stmt.body, body_held)
+                continue
+            if cur:
+                self._check_stmt(stmt, cur)
+            else:
+                # still need to descend: a nested With may take a lock
+                self._descend_lockfree(stmt)
+
+    def _descend_lockfree(self, stmt) -> None:
+        for child in _iter_executed(stmt):
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                self._walk_body([child], held=[])
+            elif isinstance(child, ast.stmt):
+                self._descend_lockfree(child)
+            else:
+                self._descend_lockfree(child)
+
+    def _check_stmt(self, stmt, held: list[str]) -> None:
+        """Everything inline under ``stmt`` runs with ``held`` locks."""
+        for child in _iter_executed(stmt):
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                self._walk_body([child], held)
+                continue
+            if isinstance(child, ast.Call):
+                self._check_call(child, held)
+            self._check_stmt(child, held)
+
+    def _check_expr(self, expr, held: list[str]) -> None:
+        if not held:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(node, held)
+
+    def _check_call(self, call: ast.Call, held: list[str]) -> None:
+        reason = blocking_reason(call, held)
+        lock = held[-1] if held else "?"
+        if reason is not None:
+            self.on_violation(call, reason[0], reason[1], lock)
+            return
+        if self.model is not None \
+                and isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self":
+            name = call.func.attr
+            if name == self.fn_name:
+                return  # plain recursion, not new information
+            hit = self.model.may_block.get(name)
+            if hit is not None:
+                tag, desc = hit
+                self.on_violation(call, f"self.{name}",
+                                  f"self.{name}() — {desc}", lock)

@@ -1,0 +1,115 @@
+"""graftlint concurrency passes: lock-discipline and rpc-ack.
+
+These target the two bug classes PR 7's review and PR 8 shipped fixes
+for — disk/ref I/O held under the ``KVTierStore`` lock, and one-way
+``notify()`` on the metrics/trace flusher paths where the backlog never
+engaged because a half-closed socket swallows one-way writes without an
+error.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.analysis import lockmodel
+from ray_tpu.analysis.core import Finding, ModuleSource, Pass, register
+
+
+def _def_line(fn: ast.AST) -> int:
+    return getattr(fn, "lineno", 1)
+
+
+@register
+class LockDisciplinePass(Pass):
+    """Blocking operations reachable while a threading lock is held.
+
+    Flags RPC calls (`.call` / `.call_with_retry` / `.notify`), socket /
+    pipe sends+recvs, file ``open()``, ``subprocess.*``, ``time.sleep``
+    and Event-style ``.wait`` executed inside ``with self._lock:`` (or
+    between ``acquire()``/``release()``), directly or via a same-class
+    method that may block. Condition-variable waits/notifies on the held
+    lock are the sanctioned pattern and exempt.
+    """
+
+    id = "lock-discipline"
+    title = "blocking operation while holding a lock"
+    hint = ("snapshot state under the lock, do the blocking work outside "
+            "it (see KVTierStore._make_room), or pragma "
+            "`# graftlint: disable=lock-discipline` with a justification")
+
+    def run(self, module: ModuleSource) -> list:
+        findings: list = []
+        # class-level models first (method map + may-block fixpoint)
+        models: dict[ast.ClassDef, lockmodel.ClassModel] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                models[node] = lockmodel.ClassModel(node)
+
+        from ray_tpu.analysis.core import iter_functions
+        for fn, qualname, cls in iter_functions(module.tree):
+            model = models.get(cls)
+
+            def on_violation(call, tag, desc, lock, _fn=fn, _q=qualname):
+                findings.append(self.emit(
+                    module, call, _q,
+                    f"{desc} while holding {lock}", tag,
+                    extra_pragma_lines=(_def_line(_fn),)))
+
+            lockmodel.LockWalker(model, getattr(fn, "name", ""),
+                                 on_violation).walk_function(fn)
+        return [f for f in findings if f is not None]
+
+
+@register
+class RpcAckPass(Pass):
+    """One-way ``notify()`` RPC on paths that may depend on delivery.
+
+    ``RpcClient.notify`` writes into the socket and returns — a write
+    into a half-closed connection vanishes in the kernel buffer with no
+    error (PR 8's metrics-backlog bug). Every RPC-shaped ``X.notify(
+    "method", ...)`` call is flagged unless the site carries an explicit
+    ``# graftlint: fire-and-forget`` pragma asserting the protocol
+    tolerates silent loss (heartbeat self-heal, pubsub long-poll
+    recovery, observability sinks), or is baselined with a written
+    justification.
+    """
+
+    id = "rpc-ack"
+    title = "unacknowledged one-way RPC"
+    hint = ("use an acknowledged call() with a timeout when callers "
+            "depend on delivery, or annotate the site with "
+            "`# graftlint: fire-and-forget` and say why loss is safe")
+
+    def run(self, module: ModuleSource) -> list:
+        findings: list[Finding] = []
+        from ray_tpu.analysis.core import iter_functions
+        fn_spans = [(fn, q) for fn, q, _ in iter_functions(module.tree)]
+
+        def enclosing(call) -> tuple:
+            best = None
+            for fn, q in fn_spans:
+                if fn.lineno <= call.lineno <= (fn.end_lineno or fn.lineno):
+                    if best is None or fn.lineno > best[0].lineno:
+                        best = (fn, q)
+            return best or (None, "<module>")
+
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "notify"):
+                continue
+            # RPC shape: first positional arg is the method-name string.
+            # Condition.notify() has no args; Condition.notify(n) has a
+            # non-string arg.
+            if not node.args or not (isinstance(node.args[0], ast.Constant)
+                                     and isinstance(node.args[0].value, str)):
+                continue
+            method = node.args[0].value
+            fn, qualname = enclosing(node)
+            findings.append(self.emit(
+                module, node, qualname,
+                f"one-way notify({method!r}) — delivery is unacknowledged "
+                f"and silently lost on a half-closed socket",
+                f"notify:{method}",
+                extra_pragma_lines=(_def_line(fn),) if fn is not None else ()))
+        return [f for f in findings if f is not None]

@@ -1,0 +1,160 @@
+"""graftlint unbounded-growth pass.
+
+The repo invariant since PR 4 (metrics GC): any container an RPC/event
+handler grows must have a visible retraction path — a cap/trim, a TTL
+sweep, or a death-event GC. This pass finds class-attribute dicts/lists/
+sets initialized empty in ``__init__`` and mutated from handler-reachable
+methods (``_h_*`` / ``_on_*`` / ``on_*`` / ``handle*``, plus methods a
+handler calls directly) in classes that never shrink them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from ray_tpu.analysis.core import ModuleSource, Pass, register
+from ray_tpu.analysis.lockmodel import self_calls
+
+HANDLER_RE = re.compile(r"^(_h_|_on_|on_|handle)")
+
+_EMPTY_CALLS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                "Counter"}
+_GROW_ATTRS = {"append", "add", "extend", "insert", "setdefault", "update",
+               "appendleft"}
+_SHRINK_ATTRS = {"pop", "popitem", "popleft", "clear", "remove", "discard"}
+
+
+def _container_attrs(cls: ast.ClassDef) -> dict[str, int]:
+    """self.X = {} / [] / set() / dict() / OrderedDict() / defaultdict(..)
+    assignments in __init__ -> {attr: lineno}. deque(maxlen=...) and any
+    non-empty initializer are considered bounded/deliberate."""
+    init = next((m for m in cls.body
+                 if isinstance(m, ast.FunctionDef) and m.name == "__init__"),
+                None)
+    if init is None:
+        return {}
+    out: dict[str, int] = {}
+    for node in ast.walk(init):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            v = node.value
+            empty = False
+            if isinstance(v, (ast.Dict, ast.List, ast.Set)) \
+                    and not getattr(v, "keys", getattr(v, "elts", None)):
+                empty = True
+            elif isinstance(v, ast.Call):
+                name = v.func.id if isinstance(v.func, ast.Name) else (
+                    v.func.attr if isinstance(v.func, ast.Attribute) else "")
+                if name in _EMPTY_CALLS and not v.args:
+                    empty = True
+                elif name == "deque" and not any(
+                        kw.arg == "maxlen" for kw in v.keywords):
+                    empty = True
+            if empty:
+                out[t.attr] = node.lineno
+    return out
+
+
+def _attr_of(node: ast.AST) -> Optional[str]:
+    """self.X for self.X / self.X[...] expressions."""
+    if isinstance(node, ast.Subscript):
+        return _attr_of(node.value)
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@register
+class UnboundedGrowthPass(Pass):
+    id = "unbounded-growth"
+    title = "handler-fed container with no visible bound"
+    hint = ("add a cap/trim (del x[:-N], len() check), a TTL sweep, or a "
+            "death-event retraction — or pragma "
+            "`# graftlint: disable=unbounded-growth` with the bound's "
+            "location")
+
+    def run(self, module: ModuleSource) -> list:
+        findings = []
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(self._check_class(module, cls))
+        return [f for f in findings if f is not None]
+
+    def _check_class(self, module: ModuleSource, cls: ast.ClassDef) -> list:
+        containers = _container_attrs(cls)
+        if not containers:
+            return []
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        handler_names = {n for n in methods if HANDLER_RE.match(n)}
+        # one hop: methods a handler calls directly are handler-reachable
+        reachable = set(handler_names)
+        for h in handler_names:
+            reachable |= self_calls(methods[h]) & set(methods)
+
+        shrunk: set[str] = set()
+        for m in methods.values():
+            for node in ast.walk(m):
+                # del self.X[...] / del self.X
+                if isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        a = _attr_of(t)
+                        if a:
+                            shrunk.add(a)
+                # self.X.pop(...) etc.
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SHRINK_ATTRS:
+                    a = _attr_of(node.func.value)
+                    if a:
+                        shrunk.add(a)
+                # reassignment outside __init__ resets the container
+                elif isinstance(node, ast.Assign) and m.name != "__init__":
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute):
+                            a = _attr_of(t)
+                            if a:
+                                shrunk.add(a)
+                # an explicit len() comparison counts as a visible cap
+                elif isinstance(node, ast.Compare):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Call) \
+                                and isinstance(sub.func, ast.Name) \
+                                and sub.func.id == "len" and sub.args:
+                            a = _attr_of(sub.args[0])
+                            if a:
+                                shrunk.add(a)
+
+        findings = []
+        seen: set[tuple] = set()  # one finding per (method, attr)
+        for name in sorted(reachable):
+            m = methods[name]
+            for node in ast.walk(m):
+                grown = None
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            grown = _attr_of(t)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _GROW_ATTRS:
+                    grown = _attr_of(node.func.value)
+                if not grown or grown not in containers or grown in shrunk \
+                        or (name, grown) in seen:
+                    continue
+                seen.add((name, grown))
+                findings.append(self.emit(
+                    module, node, f"{cls.name}.{name}",
+                    f"self.{grown} grows in handler path {name} but "
+                    f"{cls.name} never caps, trims, or retracts it",
+                    f"self.{grown}",
+                    extra_pragma_lines=(m.lineno, containers[grown])))
+        return findings

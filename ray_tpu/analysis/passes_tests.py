@@ -1,0 +1,96 @@
+"""graftlint tier1-marks pass: chaos/multi-node tests must be slow-marked.
+
+Migration of the hand-rolled AST guard from ``tests/test_tier1_guard.py``
+onto the pass framework. Semantics are identical to the original: a test
+function that references a chaos harness class (WorkerKiller /
+NodeKiller / FaultSchedule) or issues 3+ ``add_node`` calls must carry
+``@pytest.mark.slow`` so the tier-1 gate (``pytest -m 'not slow'``)
+stays fast and deterministic. The allowlist freezes the seed-era
+exceptions and must not grow — mark new tests slow instead.
+
+Scope is "tests": this pass never joins the default package sweep (it
+analyzes test files, not ``ray_tpu/``); the tier-1 guard test and
+``ray-tpu lint --tests`` run it explicitly.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.analysis.core import ModuleSource, Pass, register
+
+CHAOS_NAMES = frozenset({"WorkerKiller", "NodeKiller", "FaultSchedule"})
+ADD_NODE_MIN = 3
+
+# Frozen seed-era exceptions — deliberate tier-1 residents. Do NOT grow
+# this set for new tests; mark them slow instead. (Single source of
+# truth: tests/test_tier1_guard.py asserts against THIS set.)
+FROZEN_ALLOWLIST = frozenset({
+    # seed-era tier-1 chaos coverage, bounded (< ~30s each) and
+    # load-bearing for the lineage/retry acceptance of earlier PRs
+    "test_node_killer_lineage_reconstruction",
+    "test_chaos_worker_killer_workload_completes",
+    # pure unit tests of the chaos harnesses themselves (fake procs /
+    # no cluster, sub-second)
+    "test_faultschedule_validates_and_fires_rpc_faults",
+    "test_worker_killer_max_kills",
+})
+
+
+def _is_slow_marker(dec: ast.expr) -> bool:
+    """True for `@pytest.mark.slow` (bare or called)."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return (isinstance(dec, ast.Attribute) and dec.attr == "slow"
+            and isinstance(dec.value, ast.Attribute)
+            and dec.value.attr == "mark")
+
+
+@register
+class Tier1MarksPass(Pass):
+    id = "tier1-marks"
+    title = "chaos/multi-node test missing @pytest.mark.slow"
+    hint = ("add @pytest.mark.slow (the frozen ALLOWLIST in "
+            "tests/test_tier1_guard.py is not to be grown)")
+    scope = "tests"
+
+    def __init__(self, allowlist: frozenset = FROZEN_ALLOWLIST,
+                 chaos_names: frozenset = CHAOS_NAMES,
+                 add_node_min: int = ADD_NODE_MIN):
+        self.allowlist = frozenset(allowlist)
+        self.chaos_names = frozenset(chaos_names)
+        self.add_node_min = int(add_node_min)
+
+    def run(self, module: ModuleSource) -> list:
+        if not module.relpath.rsplit("/", 1)[-1].startswith("test_"):
+            return []
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not node.name.startswith("test"):
+                continue
+            if node.name in self.allowlist:
+                continue
+            if any(_is_slow_marker(d) for d in node.decorator_list):
+                continue
+            names = {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+            attrs = {n.attr for n in ast.walk(node)
+                     if isinstance(n, ast.Attribute)}
+            uses_chaos = (names | attrs) & self.chaos_names
+            add_node_calls = sum(
+                1 for c in ast.walk(node)
+                if isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr == "add_node")
+            if uses_chaos:
+                findings.append(self.emit(
+                    module, node, node.name,
+                    f"uses chaos harness {sorted(uses_chaos)} without "
+                    f"@pytest.mark.slow", "chaos"))
+            elif add_node_calls >= self.add_node_min:
+                findings.append(self.emit(
+                    module, node, node.name,
+                    f"{add_node_calls} add_node calls (multi-node) without "
+                    f"@pytest.mark.slow", "multi-node"))
+        return [f for f in findings if f is not None]

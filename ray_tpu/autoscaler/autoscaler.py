@@ -82,6 +82,9 @@ class Autoscaler:
         # keep showing its dead instances (best-effort — the dashboard
         # also filters rows with stale updated_at)
         try:
+            # the dashboard filters stale updated_at rows, so a lost
+            # retraction only leaves a row the UI already hides
+            # graftlint: fire-and-forget
             self._cp.notify(
                 "kv_del", {"key": f"autoscaler:instances:{self.scaler_id}"})
         except Exception:  # noqa: BLE001 — CP may already be gone
@@ -253,6 +256,9 @@ class Autoscaler:
                               self.instance_manager.instances()][-100:],
                 "updated_at": time.time(),
             }
+            # periodic full-state publish; the next reconcile pass
+            # overwrites any lost update
+            # graftlint: fire-and-forget
             self._cp.notify("kv_put", {
                 "key": f"autoscaler:instances:{self.scaler_id}",
                 "value": _json.dumps(payload, default=str).encode()})

@@ -60,7 +60,7 @@ def is_initialized() -> bool:
     return _runtime is not None
 
 
-def init(address: str | None = None, *, num_cpus: float | None = None,
+def init(address: str | None = None, *, num_cpus: float | None = None,  # graftlint: disable=lock-discipline — the init RLock exists to serialize whole init/shutdown lifecycles, blocking RPCs included
          resources: dict | None = None, labels: dict | None = None,
          object_store_memory: int | None = None,
          _system_config: dict | None = None, log_to_driver: bool = True,
@@ -151,7 +151,7 @@ def register_shutdown_hook(fn) -> None:
         _shutdown_hooks.append(fn)
 
 
-def shutdown():
+def shutdown():  # graftlint: disable=lock-discipline — same lifecycle lock as init(); see above
     """(ref: worker.py:2067)"""
     global _runtime, _head
     for hook in list(_shutdown_hooks):

@@ -374,6 +374,7 @@ class ControlPlane:
             # `state` field covers a lost notify) and the subscribers (the
             # serve controller pre-starts replacement replicas on this)
             try:
+                # graftlint: fire-and-forget (heartbeat reply carries state)
                 self._pool.get(addr).notify("drain", {"reason": reason})
             except Exception:  # noqa: BLE001 - heartbeat will deliver it
                 pass
@@ -693,6 +694,10 @@ class ControlPlane:
         msg = {"__seq": seq, "payload": msg}
         for addr in targets:
             try:
+                # push fan-out is at-most-once by design: the long-poll
+                # side channel (_h_pubsub_poll + seq dedup) upgrades the
+                # stream to at-least-once, and strike GC drops dead subs
+                # graftlint: fire-and-forget
                 self._pool.get(addr).notify("pubsub", {"channel": channel, "msg": msg})
                 # lock-free pre-check keeps the hot success path uncontended:
                 # the key only exists after a prior delivery failure
@@ -1227,6 +1232,10 @@ class ControlPlane:
                 info.max_restarts = info.num_restarts  # exhaust budget
         if addr is not None:
             try:
+                # best-effort fast kill: the worker may exit before it could
+                # ack, and _on_actor_down below settles the actor's fate
+                # either way — an acked call() would only add a stall
+                # graftlint: fire-and-forget
                 self._pool.get(addr).notify("kill_actor", {"actor_id": actor_id})
             except Exception:
                 pass

@@ -247,6 +247,9 @@ class NodeAgent:
                     "available": dict(self.available),
                     "version": self._res_version}
         try:
+            # versioned heartbeat: a lost report self-heals on the next
+            # periodic report (the CP keeps the highest version it saw)
+            # graftlint: fire-and-forget
             self._pool.get(self.cp_addr).notify("report_resources", body)
         except Exception:
             pass
@@ -309,11 +312,16 @@ class NodeAgent:
                 continue
             moved += 1
             if owner is not None and target_node is not None:
+                # Acknowledged call: the owner's location table MUST learn
+                # the copy moved — this node deregisters right after the
+                # drain, and an owner still pointing here would direct
+                # readers at a dead node. A lost one-way notify does
+                # exactly that, silently.
                 try:
-                    self._pool.get(tuple(owner)).notify(
+                    self._pool.get(tuple(owner)).call(
                         "object_moved",
                         {"object_id": oid, "node_id": target_node,
-                         "from_node_id": self.node_id})
+                         "from_node_id": self.node_id}, timeout=5.0)
                 except Exception:  # noqa: BLE001 - owner may be gone
                     pass
         return {"ok": True, "moved": moved, "failed": failed}
@@ -415,7 +423,7 @@ class NodeAgent:
         exactly the workers you most want flagged."""
         from concurrent.futures import ThreadPoolExecutor
 
-        from ray_tpu.util.profiling import dump_thread_stacks
+        from ray_tpu.observability.profiling import dump_thread_stacks
         out = {"agent": dump_thread_stacks()}
         with self._lock:
             targets = [(w.hex()[:12], i.addr) for w, i in
@@ -680,6 +688,10 @@ class NodeAgent:
                     lines = data.decode("utf-8", "replace").splitlines()
                     for lo in range(0, len(lines), 200):
                         try:
+                            # lossy log streaming by design — dropping a
+                            # chunk under CP outage beats stalling the
+                            # log monitor loop
+                            # graftlint: fire-and-forget
                             self._pool.get(self.cp_addr).notify("publish", {
                                 "channel": f"worker_logs:{info.job_id}",
                                 "msg": {"node_id": self.node_id.hex()[:8],
@@ -1143,6 +1155,10 @@ class NodeAgent:
         owner = self._object_owners.pop(object_id, None)
         if owner is not None:
             try:
+                # advisory: an owner that misses this learns the location
+                # is gone on its next failed pull and re-discovers/respawns
+                # via lineage — eviction is not a drain (no deregistration)
+                # graftlint: fire-and-forget
                 self._pool.get(owner).notify(
                     "object_lost", {"object_id": object_id, "node_id": self.node_id})
             except Exception:
@@ -1259,17 +1275,21 @@ class NodeAgent:
             except Exception:  # noqa: BLE001 - already gone
                 pass
         self._report_resources()
-        # ALWAYS notify the CP (not just for actors): a dead worker's metric
+        # ALWAYS tell the CP (not just for actors): a dead worker's metric
         # series must be retracted from the time-series store / exposition
-        # even when it held no actor (ISSUE 4 metrics GC)
+        # even when it held no actor (ISSUE 4 metrics GC). Acknowledged
+        # call, not one-way notify: metric retraction, kv-tier index
+        # retraction, and actor-death fanout all hang off this message —
+        # a notify dropped into a half-closed socket loses them silently.
         try:
-            self._pool.get(self.cp_addr).notify(
+            self._pool.get(self.cp_addr).call(
                 "worker_died",
                 {"worker_id": info.worker_id, "actor_id": info.actor_id,
                  "node_id": self.node_id,
-                 "reason": f"worker process exited with code {code}"})
-        except Exception:
-            pass
+                 "reason": f"worker process exited with code {code}"},
+                timeout=5.0)
+        except Exception:  # noqa: BLE001 — CP down; its own worker-death
+            pass           # sweep (heartbeat miss) retracts eventually
         self._report_resources()
 
     # ---- lifecycle -------------------------------------------------------
@@ -1284,6 +1304,9 @@ class NodeAgent:
         for info in workers:
             if info.addr is not None:
                 try:
+                    # polite-exit hint only: the wait/kill loop below
+                    # reaps every worker past the deadline regardless
+                    # graftlint: fire-and-forget
                     self._pool.get(info.addr).notify(
                         "exit_worker", {"worker_id": info.worker_id})
                 except Exception:

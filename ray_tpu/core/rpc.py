@@ -112,6 +112,9 @@ def _set_chaos(spec: str):
 def _send_frame(sock: socket.socket, kind: int, payload: bytes, lock: threading.Lock):
     header = struct.pack("<IB", len(payload) + 1, kind)
     with lock:
+        # the write lock's purpose IS to serialize socket writes — frames
+        # from concurrent senders must not interleave on the wire
+        # graftlint: disable=lock-discipline
         sock.sendall(header + payload)
 
 
@@ -429,7 +432,7 @@ class RpcClient:
         self._closed = False
         self._had_conn = False  # a later successful connect is a reconnect
 
-    def _ensure_conn(self, connect_timeout: float | None = None) -> socket.socket:
+    def _ensure_conn(self, connect_timeout: float | None = None) -> socket.socket:  # graftlint: disable=lock-discipline — the client lock deliberately serializes reconnect attempts (backoff sleep included) so one socket is dialed at a time
         """Returns the live socket (never read self._sock without the lock —
         the reader thread nulls it on connection loss)."""
         with self._lock:

@@ -500,6 +500,9 @@ class NormalTaskSubmitter:
 
     def _return_lease(self, lease: _Lease):
         try:
+            # per-task hot path: the agent reconciles leaked leases via
+            # worker-death cleanup and the drain deadline bounds any stall
+            # graftlint: fire-and-forget
             self._rt.peer_pool.get(lease.agent_addr).notify(
                 "return_lease", {"lease_id": lease.lease_id})
         except Exception:

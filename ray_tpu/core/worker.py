@@ -125,6 +125,9 @@ class _BatchFrame:
             if self.rt._early_send_suspended(addr):
                 continue
             try:
+                # latency hint only — the aggregate reply below still
+                # carries every result if this never lands
+                # graftlint: fire-and-forget
                 self.rt.peer_pool.get(addr).notify(
                     "task_reply_early",
                     {"task_id": spec.task_id, "reply": res},
@@ -574,6 +577,9 @@ class WorkerRuntime:
             return
         self._blocked_notified.sent = True
         try:
+            # advisory CPU-release hint; a lost one costs one idle slot
+            # until the worker unblocks, never correctness
+            # graftlint: fire-and-forget
             self.peer_pool.get(self.agent_addr).notify(
                 "worker_blocked", {"worker_id": self.worker_id})
         except Exception:
@@ -651,6 +657,8 @@ class WorkerRuntime:
             # Arena extents are copy_on_read, python-backend segments stay
             # valid while mapped — so after deserialize the lease can drop.
             try:
+                # lease-release hint; store leases expire on their own TTL
+                # graftlint: fire-and-forget
                 agent.notify("store_read_done", {"object_id": oid})
             except Exception:  # noqa: BLE001
                 pass
@@ -1064,6 +1072,9 @@ class WorkerRuntime:
                 addr = self.agent_addr if node_id == self.node_id else self._node_addr(node_id)
                 if addr is not None:
                     try:
+                        # best-effort eager free; agent-side eviction
+                        # reclaims anything a lost delete leaves behind
+                        # graftlint: fire-and-forget
                         self.peer_pool.get(addr).notify("store_delete", {"object_id": oid})
                     except Exception:
                         pass
@@ -1083,6 +1094,9 @@ class WorkerRuntime:
         if not events:
             return
         try:
+            # observability sink — losing a batch degrades the task-events
+            # timeline, never execution
+            # graftlint: fire-and-forget
             self.cp_client.notify("report_task_events", {"events": events})
         except Exception:
             pass
@@ -1104,7 +1118,7 @@ class WorkerRuntime:
         py-spy/profile endpoints, dashboard/modules/reporter/
         profile_manager.py:191 — this is how a wedged worker gets
         diagnosed without attaching a debugger)."""
-        from ray_tpu.util.profiling import dump_thread_stacks
+        from ray_tpu.observability.profiling import dump_thread_stacks
         return {"worker_id": self.worker_id.hex(), "pid": os.getpid(),
                 "stacks": dump_thread_stacks()}
 
@@ -1284,6 +1298,9 @@ class WorkerRuntime:
                     self._pubsub_seen.pop(channel, None)
                 self._subscribed_actors.discard(actor_id)
                 try:
+                    # CP strike-GC reaps subscriptions whose pushes keep
+                    # failing, so a lost unsubscribe self-heals
+                    # graftlint: fire-and-forget
                     self.cp_client.notify("unsubscribe",
                                           {"channel": channel,
                                            "addr": self.addr})
@@ -2161,6 +2178,8 @@ class WorkerRuntime:
         self._shutdown.set()
         if self.mode == "driver":
             try:  # the CP must not keep publishing logs to a dead driver
+                # (strike-GC drops the sub anyway once pushes start failing)
+                # graftlint: fire-and-forget
                 self.cp_client.notify(
                     "unsubscribe",
                     {"channel": f"worker_logs:{self.job_id.hex()}",

@@ -1,4 +1,5 @@
 """Observability subsystems: distributed tracing (tracing.py) and
 performance introspection — engine phase timers, compile-event tracking,
-device-memory accounting, on-demand XProf capture (profiling.py). Local
-context-manager profiling helpers remain in ray_tpu.util.profiling."""
+device-memory accounting, on-demand XProf capture, and the local
+context-manager profiling helpers (profiling.py — ray_tpu.util.profiling
+re-exports them for compatibility)."""

@@ -27,8 +27,9 @@ runtime to find the next one):
 Plus the **capture controller**: a process-wide start/stop pair around
 `jax.profiler` XPlane tracing, callable from an RPC handler, so
 `ray-tpu profile --node <id>` captures a trace on any live worker and the
-dashboard serves the artifact. Local context-manager helpers stay in
-`ray_tpu.util.profiling`; this module is the remote-drivable subsystem.
+dashboard serves the artifact. The local context-manager helpers
+(`profile_trace` / `annotate` / `profile_step` / `dump_thread_stacks`)
+live here too — `ray_tpu.util.profiling` is a compatibility re-export.
 """
 
 from __future__ import annotations
@@ -345,7 +346,8 @@ def capture_status() -> dict:
 
 
 def save_device_memory_profile(path: Optional[str] = None) -> str:
-    """pprof device-memory dump, RPC-friendly default path."""
+    """pprof device-memory dump — the 'why is my model OOMing' tool.
+    RPC-friendly default path when none is given."""
     import jax
 
     if not path:
@@ -355,3 +357,65 @@ def save_device_memory_profile(path: Optional[str] = None) -> str:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     jax.profiler.save_device_memory_profile(path)
     return path
+
+
+# ---------------------------------------------------------------------------
+# local context-manager helpers (driver/train-fn ergonomics; formerly
+# ray_tpu.util.profiling, which now re-exports from here)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str, *, host_tracer_level: int = 2):
+    """Capture an XPlane trace of everything inside the block.
+
+    Usage (inside a train fn)::
+
+        with profile_trace("/tmp/prof"):
+            for _ in range(10):
+                state, metrics = step(state, batch)
+        # then: tensorboard --logdir /tmp/prof  (Profile tab)
+    """
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir, create_perfetto_link=False)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a profile_trace (shows as a span in XProf).
+    Usage: `with annotate("data-load"): ...`"""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def profile_step(fn, *args, logdir: str = "/tmp/ray_tpu_prof", **kwargs):
+    """One-shot: trace a single call of `fn` and return its result."""
+    with profile_trace(logdir):
+        out = fn(*args, **kwargs)
+        import jax
+
+        jax.block_until_ready(out)
+    return out
+
+
+def dump_thread_stacks() -> str:
+    """Every thread's Python stack as text (named), for on-demand hang
+    diagnosis (ref: dashboard/modules/reporter/profile_manager.py:191 —
+    the reference shells out to py-spy; a pure-Python snapshot needs no
+    debugger attach and works from an RPC handler)."""
+    import sys
+    import threading as _threading
+    import traceback
+
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid})\n"
+                   + "".join(traceback.format_stack(frame)))
+    return "\n".join(out)

@@ -443,6 +443,16 @@ def cmd_metrics(args) -> None:
         show()
 
 
+def cmd_lint(args) -> None:
+    from ray_tpu.analysis.cli import lint
+
+    rc = lint(paths=args.paths or None, json_out=args.json,
+              write_baseline=args.baseline,
+              baseline_file=args.baseline_file,
+              include_tests=args.tests)
+    raise SystemExit(rc)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -568,6 +578,24 @@ def main(argv=None) -> None:
     sp.add_argument("--json", action="store_true",
                     help="print the raw index document instead of rows")
     sp.set_defaults(fn=cmd_kvtier)
+
+    sp = sub.add_parser(
+        "lint",
+        help="run graftlint (AST concurrency/JAX-hygiene passes) against "
+             "the committed findings baseline")
+    sp.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the ray_tpu "
+                         "package)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable findings document on stdout")
+    sp.add_argument("--baseline", action="store_true",
+                    help="regenerate GRAFTLINT_BASELINE.json from this "
+                         "run (keeps surviving justifications)")
+    sp.add_argument("--baseline-file", default=None,
+                    help="alternate baseline path (default: repo root)")
+    sp.add_argument("--tests", action="store_true",
+                    help="also run tests-scoped passes (tier1-marks)")
+    sp.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
     if args.cmd == "submit" and args.entrypoint \

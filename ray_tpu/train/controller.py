@@ -254,6 +254,9 @@ class TrainController:
                 "path": self._storage.run_path,
                 "updated_at": time.time(),
             }
+            # periodic run-state publish for the dashboard; the next
+            # step's publish supersedes a lost one
+            # graftlint: fire-and-forget
             rt.cp_client.notify("kv_put", {
                 "key": f"train_run:{self._run_name}",
                 "value": _json.dumps(payload, default=str).encode()})

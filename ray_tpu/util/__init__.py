@@ -1,8 +1,9 @@
 """ray_tpu.util — utility APIs (reference: python/ray/util/)."""
 
+from ray_tpu.observability.profiling import (annotate, profile_step,
+                                             profile_trace,
+                                             save_device_memory_profile)
 from ray_tpu.util.actor_pool import ActorPool
-from ray_tpu.util.profiling import (annotate, profile_step, profile_trace,
-                                    save_device_memory_profile)
 from ray_tpu.util.queue import Empty, Full, Queue
 
 __all__ = ["ActorPool", "Empty", "Full", "Queue", "annotate",

@@ -411,6 +411,7 @@ class MetricsFlusher:
         self._stop = threading.Event()
         self._flush_lock = threading.Lock()
         self._backlog: list[dict] = []  # unsent payloads, oldest first
+        self._sending = False  # a flush() is mid-drain outside the lock
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "MetricsFlusher":
@@ -426,13 +427,21 @@ class MetricsFlusher:
             self.flush()
 
     def flush(self) -> None:
+        # Snapshot + backlog bookkeeping happen under the lock; the sends
+        # do NOT. `_send` is an RPC into the CP — on a dead/half-closed
+        # socket it can stall for the full connect timeout, and holding
+        # `_flush_lock` across that stall would block every other flush()
+        # caller (notably stop()'s final flush) behind a hung network op.
         with self._flush_lock:
             mets = snapshot_deltas()
             if mets:
                 self._backlog.append(
                     {"source": self.source, "node_id": self.node_id,
                      "ts": time.time(), "metrics": mets})
-            if not self._backlog:
+            if not self._backlog or self._sending:
+                # nothing to do, or another flush() is mid-drain — our
+                # snapshot is queued and that drain (or the next interval)
+                # will deliver it in order
                 return
             # bound the outage buffer: drop the OLDEST payloads first (the
             # freshest snapshot is the one a recovering CP needs most)
@@ -442,15 +451,25 @@ class MetricsFlusher:
             except Exception:  # noqa: BLE001 — config mid-teardown
                 cap = 32
             del self._backlog[:-cap]
-            # oldest first so the CP's cumulative accumulators and
-            # retention windows see points in timestamp order; stop at the
-            # first failure — later payloads would arrive out of order
-            while self._backlog:
+            pending, self._backlog = self._backlog, []
+            self._sending = True
+        # oldest first so the CP's cumulative accumulators and retention
+        # windows see points in timestamp order; stop at the first failure
+        # — later payloads would arrive out of order
+        sent = 0
+        try:
+            for payload in pending:
                 try:
-                    self._send(self._backlog[0])
+                    self._send(payload)
                 except Exception:  # noqa: BLE001 — retry next interval
                     break
-                self._backlog.pop(0)
+                sent += 1
+        finally:
+            with self._flush_lock:
+                # unsent payloads predate anything queued while we were
+                # draining — splice them back at the front
+                self._backlog[:0] = pending[sent:]
+                self._sending = False
 
     @property
     def alive(self) -> bool:

@@ -1,79 +1,11 @@
-"""Profiling helpers: XLA/XPlane traces + task timelines.
+"""Compatibility re-export — the profiling helpers live in
+``ray_tpu.observability.profiling`` (one home for local context-manager
+helpers AND the remote-drivable capture subsystem; this module used to
+carry a diverging copy of ``save_device_memory_profile``)."""
 
-TPU-native analog of the reference's profiling surface (SURVEY.md §5.1:
-chrome-trace timeline in _private/state.py:438, py-spy/torch-profiler hooks).
-On TPU the profiler of record is the XLA/XPlane one — `jax.profiler` —
-whose dumps open in TensorBoard/XProf and show MXU utilization, HBM traffic
-and ICI collectives per op. The task-level chrome trace lives in
-ray_tpu.util.state.timeline().
-"""
+from ray_tpu.observability.profiling import (annotate, dump_thread_stacks,
+                                             profile_step, profile_trace,
+                                             save_device_memory_profile)
 
-from __future__ import annotations
-
-import contextlib
-import os
-
-
-@contextlib.contextmanager
-def profile_trace(logdir: str, *, host_tracer_level: int = 2):
-    """Capture an XPlane trace of everything inside the block.
-
-    Usage (inside a train fn)::
-
-        with profile_trace("/tmp/prof"):
-            for _ in range(10):
-                state, metrics = step(state, batch)
-        # then: tensorboard --logdir /tmp/prof  (Profile tab)
-    """
-    import jax
-
-    os.makedirs(logdir, exist_ok=True)
-    jax.profiler.start_trace(logdir, create_perfetto_link=False)
-    try:
-        yield logdir
-    finally:
-        jax.profiler.stop_trace()
-
-
-def annotate(name: str):
-    """Named region inside a profile_trace (shows as a span in XProf).
-    Usage: `with annotate("data-load"): ...`"""
-    import jax
-
-    return jax.profiler.TraceAnnotation(name)
-
-
-def save_device_memory_profile(path: str) -> str:
-    """Dump the current device (HBM) memory profile in pprof format —
-    the 'why is my model OOMing' tool."""
-    import jax
-
-    jax.profiler.save_device_memory_profile(path)
-    return path
-
-
-def profile_step(fn, *args, logdir: str = "/tmp/ray_tpu_prof", **kwargs):
-    """One-shot: trace a single call of `fn` and return its result."""
-    with profile_trace(logdir):
-        out = fn(*args, **kwargs)
-        import jax
-
-        jax.block_until_ready(out)
-    return out
-
-
-def dump_thread_stacks() -> str:
-    """Every thread's Python stack as text (named), for on-demand hang
-    diagnosis (ref: dashboard/modules/reporter/profile_manager.py:191 —
-    the reference shells out to py-spy; a pure-Python snapshot needs no
-    debugger attach and works from an RPC handler)."""
-    import sys
-    import threading
-    import traceback
-
-    names = {t.ident: t.name for t in threading.enumerate()}
-    out = []
-    for tid, frame in sys._current_frames().items():
-        out.append(f"--- thread {names.get(tid, '?')} ({tid})\n"
-                   + "".join(traceback.format_stack(frame)))
-    return "\n".join(out)
+__all__ = ["annotate", "dump_thread_stacks", "profile_step",
+           "profile_trace", "save_device_memory_profile"]

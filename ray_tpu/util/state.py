@@ -236,7 +236,7 @@ def dump_cluster_stacks() -> dict[str, str]:
     profile_manager.py:191). The tool that turns "the job is stuck" into a
     diagnosis in one call."""
     from ray_tpu.core import api
-    from ray_tpu.util.profiling import dump_thread_stacks
+    from ray_tpu.observability.profiling import dump_thread_stacks
 
     rt = api._get_runtime()
     out = {"driver": dump_thread_stacks()}

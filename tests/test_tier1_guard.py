@@ -2,74 +2,46 @@
 `@pytest.mark.slow` so the tier-1 gate (`pytest -m 'not slow'`) stays fast
 and deterministic.
 
-The guard is static (AST scan, no imports, no collection side effects): a
-test function that references a chaos harness class (WorkerKiller /
-NodeKiller / FaultSchedule) or builds a 3+-node in-process Cluster belongs
-in the slow tier. The allowlist freezes the seed-era exceptions — do NOT
-grow it for new tests; mark them slow instead.
+The guard now rides the graftlint pass framework (`tier1-marks` in
+ray_tpu/analysis/passes_tests.py) instead of a hand-rolled AST walk; the
+semantics are unchanged — static scan, no imports, no collection side
+effects. The allowlist freezes the seed-era exceptions — do NOT grow it
+for new tests; mark them slow instead.
 """
 
-import ast
 import pathlib
 
-CHAOS_NAMES = {"WorkerKiller", "NodeKiller", "FaultSchedule"}
-
-# Frozen exceptions. Each entry is a deliberate tier-1 resident:
-ALLOWLIST = {
-    # seed-era tier-1 chaos coverage, bounded (< ~30s each) and load-bearing
-    # for the lineage/retry acceptance of earlier PRs
-    "test_node_killer_lineage_reconstruction",
-    "test_chaos_worker_killer_workload_completes",
-    # pure unit tests of the chaos harnesses themselves (fake procs / no
-    # cluster, sub-second)
-    "test_faultschedule_validates_and_fires_rpc_faults",
-    "test_worker_killer_max_kills",
-}
+from ray_tpu.analysis.core import ModuleSource
+from ray_tpu.analysis.passes_tests import (ADD_NODE_MIN, CHAOS_NAMES,
+                                           FROZEN_ALLOWLIST, Tier1MarksPass)
 
 
-def _is_slow_marker(dec: ast.expr) -> bool:
-    """True for `@pytest.mark.slow` (bare or called)."""
-    if isinstance(dec, ast.Call):
-        dec = dec.func
-    return (isinstance(dec, ast.Attribute) and dec.attr == "slow"
-            and isinstance(dec.value, ast.Attribute)
-            and dec.value.attr == "mark")
+def test_allowlist_is_frozen():
+    # the allowlist is the seed-era set, verbatim. Growing it is the
+    # drift this guard exists to catch — new chaos/multi-node tests get
+    # @pytest.mark.slow instead.
+    assert FROZEN_ALLOWLIST == frozenset({
+        "test_node_killer_lineage_reconstruction",
+        "test_chaos_worker_killer_workload_completes",
+        "test_faultschedule_validates_and_fires_rpc_faults",
+        "test_worker_killer_max_kills",
+    })
+    assert CHAOS_NAMES == frozenset(
+        {"WorkerKiller", "NodeKiller", "FaultSchedule"})
+    assert ADD_NODE_MIN == 3
 
 
 def test_chaos_and_multinode_tests_are_slow_marked():
-    offenders = []
     here = pathlib.Path(__file__).parent
+    guard = Tier1MarksPass()
+    offenders = []
     for path in sorted(here.glob("test_*.py")):
         if path.name == pathlib.Path(__file__).name:
             continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if not node.name.startswith("test"):
-                continue
-            if node.name in ALLOWLIST:
-                continue
-            if any(_is_slow_marker(d) for d in node.decorator_list):
-                continue
-            names = {n.id for n in ast.walk(node)
-                     if isinstance(n, ast.Name)}
-            attrs = {n.attr for n in ast.walk(node)
-                     if isinstance(n, ast.Attribute)}
-            uses_chaos = (names | attrs) & CHAOS_NAMES
-            add_node_calls = sum(
-                1 for c in ast.walk(node)
-                if isinstance(c, ast.Call)
-                and isinstance(c.func, ast.Attribute)
-                and c.func.attr == "add_node")
-            if uses_chaos:
-                offenders.append(
-                    f"{path.name}::{node.name} (uses {sorted(uses_chaos)})")
-            elif add_node_calls >= 3:
-                offenders.append(
-                    f"{path.name}::{node.name} "
-                    f"({add_node_calls} add_node calls)")
+        module = ModuleSource(str(path), path.name, path.read_text())
+        for f in guard.run(module):
+            offenders.append(f.format())
     assert not offenders, (
         "chaos/multi-node tests must be @pytest.mark.slow so tier-1 stays "
-        "fast (or, exceptionally, added to the frozen ALLOWLIST in "
-        f"{pathlib.Path(__file__).name}):\n  " + "\n  ".join(offenders))
+        "fast (or, exceptionally, added to FROZEN_ALLOWLIST in "
+        "ray_tpu/analysis/passes_tests.py):\n  " + "\n  ".join(offenders))
