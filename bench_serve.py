@@ -279,6 +279,355 @@ def _run_chaos_suite(args):
     print(json.dumps({"chaos_suite": chaos_suite}))
 
 
+def _run_fleet(args):
+    """--fleet: sustained-load fleet harness for prefix-affinity routing
+    (ISSUE 10). A multi-tenant shared-prefix workload (every tenant's
+    requests carry that tenant's long system prefix + a unique suffix)
+    over >=4 cpu-tiny replicas, A/B'd affinity-on vs pow-2-only:
+
+      - fleet prefix-cache hit rate (summed engine counters over offered
+        prompt tokens) must clear --fleet-min-hit-rate with affinity on
+        and beat the pow-2 arm by a real margin (pow-2 sprays each tenant
+        across every replica, so each tenant's prefix is recomputed
+        per-replica instead of once);
+      - p50 TTFT must improve (hard) and is flagged outside/within noise;
+      - greedy completions must be token-identical across arms (HARD:
+        affinity is a placement hint, never a semantics knob);
+      - chaos: killing the preferred holder of a hot prefix mid-load must
+        keep >=99% success (retries + ejection absorb, replacement starts
+        cold and re-converges).
+
+    Merges into --out under extra.fleet."""
+    import dataclasses as _dc
+    import os
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import llama
+    from ray_tpu.serve import affinity
+    from ray_tpu.serve.config import RouterConfig
+    from ray_tpu.serve.controller import get_or_create_controller
+    from ray_tpu.serve.llm import LLMConfig, build_openai_app
+    from ray_tpu.serve.router import Router
+
+    n_replicas = max(4, args.fleet_replicas)
+    tenants = args.fleet_tenants
+    requests = args.fleet_requests
+    concurrency = args.fleet_concurrency
+
+    # byte tokenizer: 1 token per char. 480-char tenant prefix = 15 full
+    # 32-token pages shared per tenant; the unique suffix never fills a
+    # page, so steady-state hit rate ~ prefix/(prefix+suffix) ~ 0.95
+    prefixes = [
+        (f"[tenant {t:02d} system] You answer tersely and cite sources. "
+         * 12)[:480]
+        for t in range(tenants)]
+
+    def mk_prompt(t: int, i: int) -> str:
+        return prefixes[t % tenants] + f" Q{i:05d}: summarize item {i}."
+
+    llm_cfg = LLMConfig(
+        model_id="llama-tiny", model_config=llama.llama_tiny(vocab_size=2048),
+        num_replicas=n_replicas, max_batch_size=8, page_size=32,
+        num_pages=256, max_prompt_len=576, max_seq_len=640, max_tokens=8,
+        # the tier makes router prefetch hints live (meta kv_tier=true);
+        # a small retention cap keeps chains spilling so hints have work
+        kv_tier_enabled=True, prefix_cache_max_pages=64)
+
+    bench_cpus = max(8, (os.cpu_count() or 1))
+
+    def fleet_engines(ctl, app_name: str) -> list:
+        st = ray_tpu.get(ctl.detailed_status.remote(), timeout=60)
+        for full, d in st.items():
+            if d.get("app") == app_name and d.get("engine"):
+                return [e or {} for e in d["engine"]]
+        return []
+
+    def fleet_sum(engines: list, key: str) -> int:
+        return sum(e.get(key) or 0 for e in engines)
+
+    def fleet_arm(affinity_on: bool) -> dict:
+        tag = "on" if affinity_on else "off"
+        app_name = f"llm-fleet-{tag}"
+        router_cfg = (RouterConfig() if affinity_on else
+                      RouterConfig(affinity_enabled=False,
+                                   prefetch_hints_enabled=False))
+        ray_tpu.init(num_cpus=bench_cpus)
+        ctl = get_or_create_controller()
+        serve.run(build_openai_app(llm_cfg, route_prefix="/v1"),
+                  name=app_name, route_prefix="/v1")
+        proxy = serve.start_http_proxy(port=0, router_config=router_cfg)
+        base = f"http://127.0.0.1:{proxy.port}/v1/completions"
+
+        # warm: compile the long bucket before anything is measured
+        _post_stream(base, {"prompt": mk_prompt(0, 90000), "max_tokens": 4})
+
+        # greedy fingerprint on dedicated probe tenants, BEFORE traffic
+        # muddies cache history: the first call is a cold full prefill
+        # (identical weights => identical across arms), the immediate
+        # second call is a cache hit (affinity pins it to the holder).
+        # hit==cold through the full HTTP->router->digest-reuse stack is
+        # a HARD within-arm assert; the cold outputs are the cross-arm
+        # fingerprint. (Probing tenants from the traffic mix instead
+        # would compare KV with different chunk-split float histories
+        # across arms — placement-dependent ULP noise, not a bug.)
+        completions = []
+        for t in range(tenants):
+            pp = (f"[probe tenant {t:02d}] Answer briefly and cite. "
+                  * 16)[:480] + " Q: summarize the policy."
+            fps = []
+            for _ in range(2):
+                o = _post(base, {"prompt": pp, "max_tokens": 12,
+                                 "temperature": 0.0})
+                fps.append((o["choices"][0]["text"],
+                            o["usage"]["completion_tokens"]))
+            if fps[0] != fps[1]:
+                raise SystemExit(
+                    f"fleet [{tag}]: greedy output changed between cold "
+                    f"prefill and cache-hit serve for the same prompt: "
+                    f"{fps!r} — the digest-reuse/restore path is corrupting "
+                    f"KV, not benchmarking it")
+            completions.append(fps[0])
+
+        # seed: give every traffic tenant one request so each prefix is
+        # resident SOMEWHERE before the window
+        for t in range(tenants):
+            _post_stream(base, {"prompt": mk_prompt(t, 91000 + t),
+                                "max_tokens": 4})
+        # let the controller's summary tick + long-poll ship every seeded
+        # tenant prefix before the window opens (affinity arm), so the
+        # measurement sees steady-state placement rather than the
+        # convergence transient; the pow-2 arm just gets a fixed settle
+        if affinity_on:
+            probe_router = Router(ctl, app_name)
+            try:
+                want = set()
+                deadline = time.monotonic() + 30.0
+                while True:
+                    meta = probe_router.affinity_meta("llm")
+                    if meta and not want:
+                        for t in range(tenants):
+                            d = affinity.compute_prefix_digests(
+                                mk_prompt(t, 91000 + t), meta, 64)
+                            if d:
+                                want.add(d[0])
+                    with probe_router._lock:
+                        rs = probe_router._sets.get("llm")
+                        seen = (set().union(*rs._summaries.values())
+                                if rs and rs._summaries else set())
+                    if want and want <= seen:
+                        break
+                    if time.monotonic() > deadline:
+                        print(f"# fleet [{tag}]: summaries converged for "
+                              f"{len(want & seen)}/{len(want)} tenants "
+                              f"before the window", flush=True)
+                        break
+                    time.sleep(0.2)
+            finally:
+                probe_router.stop()
+        else:
+            time.sleep(3.0)
+
+        e0 = fleet_engines(ctl, app_name)
+        ttfts, prompt_toks, failures = [], [0], []
+        lock = threading.Lock()
+
+        def one(i: int):
+            try:
+                # short generations keep TTFT prefill-bound (the thing
+                # affinity actually moves) instead of decode-queue-bound
+                out = _post_stream(base, {"prompt": mk_prompt(i, i),
+                                          "max_tokens":
+                                          min(8, args.max_tokens)})
+                with lock:
+                    if out["client_ttft_s"] is not None:
+                        ttfts.append(out["client_ttft_s"])
+                    prompt_toks[0] += out["usage"].get("prompt_tokens", 0)
+            except Exception as e:  # noqa: BLE001 — failure is data here
+                with lock:
+                    failures.append(repr(e)[:200])
+
+        t0 = time.monotonic()
+        with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
+            list(pool.map(one, range(requests)))
+        wall = time.monotonic() - t0
+        e1 = fleet_engines(ctl, app_name)
+
+        hit_toks = (fleet_sum(e1, "prefix_hit_tokens")
+                    - fleet_sum(e0, "prefix_hit_tokens"))
+        hit_rate = hit_toks / prompt_toks[0] if prompt_toks[0] else 0.0
+        p50 = statistics.median(ttfts) * 1e3 if ttfts else float("nan")
+        p99 = (statistics.quantiles(ttfts, n=100)[-1] * 1e3
+               if len(ttfts) >= 20 else p50)
+
+        row = {
+            "label": f"fleet_affinity_{tag}",
+            "replicas": n_replicas, "tenants": tenants,
+            "requests": requests, "concurrency": concurrency,
+            "failures": len(failures),
+            "req_per_s": round(requests / wall, 3),
+            "p50_ttft_ms": round(p50, 2),
+            "p99_ttft_ms": round(p99, 2),
+            "fleet_hit_rate": round(hit_rate, 4),
+            "prefix_hit_tokens": hit_toks,
+            "prompt_tokens_total": prompt_toks[0],
+            # concentration fingerprint: affinity pins tenants, pow-2
+            # sprays them — visible as per-replica prefill spread
+            "per_replica_prefills": [
+                (b.get("prefills") or 0) - (a.get("prefills") or 0)
+                for a, b in zip(e0, e1)],
+            "tier_prefetch_hints": fleet_sum(e1, "tier_prefetch_hints"),
+            "completions": completions,
+        }
+        if failures:
+            print(json.dumps({"fleet_arm": row}))
+            raise SystemExit(f"fleet [{tag}]: {len(failures)} measured "
+                             f"requests failed: {failures[:5]}")
+
+        chaos = None
+        if affinity_on:
+            chaos = _fleet_chaos(ctl, app_name, base, mk_prompt, affinity,
+                                 Router, args)
+            row["chaos"] = chaos
+        serve.shutdown()
+        ray_tpu.shutdown()
+        return row
+
+    def _fleet_chaos(ctl, app_name, base, mk_prompt, affinity, Router,
+                     args):
+        """Kill the preferred holder of tenant 0's prefix under sustained
+        load; retries + ejection must hold >=99% success while the
+        replacement comes up cold."""
+        router = Router(ctl, app_name)
+        try:
+            deadline = time.monotonic() + 30.0
+            digs = None
+            while True:
+                meta = router.affinity_meta("llm")
+                if meta and digs is None:
+                    digs = affinity.compute_prefix_digests(
+                        mk_prompt(0, 42), meta, 64)
+                with router._lock:
+                    rs = router._sets.get("llm")
+                    ready = bool(
+                        rs and digs
+                        and any(digs[0] in s for s in rs._summaries.values()))
+                if ready:
+                    break
+                if time.monotonic() > deadline:
+                    raise SystemExit(
+                        "fleet chaos: affinity summaries never converged — "
+                        "nothing to kill, refusing to report an SLO")
+                time.sleep(0.2)
+            victim, matched = rs.choose_info("", digs)
+            if matched < 1:
+                raise SystemExit("fleet chaos: router matched no holder "
+                                 "for a seeded prefix")
+        finally:
+            router.stop()
+
+        results = []
+        lock = threading.Lock()
+
+        def one(i: int):
+            try:
+                out = _post_stream(
+                    base, {"prompt": mk_prompt(i, 80000 + i),
+                           "max_tokens": 4}, timeout=60.0)
+                ok = out["client_ttft_s"] is not None
+                detail = "ok"
+            except Exception as e:  # noqa: BLE001 — failure is data here
+                ok, detail = False, repr(e)[:200]
+            with lock:
+                results.append((ok, detail))
+
+        n = args.fleet_chaos_requests
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futs = [pool.submit(one, i) for i in range(n // 4)]
+            import ray_tpu as _rt
+            _rt.kill(victim)          # the preferred holder dies mid-load
+            futs += [pool.submit(one, i) for i in range(n // 4, n)]
+            for f in futs:
+                f.result(timeout=120)
+        succ = sum(1 for ok, _ in results if ok)
+        rate = succ / len(results)
+        chaos = {
+            "requests": len(results), "succeeded": succ,
+            "success_rate": round(rate, 4), "min_success_rate": 0.99,
+            "killed_matched_pages": matched,
+        }
+        if rate < 0.99:
+            fails = [d for ok, d in results if not ok]
+            print(json.dumps({"fleet_chaos": chaos}))
+            raise SystemExit(
+                f"fleet chaos: success rate {rate:.4f} after killing the "
+                f"preferred holder (SLO 0.99); failures: {fails[:5]}")
+        return chaos
+
+    off_row = fleet_arm(False)
+    on_row = fleet_arm(True)
+
+    comp_off = off_row.pop("completions")
+    comp_on = on_row.pop("completions")
+    identical = comp_off == comp_on
+    improved_ms = round(off_row["p50_ttft_ms"] - on_row["p50_ttft_ms"], 2)
+    tol_ms = round(max(0.15 * off_row["p50_ttft_ms"], 3.0), 2)
+    fleet = {
+        "label": "fleet_affinity_ab",
+        "model": llm_cfg.model_id, "env": "cpu-tiny",
+        "replicas": n_replicas, "tenants": tenants,
+        "greedy_identical": identical,
+        "affinity_on": on_row, "affinity_off": off_row,
+        "fleet_hit_rate_on": on_row["fleet_hit_rate"],
+        "fleet_hit_rate_off": off_row["fleet_hit_rate"],
+        "min_hit_rate": args.fleet_min_hit_rate,
+        "p50_ttft_improvement_ms": improved_ms,
+        "noise_tolerance_ms": tol_ms,
+        "improved_outside_noise": improved_ms > tol_ms,
+        "chaos": on_row.pop("chaos", None),
+    }
+    print(json.dumps({"fleet": fleet}))
+    if not identical:
+        diffs = [(i, a, b) for i, (a, b) in
+                 enumerate(zip(comp_off, comp_on)) if a != b]
+        raise SystemExit(
+            f"fleet A/B: affinity routing changed greedy output — "
+            f"placement must never alter tokens, not benchmarking it; "
+            f"diverging probes (tenant, pow2, affinity): {diffs[:4]!r}")
+    if fleet["fleet_hit_rate_on"] < args.fleet_min_hit_rate:
+        raise SystemExit(
+            f"fleet A/B: affinity-on fleet hit rate "
+            f"{fleet['fleet_hit_rate_on']} below the "
+            f"{args.fleet_min_hit_rate} SLO")
+    if (fleet["fleet_hit_rate_on"] - fleet["fleet_hit_rate_off"]) < 0.05:
+        raise SystemExit(
+            f"fleet A/B: affinity-on hit rate "
+            f"{fleet['fleet_hit_rate_on']} is not materially above pow-2 "
+            f"({fleet['fleet_hit_rate_off']}) — cache-aware placement is "
+            f"inert")
+    if improved_ms <= tol_ms:
+        raise SystemExit(
+            f"fleet A/B: affinity p50 TTFT gain {improved_ms}ms is not "
+            f"outside noise ({tol_ms}ms tolerance; "
+            f"{on_row['p50_ttft_ms']}ms on vs {off_row['p50_ttft_ms']}ms "
+            f"pow-2)")
+
+    # merge into --out WITHOUT clobbering earlier headline rows
+    merged = {"metric": "serve_fleet_affinity", "value":
+              fleet["fleet_hit_rate_on"], "unit": "hit_rate",
+              "extra": {"fleet": fleet}}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                merged = json.load(f)
+            merged.setdefault("extra", {})["fleet"] = fleet
+        except ValueError:
+            pass
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=64)
@@ -325,6 +674,22 @@ def main():
                          "skips the LLM bench")
     ap.add_argument("--chaos-seed", type=int, default=7,
                     help="seed for the chaos suite's FaultSchedules")
+    ap.add_argument("--fleet", action="store_true",
+                    help="sustained-load fleet harness: multi-tenant "
+                         "shared-prefix traffic over >=4 replicas, "
+                         "affinity-on vs pow-2-only A/B with hard "
+                         "fleet-hit-rate / p50-TTFT / greedy-identity / "
+                         "chaos-SLO asserts; merges into --out under "
+                         "extra.fleet and skips the LLM headline bench")
+    ap.add_argument("--fleet-replicas", type=int, default=4)
+    ap.add_argument("--fleet-tenants", type=int, default=8)
+    ap.add_argument("--fleet-requests", type=int, default=128,
+                    help="measured requests per fleet arm")
+    ap.add_argument("--fleet-concurrency", type=int, default=16)
+    ap.add_argument("--fleet-chaos-requests", type=int, default=128)
+    ap.add_argument("--fleet-min-hit-rate", type=float, default=0.90,
+                    help="fleet prefix-cache hit-rate SLO for the "
+                         "affinity-on arm")
     ap.add_argument("--out", default="SERVE_BENCH.json",
                     help="JSON file the shared-prefix result merges into")
     ap.add_argument("--no-preflight", action="store_true",
@@ -336,6 +701,26 @@ def main():
         # the chaos suite is a robustness harness, not a perf number: it
         # runs a plain (non-LLM) app, so the LLM preflight doesn't apply
         _run_chaos_suite(args)
+        return
+
+    if args.fleet:
+        if not args.no_preflight:
+            import os
+            import subprocess
+            import sys
+            repo = os.path.dirname(os.path.abspath(__file__))
+            # affinity unit/integration coverage first: a fleet hit-rate
+            # number from a broken scorer is a lie with a decimal point
+            rc = subprocess.run(
+                [sys.executable, "-m", "pytest", "-q",
+                 "tests/test_affinity_routing.py"],
+                cwd=repo,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
+            if rc != 0:
+                sys.exit(f"preflight failed: pytest -q "
+                         f"tests/test_affinity_routing.py exited {rc} "
+                         f"(--no-preflight to override)")
+        _run_fleet(args)
         return
 
     # Preflight: a perf number from a broken engine is worse than no

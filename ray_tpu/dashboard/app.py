@@ -186,7 +186,31 @@ def _serve_apps() -> list[dict]:
                              timeout=15.0)
     except Exception:  # noqa: BLE001
         return []
-    return [{"deployment": name, **info} for name, info in status.items()]
+    rows = [{"deployment": name, **info} for name, info in status.items()]
+    # cache-aware routing counters (ISSUE 10) ride along per deployment:
+    # summed across every router that reported to the metrics store
+    try:
+        from ray_tpu.util import state as _state
+        for row in rows:
+            dep = row["deployment"].split("#")[-1]
+            aff = {}
+            for short, metric in (
+                    ("hits", "ray_tpu_serve_router_affinity_hits_total"),
+                    ("spillovers",
+                     "ray_tpu_serve_router_affinity_spillovers_total"),
+                    ("stale_fallbacks",
+                     "ray_tpu_serve_router_affinity_stale_fallbacks_total")):
+                res = _state.query_metrics(metric, tags={"deployment": dep})
+                series = (res or {}).get("series") or []
+                if series:
+                    aff[short] = sum(s["points"][-1][1] for s in series
+                                     if s.get("points"))
+            if aff.get("hits") or aff.get("spillovers") or \
+                    aff.get("stale_fallbacks"):
+                row["affinity"] = aff
+    except Exception:  # noqa: BLE001 — counters are best-effort decoration
+        pass
+    return rows
 
 
 def _collapse_stacks(proc: str, text: str) -> list[str]:

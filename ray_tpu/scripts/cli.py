@@ -228,6 +228,26 @@ def cmd_status(args) -> None:
     print(f"actors: {by_state or 0}")
     pgs = state.list_placement_groups()
     print(f"placement groups: {len(pgs)}")
+
+    # serve prefix-affinity routing (ISSUE 10): router counters from the
+    # CP time-series store; silent until a router has reported
+    def _counter_total(name: str):
+        try:
+            res = state.query_metrics(name)
+            if not res or not res.get("series"):
+                return None
+            return sum(s["points"][-1][1] for s in res["series"])
+        except Exception:  # noqa: BLE001 — metrics are best-effort
+            return None
+
+    hits = _counter_total("ray_tpu_serve_router_affinity_hits_total")
+    if hits is not None:
+        spill = _counter_total(
+            "ray_tpu_serve_router_affinity_spillovers_total") or 0
+        stale = _counter_total(
+            "ray_tpu_serve_router_affinity_stale_fallbacks_total") or 0
+        print(f"serve affinity: hits={hits:.0f} spillovers={spill:.0f} "
+              f"stale_fallbacks={stale:.0f}")
     ray_tpu.shutdown()
 
 

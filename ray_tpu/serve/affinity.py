@@ -1,0 +1,129 @@
+"""Prefix-affinity ingress helpers (ISSUE 10).
+
+The HTTP proxy computes the prompt's leading page-chain digests ONCE per
+request — the same blake2b-128 hash chain the engine's prefix index uses
+(serve/llm/kv_cache.py `_chain_digest`) over the same tokenization — and
+hands them to the router (`choose()` scores replicas by longest resident
+match) AND to the chosen replica (which reuses them for its tier restore
+instead of re-hashing, after a page-0 verification).
+
+This module must stay importable in the proxy process: hashlib + numpy
+only, no jax. The digest chain is duplicated from kv_cache rather than
+imported because kv_cache pulls in jax at module scope; the byte-for-byte
+equivalence is pinned by tests/test_affinity_routing.py.
+
+The replica side carries the digests request-scoped through a contextvar
+(same pattern as serve/multiplex.py's multiplexed model id): the replica
+pops `_prefix_digests` from kwargs, sets the contextvar, and the engine
+submit path reads it back.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import hashlib
+import threading
+from typing import Optional
+
+import numpy as np
+
+# request-scoped ingress digests on the replica (serve/replica.py sets it
+# before dispatching into user code; copy_context() carries it into the
+# executor thread)
+_current_digests: contextvars.ContextVar[Optional[tuple]] = \
+    contextvars.ContextVar("ray_tpu_prefix_digests", default=None)
+
+# proxy-side tokenizer cache: one tokenizer per spec string, shared by
+# every request (HF tokenizers are expensive to construct). Bounded by
+# the number of distinct tokenizer specs the app serves.
+_tok_cache: dict = {}
+_tok_lock = threading.Lock()
+
+
+def _set_request_prefix_digests(digests: Optional[list]) -> None:
+    _current_digests.set(tuple(digests) if digests else None)
+
+
+def get_request_prefix_digests() -> Optional[list]:
+    cur = _current_digests.get()
+    return list(cur) if cur else None
+
+
+def _chain_digest(parent: bytes, chunk) -> bytes:
+    # MUST mirror kv_cache._chain_digest exactly: equal digests are the
+    # contract that lets the router match against replica-resident chains
+    return hashlib.blake2b(
+        parent + np.asarray(chunk, np.int32).tobytes(),
+        digest_size=16).digest()
+
+
+def _get_tokenizer(spec: str):
+    with _tok_lock:
+        tok = _tok_cache.get(spec)
+    if tok is None:
+        from ray_tpu.serve.llm.tokenizer import get_tokenizer
+        tok = get_tokenizer(spec)
+        with _tok_lock:
+            tok = _tok_cache.setdefault(spec, tok)
+    return tok
+
+
+def prompt_from_payload(path: str, payload) -> Optional[str]:
+    """The prompt string the LLM deployment will tokenize for this HTTP
+    request, or None when the route doesn't submit to the engine."""
+    if not isinstance(payload, dict):
+        return None
+    path = "/" + str(path).strip("/")
+    if path.endswith("/chat/completions"):
+        from ray_tpu.serve.llm.llm_server import _chat_prompt
+        return _chat_prompt(payload.get("messages", []))
+    if path.endswith("/completions"):
+        prompt = payload.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        return prompt if isinstance(prompt, str) else None
+    return None
+
+
+def digests_for_http(subpath: str, payload, meta: dict,
+                     max_digests: int) -> Optional[list]:
+    """Proxy entry point: ingress digests for one HTTP request, or None
+    (non-LLM route, short prompt, or any failure — all mean pow-2)."""
+    prompt = prompt_from_payload(subpath, payload)
+    if prompt is None:
+        return None
+    return compute_prefix_digests(prompt, meta, max_digests)
+
+
+def compute_prefix_digests(prompt: str, meta: dict,
+                           max_digests: int) -> Optional[list]:
+    """Leading page-chain digests (hex) for ``prompt`` under the
+    deployment's affinity ``meta`` ({tokenizer, page_size,
+    max_prompt_len}). Mirrors the engine exactly: same tokenization, same
+    max_prompt_len truncation, and the same (len-1)//page_size full-page
+    limit as match_prefix (at least one suffix token always remains to
+    prefill). Returns None when the prompt has no full page — routing
+    then stays plain pow-2."""
+    try:
+        page_size = int(meta["page_size"])
+        tok = _get_tokenizer(str(meta["tokenizer"]))
+        toks = tok.encode(prompt)
+        max_len = int(meta.get("max_prompt_len") or 0)
+        if max_len > 0:
+            toks = toks[:max_len]
+        limit = (len(toks) - 1) // page_size
+        if max_digests > 0:
+            limit = min(limit, max_digests)
+        if limit <= 0:
+            return None
+        digest = b""
+        out = []
+        for i in range(limit):
+            digest = _chain_digest(
+                digest, toks[i * page_size:(i + 1) * page_size])
+            out.append(digest.hex())
+        return out
+    except Exception:  # noqa: BLE001 — affinity is an optimization; a
+        # digest failure must degrade to pow-2 routing, never 500 the
+        # request
+        return None

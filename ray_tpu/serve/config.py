@@ -52,6 +52,26 @@ class RouterConfig:
     health_probe_timeout_s: float = 1.0
     # how long `call`/`assign` wait for a deployment to have any replica
     no_replica_timeout_s: float = 30.0
+    # ---- prefix-affinity routing (ISSUE 10) ----------------------------
+    # Cache-aware replica selection: replicas export bounded summaries of
+    # their resident prefix chains (page-chain digests) via the controller
+    # long-poll; `choose()` routes to the best non-saturated holder of the
+    # request's leading digests and demotes to pow-2 when nothing useful
+    # is resident, the best holder is saturated, or summaries are stale
+    # (Mooncake's KVCache-centric scheduling).
+    affinity_enabled: bool = True
+    # minimum matched pages before affinity overrides pow-2
+    affinity_min_match_pages: int = 1
+    # spillover: a holder whose probed queue length is >= this takes no
+    # affinity traffic (the next-best holder, then pow-2, absorbs it)
+    affinity_spillover_qlen: int = 8
+    # summaries older than this are treated as unusable (degrade to pow-2)
+    affinity_summary_ttl_s: float = 10.0
+    # leading page-chain digests computed at ingress per request
+    affinity_max_digests: int = 64
+    # on an affinity miss, fire a fire-and-forget prefetch hint to the
+    # chosen replica so its KV-tier restore overlaps admission
+    prefetch_hints_enabled: bool = True
 
 
 @dataclasses.dataclass

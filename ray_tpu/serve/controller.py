@@ -52,6 +52,20 @@ class _DeploymentState:
         # consecutive failed health checks per replica (actor id hex) — a
         # replica is dropped only at health_check_failure_threshold
         self.health_fails: dict[str, int] = {}
+        # Prefix-affinity summaries (ISSUE 10): per-replica resident
+        # page-chain digests, collected piggyback on the reconcile tick and
+        # shipped to routers through the routing-table long-poll (the
+        # request path stays RPC-free). Keyed by replica actor-id hex —
+        # bounded by (replicas × prefix_summary_max_pages). A replaced
+        # replica's entry is pruned the tick it leaves `replicas`, so it
+        # starts cold in every router.
+        self.summary_gen = 0
+        self.summaries: dict[str, list] = {}
+        self.summary_versions: dict[str, int] = {}
+        self.summary_meta: dict = {}
+        # replicas that answered "prefix cache off / not an engine": never
+        # probed again (their entry is dropped if the actor is replaced)
+        self.summary_unsupported: set[str] = set()
         self._last_scale_ts = 0.0
         self._scale_pending_since: Optional[float] = None
         self._pending_target: Optional[int] = None
@@ -75,6 +89,10 @@ class ServeController:
         self._draining_nodes: list = []
         self._dead_nodes_lock = threading.Lock()
         self._node_sub_done = False
+        # affinity-summary collection cadence (ISSUE 10): piggybacks on
+        # the 0.2s reconcile tick but only probes replicas this often
+        self._summary_ts = 0.0
+        self._summary_interval_s = 1.0
 
     def _ensure_started(self):
         if self._loop_task is None:
@@ -195,30 +213,62 @@ class ServeController:
         state.draining = []
 
     # ---- introspection -------------------------------------------------
-    async def get_routing_table(self, app_name: str) -> dict:
+    def _summary_entry(self, state: _DeploymentState,
+                       known_gen: Optional[int]) -> Optional[dict]:
+        """The affinity-summary element of a routing-table entry. None when
+        the router already holds this generation (delta shipping: an
+        unchanged fleet costs zero summary bytes per poll) or when nothing
+        has ever been collected (non-LLM deployments)."""
+        if known_gen is not None and known_gen == state.summary_gen:
+            return None
+        if not state.summaries and not state.summary_meta:
+            return None
+        return {"gen": state.summary_gen,
+                "meta": dict(state.summary_meta),
+                "replicas": {k: list(v) for k, v in state.summaries.items()}}
+
+    async def get_routing_table(self, app_name: str,
+                                known_gens: Optional[dict] = None) -> dict:
         self._ensure_started()
+        known_gens = known_gens or {}
         out = {}
         for state in self._deployments.values():
             if state.app == app_name:
                 # draining replicas stay routable until replacements are
-                # ready — the table never shrinks below target mid-drain
+                # ready — the table never shrinks below target mid-drain.
+                # (Their affinity summaries are ALREADY gone: the collector
+                # prunes anything not in `replicas`, so draining replicas
+                # take load-balanced spillover only, never affinity pulls.)
                 out[state.name] = (list(state.replicas) + list(state.draining),
-                                   state.version)
+                                   state.version,
+                                   self._summary_entry(
+                                       state, known_gens.get(state.name)))
         return out
 
     async def poll_routing_table(self, app_name: str,
                                  known_versions: dict,
                                  timeout_s: float = 30.0) -> dict | None:
         """LONG-POLL (reference long_poll.py LongPollHost:228): returns the
-        app's routing table as soon as any deployment's version differs from
-        `known_versions` ({name: version}), or None at timeout. Routers hang
-        on this instead of re-polling on a timer."""
+        app's routing table as soon as any deployment's version OR affinity
+        summary generation differs from `known_versions`
+        ({name: version} or {name: [version, summary_gen]} — both accepted),
+        or None at timeout. Routers hang on this instead of re-polling on a
+        timer."""
         self._ensure_started()
         deadline = asyncio.get_event_loop().time() + timeout_s
-        known = dict(known_versions or {})
+        known: dict = {}
+        known_gens: dict = {}
+        for d, v in dict(known_versions or {}).items():
+            if isinstance(v, (list, tuple)) and v:
+                known[d] = v[0]
+                # legacy single-int callers never subscribe to summaries
+                known_gens[d] = v[1] if len(v) > 1 else None
+            else:
+                known[d] = v
         while True:
-            current = {s.name: s.version for s in self._deployments.values()
-                       if s.app == app_name}
+            states = [s for s in self._deployments.values()
+                      if s.app == app_name]
+            current = {s.name: s.version for s in states}
             # Changed = a deployment the router hasn't seen (or at an older
             # version), or a deployment the router saw a REAL version of that
             # is now gone. A router-side placeholder (version -1 for a
@@ -226,9 +276,12 @@ class ServeController:
             # long-poll degenerates into a hot spin.
             changed = any(known.get(d) != ver for d, ver in current.items()) \
                 or any(ver >= 0 and d not in current
-                       for d, ver in known.items())
+                       for d, ver in known.items()) \
+                or any(d in known_gens and known_gens[d] is not None
+                       and known_gens[d] != s.summary_gen for d, s in
+                       ((s.name, s) for s in states))
             if changed:
-                return await self.get_routing_table(app_name)
+                return await self.get_routing_table(app_name, known_gens)
             ev = self._change_event
             remaining = deadline - asyncio.get_event_loop().time()
             if remaining <= 0:
@@ -307,6 +360,9 @@ class ServeController:
                         "spilled_pages", "restored_pages",
                         "tier_hit_tokens", "tier_bytes_shm",
                         "tier_bytes_disk",
+                        "tier_prefetch_hints", "tier_prefetch_pages",
+                        "tier_prefetch_hit_pages",
+                        "prefix_summary_version", "prefix_summary_pages",
                         "decode_block_effective", "pending_pipeline_depth",
                         "spec_rounds", "spec_drafted_tokens",
                         "spec_accepted_tokens",
@@ -526,6 +582,70 @@ class ServeController:
                     except Exception:  # noqa: BLE001
                         pass
 
+    async def _collect_summaries(self):
+        """Refresh per-replica prefix summaries (ISSUE 10). Rate-limited;
+        per-replica `since` versions make an idle fleet answer with tiny
+        "unchanged" markers. A changed deployment bumps its summary_gen and
+        wakes long-pollers WITHOUT a routing-table version bump (routers
+        must not reshuffle probe caches for a summary delta)."""
+        now = time.monotonic()
+        if now - self._summary_ts < self._summary_interval_s:
+            return
+        self._summary_ts = now
+
+        async def probe_summary(state, replica):
+            key = self._replica_key(replica)
+            if key in state.summary_unsupported:
+                return False
+            since = state.summary_versions.get(key)
+            try:
+                res = await asyncio.wait_for(_as_future(
+                    replica.handle_request.remote(
+                        "prefix_summary", (since,), {}), timeout=2.0), 3.0)
+            except asyncio.TimeoutError:
+                return False  # busy replica: retry next round
+            except Exception:  # noqa: BLE001 — no prefix_summary method
+                # (plain deployment) or replica fault: a fault clears on
+                # replacement (the key is pruned), a plain deployment
+                # never grows the method — either way stop probing
+                state.summary_unsupported.add(key)
+                return False
+            if not isinstance(res, dict) or not res.get("supported"):
+                state.summary_unsupported.add(key)
+                return False
+            if res.get("meta"):
+                state.summary_meta = dict(res["meta"])
+            if res.get("unchanged"):
+                return False
+            state.summary_versions[key] = int(res.get("version", 0))
+            digests = list(res.get("digests") or [])
+            if state.summaries.get(key) == digests:
+                return False
+            state.summaries[key] = digests
+            return True
+
+        for state in list(self._deployments.values()):
+            changed = False
+            # prune entries for replicas that left the routable-and-counted
+            # set (dead, draining, replaced): they must exit every router's
+            # affinity candidate set on the NEXT poll, and a replacement
+            # replica starts cold
+            live = {self._replica_key(r) for r in state.replicas}
+            for k in [k for k in state.summaries if k not in live]:
+                del state.summaries[k]
+                state.summary_versions.pop(k, None)
+                changed = True
+            state.summary_unsupported &= live
+            for k in [k for k in state.summary_versions if k not in live]:
+                del state.summary_versions[k]
+            if state.replicas:
+                flags = await asyncio.gather(
+                    *(probe_summary(state, r) for r in state.replicas))
+                changed = changed or any(flags)
+            if changed:
+                state.summary_gen += 1
+                self._notify_change()
+
     async def _reconcile_once(self):
         await self._drop_replicas_on_dead_nodes()
         await self._move_replicas_on_draining_nodes()
@@ -687,6 +807,11 @@ class ServeController:
                     pass
             if changed_any:
                 self._notify_change()
+
+        # prefix-affinity summaries ride the reconcile loop (rate-limited
+        # inside): collection must see the post-churn replica sets so a
+        # replica dropped above leaves every router's candidate set now
+        await self._collect_summaries()
 
 
 async def _as_future(ref, timeout: Optional[float] = None):

@@ -74,18 +74,21 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, app_name: str,
                  method_name: str = "__call__", *, stream: bool = False,
-                 _timeout_s: float = 30.0, _multiplexed_model_id: str = ""):
+                 _timeout_s: float = 30.0, _multiplexed_model_id: str = "",
+                 _prefix_digests: Optional[list] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method = method_name
         self._stream = stream
         self._timeout_s = _timeout_s
         self._multiplexed_model_id = _multiplexed_model_id
+        self._prefix_digests = _prefix_digests
 
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
                 timeout_s: Optional[float] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                prefix_digests: Optional[list] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name if method_name is not None else self._method,
@@ -93,7 +96,9 @@ class DeploymentHandle:
             _timeout_s=self._timeout_s if timeout_s is None else timeout_s,
             _multiplexed_model_id=(self._multiplexed_model_id
                                    if multiplexed_model_id is None
-                                   else multiplexed_model_id))
+                                   else multiplexed_model_id),
+            _prefix_digests=(self._prefix_digests
+                             if prefix_digests is None else prefix_digests))
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
@@ -116,10 +121,15 @@ class DeploymentHandle:
         if self._multiplexed_model_id:
             kwargs = {**kwargs,
                       "_multiplexed_model_id": self._multiplexed_model_id}
+        if self._prefix_digests:
+            # affinity routing for handle traffic (composition/bench): the
+            # replica reuses these for its tier restore, same as HTTP
+            kwargs = {**kwargs, "_prefix_digests": list(self._prefix_digests)}
         ref = router.assign(self.deployment_name, self._method, args, kwargs,
                             streaming=self._stream,
                             timeout_s=self._timeout_s,
-                            multiplexed_model_id=self._multiplexed_model_id)
+                            multiplexed_model_id=self._multiplexed_model_id,
+                            prefix_digests=self._prefix_digests)
         if self._stream:
             return DeploymentResponseGenerator(ref)
         return DeploymentResponse(ref)
@@ -128,9 +138,11 @@ class DeploymentHandle:
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name, self._method),
                 {"_stream": self._stream, "_timeout_s": self._timeout_s,
-                 "_multiplexed_model_id": self._multiplexed_model_id})
+                 "_multiplexed_model_id": self._multiplexed_model_id,
+                 "_prefix_digests": self._prefix_digests})
 
     def __setstate__(self, state):
         self._stream = state["_stream"]
         self._timeout_s = state["_timeout_s"]
         self._multiplexed_model_id = state.get("_multiplexed_model_id", "")
+        self._prefix_digests = state.get("_prefix_digests")

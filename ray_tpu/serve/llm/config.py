@@ -115,6 +115,13 @@ class LLMConfig:
     kv_tier_disk_max_bytes: int = 1024 * 1024 * 1024
     kv_tier_ttl_s: float = 600.0                 # entry lifetime; <=0 = none
 
+    # Prefix-affinity routing (ISSUE 10): cap on the resident page-chain
+    # digests each replica exports to the router through the controller
+    # long-poll. Low chain positions win the cut (a leading page is what
+    # lets the router match any prefix). 512 digests ≈ 16 KB of hex per
+    # replica per ship — bounded by construction.
+    prefix_summary_max_pages: int = 512
+
     # sampling defaults (overridable per request)
     max_tokens: int = 128
     temperature: float = 0.0          # 0 = greedy

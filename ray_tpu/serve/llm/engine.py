@@ -87,6 +87,12 @@ class _Request:
     # captured at submit: the admission loop sheds waiting requests whose
     # deadline passed instead of prefilling answers nobody will read
     deadline: Optional[float] = None
+    # leading page-chain digests (hex) computed at serve ingress (ISSUE
+    # 10): _kv_tier_restore reuses them instead of re-hashing the prompt,
+    # after verifying page 0 against a local recompute (a tokenizer
+    # mismatch between ingress and engine must degrade to the recompute
+    # path, never restore wrong KV)
+    ingress_digests: Optional[list] = None
 
 
 class LLMEngine:
@@ -536,7 +542,8 @@ class LLMEngine:
                max_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
-               request_id: Optional[str] = None) -> str:
+               request_id: Optional[str] = None,
+               prefix_digests: Optional[list] = None) -> str:
         """Enqueue a request; returns its id. Tokens stream via drain()."""
         if isinstance(prompt, str):
             toks = self.tokenizer.encode(prompt)
@@ -551,7 +558,9 @@ class LLMEngine:
             temperature=(self.cfg.temperature if temperature is None
                          else temperature),
             top_k=self.cfg.top_k if top_k is None else top_k,
-            stop_token=getattr(self.tokenizer, "eos_token_id", None))
+            stop_token=getattr(self.tokenizer, "eos_token_id", None),
+            ingress_digests=(list(prefix_digests)
+                             if prefix_digests else None))
         from ray_tpu.core import deadline as request_deadline
         from ray_tpu.observability import tracing
         req.trace_ctx = tracing.inject()
@@ -679,6 +688,28 @@ class LLMEngine:
         rid = self.submit(prompt, **kw)
         return self.result(rid)
 
+    def prefix_summary(self, max_pages: Optional[int] = None):
+        """(index_version, resident page-chain digest hex list) for the
+        affinity router, or None when prefix caching is off (the caller
+        marks this engine unsupported and stops probing)."""
+        if not self._prefix_cache_on:
+            return None
+        cap = (self.cfg.prefix_summary_max_pages if max_pages is None
+               else max_pages)
+        return self.allocator.prefix_summary(cap)
+
+    def prefetch_hint(self, digests: list[str]) -> dict:
+        """Router affinity-miss hint: start pulling the tier-held tail of
+        this chain NOW so the restore inside _admit finds the pages in the
+        hint buffer instead of paying the remote fetch inline. Locally
+        resident pages are skipped; everything is best-effort."""
+        if not self._kv_tier_on or not digests:
+            return {"accepted": False}
+        start = self.allocator.match_digest_chain(list(digests))
+        if start >= len(digests):
+            return {"accepted": False}
+        return {"accepted": self._kv_tier.prefetch(list(digests), start)}
+
     def engine_stats(self) -> dict:
         with self._lock:
             active = sum(1 for r in self.slot_req if r is not None)
@@ -728,6 +759,19 @@ class LLMEngine:
         ts = self._kv_tier.stats() if self._kv_tier is not None else {}
         out["tier_bytes_shm"] = ts.get("shm_bytes", 0)
         out["tier_bytes_disk"] = ts.get("disk_bytes", 0)
+        # affinity-routing surface (ISSUE 10), same stable-key contract:
+        # summary export state + hinted-prefetch effectiveness
+        out["tier_prefetch_hints"] = ts.get("prefetch_hints", 0)
+        out["tier_prefetch_pages"] = ts.get("prefetch_pages", 0)
+        out["tier_prefetch_hit_pages"] = ts.get("prefetch_hit_pages", 0)
+        if self._prefix_cache_on:
+            ver, digs = self.allocator.prefix_summary(
+                self.cfg.prefix_summary_max_pages)
+            out["prefix_summary_version"] = ver
+            out["prefix_summary_pages"] = len(digs)
+        else:
+            out["prefix_summary_version"] = 0
+            out["prefix_summary_pages"] = 0
         return out
 
     # ---- engine loop ---------------------------------------------------
@@ -959,6 +1003,28 @@ class LLMEngine:
                 logger.warning("kv-tier spill put failed; chain evicted "
                                "without spilling", exc_info=True)
 
+    def _chain_digests(self, toks, limit: int,
+                       ingress: Optional[list]) -> list[str]:
+        """Hex chain digests for the first ``limit`` full pages. Reuses
+        the serve-ingress digests when they cover the range AND page 0
+        verifies against a local recompute — equal chain roots over the
+        same tokens mean the ingress tokenizer matched ours, so the rest
+        of the chain is trustworthy; any mismatch (different tokenizer
+        version, truncation skew) falls back to the full recompute. A
+        wrong digest here would restore another prefix's KV."""
+        ps = self.cfg.page_size
+        if ingress and len(ingress) >= limit and limit > 0:
+            page0 = self._kvc._chain_digest(b"", toks[:ps]).hex()
+            if ingress[0] == page0:
+                return list(ingress[:limit])
+        digest = b""
+        digs = []
+        for i in range(limit):
+            digest = self._kvc._chain_digest(
+                digest, toks[i * ps:(i + 1) * ps])
+            digs.append(digest.hex())
+        return digs
+
     def _kv_tier_restore(self, req: _Request, m_loc: int) -> int:
         """Restore tier-held chain pages into this request's freshly
         allocated pages: local-shm/disk hits load from this process,
@@ -971,12 +1037,7 @@ class LLMEngine:
             limit = min((len(toks) - 1) // ps, len(req.pages))
             if limit <= m_loc:
                 return 0
-            digest = b""
-            digs = []
-            for i in range(limit):
-                digest = self._kvc._chain_digest(
-                    digest, toks[i * ps:(i + 1) * ps])
-                digs.append(digest.hex())
+            digs = self._chain_digests(toks, limit, req.ingress_digests)
             t, k_np, v_np = self._kv_tier.fetch_chain(digs, start=m_loc)
             t = min(t, limit - m_loc)
             if t <= 0:

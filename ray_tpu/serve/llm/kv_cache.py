@@ -114,6 +114,11 @@ class PageAllocator:
         self.spill_hook = None
         self.counters = {"hit_pages": 0, "miss_pages": 0, "evicted": 0,
                          "inserted": 0}
+        # monotone index version: bumps whenever the set of indexed
+        # digests changes (insert or eviction). Lets prefix_summary()
+        # callers skip re-reading an unchanged index — the affinity
+        # summary export (ISSUE 10) polls this.
+        self._version = 0
 
     # ---- allocation ----------------------------------------------------
     def _evict_one_locked(self, spilled: list | None = None) -> bool:
@@ -128,6 +133,7 @@ class PageAllocator:
         pos = self._page_pos.pop(page, None)
         if self._index.get(key) == page:
             del self._index[key]
+            self._version += 1
         if spilled is not None and self.spill_hook is not None:
             spilled.append((page, key, pos))
         self._free.append(page)
@@ -267,7 +273,44 @@ class PageAllocator:
                 self._page_pos[page] = i
                 added += 1
             self.counters["inserted"] += added
+            if added:
+                self._version += 1
         return added
+
+    def index_version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def prefix_summary(self, max_pages: int = 0) -> tuple[int, list[str]]:
+        """(version, resident page-chain digests as hex) — the bounded
+        summary the affinity router consumes (ISSUE 10). When the index
+        exceeds ``max_pages`` (0 = unbounded), LOW chain positions win the
+        cut: a leading page is what lets the router match any prefix at
+        all, while a deep page is only reachable through the pages before
+        it. Every digest here names a page whose KV is resident (live or
+        parked in the cached LRU) — both are served by match_prefix."""
+        with self._lock:
+            ver = self._version
+            items = list(self._page_key.items())  # (page, digest)
+            if max_pages and len(items) > max_pages:
+                items.sort(key=lambda it: self._page_pos.get(it[0], 0))
+                items = items[:max_pages]
+            return ver, [d.hex() for _, d in items]
+
+    def match_digest_chain(self, digests_hex: list[str]) -> int:
+        """Leading run of ``digests_hex`` resident in the index (no
+        incref, no LRU touch — pure inspection, used to size a tier
+        prefetch so it skips pages already local)."""
+        n = 0
+        with self._lock:
+            for d in digests_hex:
+                try:
+                    if bytes.fromhex(d) not in self._index:
+                        break
+                except ValueError:
+                    break
+                n += 1
+        return n
 
     def cache_stats(self) -> dict:
         """Snapshot for engine stats / metrics export.

@@ -73,6 +73,15 @@ _LOCAL_REF_TIMEOUT_S = 2.0      # object-plane get of our own shm blob
 # idle exit for the lazily-started index-publisher thread
 _PUB_IDLE_EXIT_S = 5.0
 
+# Prefetch-hint buffer (ISSUE 10): pages fetched ahead of the request by
+# the router's affinity-miss hint. Bounded by page count + TTL so a storm
+# of hints (or hints for requests that never arrive) can't grow host
+# memory — the buffer is pure opportunism, fetch_chain falls through to
+# the normal remote path on a miss.
+_HINT_MAX_PAGES = 512
+_HINT_TTL_S = 30.0
+_HINT_QUEUE_MAX = 8  # pending prefetch jobs; extra hints drop, not queue
+
 
 def _now() -> float:
     return time.time()
@@ -113,10 +122,19 @@ class KVTierStore:
         self._disk_bytes = 0
         self.counters = {"put_blobs": 0, "put_pages": 0, "demoted_blobs": 0,
                          "dropped_blobs": 0, "expired_blobs": 0,
-                         "local_hits": 0, "remote_hits": 0}
+                         "local_hits": 0, "remote_hits": 0,
+                         "prefetch_hints": 0, "prefetch_pages": 0,
+                         "prefetch_hit_pages": 0, "prefetch_dropped": 0}
         # ordered cluster-index publisher (see module docstring)
         self._pub_q: queue.Queue = queue.Queue()
         self._pub_thread: Optional[threading.Thread] = None
+        # prefetch-hint buffer: digest -> {"k","v" [L,Hkv,1,page,D], "ts"}
+        # (cap + TTL above); filled by the background prefetch worker,
+        # consumed (and kept until TTL/cap) by fetch_chain
+        self._hints: OrderedDict[str, dict] = OrderedDict()
+        self._prefetch_q: queue.Queue = queue.Queue(
+            maxsize=_HINT_QUEUE_MAX)
+        self._prefetch_thread: Optional[threading.Thread] = None
 
     # ---- runtime plumbing ----------------------------------------------
     @staticmethod
@@ -408,7 +426,99 @@ class KVTierStore:
                 # fall through to the cluster probe
                 logger.debug("kv-tier: local chain load failed",
                              exc_info=True)
+        hit = self._hint_chain(digests, start)
+        if hit is not None:
+            return hit
         return self._fetch_remote(digests, start)
+
+    # ---- hinted prefetch (ISSUE 10) --------------------------------------
+    def _hint_chain(self, digests: list[str], start: int):
+        """Serve a restore run out of the prefetch-hint buffer: pages the
+        router's affinity-miss hint already pulled over the object plane.
+        Pure memory — no I/O, no CP call. Returns (t, k, v) or None."""
+        with self._lock:
+            self._expire_hints_locked()
+            parts_k, parts_v = [], []
+            i = start
+            while i < len(digests):
+                h = self._hints.get(digests[i])
+                if h is None:
+                    break
+                parts_k.append(h["k"])
+                parts_v.append(h["v"])
+                i += 1
+            if not parts_k:
+                return None
+            self.counters["prefetch_hit_pages"] += len(parts_k)
+        return (len(parts_k), np.concatenate(parts_k, axis=2),
+                np.concatenate(parts_v, axis=2))
+
+    def _expire_hints_locked(self) -> None:
+        cutoff = _now() - _HINT_TTL_S
+        while self._hints:
+            d, h = next(iter(self._hints.items()))
+            if h["ts"] >= cutoff:
+                break
+            del self._hints[d]
+
+    def prefetch(self, digests: list[str], start: int) -> bool:
+        """Queue a background fetch of ``digests[start:]`` into the hint
+        buffer (router affinity-miss hint). Never blocks the caller: a
+        full queue drops the hint — the request's own restore path is the
+        fallback. Returns whether the job was accepted."""
+        with self._lock:
+            self._expire_hints_locked()
+            # skip pages already hinted; an all-hinted chain needs no job
+            while start < len(digests) and digests[start] in self._hints:
+                start += 1
+            if start >= len(digests):
+                return False
+            self.counters["prefetch_hints"] += 1
+        try:
+            self._prefetch_q.put_nowait((list(digests), start))
+        except queue.Full:
+            with self._lock:
+                self.counters["prefetch_dropped"] += 1
+            return False
+        t = self._prefetch_thread
+        if t is None or not t.is_alive():
+            t = threading.Thread(target=self._prefetch_loop, daemon=True,
+                                 name="kv-tier-prefetch")
+            self._prefetch_thread = t
+            t.start()
+        return True
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            try:
+                job = self._prefetch_q.get(timeout=_PUB_IDLE_EXIT_S)
+            except queue.Empty:
+                with self._lock:
+                    if self._prefetch_q.empty():
+                        self._prefetch_thread = None
+                        return
+                continue
+            if job is None:  # close() sentinel
+                return
+            digests, start = job
+            try:
+                t, k_np, v_np = self._fetch_remote(digests, start)
+            except Exception:  # noqa: BLE001 — prefetch is best-effort
+                logger.debug("kv-tier: prefetch fetch failed",
+                             exc_info=True)
+                continue
+            if t <= 0:
+                continue
+            now = _now()
+            with self._lock:
+                for i in range(t):
+                    self._hints[digests[start + i]] = {
+                        "k": k_np[:, :, i:i + 1], "v": v_np[:, :, i:i + 1],
+                        "ts": now}
+                    self._hints.move_to_end(digests[start + i])
+                self.counters["prefetch_pages"] += t
+                while len(self._hints) > _HINT_MAX_PAGES:
+                    self._hints.popitem(last=False)
 
     def _fetch_remote(self, digests: list[str], start: int):
         rt = self._runtime()
@@ -461,7 +571,8 @@ class KVTierStore:
                     "disk_bytes": self._disk_bytes,
                     "blobs_shm": shm,
                     "blobs_disk": len(self._blobs) - shm,
-                    "indexed_pages": len(self._by_digest)}
+                    "indexed_pages": len(self._by_digest),
+                    "hint_pages": len(self._hints)}
 
     def close(self) -> None:
         """Drop every blob and retract our index entries (clean engine
@@ -471,5 +582,13 @@ class KVTierStore:
                 self._drop_locked(bid, reason="dropped")
             t = self._pub_thread
             self._pub_q.put((None, None))  # drains behind the retracts
+            pt = self._prefetch_thread
+            self._hints.clear()
+        try:
+            self._prefetch_q.put_nowait(None)
+        except queue.Full:
+            pass
         if t is not None and t.is_alive():
             t.join(timeout=5.0)
+        if pt is not None and pt.is_alive():
+            pt.join(timeout=5.0)

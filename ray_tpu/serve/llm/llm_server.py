@@ -36,6 +36,10 @@ _EXPORTED_STATS = (
     # tiered KV cache (ISSUE 7): spill/restore economy + per-tier bytes
     "spilled_pages", "restored_pages", "tier_hit_tokens",
     "tier_bytes_shm", "tier_bytes_disk",
+    # prefix-affinity routing (ISSUE 10): tier-hint prefetch economy +
+    # the summary the router sees (version/pages exported to the CP)
+    "tier_prefetch_hints", "tier_prefetch_pages", "tier_prefetch_hit_pages",
+    "prefix_summary_version", "prefix_summary_pages",
     "spec_rounds", "spec_drafted_tokens", "spec_accepted_tokens",
     # introspection scalars (ISSUE 6): compile tracker + memory gauges;
     # None-valued entries (no samples yet / cpu backend) are skipped
@@ -125,6 +129,14 @@ class LLMServer:
             out["temperature"] = float(payload["temperature"])
         if payload.get("top_k") is not None:
             out["top_k"] = int(payload["top_k"])
+        # Ingress page-chain digests (ISSUE 10): the proxy computed them
+        # once for routing; the replica carries them request-scoped
+        # (serve/replica.py set the contextvar before dispatch) and the
+        # engine reuses them for its tier restore after a page-0 check.
+        from ray_tpu.serve import affinity
+        digests = affinity.get_request_prefix_digests()
+        if digests:
+            out["prefix_digests"] = digests
         return out
 
     def _completion_response(self, out: dict, chat: bool) -> dict:
@@ -228,6 +240,36 @@ class LLMServer:
         stats = self.engine.engine_stats()
         _export_engine_stats(self.cfg.model_id, stats)
         return stats
+
+    # ---- prefix-affinity routing (ISSUE 10) ---------------------------
+    def prefix_summary(self, since: Optional[int] = None) -> dict:
+        """Bounded summary of this replica's resident prefix chains, for
+        the controller's summary collector. `since` is the version the
+        caller already holds — an unchanged index answers with a tiny
+        "unchanged" marker instead of re-shipping the digest list.
+        {"supported": False} permanently when the prefix cache is off."""
+        snap = self.engine.prefix_summary(self.cfg.prefix_summary_max_pages)
+        if snap is None:
+            return {"supported": False}
+        version, digests = snap
+        meta = {
+            "tokenizer": self.cfg.tokenizer,
+            "page_size": self.cfg.page_size,
+            "max_prompt_len": self.cfg.max_prompt_len,
+            "kv_tier": bool(self.cfg.kv_tier_enabled
+                            and self.cfg.prefix_cache_enabled),
+            "model_id": self.cfg.model_id,
+        }
+        if since is not None and int(since) == version:
+            return {"supported": True, "version": version,
+                    "unchanged": True, "meta": meta}
+        return {"supported": True, "version": version, "meta": meta,
+                "digests": digests}
+
+    def prefetch_hint(self, digests: list) -> dict:
+        """Router's tier-hint: start fetching the non-resident tail of
+        this chain from the KV tier now, overlapping admission."""
+        return self.engine.prefetch_hint(digests)
 
     def check_health(self) -> bool:
         # periodic health checks double as the metrics heartbeat: every

@@ -32,6 +32,8 @@ from ray_tpu.core import deadline as request_deadline
 from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import DeadlineExceededError, TaskError
 from ray_tpu.observability import tracing
+from ray_tpu.serve import affinity as _affinity
+from ray_tpu.serve.config import RouterConfig
 from ray_tpu.serve.router import Router
 from ray_tpu.util import metrics as _metrics
 
@@ -57,10 +59,12 @@ def _is_deadline_error(e: BaseException) -> bool:
 
 class HTTPProxy:
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 router_config: Optional[RouterConfig] = None):
         self._controller = controller
         self.host = host
         self.port = port
+        self._router_config = router_config
         self._routers: dict[str, Router] = {}
         self._http_dispatch: dict[tuple, bool] = {}
         self._req_timeout: dict[tuple, Optional[float]] = {}
@@ -267,7 +271,8 @@ class HTTPProxy:
 
         router = self._routers.get(app_name)
         if router is None:
-            router = Router(self._controller, app_name)
+            router = Router(self._controller, app_name,
+                            config=self._router_config)
             self._routers[app_name] = router
 
         loop = asyncio.get_event_loop()
@@ -322,12 +327,27 @@ class HTTPProxy:
                             (subpath, request.method, payload))
                 else:
                     call = (deployment, "__call__", (payload,))
+                # Prefix-affinity (ISSUE 10): compute the prompt's leading
+                # page-chain digests ONCE here (tokenization runs on the
+                # executor, off the event loop) and hand them both to the
+                # router (cache-aware choose) and to the replica (which
+                # reuses them for tier restore). None on non-LLM routes,
+                # short prompts, missing summaries, or any failure — all
+                # mean plain pow-2, never an error.
+                digests = None
+                if wants_dispatch and router.config.affinity_enabled:
+                    meta = router.affinity_meta(deployment)
+                    if meta:
+                        digests = await loop.run_in_executor(
+                            None, _affinity.digests_for_http, subpath,
+                            payload, meta, router.config.affinity_max_digests)
+                kwargs = {"_prefix_digests": digests} if digests else {}
                 pctx = contextvars.copy_context()
                 if streaming:
                     ref = await loop.run_in_executor(
                         None, lambda: pctx.run(
-                            router.assign, call[0], call[1], call[2], {},
-                            streaming=True))
+                            router.assign, call[0], call[1], call[2], kwargs,
+                            streaming=True, prefix_digests=digests))
                     if hasattr(ref, "__next__"):
                         resp = await self._stream_sse(request, ref, dl, sp)
                         self._observe_request(
@@ -337,7 +357,8 @@ class HTTPProxy:
                 else:
                     result, attempts = await loop.run_in_executor(
                         None, lambda: pctx.run(
-                            router.call, call[0], call[1], call[2], {}))
+                            router.call, call[0], call[1], call[2], kwargs,
+                            prefix_digests=digests))
                     if attempts > 1:
                         self.stats["retries"] += attempts - 1
                         if sp is not None:

@@ -89,6 +89,12 @@ class ServeReplica:
         if model_id:
             from ray_tpu.serve.multiplex import _set_multiplexed_model_id
             _set_multiplexed_model_id(model_id)
+        digests = (kwargs or {}).pop("_prefix_digests", None)
+        if digests:
+            # proxy-computed page-chain digests (ISSUE 10): request-scoped
+            # contextvar, carried into the pool thread by copy_context()
+            from ray_tpu.serve.affinity import _set_request_prefix_digests
+            _set_request_prefix_digests(digests)
         try:
             if self._is_fn:
                 target = self._callable
@@ -143,6 +149,10 @@ class ServeReplica:
         if model_id:
             from ray_tpu.serve.multiplex import _set_multiplexed_model_id
             _set_multiplexed_model_id(model_id)
+        digests = (kwargs or {}).pop("_prefix_digests", None)
+        if digests:
+            from ray_tpu.serve.affinity import _set_request_prefix_digests
+            _set_request_prefix_digests(digests)
         try:
             target = (self._callable if self._is_fn or method_name == "__call__"
                       else getattr(self._callable, method_name))

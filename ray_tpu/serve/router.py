@@ -54,6 +54,27 @@ _EJECTION_COUNTER = _metrics.Counter(
     "ray_tpu_serve_router_ejections_total",
     "replicas ejected from routing by the circuit breaker",
     tag_keys=("deployment",))
+# Prefix-affinity routing (ISSUE 10): hit = routed to a resident-prefix
+# holder; spillover = best holder saturated, demoted to pow-2; stale
+# fallback = summaries too old / router degraded, demoted to pow-2.
+_AFFINITY_HITS = _metrics.Counter(
+    "ray_tpu_serve_router_affinity_hits_total",
+    "requests routed to a replica holding their resident prefix",
+    tag_keys=("deployment",))
+_AFFINITY_SPILLOVERS = _metrics.Counter(
+    "ray_tpu_serve_router_affinity_spillovers_total",
+    "affinity demotions because every useful holder was saturated",
+    tag_keys=("deployment",))
+_AFFINITY_STALE = _metrics.Counter(
+    "ray_tpu_serve_router_affinity_stale_fallbacks_total",
+    "affinity demotions because summaries were stale or the router "
+    "was degraded",
+    tag_keys=("deployment",))
+_AFFINITY_MATCHED_PAGES = _metrics.Histogram(
+    "ray_tpu_serve_router_affinity_matched_pages",
+    "resident prefix pages matched on affinity-routed requests",
+    boundaries=[1, 2, 4, 8, 16, 32, 64],
+    tag_keys=("deployment",))
 
 
 def is_replica_fault(exc: BaseException) -> bool:
@@ -96,17 +117,42 @@ class ReplicaSet:
     circuit-breaker state (keyed by actor id, so state survives routing-table
     refreshes that rebuild the handle list)."""
 
-    def __init__(self, config: Optional[RouterConfig] = None):
+    def __init__(self, config: Optional[RouterConfig] = None,
+                 name: str = ""):
         self.config = config or RouterConfig()
+        self.name = name                   # deployment (metric tag)
         self.replicas: list = []           # actor handles
         self.version: int = -1
-        self._qlen: dict[int, tuple[float, int]] = {}  # idx -> (ts, len)
+        # probe cache keyed by STABLE replica identity (actor id hex), not
+        # list index: a routing-table refresh reshuffles indices, and an
+        # index-keyed cache would attribute one replica's queue length to
+        # another for up to queue_len_staleness_s
+        self._qlen: dict[str, tuple[float, int]] = {}  # key -> (ts, len)
         # circuit breaker, keyed by actor id hex
         self._fails: dict[str, int] = {}          # consecutive failures
         self._ejected: dict[str, float] = {}      # key -> ejected-at ts
         self._cb_lock = threading.Lock()
         self.ejections = 0
         self.readmissions = 0
+        # ---- prefix-affinity state (ISSUE 10) --------------------------
+        # per-replica resident-prefix summaries shipped by the controller
+        # through the routing long-poll: key -> frozenset of page-chain
+        # digest hex strings
+        self._summaries: dict[str, frozenset] = {}
+        self.summary_gen: int = -1   # controller's summary generation
+        self.meta: dict = {}         # deployment affinity meta (tokenizer,
+        #                              page_size, kv_tier, ...)
+        # last time a long-poll cycle against the controller SUCCEEDED
+        # (whether or not it shipped new summaries): choose() treats
+        # summaries as stale once this ages past affinity_summary_ttl_s —
+        # a wedged controller must not steer traffic on a frozen view
+        self.summaries_ok_at: float = 0.0
+        # router-level degraded flag mirrored here so choose() can demote
+        # affinity the moment the control plane goes away
+        self.degraded = False
+        self.affinity_hits = 0
+        self.affinity_spillovers = 0
+        self.affinity_stale_fallbacks = 0
 
     @staticmethod
     def _key(replica) -> str:
@@ -116,14 +162,37 @@ class ReplicaSet:
     def update(self, replicas: list, version: int):
         self.replicas = replicas
         self.version = version
-        self._qlen = {}
         live = {self._key(r) for r in replicas}
+        # identity-keyed probe entries stay valid across a table refresh;
+        # only entries for departed replicas are dropped
+        self._qlen = {k: v for k, v in self._qlen.items() if k in live}
+        # a replaced replica must start cold: its predecessor's summary
+        # (same deployment slot, different actor) does not carry over
+        self._summaries = {k: v for k, v in self._summaries.items()
+                           if k in live}
         with self._cb_lock:
             # controller replaced dead replicas: drop breaker state for
             # handles that no longer route
             self._fails = {k: v for k, v in self._fails.items() if k in live}
             self._ejected = {k: v for k, v in self._ejected.items()
                              if k in live}
+
+    def apply_summaries(self, gen: int, meta: dict,
+                        summaries: dict[str, list]) -> None:
+        """Install controller-shipped prefix summaries (long-poll path).
+
+        `summaries` maps replica key -> list of resident page-chain digest
+        hex strings. The payload is the deployment's FULL summary state:
+        entries absent from it are removed now (the replica reported
+        nothing resident or stopped answering probes), and entries for
+        replicas outside the current table never route (choose() walks the
+        routable set)."""
+        self.summary_gen = gen
+        self.meta = dict(meta or {})
+        live = {self._key(r) for r in self.replicas}
+        self._summaries = {key: frozenset(digs)
+                           for key, digs in (summaries or {}).items()
+                           if key in live}
 
     # ---- circuit breaker ------------------------------------------------
     def record_success(self, replica) -> None:
@@ -145,8 +214,10 @@ class ReplicaSet:
         return False
 
     def _routable(self) -> list:
-        """Replicas not currently ejected; cooled-down ejectees are health
-        probed and readmitted when they pass (re-armed when they don't)."""
+        """(replica, key) pairs not currently ejected; cooled-down ejectees
+        are health probed and readmitted when they pass (re-armed when they
+        don't). The identity key rides along so selection never rescans
+        self.replicas to recover it."""
         now = time.monotonic()
         out = []
         for r in self.replicas:
@@ -154,7 +225,7 @@ class ReplicaSet:
             with self._cb_lock:
                 ejected_at = self._ejected.get(key)
             if ejected_at is None:
-                out.append(r)
+                out.append((r, key))
                 continue
             if now - ejected_at < self.config.ejection_cooldown_s:
                 continue
@@ -176,56 +247,119 @@ class ReplicaSet:
                 else:
                     self._ejected[key] = time.monotonic()  # re-arm cooldown
             if ok:
-                out.append(r)
+                out.append((r, key))
         return out
 
     # ---- selection ------------------------------------------------------
     _QLEN_DEAD = 1 << 30  # probe-failed sentinel: replica looks infinitely busy
 
-    def _probe(self, idx: int) -> int:
+    def _probe(self, replica, key: str) -> int:
         now = time.monotonic()
-        cached = self._qlen.get(idx)
+        cached = self._qlen.get(key)
         if cached and now - cached[0] < self.config.queue_len_staleness_s:
             return cached[1]
         try:
             # bounded by the ambient deadline too: probing a dead replica
             # must not burn the caller's remaining budget
-            qlen = ray_tpu.get(self.replicas[idx].get_queue_len.remote(),
+            qlen = ray_tpu.get(replica.get_queue_len.remote(),
                                timeout=request_deadline.bound(
                                    self.config.queue_probe_timeout_s))
         except Exception:  # noqa: BLE001 - dead replica looks busy
             qlen = self._QLEN_DEAD
-        self._qlen[idx] = (now, qlen)
+        self._qlen[key] = (now, qlen)
         return qlen
 
-    def choose(self, model_id: str = "") -> Optional[object]:
+    def _match_len(self, digests: list, resident: frozenset) -> int:
+        """Longest LEADING run of request digests resident on a replica.
+        Chain digests commit to the whole prefix, so a broken run past the
+        first miss cannot be reused by match_prefix — stop there."""
+        n = 0
+        for d in digests:
+            if d not in resident:
+                break
+            n += 1
+        return n
+
+    def _summaries_usable(self) -> bool:
+        if self.degraded:
+            return False
+        ttl = self.config.affinity_summary_ttl_s
+        return (self.summaries_ok_at > 0.0
+                and time.monotonic() - self.summaries_ok_at < ttl)
+
+    def _pow2(self, candidates: list):
+        """Power-of-two-choices over (replica, key) pairs."""
+        n = len(candidates)
+        if n == 1:
+            return candidates[0][0]
+        i, j = random.sample(range(n), 2)
+        (ri, ki), (rj, kj) = candidates[i], candidates[j]
+        qi, qj = self._probe(ri, ki), self._probe(rj, kj)
+        if min(qi, qj) < self._QLEN_DEAD:
+            return ri if qi <= qj else rj
+        # both sampled candidates look dead (a node just died): fall back
+        # to a full scan — any live replica beats two dead ones
+        best, best_q = ri, qi
+        for c, key in candidates:
+            q = self._probe(c, key)
+            if q < best_q:
+                best, best_q = c, q
+        return best
+
+    def choose(self, model_id: str = "",
+               prefix_digests: Optional[list] = None) -> Optional[object]:
+        return self.choose_info(model_id, prefix_digests)[0]
+
+    def choose_info(self, model_id: str = "",
+                    prefix_digests: Optional[list] = None) -> tuple:
+        """Pick a replica; returns (replica | None, matched_prefix_pages).
+
+        Selection order: multiplexed rendezvous (model cache affinity
+        outranks prefix affinity), then prefix affinity when the request
+        carries digests and fresh summaries name a non-saturated holder,
+        else pow-2. matched_prefix_pages is 0 on every non-affinity path —
+        the caller uses it to decide whether a tier prefetch hint is worth
+        sending."""
         candidates = self._routable()
         n = len(candidates)
         if n == 0:
-            return None
+            return None, 0
         if model_id:
             # multiplexed request: rendezvous-hash affinity keeps the model's
             # per-replica cache hot (serve/multiplex.py)
             from ray_tpu.serve.multiplex import rendezvous_pick
-            return candidates[rendezvous_pick(candidates, model_id)]
-        if n == 1:
-            return candidates[0]
-        i, j = random.sample(range(n), 2)
-        # probe cache is indexed into self.replicas (stable across choose
-        # calls within one table version)
-        pi = self.replicas.index(candidates[i])
-        pj = self.replicas.index(candidates[j])
-        qi, qj = self._probe(pi), self._probe(pj)
-        if min(qi, qj) < self._QLEN_DEAD:
-            return candidates[i if qi <= qj else j]
-        # both sampled candidates look dead (a node just died): fall back
-        # to a full scan — any live replica beats two dead ones
-        best, best_q = candidates[i], qi
-        for c in candidates:
-            q = self._probe(self.replicas.index(c))
-            if q < best_q:
-                best, best_q = c, q
-        return best
+            reps = [r for r, _ in candidates]
+            return reps[rendezvous_pick(reps, model_id)], 0
+        if (prefix_digests and self.config.affinity_enabled
+                and self._summaries):
+            if not self._summaries_usable():
+                self.affinity_stale_fallbacks += 1
+                _AFFINITY_STALE.inc(tags={"deployment": self.name})
+                return self._pow2(candidates), 0
+            scored = []
+            for r, key in candidates:
+                resident = self._summaries.get(key)
+                if not resident:
+                    continue
+                m = self._match_len(prefix_digests, resident)
+                if m >= self.config.affinity_min_match_pages:
+                    scored.append((m, r, key))
+            if scored:
+                # best holder first; a saturated one spills to the next
+                # holder, and only when EVERY holder is saturated does the
+                # request demote to pow-2 (load wins over locality)
+                scored.sort(key=lambda t: t[0], reverse=True)
+                for m, r, key in scored:
+                    if self._probe(r, key) < \
+                            self.config.affinity_spillover_qlen:
+                        self.affinity_hits += 1
+                        _AFFINITY_HITS.inc(tags={"deployment": self.name})
+                        _AFFINITY_MATCHED_PAGES.observe(
+                            m, tags={"deployment": self.name})
+                        return r, m
+                self.affinity_spillovers += 1
+                _AFFINITY_SPILLOVERS.inc(tags={"deployment": self.name})
+        return self._pow2(candidates), 0
 
 
 class Router:
@@ -272,6 +406,11 @@ class Router:
             elif not degraded and self._degraded:
                 self._degraded = False
                 self._degraded_since = None
+        # mirror into every replica set: affinity must demote to pow-2 the
+        # moment the control plane goes away, not a summary-TTL later
+        with self._lock:
+            for rs in self._sets.values():
+                rs.degraded = degraded
 
     def stats_snapshot(self) -> dict:
         with self._stats_lock:
@@ -285,14 +424,34 @@ class Router:
             out["ejections"] = sum(rs.ejections for rs in self._sets.values())
             out["readmissions"] = sum(rs.readmissions
                                       for rs in self._sets.values())
+            out["affinity_hits"] = sum(rs.affinity_hits
+                                       for rs in self._sets.values())
+            out["affinity_spillovers"] = sum(
+                rs.affinity_spillovers for rs in self._sets.values())
+            out["affinity_stale_fallbacks"] = sum(
+                rs.affinity_stale_fallbacks for rs in self._sets.values())
         return out
 
     def _apply_table(self, table: dict) -> None:
         with self._lock:
-            for dep, (replicas, version) in table.items():
-                cur = self._sets.setdefault(dep, ReplicaSet(self.config))
+            for dep, entry in table.items():
+                # entries are (replicas, version) or, from controllers that
+                # collect prefix summaries, (replicas, version, summary)
+                # where summary = {"gen", "meta", "replicas"} or None
+                # (= unchanged since the gen we acknowledged)
+                replicas, version = entry[0], entry[1]
+                summary = entry[2] if len(entry) > 2 else None
+                cur = self._sets.setdefault(dep,
+                                            ReplicaSet(self.config, dep))
+                cur.degraded = self._degraded
                 if version != cur.version:
                     cur.update(replicas, version)
+                if summary is not None:
+                    # after update(): apply_summaries filters against the
+                    # replica set the summaries describe
+                    cur.apply_summaries(summary.get("gen", 0),
+                                        summary.get("meta") or {},
+                                        summary.get("replicas") or {})
             # the table is the app's FULL routing state: deployments that
             # were deleted must drop out of the cache, or the long-poll
             # version handshake never converges
@@ -300,10 +459,21 @@ class Router:
                         if d not in table and rs.version >= 0]:
                 del self._sets[dep]
 
+    def _mark_summaries_ok(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for rs in self._sets.values():
+                rs.summaries_ok_at = now
+
     def _long_poll_loop(self) -> None:
         while not self._stopped.is_set():
             with self._lock:
-                known = {d: rs.version for d, rs in self._sets.items()}
+                # [table_version, summary_gen] handshake: the controller
+                # re-ships a deployment when EITHER moves (older
+                # controllers that expect bare ints still work — they
+                # compare unequal and ship a full 2-tuple entry)
+                known = {d: [rs.version, rs.summary_gen]
+                         for d, rs in self._sets.items()}
             try:
                 table = ray_tpu.get(
                     self._controller.poll_routing_table.remote(
@@ -317,13 +487,25 @@ class Router:
             self._set_degraded(False)
             if table:
                 self._apply_table(table)
+            # a completed poll round (even an empty timeout) proves the
+            # controller is alive: its summaries are as fresh as they get
+            self._mark_summaries_ok()
 
     def stop(self) -> None:
         self._stopped.set()
 
+    def affinity_meta(self, deployment: str) -> dict:
+        """Deployment affinity meta (tokenizer/page_size/...) shipped with
+        its summaries; {} until summaries have arrived — the proxy then
+        skips digest computation entirely."""
+        with self._lock:
+            rs = self._sets.get(deployment)
+            return dict(rs.meta) if rs is not None and rs.meta else {}
+
     def _maybe_refresh(self, deployment: str, force: bool = False):
         with self._lock:
-            rs = self._sets.setdefault(deployment, ReplicaSet(self.config))
+            rs = self._sets.setdefault(
+                deployment, ReplicaSet(self.config, deployment))
             if rs.replicas and not force:
                 return rs
         # cold start / forced: one synchronous fetch. During a controller /
@@ -337,21 +519,25 @@ class Router:
         else:
             self._set_degraded(False)
             self._apply_table(table)
+            self._mark_summaries_ok()
         with self._lock:
-            return self._sets.setdefault(deployment, ReplicaSet(self.config))
+            return self._sets.setdefault(
+                deployment, ReplicaSet(self.config, deployment))
 
     def _pick(self, deployment: str, multiplexed_model_id: str,
-              timeout_s: float):
+              timeout_s: float, prefix_digests: Optional[list] = None):
         """Block until a routable replica exists (bounded by `timeout_s`
-        AND the ambient deadline). Returns (replica_set, replica)."""
+        AND the ambient deadline). Returns (replica_set, replica,
+        matched_prefix_pages)."""
         wait_until = time.monotonic() \
             + request_deadline.bound(timeout_s)
         while True:
             request_deadline.raise_if_expired("request")
             rs = self._maybe_refresh(deployment)
-            replica = rs.choose(multiplexed_model_id)
+            replica, matched = rs.choose_info(multiplexed_model_id,
+                                              prefix_digests)
             if replica is not None:
-                return rs, replica
+                return rs, replica, matched
             if time.monotonic() > wait_until:
                 raise TimeoutError(
                     f"no replicas available for deployment "
@@ -359,14 +545,35 @@ class Router:
             self._maybe_refresh(deployment, force=True)
             time.sleep(0.1)
 
+    def _maybe_prefetch(self, rs: ReplicaSet, replica, matched: int,
+                        prefix_digests: Optional[list]) -> None:
+        """Tier prefetch hint: the chosen replica does not hold the whole
+        requested prefix resident, so tell it NOW which chain is coming —
+        its KV-tier lookup/fetch then overlaps request transfer + queueing
+        instead of serializing inside engine._admit. Data-plane RPC to the
+        replica itself: the request path stays free of controller/CP
+        calls."""
+        if (not prefix_digests or not self.config.prefetch_hints_enabled
+                or matched >= len(prefix_digests)
+                or not rs.meta.get("kv_tier")):
+            return
+        try:
+            replica.handle_request.remote(  # graftlint: fire-and-forget — best-effort warmup; the request itself is the fallback path
+                "prefetch_hint", (list(prefix_digests),), {})
+        except Exception:  # noqa: BLE001 — hint is pure opportunism
+            pass
+
     def assign(self, deployment: str, method: str, args: tuple,
                kwargs: dict, *, streaming: bool = False,
-               timeout_s: float = 30.0, multiplexed_model_id: str = ""):
+               timeout_s: float = 30.0, multiplexed_model_id: str = "",
+               prefix_digests: Optional[list] = None):
         """Pick a replica and submit; returns the reply ObjectRef.
 
         No retries — the caller owns the ref (DeploymentHandle path).
         `call()` is the retrying variant for request/response traffic."""
-        rs, replica = self._pick(deployment, multiplexed_model_id, timeout_s)
+        rs, replica, matched = self._pick(deployment, multiplexed_model_id,
+                                          timeout_s, prefix_digests)
+        self._maybe_prefetch(rs, replica, matched, prefix_digests)
         if streaming:
             # streaming-generator call: returns an ObjectRefGenerator
             # whose items land as the replica yields them
@@ -376,7 +583,8 @@ class Router:
 
     def call(self, deployment: str, method: str, args: tuple, kwargs: dict,
              *, timeout_s: Optional[float] = None,
-             multiplexed_model_id: str = "") -> tuple:
+             multiplexed_model_id: str = "",
+             prefix_digests: Optional[list] = None) -> tuple:
         """Submit and WAIT for the reply, absorbing replica faults: a
         dead/unreachable replica is recorded against the circuit breaker
         and the request is retried on another replica, gated by the retry
@@ -397,8 +605,10 @@ class Router:
             except DeadlineExceededError:
                 self._bump("deadline_exceeded")
                 raise
-            rs, replica = self._pick(deployment, multiplexed_model_id,
-                                     no_replica_timeout)
+            rs, replica, matched = self._pick(
+                deployment, multiplexed_model_id, no_replica_timeout,
+                prefix_digests)
+            self._maybe_prefetch(rs, replica, matched, prefix_digests)
             ref = replica.handle_request.remote(method, args, kwargs)
             attempts += 1
             try:

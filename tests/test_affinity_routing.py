@@ -1,0 +1,527 @@
+"""Prefix-affinity routing tests (ISSUE 10): ingress digest computation,
+cache-aware replica selection, churn/staleness demotion to pow-2, the
+tier-hint prefetch buffer, and the controller->router summary flow.
+
+Models the reference's prefix-aware routing tests (vLLM/SGLang-style
+cache-aware scheduling) on top of the serve router's pow-2 base."""
+
+import time
+import types
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve import affinity
+from ray_tpu.serve.config import RouterConfig
+from ray_tpu.serve.router import ReplicaSet, Router
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular(ray_start_module):
+    yield ray_start_module
+
+
+def _tiny_cfg(**kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+
+    d = dict(model_config=llama.llama_tiny(vocab_size=512),
+             max_batch_size=4, page_size=16, num_pages=64,
+             max_prompt_len=64, max_seq_len=128, max_tokens=8)
+    d.update(kw)
+    return LLMConfig(**d)
+
+
+# ---- fakes (same idiom as test_serve_robustness) ---------------------------
+
+class _AID:
+    def __init__(self, h):
+        self._h = h
+
+    def hex(self):
+        return self._h
+
+
+class _FakeMethod:
+    def __init__(self, replica, kind):
+        self._replica = replica
+        self._kind = kind
+
+    def remote(self, *args):
+        if args:  # handle_request(method, args, kwargs): record the call
+            self._replica.calls.append((self._kind,) + args)
+            return ("call", self._replica)
+        return (self._kind, self._replica)
+
+
+class _FakeReplica:
+    def __init__(self, name, healthy=True, qlen=0):
+        self._actor_id = _AID(name)
+        self.healthy = healthy
+        self.qlen = qlen
+        self.calls = []
+
+    @property
+    def check_health(self):
+        return _FakeMethod(self, "health")
+
+    @property
+    def get_queue_len(self):
+        return _FakeMethod(self, "qlen")
+
+    @property
+    def handle_request(self):
+        return _FakeMethod(self, "handle_request")
+
+
+def _fake_get(ref, timeout=None):
+    kind, replica = ref
+    if not replica.healthy:
+        raise RuntimeError(f"replica {replica._actor_id.hex()} is dead")
+    return replica.qlen if kind == "qlen" else True
+
+
+def _fresh(rs: ReplicaSet) -> None:
+    rs.summaries_ok_at = time.monotonic()
+
+
+# ---- digest-chain equivalence (the cross-process contract) -----------------
+
+def test_chain_digest_matches_kv_cache():
+    """affinity.py duplicates kv_cache's chain digest (no jax import in
+    the proxy process) — the two must stay byte-for-byte identical, or
+    router matches silently drop to zero."""
+    from ray_tpu.serve.llm import kv_cache as kvc
+
+    digest_a, digest_k = b"", b""
+    for i in range(5):
+        chunk = list(range(i * 4, i * 4 + 4))
+        digest_a = affinity._chain_digest(digest_a, chunk)
+        digest_k = kvc._chain_digest(digest_k, chunk)
+        assert digest_a == digest_k
+
+
+def test_compute_prefix_digests_matches_engine_chain():
+    """Proxy-side digests over the byte tokenizer must equal the chain the
+    engine computes: same tokenization, same max_prompt_len truncation,
+    same (len-1)//page_size full-page limit."""
+    from ray_tpu.serve.llm import kv_cache as kvc
+    from ray_tpu.serve.llm.tokenizer import get_tokenizer
+
+    meta = {"tokenizer": "byte", "page_size": 4, "max_prompt_len": 19}
+    prompt = "the quick brown fox jumps"
+    out = affinity.compute_prefix_digests(prompt, meta, max_digests=64)
+
+    toks = get_tokenizer("byte").encode(prompt)[:19]
+    limit = (len(toks) - 1) // 4
+    digest, want = b"", []
+    for i in range(limit):
+        digest = kvc._chain_digest(digest, toks[i * 4:(i + 1) * 4])
+        want.append(digest.hex())
+    assert out == want and len(out) == limit
+
+    # max_digests caps the leading run
+    assert affinity.compute_prefix_digests(prompt, meta, 2) == want[:2]
+    # no full page -> None (router stays pow-2)
+    assert affinity.compute_prefix_digests("hi", meta, 64) is None
+    # malformed meta degrades to None, never raises
+    assert affinity.compute_prefix_digests(prompt, {}, 64) is None
+
+
+# ---- allocator summary surface ---------------------------------------------
+
+def test_allocator_prefix_summary_version_and_cap():
+    from ray_tpu.serve.llm.kv_cache import PageAllocator
+
+    ps = 4
+    a = PageAllocator(num_pages=16)
+    v0 = a.index_version()
+    ver, digs = a.prefix_summary()
+    assert ver == v0 and digs == []
+
+    pages = a.alloc(3)
+    a.insert_prefix(list(range(12)), pages, ps)
+    ver, digs = a.prefix_summary()
+    assert ver > v0 and len(digs) == 3
+
+    # cap keeps LOW chain positions (a leading page is what makes any
+    # prefix matchable at all)
+    _, capped = a.prefix_summary(max_pages=2)
+    assert capped == digs[:2] or set(capped) == set(digs[:2])
+
+    # eviction bumps the version so the controller re-collects
+    a.free(pages)
+    before = a.index_version()
+    got = a.alloc(14)           # forces eviction of parked cache pages
+    assert a.index_version() > before
+    a.free(got)
+
+
+def test_allocator_match_digest_chain():
+    from ray_tpu.serve.llm.kv_cache import PageAllocator
+
+    ps = 4
+    a = PageAllocator(num_pages=16)
+    pages = a.alloc(3)
+    a.insert_prefix(list(range(12)), pages, ps)
+    _, digs = a.prefix_summary()
+    assert a.match_digest_chain(digs) == 3
+    assert a.match_digest_chain(digs[:1]) == 1
+    assert a.match_digest_chain(["ff" * 16] + digs) == 0
+    # leading run only: a gap ends the match even if later digests exist
+    assert a.match_digest_chain([digs[0], "ff" * 16, digs[2]]) == 1
+    assert a.match_digest_chain(["not-hex"]) == 0
+    a.free(pages)
+
+
+# ---- cache-aware selection --------------------------------------------------
+
+def _affinity_set(monkeypatch, cfg=None, n=3):
+    from ray_tpu.serve import router as router_mod
+    monkeypatch.setattr(router_mod.ray_tpu, "get", _fake_get)
+    rs = ReplicaSet(cfg or RouterConfig(), "llm")
+    reps = [_FakeReplica(f"r{i}") for i in range(n)]
+    rs.update(reps, 0)
+    _fresh(rs)
+    return rs, reps
+
+
+def test_affinity_routes_to_longest_prefix_holder(monkeypatch):
+    rs, (r0, r1, r2) = _affinity_set(monkeypatch)
+    digs = [f"{i:02x}" * 16 for i in range(4)]
+    rs.apply_summaries(1, {"tokenizer": "byte"}, {
+        "r0": digs[:2],          # 2-page holder
+        "r1": digs[:4],          # full holder
+    })
+    replica, matched = rs.choose_info("", digs)
+    assert replica is r1 and matched == 4
+    assert rs.affinity_hits == 1
+
+    # digests nobody holds -> pow-2 (no hit, no stale fallback)
+    other = ["ee" * 16, "dd" * 16]
+    replica, matched = rs.choose_info("", other)
+    assert matched == 0
+    assert rs.affinity_hits == 1 and rs.affinity_stale_fallbacks == 0
+
+    # affinity disabled by config -> matched stays 0 even for a holder
+    rs.config = RouterConfig(affinity_enabled=False)
+    assert rs.choose_info("", digs)[1] == 0
+
+
+def test_affinity_spillover_and_all_saturated_pow2(monkeypatch):
+    cfg = RouterConfig(affinity_spillover_qlen=4, queue_len_staleness_s=100)
+    rs, (r0, r1, r2) = _affinity_set(monkeypatch, cfg)
+    digs = [f"{i:02x}" * 16 for i in range(4)]
+    rs.apply_summaries(1, {}, {"r1": digs[:4], "r2": digs[:2]})
+
+    # best holder saturated -> spill to the NEXT holder, still affinity
+    r1.qlen = 10
+    replica, matched = rs.choose_info("", digs)
+    assert replica is r2 and matched == 2
+    assert rs.affinity_hits == 1 and rs.affinity_spillovers == 0
+
+    # every holder saturated -> pow-2 + spillover counter (load beats
+    # locality)
+    rs._qlen.clear()
+    r2.qlen = 10
+    replica, matched = rs.choose_info("", digs)
+    assert matched == 0
+    assert rs.affinity_spillovers == 1
+
+
+def test_affinity_stale_and_degraded_demote_to_pow2(monkeypatch):
+    cfg = RouterConfig(affinity_summary_ttl_s=0.2)
+    rs, reps = _affinity_set(monkeypatch, cfg)
+    digs = ["aa" * 16]
+    rs.apply_summaries(1, {}, {"r1": digs})
+
+    rs.summaries_ok_at = time.monotonic() - 1.0   # controller went quiet
+    assert rs.choose_info("", digs)[1] == 0
+    assert rs.affinity_stale_fallbacks == 1
+
+    # fresh again, but the router flagged DEGRADED (CP outage): demote
+    # immediately, not a TTL later
+    _fresh(rs)
+    rs.degraded = True
+    assert rs.choose_info("", digs)[1] == 0
+    assert rs.affinity_stale_fallbacks == 2
+
+    rs.degraded = False
+    assert rs.choose_info("", digs)[1] == 1
+    assert rs.affinity_hits == 1
+
+
+def test_churn_replaced_replica_starts_cold(monkeypatch):
+    """A table refresh that drops a replica must drop its summary AND its
+    probe-cache entry in the same breath — its replacement (new actor id)
+    must never inherit either."""
+    rs, (r0, r1, r2) = _affinity_set(monkeypatch)
+    digs = ["aa" * 16, "bb" * 16]
+    rs.apply_summaries(1, {}, {"r1": digs})
+    rs._probe(r1, "r1")
+    assert "r1" in rs._summaries and "r1" in rs._qlen
+
+    r1b = _FakeReplica("r1b")                 # replacement, fresh actor id
+    rs.update([r0, r1b, r2], 1)
+    assert "r1" not in rs._summaries and "r1" not in rs._qlen
+    _fresh(rs)
+    assert rs.choose_info("", digs)[1] == 0   # nobody claims the prefix
+
+
+def test_ejected_replica_leaves_affinity_candidates(monkeypatch):
+    cfg = RouterConfig(ejection_threshold=1, ejection_cooldown_s=60.0)
+    rs, (r0, r1, r2) = _affinity_set(monkeypatch, cfg)
+    digs = ["aa" * 16]
+    rs.apply_summaries(1, {}, {"r1": digs})
+    assert rs.choose_info("", digs)[0] is r1
+
+    assert rs.record_failure(r1)              # circuit breaker ejects it
+    replica, matched = rs.choose_info("", digs)
+    assert replica is not r1                  # holder is out of rotation
+    assert matched == 0
+
+
+def test_draining_replica_leaves_affinity_candidates(monkeypatch):
+    """PR 8 drain: a draining replica stays in the routing table (keeps
+    serving in-flight + pow-2 traffic) but the controller stops probing it
+    for summaries, so the next shipped generation retracts its entry —
+    apply_summaries replaces the whole summary state, it never merges."""
+    rs, (r0, r1, r2) = _affinity_set(monkeypatch)
+    digs = ["aa" * 16, "bb" * 16]
+    rs.apply_summaries(1, {}, {"r1": digs})
+    assert rs.choose_info("", digs)[0] is r1
+
+    # r1 drains: still in the table, gone from the collector's summary set
+    rs.apply_summaries(2, {}, {"r0": digs[:1]})
+    assert "r1" not in rs._summaries
+    replica, matched = rs.choose_info("", digs)
+    assert replica is r0 and matched == 1     # next-best holder wins
+    # r1 is still pow-2 routable (liveness unchanged)
+    assert any(rs.choose() is r1 for _ in range(40))
+
+
+def test_apply_summaries_filters_nonlive_keys(monkeypatch):
+    rs, reps = _affinity_set(monkeypatch)
+    rs.apply_summaries(1, {}, {"r0": ["aa" * 16], "ghost": ["bb" * 16]})
+    assert set(rs._summaries) == {"r0"}
+
+
+def test_probe_cache_identity_keys_survive_reshuffle(monkeypatch):
+    """Regression for the index-keyed probe cache: a routing-table refresh
+    that reorders the replica list must not swap cached queue lengths
+    between replicas."""
+    from ray_tpu.serve import router as router_mod
+
+    def _no_rpc(ref, timeout=None):
+        raise AssertionError("probe RPC issued despite fresh cache")
+
+    rs = ReplicaSet(RouterConfig(queue_len_staleness_s=100.0))
+    r1, r2 = _FakeReplica("a", qlen=0), _FakeReplica("b", qlen=5)
+    rs.update([r1, r2], 0)
+    now = time.monotonic()
+    rs._qlen = {"a": (now, 0), "b": (now, 5)}
+    monkeypatch.setattr(router_mod.ray_tpu, "get", _no_rpc)
+    rs.update([r2, r1], 1)                    # reshuffled table
+    assert rs._qlen == {"a": (now, 0), "b": (now, 5)}
+    for _ in range(10):
+        assert rs.choose() is r1              # identity keys still correct
+
+
+# ---- tier-hint prefetch ------------------------------------------------------
+
+def test_router_prefetch_hint_gating():
+    """_maybe_prefetch fires the data-plane hint RPC only on a partial
+    match against a kv-tier-backed deployment."""
+    digs = ["aa" * 16, "bb" * 16, "cc" * 16]
+    rs = ReplicaSet(RouterConfig(), "llm")
+    replica = _FakeReplica("r0")
+    self = types.SimpleNamespace(config=RouterConfig())
+
+    # no kv tier behind the deployment -> no hint
+    rs.meta = {"kv_tier": False}
+    Router._maybe_prefetch(self, rs, replica, 1, digs)
+    assert replica.calls == []
+
+    # full local match -> nothing to prefetch
+    rs.meta = {"kv_tier": True}
+    Router._maybe_prefetch(self, rs, replica, 3, digs)
+    assert replica.calls == []
+
+    # partial match + kv tier -> one fire-and-forget hint with the chain
+    Router._maybe_prefetch(self, rs, replica, 1, digs)
+    assert replica.calls == [("handle_request", "prefetch_hint",
+                              (digs,), {})]
+
+    # disabled by config -> silent
+    replica.calls.clear()
+    self.config = RouterConfig(prefetch_hints_enabled=False)
+    Router._maybe_prefetch(self, rs, replica, 1, digs)
+    assert replica.calls == []
+
+
+def test_kv_tier_prefetch_fills_hint_buffer(monkeypatch):
+    """prefetch() pulls the chain tail in the background; fetch_chain then
+    serves those pages from the hint buffer without a remote call."""
+    from ray_tpu.serve.llm.kv_tier import KVTierStore
+
+    ps = 4
+    s = KVTierStore(max_bytes=1 << 20, disk_dir=None, disk_max_bytes=0,
+                    ttl_s=600.0, page_size=ps)
+    rng = np.random.default_rng(7)
+    shape = (2, 2, 3, ps, 8)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    digs = ["%02d" % i * 16 for i in range(3)]
+
+    fetched = []
+
+    def fake_remote(digests, start):
+        fetched.append((list(digests), start))
+        return 3 - start, k[:, :, start:], v[:, :, start:]
+
+    monkeypatch.setattr(s, "_fetch_remote", fake_remote)
+    assert s.prefetch(digs, start=1)
+    deadline = time.monotonic() + 5.0
+    while s.counters["prefetch_pages"] < 2:
+        assert time.monotonic() < deadline, "prefetch never landed"
+        time.sleep(0.01)
+    assert fetched == [(digs, 1)]
+    assert s.stats()["hint_pages"] == 2
+
+    # restore is served from the hint buffer (fake_remote NOT called again)
+    t, gk, gv = s.fetch_chain(digs, start=1)
+    assert t == 2
+    np.testing.assert_array_equal(gk, k[:, :, 1:])
+    np.testing.assert_array_equal(gv, v[:, :, 1:])
+    assert s.counters["prefetch_hit_pages"] == 2
+    assert len(fetched) == 1
+
+    # an all-hinted chain needs no new job
+    assert not s.prefetch(digs, start=1)
+    s.close()
+    assert s.stats()["hint_pages"] == 0
+
+
+def test_engine_prefetch_hint_gated_off_without_tier():
+    from ray_tpu.serve.llm.engine import LLMEngine
+
+    eng = LLMEngine(_tiny_cfg())              # kv_tier_enabled defaults off
+    try:
+        assert eng.prefetch_hint(["aa" * 16]) == {"accepted": False}
+        ver, digs = eng.prefix_summary()
+        assert ver == 0 and digs == []
+    finally:
+        eng.shutdown()
+
+
+def test_engine_reuses_verified_ingress_digests():
+    """_chain_digests trusts the proxy's digests only when page 0 verifies
+    against a local recompute — a tokenizer mismatch falls back to the
+    full recompute instead of restoring another prefix's KV."""
+    from ray_tpu.serve.llm.engine import LLMEngine
+    from ray_tpu.serve.llm import kv_cache as kvc
+
+    cfg = _tiny_cfg()
+    eng = LLMEngine(cfg)
+    try:
+        toks = list(range(40))
+        limit = (len(toks) - 1) // cfg.page_size
+        digest, want = b"", []
+        for i in range(limit):
+            digest = kvc._chain_digest(
+                digest, toks[i * cfg.page_size:(i + 1) * cfg.page_size])
+            want.append(digest.hex())
+
+        assert eng._chain_digests(toks, limit, list(want)) == want
+        # corrupted page 0 -> full recompute, still correct
+        bad = ["00" * 16] + want[1:]
+        assert eng._chain_digests(toks, limit, bad) == want
+        # ingress too short for the range -> recompute
+        assert eng._chain_digests(toks, limit, want[:1]) == want
+        assert eng._chain_digests(toks, limit, None) == want
+    finally:
+        eng.shutdown()
+
+
+# ---- controller -> router summary flow (cluster) ----------------------------
+
+def test_summaries_flow_to_router_and_steer_choice(ray_start_regular):
+    """End to end on a live cluster: the controller collects replica
+    prefix summaries, ships them through the routing long-poll, the
+    router's choose() then pins a shared-prefix request to the replica
+    already holding it. A plain (non-engine) deployment is marked
+    unsupported and never ships meta."""
+    from ray_tpu.serve.controller import get_or_create_controller
+    from ray_tpu.serve.llm import build_openai_app
+
+    cfg = _tiny_cfg(name="llm")
+    serve.run(build_openai_app(cfg, route_prefix="/v1"),
+              name="affapp", route_prefix="/v1")
+
+    @serve.deployment(num_replicas=1)
+    def echo(x):
+        return x
+
+    serve.run(echo.bind(), name="affplain", route_prefix=None)
+
+    ctl = get_or_create_controller()
+    router = Router(ctl, "affapp")
+    plain_router = Router(ctl, "affplain")
+    prompt = "affinity " * 8                  # several full 16-token pages
+    try:
+        out, _ = router.call(
+            "llm", "handle_http",
+            ("/v1/completions", "POST",
+             {"prompt": prompt, "max_tokens": 4}), {}, timeout_s=120)
+        assert out["object"] == "text_completion"
+
+        # summaries arrive via the long-poll (collector tick ~1s); wait
+        # until the summary actually covers the prompt's pages — an early
+        # snapshot may predate the insert
+        digs = None
+        deadline = time.monotonic() + 30.0
+        while True:
+            meta = router.affinity_meta("llm")
+            if meta and digs is None:
+                digs = affinity.compute_prefix_digests(prompt, meta, 64)
+                assert digs, "shared prefix produced no digests"
+            with router._lock:
+                rs = router._sets.get("llm")
+                covered = bool(
+                    rs and digs
+                    and any(digs[0] in s for s in rs._summaries.values()))
+            if covered:
+                break
+            assert time.monotonic() < deadline, \
+                "prefix summaries never reached the router"
+            time.sleep(0.2)
+        assert meta["tokenizer"] == "byte"
+        assert meta["page_size"] == cfg.page_size
+        assert meta["model_id"] == cfg.model_id
+
+        replica, matched = rs.choose_info("", digs)
+        assert matched >= 1, "router failed to match the resident prefix"
+        holder_key = rs._key(replica)
+        assert digs[0] in rs._summaries[holder_key]
+        snap = router.stats_snapshot()
+        assert snap["affinity_hits"] >= 1
+
+        # legacy int-valued known_versions handshake still answers
+        table = ray_tpu.get(ctl.poll_routing_table.remote(
+            "affapp", {"llm": -1}, 5.0), timeout=15)
+        assert table and len(table["llm"]) == 3
+
+        # the plain deployment never grows affinity meta (unsupported)
+        out, _ = plain_router.call("echo", "__call__", (1,), {},
+                                   timeout_s=30)
+        assert out == 1
+        time.sleep(2.5)                       # > collector interval
+        assert plain_router.affinity_meta("echo") == {}
+    finally:
+        router.stop()
+        plain_router.stop()
+        serve.delete("affapp")
+        serve.delete("affplain")
+        serve.shutdown()

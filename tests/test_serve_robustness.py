@@ -195,7 +195,9 @@ def test_router_queue_probe_config_knobs(monkeypatch):
     r1, r2 = _FakeReplica("a", qlen=0), _FakeReplica("b", qlen=5)
     rs.update([r1, r2], 0)
     now = time.monotonic()
-    rs._qlen = {0: (now, 0), 1: (now, 5)}
+    # probe cache is keyed by STABLE replica identity (actor id hex), not
+    # list index — a table reshuffle must not swap cached queue lengths
+    rs._qlen = {"a": (now, 0), "b": (now, 5)}
     monkeypatch.setattr(router_mod.ray_tpu, "get", _no_rpc)
     for _ in range(10):
         assert rs.choose() is r1  # cached lengths decide; no RPC
@@ -431,7 +433,7 @@ def test_router_retry_absorbs_dead_replica(serve_shutdown):
 
         table = ray_tpu.get(ctl.get_routing_table.remote("appretry"),
                             timeout=10)
-        replicas, _version = table["echo"]
+        replicas = table["echo"][0]
         assert len(replicas) == 2
         ray_tpu.kill(replicas[0])
         time.sleep(0.5)  # let the death propagate to submitters
