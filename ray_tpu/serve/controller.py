@@ -18,6 +18,7 @@ import time
 from typing import Any, Optional
 
 import ray_tpu
+from ray_tpu.exceptions import TaskError
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.replica import ServeReplica
 
@@ -217,11 +218,12 @@ class ServeController:
                        known_gen: Optional[int]) -> Optional[dict]:
         """The affinity-summary element of a routing-table entry. None when
         the router already holds this generation (delta shipping: an
-        unchanged fleet costs zero summary bytes per poll) or when nothing
-        has ever been collected (non-LLM deployments)."""
+        unchanged fleet costs zero summary bytes per poll). A deployment
+        with nothing collected (non-LLM) still ships its empty gen-0
+        entry until the router acknowledges the gen — withholding it
+        would pin the router at gen -1, make every poll look changed,
+        and degenerate the long-poll into a hot spin."""
         if known_gen is not None and known_gen == state.summary_gen:
-            return None
-        if not state.summaries and not state.summary_meta:
             return None
         return {"gen": state.summary_gen,
                 "meta": dict(state.summary_meta),
@@ -604,11 +606,15 @@ class ServeController:
                         "prefix_summary", (since,), {}), timeout=2.0), 3.0)
             except asyncio.TimeoutError:
                 return False  # busy replica: retry next round
-            except Exception:  # noqa: BLE001 — no prefix_summary method
-                # (plain deployment) or replica fault: a fault clears on
-                # replacement (the key is pruned), a plain deployment
-                # never grows the method — either way stop probing
-                state.summary_unsupported.add(key)
+            except Exception as e:  # noqa: BLE001
+                # only a proven-missing prefix_summary method (plain
+                # deployment: getattr raises AttributeError, a wrong
+                # signature TypeError) is permanent; any other failure
+                # is a replica fault — transient blips must not exile a
+                # healthy replica from affinity until replacement
+                cause = e.cause if isinstance(e, TaskError) else e
+                if isinstance(cause, (AttributeError, TypeError)):
+                    state.summary_unsupported.add(key)
                 return False
             if not isinstance(res, dict) or not res.get("supported"):
                 state.summary_unsupported.add(key)

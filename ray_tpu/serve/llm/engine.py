@@ -139,6 +139,9 @@ class LLMEngine:
         # pages change WHICH pool pages a slot reads, never the compiled
         # programs or their shapes.
         self._prefix_cache_on = bool(cfg.prefix_cache_enabled)
+        # one-shot log guard: ingress digests disagreeing with the local
+        # recompute (tokenizer skew) warns once, not once per request
+        self._ingress_skew_warned = False
         self.allocator = kvc.PageAllocator(
             cfg.num_pages, cache_pages=cfg.prefix_cache_max_pages)
         self.page_tables = np.zeros((b, self.max_pages_per_seq), np.int32)
@@ -1005,24 +1008,28 @@ class LLMEngine:
 
     def _chain_digests(self, toks, limit: int,
                        ingress: Optional[list]) -> list[str]:
-        """Hex chain digests for the first ``limit`` full pages. Reuses
-        the serve-ingress digests when they cover the range AND page 0
-        verifies against a local recompute — equal chain roots over the
-        same tokens mean the ingress tokenizer matched ours, so the rest
-        of the chain is trustworthy; any mismatch (different tokenizer
-        version, truncation skew) falls back to the full recompute. A
-        wrong digest here would restore another prefix's KV."""
+        """Hex chain digests for the first ``limit`` full pages, always
+        recomputed over this engine's own tokens. Ingress digests are
+        only cross-checked, never trusted: page-0 equality proves the
+        proxy tokenizer agreed on the FIRST page, not on later ones — a
+        version skew past page 0 would name different token content and
+        restore KV that doesn't match the request. The chaining is
+        blake2b over the token ids, microseconds against the cost of a
+        wrong restore."""
         ps = self.cfg.page_size
-        if ingress and len(ingress) >= limit and limit > 0:
-            page0 = self._kvc._chain_digest(b"", toks[:ps]).hex()
-            if ingress[0] == page0:
-                return list(ingress[:limit])
         digest = b""
         digs = []
         for i in range(limit):
             digest = self._kvc._chain_digest(
                 digest, toks[i * ps:(i + 1) * ps])
             digs.append(digest.hex())
+        if ingress and digs and list(ingress[:limit]) != digs \
+                and not self._ingress_skew_warned:
+            self._ingress_skew_warned = True
+            logger.warning(
+                "ingress prefix digests disagree with local recompute "
+                "(proxy/replica tokenizer skew?); affinity hints from "
+                "this proxy will miss — using local digests")
         return digs
 
     def _kv_tier_restore(self, req: _Request, m_loc: int) -> int:

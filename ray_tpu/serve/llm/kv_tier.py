@@ -473,19 +473,23 @@ class KVTierStore:
                 start += 1
             if start >= len(digests):
                 return False
-            self.counters["prefetch_hints"] += 1
-        try:
-            self._prefetch_q.put_nowait((list(digests), start))
-        except queue.Full:
-            with self._lock:
+            try:
+                self._prefetch_q.put_nowait((list(digests), start))
+            except queue.Full:
                 self.counters["prefetch_dropped"] += 1
-            return False
-        t = self._prefetch_thread
-        if t is None or not t.is_alive():
-            t = threading.Thread(target=self._prefetch_loop, daemon=True,
-                                 name="kv-tier-prefetch")
-            self._prefetch_thread = t
-            t.start()
+                return False
+            self.counters["prefetch_hints"] += 1
+            # enqueue and worker-liveness check run under the same lock
+            # as the worker's exit decision in _prefetch_loop: without
+            # this, a hint slipped between the worker's empty-check and
+            # its exit could observe the old thread as alive, start no
+            # replacement, and strand the job until the next hint
+            t = self._prefetch_thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._prefetch_loop,
+                                     daemon=True, name="kv-tier-prefetch")
+                self._prefetch_thread = t
+                t.start()
         return True
 
     def _prefetch_loop(self) -> None:
@@ -512,8 +516,13 @@ class KVTierStore:
             now = _now()
             with self._lock:
                 for i in range(t):
+                    # per-page copies, not views: a view would pin the
+                    # whole fetched chain array alive until every sibling
+                    # page is evicted, so the _HINT_MAX_PAGES cap would
+                    # bound entry count but not bytes
                     self._hints[digests[start + i]] = {
-                        "k": k_np[:, :, i:i + 1], "v": v_np[:, :, i:i + 1],
+                        "k": k_np[:, :, i:i + 1].copy(),
+                        "v": v_np[:, :, i:i + 1].copy(),
                         "ts": now}
                     self._hints.move_to_end(digests[start + i])
                 self.counters["prefetch_pages"] += t

@@ -416,10 +416,11 @@ def test_engine_prefetch_hint_gated_off_without_tier():
         eng.shutdown()
 
 
-def test_engine_reuses_verified_ingress_digests():
-    """_chain_digests trusts the proxy's digests only when page 0 verifies
-    against a local recompute — a tokenizer mismatch falls back to the
-    full recompute instead of restoring another prefix's KV."""
+def test_engine_never_trusts_ingress_digests():
+    """_chain_digests always recomputes over the engine's own tokens —
+    ingress digests are cross-checked only. Page-0 agreement must NOT
+    make later corrupted pages trusted (a tokenizer skew past page 0
+    would otherwise restore KV for different token content)."""
     from ray_tpu.serve.llm.engine import LLMEngine
     from ray_tpu.serve.llm import kv_cache as kvc
 
@@ -435,14 +436,67 @@ def test_engine_reuses_verified_ingress_digests():
             want.append(digest.hex())
 
         assert eng._chain_digests(toks, limit, list(want)) == want
-        # corrupted page 0 -> full recompute, still correct
+        # corrupted page 0 -> recompute wins
         bad = ["00" * 16] + want[1:]
         assert eng._chain_digests(toks, limit, bad) == want
-        # ingress too short for the range -> recompute
+        # page 0 agrees but a LATER page is corrupted (tokenizer skew
+        # past page 0): the local recompute must still win
+        skew = want[:-1] + ["ff" * 16]
+        assert eng._chain_digests(toks, limit, skew) == want
+        # ingress too short for the range / absent -> recompute
         assert eng._chain_digests(toks, limit, want[:1]) == want
         assert eng._chain_digests(toks, limit, None) == want
     finally:
         eng.shutdown()
+
+
+# ---- controller summary handshake (unit) ------------------------------------
+
+def test_summary_entry_ships_empty_gen_for_convergence():
+    """Regression: a deployment with no collected summaries (non-LLM)
+    must still ship its empty gen-0 entry to a router that hasn't
+    acknowledged the gen — withholding it pins the router at gen -1,
+    every poll looks changed, and the long-poll hot-spins."""
+    from ray_tpu.serve.controller import ServeController
+
+    ctl = ServeController._cls()
+    state = types.SimpleNamespace(summary_gen=0, summaries={},
+                                  summary_meta={})
+    empty = {"gen": 0, "meta": {}, "replicas": {}}
+    assert ctl._summary_entry(state, -1) == empty    # router placeholder
+    assert ctl._summary_entry(state, None) == empty  # initial full fetch
+    assert ctl._summary_entry(state, 0) is None      # acked: delta elides
+
+
+def test_probe_fault_does_not_mark_summary_unsupported():
+    """Regression: a transient replica fault during a summary probe must
+    not permanently exclude the replica from affinity summaries — only a
+    proven-missing prefix_summary method (AttributeError/TypeError in
+    the TaskError cause) is terminal."""
+    import asyncio
+
+    from ray_tpu.exceptions import ActorDiedError, TaskError
+    from ray_tpu.serve.controller import ServeController
+
+    def _raising_replica(exc):
+        def _remote(*a, **k):
+            raise exc
+        return types.SimpleNamespace(
+            handle_request=types.SimpleNamespace(remote=_remote))
+
+    ctl = ServeController._cls()
+    faulty = _raising_replica(TaskError(RuntimeError("brief hiccup")))
+    dead = _raising_replica(ActorDiedError())
+    plain = _raising_replica(TaskError(AttributeError("prefix_summary")))
+    state = types.SimpleNamespace(
+        replicas=[faulty, dead, plain], summary_gen=0, summaries={},
+        summary_versions={}, summary_meta={}, summary_unsupported=set())
+    ctl._deployments = {"d": state}
+    asyncio.run(ctl._collect_summaries())
+
+    assert ctl._replica_key(plain) in state.summary_unsupported
+    assert ctl._replica_key(faulty) not in state.summary_unsupported
+    assert ctl._replica_key(dead) not in state.summary_unsupported
 
 
 # ---- controller -> router summary flow (cluster) ----------------------------
@@ -519,6 +573,10 @@ def test_summaries_flow_to_router_and_steer_choice(ray_start_regular):
         assert out == 1
         time.sleep(2.5)                       # > collector interval
         assert plain_router.affinity_meta("echo") == {}
+        # the no-summary deployment still converges the gen handshake
+        # (gen -1 would make every long-poll look changed: hot spin)
+        with plain_router._lock:
+            assert plain_router._sets["echo"].summary_gen == 0
     finally:
         router.stop()
         plain_router.stop()
