@@ -333,7 +333,12 @@ def _run_fleet(args):
         num_pages=256, max_prompt_len=576, max_seq_len=640, max_tokens=8,
         # the tier makes router prefetch hints live (meta kv_tier=true);
         # a small retention cap keeps chains spilling so hints have work
-        kv_tier_enabled=True, prefix_cache_max_pages=64)
+        kv_tier_enabled=True, prefix_cache_max_pages=64,
+        # deliberately unmeetable TTFT SLO + sample-everything: every
+        # measured request becomes a violation exemplar, so the fleet
+        # report can hard-assert a complete ordered critical path
+        # (ingress -> route -> queue -> prefill -> decode) came through
+        slo_ttft_p99_ms=0.1, slo_sample_rate=1.0)
 
     bench_cpus = max(8, (os.cpu_count() or 1))
 
@@ -485,14 +490,64 @@ def _run_fleet(args):
             raise SystemExit(f"fleet [{tag}]: {len(failures)} measured "
                              f"requests failed: {failures[:5]}")
 
-        chaos = None
         if affinity_on:
-            chaos = _fleet_chaos(ctl, app_name, base, mk_prompt, affinity,
-                                 Router, args)
-            row["chaos"] = chaos
+            # pull the tail-latency breakdown BEFORE chaos muddies the
+            # window with kill-induced retries
+            row["slo_attribution"] = _fleet_slo_attribution()
+            row["chaos"] = _fleet_chaos(ctl, app_name, base, mk_prompt,
+                                        affinity, Router, args)
         serve.shutdown()
         ray_tpu.shutdown()
         return row
+
+    def _fleet_slo_attribution() -> dict:
+        """Per-stage tail breakdown + one full violation exemplar from
+        the CP store. The unmeetable TTFT SLO above made every measured
+        request a violation, so an empty store or an incomplete critical
+        path is a HARD failure — stamping that silently drops stages
+        would make the attribution table a lie."""
+        from ray_tpu.observability import attribution
+        from ray_tpu.util import state
+
+        deadline = time.monotonic() + 20.0
+        exemplars = []
+        while time.monotonic() < deadline:
+            exemplars = state.list_slo_exemplars(limit=10, kind="violation")
+            if exemplars:
+                break
+            time.sleep(0.5)
+        if not exemplars:
+            raise SystemExit(
+                "fleet slo: no violation exemplars reached the CP store "
+                "under an unmeetable TTFT SLO — timeline stamping or the "
+                "exemplar shipper is inert")
+        rec = state.get_slo_exemplar(exemplars[0]["request_id"])
+        if rec is None:
+            raise SystemExit("fleet slo: exemplar listed but its full "
+                             "record is missing from the store")
+        names = [s.get("stage") for s in rec.get("stages") or []]
+        for want in ("ingress", "route", "queue", "prefill", "decode"):
+            if want not in names:
+                raise SystemExit(
+                    f"fleet slo: exemplar {rec.get('request_id')} is "
+                    f"missing stage '{want}' (has {names}) — the critical "
+                    f"path is incomplete")
+        ranks = [attribution._STAGE_INDEX[n] for n in names
+                 if n in attribution._STAGE_INDEX]
+        if ranks != sorted(ranks):
+            raise SystemExit(f"fleet slo: exemplar stages out of "
+                             f"canonical order: {names}")
+        report = state.slo_report()
+        return {
+            "records": report.get("count"),
+            "violations": report.get("violations"),
+            "stage_ms": report.get("stage_ms"),
+            "dominant_stage": report.get("dominant_stage"),
+            "replica_skew": report.get("replica_skew"),
+            "exemplar_request_id": rec.get("request_id"),
+            "exemplar_stages": names,
+            "exemplar_ttft_ms": rec.get("ttft_ms"),
+        }
 
     def _fleet_chaos(ctl, app_name, base, mk_prompt, affinity, Router,
                      args):
@@ -586,6 +641,9 @@ def _run_fleet(args):
         "noise_tolerance_ms": tol_ms,
         "improved_outside_noise": improved_ms > tol_ms,
         "chaos": on_row.pop("chaos", None),
+        # per-stage p99 attribution + per-replica skew + the asserted
+        # violation exemplar (ISSUE 12): where the fleet's tail went
+        "slo_attribution": on_row.pop("slo_attribution", None),
     }
     print(json.dumps({"fleet": fleet}))
     if not identical:
@@ -661,6 +719,12 @@ def main():
                     help="A/B the engine phase timers (profiling_enabled "
                          "on vs off) on the headline point; exits nonzero "
                          "if the p50 TTFT overhead exceeds noise")
+    ap.add_argument("--slo-ab", action="store_true",
+                    help="A/B the per-request SLO attribution pipeline "
+                         "(timeline stamping + exemplar shipping) on the "
+                         "headline point: rerun with "
+                         "slo_attribution_enabled=False on a fresh cluster "
+                         "and assert the p50 TTFT delta is within noise")
     ap.add_argument("--metrics-ab", action="store_true",
                     help="A/B the built-in metrics pipeline: rerun the "
                          "headline point with metrics_enabled=False on a "
@@ -710,15 +774,20 @@ def main():
             import sys
             repo = os.path.dirname(os.path.abspath(__file__))
             # affinity unit/integration coverage first: a fleet hit-rate
-            # number from a broken scorer is a lie with a decimal point
+            # number from a broken scorer is a lie with a decimal point.
+            # attribution coverage too: the fleet report now carries the
+            # per-stage tail breakdown, which is only as good as the
+            # timeline stamping + exemplar store it reads from.
             rc = subprocess.run(
                 [sys.executable, "-m", "pytest", "-q",
-                 "tests/test_affinity_routing.py"],
+                 "tests/test_affinity_routing.py",
+                 "tests/test_attribution.py"],
                 cwd=repo,
                 env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
             if rc != 0:
                 sys.exit(f"preflight failed: pytest -q "
-                         f"tests/test_affinity_routing.py exited {rc} "
+                         f"tests/test_affinity_routing.py "
+                         f"tests/test_attribution.py exited {rc} "
                          f"(--no-preflight to override)")
         _run_fleet(args)
         return
@@ -742,6 +811,8 @@ def main():
                      f"the findings, pragma the sites, or regenerate the "
                      f"baseline (--no-preflight to override)")
         preflight_tests = ["tests/test_serve_llm.py"]
+        if args.slo_ab:
+            preflight_tests.append("tests/test_attribution.py")
         if args.spec_ab:
             preflight_tests.append("tests/test_spec_decode.py")
         if args.kv_tier_ab:
@@ -993,6 +1064,47 @@ def main():
             raise SystemExit(
                 f"phase-timer overhead out of bounds: p50 TTFT "
                 f"+{delta_ms}ms with profiling on (tolerance {tol_ms}ms)")
+
+    # SLO-attribution A/B (ISSUE 12): the headline point ran with the
+    # per-request timeline stamping + exemplar shipping on (the default);
+    # rerun it on a fresh cluster with slo_attribution_enabled=False and
+    # bound the p50 TTFT cost of the stamping. Needs a full cluster
+    # restart (system config is fixed at init), like the metrics A/B.
+    # Same noise-sized tolerance: a handful of dict appends per request
+    # is far under cpu-tiny run-to-run spread.
+    slo_overhead = None
+    if args.slo_ab:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=bench_cpus,
+                     _system_config={"slo_attribution_enabled": False})
+        app = build_openai_app(llm_cfg, route_prefix="/v1")
+        serve.run(app, name="llm-bench-noslo", route_prefix="/v1")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}/v1/completions"
+        _post(base, {"prompt": prompt, "max_tokens": 4})
+        _post_stream(base, {"prompt": prompt, "max_tokens": 4})
+        off_row = run_point(args.concurrency, args.requests,
+                            label="slo_attribution_off")
+        points.append(off_row)
+        delta_ms = round(head["p50_ttft_ms"] - off_row["p50_ttft_ms"], 2)
+        tol_ms = round(max(0.25 * off_row["p50_ttft_ms"], 30.0), 2)
+        slo_overhead = {
+            "attribution_on": {k: head[k] for k in
+                               ("p50_ttft_ms", "p90_ttft_ms", "req_per_s",
+                                "proxy_cpu_share")},
+            "attribution_off": {k: off_row[k] for k in
+                                ("p50_ttft_ms", "p90_ttft_ms", "req_per_s",
+                                 "proxy_cpu_share")},
+            "p50_delta_ms": delta_ms,
+            "tolerance_ms": tol_ms,
+            "within_noise": delta_ms <= tol_ms,
+        }
+        if not slo_overhead["within_noise"]:
+            print(json.dumps({"slo_overhead": slo_overhead}))
+            raise SystemExit(
+                f"SLO attribution overhead out of bounds: p50 TTFT "
+                f"+{delta_ms}ms with stamping on (tolerance {tol_ms}ms)")
 
     # shared_prefix_1024: every request carries the same 1024-token prefix
     # (system prompt) plus a short unique suffix — the workload automatic
@@ -1304,6 +1416,8 @@ def main():
         result["extra"]["metrics_overhead"] = metrics_overhead
     if profiling_overhead is not None:
         result["extra"]["profiling_overhead"] = profiling_overhead
+    if slo_overhead is not None:
+        result["extra"]["slo_overhead"] = slo_overhead
     mergeable = {"prefix_cache": prefix_cache, "spec_decode": spec_decode,
                  "kv_tier": kv_tier}
     mergeable = {k: v for k, v in mergeable.items() if v is not None}

@@ -163,6 +163,13 @@ class Config:
     # control-plane trace store: evict whole oldest traces past this
     # total span count (bounded ring, ref: GcsTaskManager's bounded sink)
     trace_store_max_spans: int = 50000
+    # Critical-path attribution (observability/attribution.py): per-request
+    # stage timelines stamped at the proxy/router/engine; SLO-violating
+    # requests persist full timelines to the CP exemplar store. Stamping is
+    # host-side dict appends (A/B-bounded by `bench_serve.py --slo-ab`).
+    slo_attribution_enabled: bool = True
+    # CP exemplar store cap: oldest records evict first past this
+    slo_exemplar_max_records: int = 512
     # Metrics pipeline (util/metrics.py MetricsFlusher → CP TimeSeriesStore).
     # Every worker/driver/node-agent process runs one background flusher
     # pushing delta snapshots on this period (plus once on clean shutdown).

@@ -161,6 +161,11 @@ class ControlPlane:
         self._trace_meta: dict[str, dict] = {}         # trace_id -> summary
         self._trace_order: list[str] = []              # insertion order
         self._trace_span_count = 0
+        # SLO exemplar store (observability/attribution.py): full
+        # critical-path timelines of SLO-violating requests plus a sampled
+        # baseline, append order = age; oldest evicted past
+        # slo_exemplar_max_records and on owner death (worker/node GC)
+        self._slo_exemplars: list[dict] = []
         # time-series store (util/metrics.py flusher sink; Monarch-shaped:
         # per-series bounded ring, delta reports accumulated CP-side into
         # cumulative points so queries never re-derive counter state)
@@ -811,6 +816,93 @@ class ControlPlane:
                      if t in self._trace_meta]
         return metas[:limit]
 
+    # ---- SLO exemplar store (observability/attribution.py sink) --------
+    def _h_report_slo_exemplar(self, body):
+        """Persist one request's critical-path timeline. Bounded: oldest
+        records (and their `slo_exemplar:` KV keys) evict first past
+        slo_exemplar_max_records; reports from retracted workers are
+        rejected like late metric flushes."""
+        import json as _json
+        rec = (body or {}).get("record")
+        if not isinstance(rec, dict) or not rec.get("request_id"):
+            return {"ok": False, "error": "malformed record"}
+        source = rec.get("source") or ""
+        with self._lock:
+            if source and source in self._dead_workers:
+                return {"ok": False, "error": "source retracted"}
+            self._slo_exemplars.append(rec)
+            cap = max(1, get_config().slo_exemplar_max_records)
+            while len(self._slo_exemplars) > cap:
+                old = self._slo_exemplars.pop(0)
+                self._h_kv_del(
+                    {"key": f"slo_exemplar:{old.get('request_id')}"})
+            # KV index entry: summary queryable via kv_keys, retracted
+            # with the record (RLock: _h_kv_put re-enters safely)
+            self._h_kv_put({
+                "key": f"slo_exemplar:{rec['request_id']}",
+                "value": _json.dumps({
+                    "request_id": rec.get("request_id"),
+                    "kind": rec.get("kind"),
+                    "violated": rec.get("violated"),
+                    "deployment": rec.get("deployment"),
+                    "replica": rec.get("replica"),
+                    "ttft_ms": rec.get("ttft_ms"),
+                    "e2e_ms": rec.get("e2e_ms"),
+                    "ts": rec.get("ts")}).encode()})
+        return {"ok": True}
+
+    def _h_list_slo_exemplars(self, body):
+        """Summaries, newest first; `kind` filters violation/baseline."""
+        body = body or {}
+        limit = body.get("limit", 50)
+        kind = body.get("kind")
+        with self._lock:
+            recs = [r for r in reversed(self._slo_exemplars)
+                    if kind is None or r.get("kind") == kind]
+        return [{k: r.get(k) for k in
+                 ("request_id", "ts", "app", "deployment", "replica",
+                  "kind", "violated", "ttft_ms", "e2e_ms", "error")}
+                for r in recs[:limit]]
+
+    def _h_get_slo_exemplar(self, body):
+        """One full exemplar record by request id (prefix ok, newest
+        match wins — retries re-ship under the same id)."""
+        rid = (body or {}).get("request_id") or ""
+        with self._lock:
+            for r in reversed(self._slo_exemplars):
+                if r.get("request_id", "").startswith(rid):
+                    return dict(r)
+        return None
+
+    def _h_slo_report(self, body):
+        """Fleet tail-latency breakdown over the stored exemplars:
+        per-stage percentiles, dominant-stage attribution for the tail,
+        per-replica skew (attribution.aggregate_report)."""
+        from ray_tpu.observability import attribution as _attr
+        deployment = (body or {}).get("deployment")
+        with self._lock:
+            recs = [dict(r) for r in self._slo_exemplars
+                    if deployment is None
+                    or r.get("deployment") == deployment]
+        return _attr.aggregate_report(recs)
+
+    def _retract_slo_exemplars_locked(self, whex: str) -> None:
+        """Drop every exemplar shipped by a dead worker (caller holds
+        self._lock; same discipline as _retract_metrics_source) — its
+        `slo_exemplar:` KV keys go with it, unless a surviving proxy
+        re-shipped the same request id."""
+        keep, gone = [], []
+        for r in self._slo_exemplars:
+            (gone if r.get("source") == whex else keep).append(r)
+        if not gone:
+            return
+        self._slo_exemplars = keep
+        live = {r.get("request_id") for r in keep}
+        for r in gone:
+            rid = r.get("request_id")
+            if rid not in live:
+                self._h_kv_del({"key": f"slo_exemplar:{rid}"})
+
     # ---- metrics time-series store (util/metrics.py flusher sink) ------
     def _h_metrics_report(self, body):
         """Accept one delta snapshot from a process flusher. Counters and
@@ -1209,6 +1301,9 @@ class ControlPlane:
                 # probing the tier index must miss instead of fetching
                 # a dead worker's object refs
                 self._retract_kv_tier_locked(whex=whex)
+                # and its SLO exemplars: a dead proxy/replica process must
+                # not keep serving stale slow-request timelines
+                self._retract_slo_exemplars_locked(whex)
         aid = body.get("actor_id")
         if aid is not None:
             self._on_actor_down(aid, body.get("reason", "worker died"), clean=False)
@@ -1697,6 +1792,7 @@ class ControlPlane:
                 self._retract_metrics_source(src)
                 if not src.startswith("node:"):
                     self._dead_workers.add(src)
+                    self._retract_slo_exemplars_locked(src)
             # every kv_tier entry spilled from this node is unservable
             self._retract_kv_tier_locked(nhex=nhex)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)

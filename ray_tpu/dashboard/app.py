@@ -90,7 +90,7 @@ async function refresh() {
     sparkline(ts, "memory_percent_avg", "cluster mem %") +
     sparkline(ts, "logical_cpus_in_use", "logical CPUs in use") +
     sparkline(ts, "object_store_used_bytes", "object store bytes");
-  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks", "traces", "kvtier"];
+  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks", "traces", "kvtier", "slo"];
   let html = "";
   for (const s of sections) {
     const rows = await (await fetch("/api/" + s)).json();
@@ -107,6 +107,10 @@ async function refresh() {
           }
           if (s === "traces" && c === "trace_id" && typeof r[c] === "string") {
             cell = "<a href='/trace/" + encodeURIComponent(r[c]) + "'>" +
+                   cell + "</a>";
+          }
+          if (s === "slo" && c === "request_id" && typeof r[c] === "string") {
+            cell = "<a href='/slo/" + encodeURIComponent(r[c]) + "'>" +
                    cell + "</a>";
           }
           return "<td>" + cell + "</td>";
@@ -441,6 +445,8 @@ class Dashboard:
         app.router.add_get("/profiling", self._profiling_view)
         app.router.add_get("/api/trace/{trace_id}", self._trace_detail)
         app.router.add_get("/trace/{trace_id}", self._trace_view)
+        app.router.add_get("/api/slo/report", self._slo_report)
+        app.router.add_get("/slo/{request_id}", self._slo_exemplar_view)
         app.router.add_get("/api/metrics/query", self._metrics_query)
         app.router.add_get("/api/metrics/series", self._metrics_series)
         app.router.add_get("/api/{section}", self._api)
@@ -557,6 +563,11 @@ class Dashboard:
                 return _serve_apps()
             if section == "traces":
                 return state.list_traces(limit=100)
+            if section == "slo":
+                # SLO exemplar summaries (same CP query `ray-tpu slo
+                # --exemplars` renders); request_id cells link to the
+                # per-request stage waterfall at /slo/<request_id>
+                return state.list_slo_exemplars(limit=100)
             if section == "kvtier":
                 # tiered-KV prefix index rows (same CP query `ray-tpu
                 # kvtier` renders); the generic section loop tables them
@@ -643,6 +654,49 @@ class Dashboard:
             return web.Response(status=404,
                                 text=f"unknown trace {trace_id}")
         return web.Response(text=_render_waterfall(data),
+                            content_type="text/html")
+
+    async def _slo_report(self, request):
+        """Fleet tail-latency breakdown: per-stage percentiles, dominant
+        stage, per-replica skew (same aggregation `ray-tpu slo` prints).
+        Optional ?deployment=<name> filter."""
+        from aiohttp import web
+
+        deployment = request.query.get("deployment")
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.util import state
+            return state.slo_report(deployment=deployment)
+
+        return web.json_response(
+            _hexify(await loop.run_in_executor(None, fetch)))
+
+    async def _slo_exemplar_view(self, request):
+        """Per-request critical-path waterfall: the stored SLO exemplar's
+        stage timeline rendered through the same waterfall renderer the
+        trace view uses (stages become child spans of one root)."""
+        from aiohttp import web
+
+        rid = request.match_info["request_id"]
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.util import state
+            return state.get_slo_exemplar(rid)
+
+        rec = await loop.run_in_executor(None, fetch)
+        if rec is None:
+            return web.Response(status=404,
+                                text=f"unknown exemplar {rid}")
+        from ray_tpu.observability import attribution
+        kind = rec.get("kind", "?")
+        label = (f"request {rec.get('request_id', rid)} [{kind}"
+                 f"{', violated: ' + ','.join(rec['violated']) if rec.get('violated') else ''}]")
+        trace = {"spans": attribution.stages_to_spans(rec),
+                 "meta": {"name": label},
+                 "trace_id": rec.get("trace_id") or rec.get("request_id", rid)}
+        return web.Response(text=_render_waterfall(trace),
                             content_type="text/html")
 
     async def _profile(self, request):
@@ -788,8 +842,8 @@ def _render_profiling(apps: list[dict], artifacts: list[dict]) -> str:
     import html as _html
     import time as _time
 
-    phase_keys = ["admit", "prefill", "chunk_prefill", "decode_dispatch",
-                  "verify_dispatch", "harvest"]
+    phase_keys = ["queue_wait", "admit", "prefill", "chunk_prefill",
+                  "decode_dispatch", "verify_dispatch", "harvest"]
     scalar_keys = ["itl_s", "compile_events", "mid_traffic_compiles",
                    "compile_s", "kv_page_occupancy", "weights_bytes",
                    "kv_pool_bytes", "device_bytes_in_use"]

@@ -48,8 +48,8 @@ logger = logging.getLogger(__name__)
 
 # engine phases, in loop order (the drift-guard test and README table key
 # off this tuple — extend it and both follow)
-PHASES = ("admit", "prefill", "chunk_prefill", "decode_dispatch",
-          "verify_dispatch", "harvest")
+PHASES = ("queue_wait", "admit", "prefill", "chunk_prefill",
+          "decode_dispatch", "verify_dispatch", "harvest")
 
 _PHASE_BOUNDS = (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03,
                  0.1, 0.3, 1.0, 3.0, 10.0)

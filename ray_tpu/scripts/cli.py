@@ -419,6 +419,103 @@ def cmd_kvtier(args) -> None:
           f"hit_pages={c.get('hit_pages', 0)}", file=sys.stderr)
 
 
+def cmd_slo(args) -> None:
+    """Tail-latency attribution (ISSUE 12): per-stage breakdown table,
+    exemplar listing, one-exemplar waterfall, per-replica skew."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_read_address(args.address))
+
+    if args.exemplar:
+        rec = state.get_slo_exemplar(args.exemplar)
+        if rec is None:
+            print(f"no exemplar matching {args.exemplar!r}", file=sys.stderr)
+            raise SystemExit(1)
+        if args.json:
+            print(json.dumps(rec, indent=2))
+            return
+        from ray_tpu.observability import attribution, tracing
+        spans = attribution.stages_to_spans(rec)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(tracing.to_chrome_trace(spans), f)
+            print(f"chrome trace written to {args.out} "
+                  f"(load in chrome://tracing or Perfetto)", file=sys.stderr)
+            return
+        _print_exemplar_waterfall(rec, spans)
+        return
+
+    if args.exemplars:
+        rows = state.list_slo_exemplars(limit=args.limit, kind=args.kind)
+        if args.json:
+            print(json.dumps(rows, indent=2))
+            return
+        for r in rows:
+            print(json.dumps(r))
+        print(f"# {len(rows)} exemplar(s); `ray-tpu slo --exemplar <id>` "
+              f"renders one waterfall", file=sys.stderr)
+        return
+
+    report = state.slo_report(deployment=args.deployment)
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return
+    print(f"# {report.get('count', 0)} exemplar(s), "
+          f"{report.get('violations', 0)} SLO violation(s)",
+          file=sys.stderr)
+    stage_ms = report.get("stage_ms") or {}
+    if stage_ms:
+        print(f"{'stage':<10} {'p50_ms':>10} {'p95_ms':>10} "
+              f"{'p99_ms':>10} {'count':>7}")
+        for stage, row in stage_ms.items():
+            print(f"{stage:<10} {row['p50']:>10.2f} {row['p95']:>10.2f} "
+                  f"{row['p99']:>10.2f} {row['count']:>7}")
+    dom = report.get("dominant_stage") or {}
+    if dom:
+        ranked = sorted(dom.items(), key=lambda kv: kv[1], reverse=True)
+        print("# dominant stage of tail requests: "
+              + ", ".join(f"{s}={n}" for s, n in ranked), file=sys.stderr)
+    if args.replica_skew or not stage_ms:
+        skew = report.get("replica_skew") or {}
+        if skew:
+            print(f"{'replica':<14} {'count':>6} {'qwait_p50':>10} "
+                  f"{'qwait_p95':>10} {'hit_share':>10} {'prefilled':>10}")
+            for rep, row in sorted(skew.items()):
+                print(f"{rep:<14} {row['count']:>6} "
+                      f"{row['queue_wait_p50_ms']:>10.2f} "
+                      f"{row['queue_wait_p95_ms']:>10.2f} "
+                      f"{row['affinity_hit_share']:>10.2f} "
+                      f"{row['prefilled_tokens']:>10}")
+
+
+def _print_exemplar_waterfall(rec: dict, spans: list) -> None:
+    """Text waterfall of one exemplar's stage timeline (the PR 1 trace
+    span shapes, so the bar math matches `ray-tpu trace`)."""
+    stages = [s for s in spans if s.get("parent_id")]
+    if not stages:
+        print("(no stages recorded)", file=sys.stderr)
+        return
+    t_min = min(s["start"] for s in stages)
+    t_max = max(s["end"] for s in stages)
+    span_total = max(t_max - t_min, 1e-9)
+    width = 40
+    head = (f"request {rec.get('request_id')} kind={rec.get('kind')} "
+            f"violated={','.join(rec.get('violated') or []) or '-'} "
+            f"replica={rec.get('replica') or '-'} "
+            f"ttft_ms={rec.get('ttft_ms')} e2e_ms={rec.get('e2e_ms')}")
+    print(f"# {head}", file=sys.stderr)
+    for s in stages:
+        off = int((s["start"] - t_min) / span_total * width)
+        ln = max(1, int((s["end"] - s["start"]) / span_total * width))
+        bar = " " * off + "█" * min(ln, width - off)
+        dur_ms = (s["end"] - s["start"]) * 1e3
+        attrs = s.get("attrs") or {}
+        note = " ".join(f"{k}={v}" for k, v in attrs.items())
+        print(f"{s['name'][6:]:<10} |{bar:<{width}}| "
+              f"{dur_ms:>9.2f} ms  {note}")
+
+
 def _parse_tags(spec: str | None) -> dict | None:
     tags = _parse_labels(spec)
     return tags or None
@@ -598,6 +695,30 @@ def main(argv=None) -> None:
     sp.add_argument("--json", action="store_true",
                     help="print the raw index document instead of rows")
     sp.set_defaults(fn=cmd_kvtier)
+
+    sp = sub.add_parser(
+        "slo",
+        help="tail-latency attribution: per-stage breakdown, SLO "
+             "exemplars, per-replica skew (observability/attribution.py)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--deployment", default=None,
+                    help="restrict the breakdown to one deployment")
+    sp.add_argument("--exemplars", action="store_true",
+                    help="list stored exemplar summaries (newest first)")
+    sp.add_argument("--exemplar", default=None, metavar="REQUEST_ID",
+                    help="render one exemplar's stage waterfall "
+                         "(X-Request-Id, prefix ok)")
+    sp.add_argument("--kind", default=None,
+                    choices=("violation", "baseline"),
+                    help="filter --exemplars by kind")
+    sp.add_argument("--limit", type=int, default=50)
+    sp.add_argument("--replica-skew", action="store_true",
+                    help="also print the per-replica skew table")
+    sp.add_argument("--out", default=None,
+                    help="with --exemplar: write a chrome-trace JSON "
+                         "instead of the text waterfall")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_slo)
 
     sp = sub.add_parser(
         "lint",

@@ -60,6 +60,9 @@ class Deployment:
                 health_check_period_s: Optional[float] = None,
                 health_check_failure_threshold: Optional[int] = None,
                 request_timeout_s: Optional[float] = None,
+                slo_ttft_p99_ms: Optional[float] = None,
+                slo_e2e_p99_ms: Optional[float] = None,
+                slo_sample_rate: Optional[float] = None,
                 graceful_shutdown_timeout_s: Optional[float] = None) -> "Deployment":
         import copy
         cfg = copy.deepcopy(self.config)
@@ -84,6 +87,12 @@ class Deployment:
             cfg.health_check_failure_threshold = health_check_failure_threshold
         if request_timeout_s is not None:
             cfg.request_timeout_s = request_timeout_s
+        if slo_ttft_p99_ms is not None:
+            cfg.slo_ttft_p99_ms = slo_ttft_p99_ms
+        if slo_e2e_p99_ms is not None:
+            cfg.slo_e2e_p99_ms = slo_e2e_p99_ms
+        if slo_sample_rate is not None:
+            cfg.slo_sample_rate = slo_sample_rate
         if graceful_shutdown_timeout_s is not None:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         return Deployment(
@@ -108,6 +117,9 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                health_check_timeout_s: float = 30.0,
                health_check_failure_threshold: int = 3,
                request_timeout_s: Optional[float] = None,
+               slo_ttft_p99_ms: Optional[float] = None,
+               slo_e2e_p99_ms: Optional[float] = None,
+               slo_sample_rate: float = 0.01,
                graceful_shutdown_timeout_s: float = 20.0):
     """@serve.deployment decorator (reference api.py:333)."""
 
@@ -119,6 +131,9 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             health_check_timeout_s=health_check_timeout_s,
             health_check_failure_threshold=health_check_failure_threshold,
             request_timeout_s=request_timeout_s,
+            slo_ttft_p99_ms=slo_ttft_p99_ms,
+            slo_e2e_p99_ms=slo_e2e_p99_ms,
+            slo_sample_rate=slo_sample_rate,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=ray_actor_options or {})
         if num_replicas == "auto":

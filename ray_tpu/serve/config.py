@@ -90,6 +90,17 @@ class DeploymentConfig:
     # client sends no X-Request-Deadline/X-Request-Timeout-S header; None
     # falls back to the global `serve_request_timeout_s` config flag
     request_timeout_s: Optional[float] = None
+    # ---- SLO policy (ISSUE 12) -----------------------------------------
+    # Per-deployment latency objectives. Requests that violate either get
+    # their full critical-path timeline persisted to the control-plane
+    # exemplar store (observability/attribution.py); None disables the
+    # check. Names carry the intent ("this is the p99 target") — each
+    # REQUEST is compared against the value.
+    slo_ttft_p99_ms: Optional[float] = None
+    slo_e2e_p99_ms: Optional[float] = None
+    # fraction of non-violating requests shipped as baseline exemplars
+    # for contrast in the fleet breakdown
+    slo_sample_rate: float = 0.01
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
 
     def target_replicas(self) -> int:

@@ -307,6 +307,23 @@ class ServeController:
             return None
         return getattr(state.config, "request_timeout_s", None)
 
+    async def get_slo_policy(self, app_name: str,
+                             deployment: str) -> Optional[dict]:
+        """Deployment's SLO policy for the proxy's critical-path
+        attribution (None = unknown deployment; all-None values = no
+        objectives configured, baseline sampling only)."""
+        self._ensure_started()
+        state = self._deployments.get(f"{app_name}#{deployment}")
+        if state is None:
+            return None
+        return {
+            "slo_ttft_p99_ms": getattr(state.config, "slo_ttft_p99_ms",
+                                       None),
+            "slo_e2e_p99_ms": getattr(state.config, "slo_e2e_p99_ms", None),
+            "slo_sample_rate": getattr(state.config, "slo_sample_rate",
+                                       0.01),
+        }
+
     async def ingress_has_http_dispatch(self, app_name: str,
                                         deployment: str) -> bool:
         """Does the ingress class define handle_http(path, method, payload)?
@@ -373,9 +390,9 @@ class ServeController:
                         "kv_page_occupancy", "device_bytes_in_use",
                         "device_peak_bytes") + tuple(
                             f"phase_{p}_{q}_ms"
-                            for p in ("admit", "prefill", "chunk_prefill",
-                                      "decode_dispatch", "verify_dispatch",
-                                      "harvest")
+                            for p in ("queue_wait", "admit", "prefill",
+                                      "chunk_prefill", "decode_dispatch",
+                                      "verify_dispatch", "harvest")
                             for q in ("p50", "p95"))
 
         async def probe_engine(replica):

@@ -132,6 +132,13 @@ class LLMConfig:
     name: str = "llm"
     ray_actor_options: Optional[dict] = None  # e.g. {"resources": {"TPU": 1}}
 
+    # SLO policy (ISSUE 12): threaded onto the serve DeploymentConfig so
+    # the proxy captures critical-path exemplars for requests that blow
+    # the objective (observability/attribution.py). None = no check.
+    slo_ttft_p99_ms: Optional[float] = None
+    slo_e2e_p99_ms: Optional[float] = None
+    slo_sample_rate: float = 0.01
+
     def llama(self):
         from ray_tpu.models import llama
         if self.model_config is not None:

@@ -70,6 +70,14 @@ class _Request:
     done: bool = False
     error: Optional[str] = None
     submitted_at: float = field(default_factory=time.monotonic)
+    # set under the lock when _admit pops this request off _waiting; the
+    # submit→admit gap is the queue wait surfaced in result()/engine_stats
+    admitted_at: Optional[float] = None
+    # KV-tier restore accounting (ISSUE 12 attribution): tokens whose KV
+    # came back from the tier, payload size, and the blocking restore time
+    restored_tokens: int = 0
+    restore_bytes: int = 0
+    restore_ms: float = 0.0
     first_token_at: Optional[float] = None
     # inter-token latency: host record-time of the last token plus the
     # per-token gaps (pipelined harvests record blocks in bursts, so the
@@ -641,8 +649,15 @@ class LLMEngine:
             if done and req.drained_upto >= len(req.generated):
                 # fully drained: allow GC
                 self._requests.pop(request_id, None)
-        return {"tokens": new, "text": self.tokenizer.decode(new),
-                "done": done, "error": err}
+        out = {"tokens": new, "text": self.tokenizer.decode(new),
+               "done": done, "error": err}
+        if done:
+            # final chunk carries the per-request attribution (queue wait +
+            # engine stage timeline) so the streaming path surfaces the
+            # same critical-path record as result(). Built OUTSIDE the
+            # lock — pure host computation, but no reason to hold it.
+            out.update(self._attribution_payload(req))
+        return out
 
     def result(self, request_id: str, timeout: Optional[float] = None) -> dict:
         """Block until the request completes; returns the full completion.
@@ -671,7 +686,7 @@ class LLMEngine:
         ttft = (req.first_token_at - req.submitted_at
                 if req.first_token_at else None)
         gaps = sorted(req.itl_gaps)
-        return {
+        out = {
             "text": self.tokenizer.decode(req.generated),
             "tokens": list(req.generated),
             "num_prompt_tokens": len(req.prompt_tokens),
@@ -684,6 +699,35 @@ class LLMEngine:
             "itl_s": gaps[len(gaps) // 2] if gaps else None,
             "latency_s": (req.finished_at or time.monotonic())
             - req.submitted_at,
+        }
+        out.update(self._attribution_payload(req))
+        return out
+
+    def _attribution_payload(self, req: _Request) -> dict:
+        """Per-request critical-path extras (ISSUE 12): queue wait plus
+        the engine-side stage timeline, carried in the response metadata
+        back to the proxy (different process — stamps can't ride a
+        contextvar across the wire)."""
+        from ray_tpu.observability import attribution
+        gaps = sorted(req.itl_gaps)
+        queue_wait = ((req.admitted_at - req.submitted_at)
+                      if req.admitted_at is not None else None)
+        return {
+            "request_id": req.request_id,
+            "queue_wait_s": queue_wait,
+            "stages": attribution.engine_stages(
+                submitted_wall=req.submitted_wall,
+                submitted_at=req.submitted_at,
+                admitted_at=req.admitted_at,
+                first_token_at=req.first_token_at,
+                finished_at=req.finished_at,
+                cached_tokens=req.cached_tokens,
+                restored_tokens=req.restored_tokens,
+                restore_bytes=req.restore_bytes,
+                restore_ms=req.restore_ms,
+                prompt_tokens=len(req.prompt_tokens),
+                generated_tokens=len(req.generated),
+                itl_s=gaps[len(gaps) // 2] if gaps else None),
         }
 
     def generate(self, prompt: str, **kw) -> dict:
@@ -919,6 +963,7 @@ class LLMEngine:
                 self._waiting.pop(0)
                 slot = self.free_slots.pop()
                 req.slot = slot
+                req.admitted_at = time.monotonic()
                 req.pages = matched + pages
                 req.cached_tokens = len(matched) * self.cfg.page_size
                 req.prefill_pos = req.cached_tokens
@@ -927,6 +972,11 @@ class LLMEngine:
                     key = "prefix_hits" if matched else "prefix_misses"
                     self.stats[key] += 1
                     self.stats["prefix_hit_tokens"] += req.cached_tokens
+            # queue-wait phase sample (submit→admit), recorded OUTSIDE the
+            # lock: the profiler observes a metrics histogram, which must
+            # never run under the engine lock (graftlint lock-discipline)
+            self._prof.record("queue_wait",
+                              req.admitted_at - req.submitted_at)
             if self._kv_tier_on:
                 # extend the match past the local index into the KV tier:
                 # restored pages scatter into this request's fresh pages
@@ -1038,6 +1088,7 @@ class LLMEngine:
         remote hits fetch through the object plane via the CP index.
         Returns restored page count; ANY failure degrades to a plain
         miss (the pages just get prefilled normally)."""
+        t0 = time.perf_counter()
         try:
             ps = self.cfg.page_size
             toks = req.prompt_tokens
@@ -1068,6 +1119,10 @@ class LLMEngine:
                     pages_vec)
             req.cached_tokens = (m_loc + t) * ps
             req.prefill_pos = req.cached_tokens
+            req.restored_tokens = t * ps
+            req.restore_bytes = int(k_np[:, :, :t].nbytes
+                                    + v_np[:, :, :t].nbytes)
+            req.restore_ms = (time.perf_counter() - t0) * 1e3
             self.stats["restored_pages"] += t
             self.stats["tier_hit_tokens"] += t * ps
             return t

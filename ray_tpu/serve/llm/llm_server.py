@@ -137,6 +137,12 @@ class LLMServer:
         digests = affinity.get_request_prefix_digests()
         if digests:
             out["prefix_digests"] = digests
+        # Proxy-assigned X-Request-Id (ISSUE 12): reuse it as the engine
+        # request id so the exemplar/timeline and client logs correlate.
+        from ray_tpu.observability import attribution
+        rid = attribution.get_request_id()
+        if rid:
+            out["request_id"] = rid
         return out
 
     def _completion_response(self, out: dict, chat: bool) -> dict:
@@ -158,9 +164,13 @@ class LLMServer:
                 "total_tokens": out.get("num_prompt_tokens", 0)
                 + out.get("num_generated_tokens", 0),
             },
-            # engine-side timing (bench harness reads these)
+            # engine-side timing + critical-path attribution (the bench
+            # harness and the proxy's SLO finalizer read these)
             "ray_tpu": {"ttft_s": out.get("ttft_s"),
-                        "latency_s": out.get("latency_s")},
+                        "latency_s": out.get("latency_s"),
+                        "queue_wait_s": out.get("queue_wait_s"),
+                        "request_id": out.get("request_id"),
+                        "stages": out.get("stages") or []},
         }
 
     async def _stream_completion(self, prompt: str, params: dict, chat: bool):
@@ -214,7 +224,11 @@ class LLMServer:
                                        "total_tokens": n_prompt + ntok},
                              "ray_tpu": {"ttft_s": ttft,
                                          "latency_s":
-                                         _time.monotonic() - t0}}
+                                         _time.monotonic() - t0,
+                                         "queue_wait_s":
+                                         d.get("queue_wait_s"),
+                                         "request_id": d.get("request_id"),
+                                         "stages": d.get("stages") or []}}
                     if err:
                         final["error"] = {"message": str(err)}
                     yield final
@@ -305,6 +319,9 @@ def build_llm_deployment(llm_config: LLMConfig, *, name: Optional[str] = None):
         num_replicas=llm_config.num_replicas,
         max_ongoing_requests=4 * llm_config.max_batch_size,
         ray_actor_options=dict(llm_config.ray_actor_options or {}),
+        slo_ttft_p99_ms=llm_config.slo_ttft_p99_ms,
+        slo_e2e_p99_ms=llm_config.slo_e2e_p99_ms,
+        slo_sample_rate=llm_config.slo_sample_rate,
         # first requests compile XLA programs for minutes on TPU; don't let
         # routine health checking kill the replica mid-compile
         health_check_timeout_s=600.0,

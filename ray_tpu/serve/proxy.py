@@ -23,15 +23,17 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import json
+import random
 import threading
 import time
+import uuid
 from typing import Optional
 
 import ray_tpu
 from ray_tpu.core import deadline as request_deadline
 from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import DeadlineExceededError, TaskError
-from ray_tpu.observability import tracing
+from ray_tpu.observability import attribution, tracing
 from ray_tpu.serve import affinity as _affinity
 from ray_tpu.serve.config import RouterConfig
 from ray_tpu.serve.router import Router
@@ -68,6 +70,7 @@ class HTTPProxy:
         self._routers: dict[str, Router] = {}
         self._http_dispatch: dict[tuple, bool] = {}
         self._req_timeout: dict[tuple, Optional[float]] = {}
+        self._slo_policies: dict[tuple, Optional[dict]] = {}
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
@@ -183,13 +186,16 @@ class HTTPProxy:
 
     def _error_response(self, status: int, message: str, path: str, *,
                         retry_after: Optional[int] = None,
-                        error_type: str = "service_unavailable"):
+                        error_type: str = "service_unavailable",
+                        rid: str = ""):
         """503s carry Retry-After; /v1 routes (OpenAI surface) get the
         OpenAI error envelope instead of bare text."""
         from aiohttp import web
         headers = {}
         if retry_after is not None:
             headers["Retry-After"] = str(retry_after)
+        if rid:
+            headers["X-Request-Id"] = rid
         if "/v1/" in path or path.rstrip("/").endswith("/v1"):
             return web.json_response(
                 {"error": {"message": message, "type": error_type,
@@ -235,6 +241,67 @@ class HTTPProxy:
                 return None
         return self._req_timeout[key]
 
+    def _slo_policy(self, app_name: str,
+                    deployment: str) -> Optional[dict]:
+        """Deployment SLO policy ({slo_ttft_p99_ms, slo_e2e_p99_ms,
+        slo_sample_rate}) behind the same one-RPC-per-deployment cache
+        discipline as _request_timeout."""
+        key = (app_name, deployment)
+        if key not in self._slo_policies:
+            try:
+                self._slo_policies[key] = ray_tpu.get(
+                    self._controller.get_slo_policy.remote(
+                        app_name, deployment), timeout=5.0)
+            except Exception:  # noqa: BLE001 — controller away: no policy
+                # for THIS request, cache not poisoned
+                return None
+        return self._slo_policies[key]
+
+    def _admission_info(self, request, app_name: str, deployment: str):
+        """One executor hop for the per-request control-plane lookups:
+        deadline derivation + SLO policy (both cached after first use)."""
+        dl = self._derive_deadline(request, app_name, deployment)
+        policy = (self._slo_policy(app_name, deployment)
+                  if get_config().slo_attribution_enabled else None)
+        return dl, policy
+
+    def _finalize_slo(self, tl, policy: Optional[dict], *,
+                      ttft_ms: Optional[float], e2e_ms: Optional[float],
+                      engine_meta: Optional[dict],
+                      error: Optional[str] = None) -> None:
+        """Join the proxy/router stamps with the engine's stage report,
+        judge the request against the deployment SLO, and hand violators
+        (plus a sampled baseline) to the background exemplar shipper.
+        Pure dict work — safe on the event loop; the CP I/O happens on
+        the shipper thread."""
+        if tl is None:
+            return
+        try:
+            meta = engine_meta or {}
+            if meta.get("stages"):
+                tl.extend(meta["stages"])
+            pol = policy or {}
+            violated = []
+            lim = pol.get("slo_ttft_p99_ms")
+            if lim is not None and ttft_ms is not None and ttft_ms > lim:
+                violated.append("ttft")
+            lim = pol.get("slo_e2e_p99_ms")
+            if lim is not None and e2e_ms is not None and e2e_ms > lim:
+                violated.append("e2e")
+            if error:
+                violated.append("error")
+            if not violated:
+                rate = pol.get("slo_sample_rate")
+                if random.random() >= (0.01 if rate is None else rate):
+                    return
+            attribution.ship_record(attribution.build_record(
+                tl, kind="violation" if violated else "baseline",
+                violated=violated,
+                policy={k: v for k, v in pol.items() if v is not None},
+                ttft_ms=ttft_ms, e2e_ms=e2e_ms, error=error))
+        except Exception:  # noqa: BLE001 — attribution must never 500 a
+            pass           # request that already succeeded
+
     async def _handle(self, request):
         from aiohttp import web
 
@@ -255,9 +322,15 @@ class HTTPProxy:
                 r["degraded"] for r in out["routers"].values())
             return web.json_response(out)
 
+        # X-Request-Id (ISSUE 12): echo the client's or mint one; on EVERY
+        # response header so client logs correlate with server exemplars
+        rid = request.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
+        t_ingress0 = time.time()
+
         resolved = await self._resolve_route(path)
         if resolved is None:
-            return web.Response(status=404, text=f"no route for {path}")
+            return web.Response(status=404, text=f"no route for {path}",
+                                headers={"X-Request-Id": rid})
         prefix, (app_name, deployment) = resolved
         t0 = time.monotonic()
 
@@ -267,7 +340,7 @@ class HTTPProxy:
             self._observe_request(deployment, prefix, 503, t0)
             return self._error_response(
                 503, "proxy overloaded: too many in-flight requests", path,
-                retry_after=1, error_type="overloaded")
+                retry_after=1, error_type="overloaded", rid=rid)
 
         router = self._routers.get(app_name)
         if router is None:
@@ -276,15 +349,24 @@ class HTTPProxy:
             self._routers[app_name] = router
 
         loop = asyncio.get_event_loop()
-        dl = await loop.run_in_executor(
-            None, self._derive_deadline, request, app_name, deployment)
+        dl, slo_policy = await loop.run_in_executor(
+            None, self._admission_info, request, app_name, deployment)
         if time.time() >= dl:
             # already expired: refuse before a replica sees it
             self.stats["shed_expired"] += 1
             self._observe_request(deployment, prefix, 503, t0)
             return self._error_response(
                 503, "request deadline already expired", path,
-                retry_after=1, error_type="timeout")
+                retry_after=1, error_type="timeout", rid=rid)
+
+        # Critical-path timeline (ISSUE 12): one Timeline object in this
+        # task's context; router stamps reach it through copy_context()
+        # (same object reference across threads), engine stages join at
+        # finalize from the response metadata. Each aiohttp request runs
+        # in its own task = its own contextvar context.
+        tl = None
+        if get_config().slo_attribution_enabled:
+            tl = attribution.begin(rid, app=app_name, deployment=deployment)
 
         # build the request payload the user callable sees
         body = await request.read()
@@ -312,8 +394,11 @@ class HTTPProxy:
             with tracing.span(f"http.request:{path}", kind="server",
                               attrs={"method": request.method,
                                      "app": app_name,
+                                     "request_id": rid,
                                      "deployment": deployment}) as sp, \
                     request_deadline.scope(dl):
+                if tl is not None and sp is not None:
+                    tl.trace_id = sp.get("trace_id", "")
                 wants_dispatch = await loop.run_in_executor(
                     None, self._wants_http_dispatch, app_name, deployment)
                 # SSE only for multi-route (handle_http) ingresses that opt
@@ -342,6 +427,13 @@ class HTTPProxy:
                             None, _affinity.digests_for_http, subpath,
                             payload, meta, router.config.affinity_max_digests)
                 kwargs = {"_prefix_digests": digests} if digests else {}
+                kwargs["_request_id"] = rid
+                # ingress stage: header/deadline work, body read, payload
+                # parse, tokenize + digest — everything before routing
+                if tl is not None:
+                    tl.stamp("ingress", t_ingress0, time.time(),
+                             method=request.method, path=path,
+                             n_digests=len(digests or ()))
                 pctx = contextvars.copy_context()
                 if streaming:
                     ref = await loop.run_in_executor(
@@ -349,7 +441,9 @@ class HTTPProxy:
                             router.assign, call[0], call[1], call[2], kwargs,
                             streaming=True, prefix_digests=digests))
                     if hasattr(ref, "__next__"):
-                        resp = await self._stream_sse(request, ref, dl, sp)
+                        resp = await self._stream_sse(
+                            request, ref, dl, sp, rid=rid, tl=tl,
+                            policy=slo_policy, t0=t0)
                         self._observe_request(
                             deployment, prefix, resp.status, t0)
                         return resp
@@ -364,6 +458,9 @@ class HTTPProxy:
                         if sp is not None:
                             sp["attrs"]["retries"] = attempts - 1
         except Exception as e:  # noqa: BLE001 — classify below
+            self._finalize_slo(tl, slo_policy, ttft_ms=None,
+                               e2e_ms=(time.monotonic() - t0) * 1e3,
+                               engine_meta=None, error=repr(e))
             if _is_deadline_error(e):
                 self.stats["deadline_exceeded"] += 1
                 if sp is not None:
@@ -371,22 +468,31 @@ class HTTPProxy:
                 self._observe_request(deployment, prefix, 503, t0)
                 return self._error_response(
                     503, f"request deadline exceeded: {e}", path,
-                    retry_after=1, error_type="timeout")
+                    retry_after=1, error_type="timeout", rid=rid)
             self.stats["errors"] += 1
             self._observe_request(deployment, prefix, 500, t0)
             return self._error_response(
-                500, repr(e), path, error_type="server_error")
+                500, repr(e), path, error_type="server_error", rid=rid)
         finally:
             self._inflight -= 1
             _PROXY_INFLIGHT.set(self._inflight)
 
         self.stats["ok"] += 1
         self._observe_request(deployment, prefix, 200, t0)
+        e2e_ms = (time.monotonic() - t0) * 1e3
+        engine_meta = (result.get("ray_tpu")
+                       if isinstance(result, dict) else None) or {}
+        ttft_s = engine_meta.get("ttft_s")
+        self._finalize_slo(
+            tl, slo_policy,
+            ttft_ms=None if ttft_s is None else ttft_s * 1e3,
+            e2e_ms=e2e_ms, engine_meta=engine_meta)
         if streaming and isinstance(result, list):
             # server-sent events framing (legacy list-returning replicas)
             resp = web.StreamResponse(
                 headers={"Content-Type": "text/event-stream",
-                         "Cache-Control": "no-cache"})
+                         "Cache-Control": "no-cache",
+                         "X-Request-Id": rid})
             await resp.prepare(request)
             for chunk in result:
                 data = json.dumps(chunk) if not isinstance(chunk, str) \
@@ -396,12 +502,16 @@ class HTTPProxy:
             await resp.write_eof()
             return resp
         if isinstance(result, (bytes, bytearray)):
-            return web.Response(body=bytes(result))
+            return web.Response(body=bytes(result),
+                                headers={"X-Request-Id": rid})
         if isinstance(result, str):
-            return web.Response(text=result)
-        return web.json_response(result)
+            return web.Response(text=result,
+                                headers={"X-Request-Id": rid})
+        return web.json_response(result, headers={"X-Request-Id": rid})
 
-    async def _stream_sse(self, request, ref, dl: float, sp):
+    async def _stream_sse(self, request, ref, dl: float, sp, *,
+                          rid: str = "", tl=None, policy: Optional[dict] = None,
+                          t0: Optional[float] = None):
         """ObjectRefGenerator: stream each chunk to the client the moment
         the replica yields it (SSE framing; reference: proxy ASGI
         streaming). First byte goes out at first token, not at completion.
@@ -411,11 +521,17 @@ class HTTPProxy:
         constant: an expired stream ends with an in-stream timeout error."""
         from aiohttp import web
         loop = asyncio.get_event_loop()
-        resp = web.StreamResponse(
-            headers={"Content-Type": "text/event-stream",
-                     "Cache-Control": "no-cache"})
+        headers = {"Content-Type": "text/event-stream",
+                   "Cache-Control": "no-cache"}
+        if rid:
+            headers["X-Request-Id"] = rid
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         gen = iter(ref)
+        t0 = t0 if t0 is not None else time.monotonic()
+        first_chunk_at: Optional[float] = None
+        engine_meta: Optional[dict] = None
+        stream_error: Optional[str] = None
 
         def _next_chunk():
             # bounded: a hung replica must not pin an executor thread (and
@@ -434,6 +550,12 @@ class HTTPProxy:
                 chunk = await loop.run_in_executor(None, _next_chunk)
                 if chunk is _SSE_DONE:
                     break
+                if first_chunk_at is None:
+                    first_chunk_at = time.monotonic()
+                if isinstance(chunk, dict) and chunk.get("ray_tpu"):
+                    # the final chunk carries the engine's attribution
+                    # payload (queue wait + stage timeline); last one wins
+                    engine_meta = chunk["ray_tpu"]
                 data = json.dumps(chunk) \
                     if not isinstance(chunk, str) else chunk
                 await resp.write(f"data: {data}\n\n".encode())
@@ -441,6 +563,7 @@ class HTTPProxy:
         except (ConnectionResetError, asyncio.CancelledError):
             raise  # client went away: nothing left to tell it
         except Exception as e:  # noqa: BLE001 — stream error
+            stream_error = repr(e)
             if _is_deadline_error(e):
                 self.stats["deadline_exceeded"] += 1
                 if sp is not None:
@@ -453,6 +576,16 @@ class HTTPProxy:
                 + b"\n\n")
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
+        # client-observed TTFT (first SSE chunk) beats the engine's number:
+        # it includes route + replica queueing the client actually felt
+        ttft_ms = None
+        if first_chunk_at is not None:
+            ttft_ms = (first_chunk_at - t0) * 1e3
+        elif engine_meta and engine_meta.get("ttft_s") is not None:
+            ttft_ms = engine_meta["ttft_s"] * 1e3
+        self._finalize_slo(tl, policy, ttft_ms=ttft_ms,
+                           e2e_ms=(time.monotonic() - t0) * 1e3,
+                           engine_meta=engine_meta, error=stream_error)
         return resp
 
     def _wants_http_dispatch(self, app_name: str, deployment: str) -> bool:

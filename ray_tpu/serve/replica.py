@@ -95,6 +95,12 @@ class ServeReplica:
             # contextvar, carried into the pool thread by copy_context()
             from ray_tpu.serve.affinity import _set_request_prefix_digests
             _set_request_prefix_digests(digests)
+        rid = (kwargs or {}).pop("_request_id", "")
+        if rid:
+            # proxy-assigned X-Request-Id (ISSUE 12): request-scoped, so
+            # the engine's exemplar record matches the response header
+            from ray_tpu.observability.attribution import set_request_id
+            set_request_id(rid)
         try:
             if self._is_fn:
                 target = self._callable
@@ -153,6 +159,10 @@ class ServeReplica:
         if digests:
             from ray_tpu.serve.affinity import _set_request_prefix_digests
             _set_request_prefix_digests(digests)
+        rid = (kwargs or {}).pop("_request_id", "")
+        if rid:
+            from ray_tpu.observability.attribution import set_request_id
+            set_request_id(rid)
         try:
             target = (self._callable if self._is_fn or method_name == "__call__"
                       else getattr(self._callable, method_name))

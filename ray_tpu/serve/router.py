@@ -29,6 +29,7 @@ from typing import Optional
 
 import ray_tpu
 from ray_tpu.core import deadline as request_deadline
+from ray_tpu.observability import attribution
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 DeadlineExceededError, GetTimeoutError,
@@ -335,6 +336,7 @@ class ReplicaSet:
             if not self._summaries_usable():
                 self.affinity_stale_fallbacks += 1
                 _AFFINITY_STALE.inc(tags={"deployment": self.name})
+                attribution.note(demotion="stale_summaries")
                 return self._pow2(candidates), 0
             scored = []
             for r, key in candidates:
@@ -359,6 +361,7 @@ class ReplicaSet:
                         return r, m
                 self.affinity_spillovers += 1
                 _AFFINITY_SPILLOVERS.inc(tags={"deployment": self.name})
+                attribution.note(demotion="spillover")
         return self._pow2(candidates), 0
 
 
@@ -571,15 +574,22 @@ class Router:
 
         No retries — the caller owns the ref (DeploymentHandle path).
         `call()` is the retrying variant for request/response traffic."""
+        t_route = time.time()
         rs, replica, matched = self._pick(deployment, multiplexed_model_id,
                                           timeout_s, prefix_digests)
         self._maybe_prefetch(rs, replica, matched, prefix_digests)
         if streaming:
             # streaming-generator call: returns an ObjectRefGenerator
             # whose items land as the replica yields them
-            return replica.handle_request_streaming.options(
+            ref = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(method, args, kwargs)
-        return replica.handle_request.remote(method, args, kwargs)
+        else:
+            ref = replica.handle_request.remote(method, args, kwargs)
+        # route stage = pick (probe/affinity score) + queue-handoff submit;
+        # the end is the moment the replica actor owns the request
+        attribution.note(replica=rs._key(replica)[:12], matched_pages=matched)
+        attribution.stamp("route", t_route, time.time())
+        return ref
 
     def call(self, deployment: str, method: str, args: tuple, kwargs: dict,
              *, timeout_s: Optional[float] = None,
@@ -605,12 +615,19 @@ class Router:
             except DeadlineExceededError:
                 self._bump("deadline_exceeded")
                 raise
+            t_route = time.time()
             rs, replica, matched = self._pick(
                 deployment, multiplexed_model_id, no_replica_timeout,
                 prefix_digests)
             self._maybe_prefetch(rs, replica, matched, prefix_digests)
             ref = replica.handle_request.remote(method, args, kwargs)
             attempts += 1
+            # one route stamp per attempt: a retried request shows every
+            # pick + handoff in its timeline (sorted canonically)
+            attribution.note(replica=rs._key(replica)[:12],
+                             matched_pages=matched)
+            attribution.stamp("route", t_route, time.time(),
+                              attempt=attempts)
             try:
                 result = ray_tpu.get(
                     ref, timeout=request_deadline.bound(timeout_s))

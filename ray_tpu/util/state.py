@@ -324,6 +324,34 @@ def list_kv_tier() -> dict:
         "entries": [], "counters": {}}
 
 
+def slo_report(deployment: Optional[str] = None) -> dict:
+    """Fleet tail-latency breakdown over the CP SLO exemplar store
+    (observability/attribution.py): per-stage p50/p95/p99, dominant-stage
+    attribution for tail requests, per-replica skew. The `ray-tpu slo`
+    CLI and the dashboard SLO panel render this."""
+    body = {"deployment": deployment} if deployment else {}
+    return _cp().call("slo_report", body, timeout=10.0) or {
+        "count": 0, "violations": 0, "stage_ms": {},
+        "dominant_stage": {}, "replica_skew": {}}
+
+
+def list_slo_exemplars(limit: int = 50,
+                       kind: Optional[str] = None) -> list[dict]:
+    """Exemplar summaries, newest first; `kind` filters to "violation"
+    or "baseline"."""
+    body: dict[str, Any] = {"limit": limit}
+    if kind:
+        body["kind"] = kind
+    return _cp().call("list_slo_exemplars", body, timeout=10.0) or []
+
+
+def get_slo_exemplar(request_id: str) -> Optional[dict]:
+    """One full exemplar (ordered stage timeline + routing decision) by
+    X-Request-Id, prefix ok."""
+    return _cp().call("get_slo_exemplar", {"request_id": request_id},
+                      timeout=10.0)
+
+
 def kv_tier_gc() -> dict:
     """Drop expired kv_tier index entries (owners retract their own on
     demotion/shutdown; this sweeps entries whose owner is wedged).

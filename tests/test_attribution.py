@@ -1,0 +1,385 @@
+"""Per-request critical-path attribution tests (ISSUE 12): timeline
+assembly and stage ordering, engine stage mapping, fleet aggregation,
+the bounded CP exemplar store (oldest-first eviction + dead-worker
+retraction), and an end-to-end SLO-violating request whose full ordered
+timeline reaches the store."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+# ---------------------------------------------------------------------------
+# unit: timeline + engine stage mapping (no cluster)
+
+def test_timeline_note_merges_into_route_stamp():
+    from ray_tpu.observability.attribution import Timeline
+
+    tl = Timeline("req1", app="a", deployment="d")
+    tl.note(demotion="spillover")
+    tl.note(replica="rep-a", matched_pages=3)
+    tl.stamp("route", 10.0, 10.01, attempt=1)
+    (route,) = tl.stages
+    assert route["stage"] == "route"
+    assert route["attrs"]["demotion"] == "spillover"
+    assert route["attrs"]["matched_pages"] == 3
+    assert route["attrs"]["attempt"] == 1
+    assert tl.replica == "rep-a"
+    assert tl.route_attrs == {}  # consumed by the stamp
+
+
+def test_timeline_orders_stages_canonically():
+    from ray_tpu.observability.attribution import Timeline
+
+    tl = Timeline("req2")
+    # stamped in arrival order, not canonical order (engine stages land
+    # last, a retry re-stamps route after queue)
+    tl.stamp("ingress", 1.0, 1.001)
+    tl.stamp("route", 1.001, 1.002)
+    tl.extend([
+        {"stage": "decode", "start": 1.2, "end": 1.3, "attrs": {}},
+        {"stage": "queue", "start": 1.002, "end": 1.05, "attrs": {}},
+        {"stage": "prefill", "start": 1.05, "end": 1.2, "attrs": {}},
+    ])
+    tl.stamp("route", 1.01, 1.02, attempt=2)
+    names = [s["stage"] for s in tl.ordered_stages()]
+    assert names == ["ingress", "route", "route", "queue", "prefill",
+                     "decode"]
+    # same-stage occurrences keep start order (retry after first attempt)
+    routes = [s for s in tl.ordered_stages() if s["stage"] == "route"]
+    assert routes[0]["start"] < routes[1]["start"]
+
+
+def test_engine_stages_full_path_and_wall_mapping():
+    from ray_tpu.observability import attribution
+
+    stages = attribution.engine_stages(
+        submitted_wall=1000.0, submitted_at=50.0, admitted_at=50.2,
+        first_token_at=50.5, finished_at=50.9,
+        cached_tokens=16, restored_tokens=32, restore_bytes=4096,
+        restore_ms=100.0, prompt_tokens=64, generated_tokens=8,
+        itl_s=0.05)
+    names = [s["stage"] for s in stages]
+    assert names == ["queue", "restore", "prefill", "decode"]
+    queue, restore, prefill, decode = stages
+    # monotonic -> wall: submitted_wall anchors the mapping
+    assert queue["start"] == pytest.approx(1000.0)
+    assert queue["end"] == pytest.approx(1000.2)
+    assert queue["attrs"]["admitted"] is True
+    assert restore["end"] == pytest.approx(1000.3)  # +100ms restore
+    assert restore["attrs"]["restored_tokens"] == 32
+    assert prefill["start"] == pytest.approx(restore["end"])
+    assert prefill["end"] == pytest.approx(1000.5)
+    assert prefill["attrs"]["prefilled_tokens"] == 48  # prompt - cached
+    assert decode["start"] == pytest.approx(1000.5)
+    assert decode["end"] == pytest.approx(1000.9)
+    assert decode["attrs"]["itl_ms"] == pytest.approx(50.0)
+
+
+def test_engine_stages_never_admitted_is_queue_only():
+    from ray_tpu.observability import attribution
+
+    stages = attribution.engine_stages(
+        submitted_wall=time.time(), submitted_at=time.monotonic() - 1.0,
+        admitted_at=None, first_token_at=None, finished_at=None)
+    assert [s["stage"] for s in stages] == ["queue"]
+    assert stages[0]["attrs"]["admitted"] is False
+
+
+def test_engine_stages_no_restore_when_nothing_restored():
+    from ray_tpu.observability import attribution
+
+    stages = attribution.engine_stages(
+        submitted_wall=1000.0, submitted_at=0.0, admitted_at=0.1,
+        first_token_at=0.3, finished_at=0.4, prompt_tokens=8,
+        generated_tokens=2)
+    assert [s["stage"] for s in stages] == ["queue", "prefill", "decode"]
+
+
+# ---------------------------------------------------------------------------
+# unit: aggregation + span conversion
+
+def _rec(rid, *, replica="rep-a", source="src01", kind="violation",
+         violated=("ttft",), queue_ms=5.0, prefill_ms=50.0,
+         decode_ms=20.0, matched_pages=0, deployment="llm"):
+    t = 1000.0
+    q1 = t + 0.002 + queue_ms / 1e3
+    p1 = q1 + prefill_ms / 1e3
+    d1 = p1 + decode_ms / 1e3
+    stages = [
+        {"stage": "ingress", "start": t, "end": t + 0.001, "attrs": {}},
+        {"stage": "route", "start": t + 0.001, "end": t + 0.002,
+         "attrs": {"replica": replica, "matched_pages": matched_pages}},
+        {"stage": "queue", "start": t + 0.002, "end": q1,
+         "attrs": {"admitted": True}},
+        {"stage": "prefill", "start": q1, "end": p1,
+         "attrs": {"cached_tokens": 0, "restored_tokens": 0,
+                   "prefilled_tokens": 32}},
+        {"stage": "decode", "start": p1, "end": d1,
+         "attrs": {"generated_tokens": 8}},
+    ]
+    return {"request_id": rid, "ts": time.time(), "app": "app",
+            "deployment": deployment, "replica": replica,
+            "source": source, "kind": kind, "violated": list(violated),
+            "ttft_ms": queue_ms + prefill_ms,
+            "e2e_ms": queue_ms + prefill_ms + decode_ms,
+            "policy": {"slo_ttft_p99_ms": 1.0}, "error": None,
+            "trace_id": "", "stages": stages}
+
+
+def test_aggregate_report_breakdown_and_skew():
+    from ray_tpu.observability import attribution
+
+    recs = (
+        [_rec(f"a{i}", replica="rep-a", queue_ms=100.0, prefill_ms=10.0,
+              matched_pages=4) for i in range(4)]
+        + [_rec(f"b{i}", replica="rep-b", queue_ms=2.0, prefill_ms=60.0,
+                kind="baseline", violated=()) for i in range(4)])
+    rep = attribution.aggregate_report(recs)
+    assert rep["count"] == 8
+    assert rep["violations"] == 4
+    for st in ("ingress", "route", "queue", "prefill", "decode"):
+        assert rep["stage_ms"][st]["count"] == 8
+    # the violating half is queue-dominated -> dominant-stage attribution
+    assert rep["dominant_stage"] == {"queue": 4}
+    skew = rep["replica_skew"]
+    assert skew["rep-a"]["count"] == 4
+    assert skew["rep-a"]["affinity_hit_share"] == 1.0
+    assert skew["rep-b"]["affinity_hit_share"] == 0.0
+    assert skew["rep-a"]["queue_wait_p50_ms"] > \
+        skew["rep-b"]["queue_wait_p50_ms"]
+    assert skew["rep-a"]["prefilled_tokens"] == 4 * 32
+
+
+def test_aggregate_report_tail_fallback_without_violations():
+    from ray_tpu.observability import attribution
+
+    recs = [_rec(f"r{i}", kind="baseline", violated=(),
+                 decode_ms=500.0 if i == 0 else 5.0) for i in range(10)]
+    rep = attribution.aggregate_report(recs)
+    assert rep["violations"] == 0
+    # slowest decile (1 record) is decode-bound
+    assert rep["dominant_stage"] == {"decode": 1}
+
+
+def test_percentile_interpolates():
+    from ray_tpu.observability.attribution import percentile
+
+    vals = [float(v) for v in range(1, 101)]
+    assert percentile(vals, 0.50) == pytest.approx(50.5)
+    assert percentile(vals, 0.99) == pytest.approx(99.01)
+    assert percentile([7.0], 0.95) == 7.0
+    assert percentile([], 0.5) == 0.0
+
+
+def test_stages_to_spans_renders_through_trace_tooling():
+    from ray_tpu.observability import attribution, tracing
+
+    rec = _rec("span01")
+    spans = attribution.stages_to_spans(rec)
+    root = spans[0]
+    assert root["parent_id"] is None
+    assert root["name"] == "request:span01"
+    kids = spans[1:]
+    assert len(kids) == len(rec["stages"])
+    assert all(s["parent_id"] == root["span_id"] for s in kids)
+    assert [s["name"] for s in kids] == \
+        [f"stage:{st['stage']}" for st in rec["stages"]]
+    # must be renderable by the PR-1 chrome-trace exporter unchanged
+    chrome = tracing.to_chrome_trace(spans)
+    assert len(chrome) == len(spans)
+    assert all(ev["ph"] == "X" for ev in chrome)
+
+
+# ---------------------------------------------------------------------------
+# engine: queue-wait export (standalone engine, no cluster)
+
+def test_engine_exports_queue_wait_and_stages():
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig, LLMEngine
+
+    eng = LLMEngine(LLMConfig(
+        model_config=llama.llama_tiny(vocab_size=512),
+        max_batch_size=4, page_size=16, num_pages=64,
+        max_prompt_len=64, max_seq_len=128, max_tokens=8), rng_seed=0)
+    eng.start()
+    try:
+        out = eng.generate("queue wait probe", max_tokens=4)
+        assert out["queue_wait_s"] is not None
+        assert out["queue_wait_s"] >= 0.0
+        names = [s["stage"] for s in out["stages"]]
+        assert names[0] == "queue"
+        assert "prefill" in names and "decode" in names
+        st = eng.engine_stats()
+        assert "phase_queue_wait_p50_ms" in st
+        assert "phase_queue_wait_p95_ms" in st
+        # profiler on by default: the request above sampled the phase
+        assert st["phase_queue_wait_p50_ms"] is not None
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# control plane: bounded exemplar store
+
+@pytest.fixture(scope="module")
+def slo_cluster():
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=64, _system_config={
+        "health_check_period_s": 0.2,
+        "health_check_failure_threshold": 3,
+        # tiny cap so eviction is testable without 512 fixture records
+        "slo_exemplar_max_records": 6,
+    })
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _cp():
+    from ray_tpu.core import api
+    return api._get_runtime().cp_client
+
+
+def test_exemplar_store_bounded_evicts_oldest_first(slo_cluster):
+    cp = _cp()
+    for i in range(10):
+        assert cp.call("report_slo_exemplar",
+                       {"record": _rec(f"ev{i:02d}")})["ok"]
+    from ray_tpu.util import state
+    listed = [r["request_id"] for r in state.list_slo_exemplars(limit=50)]
+    mine = sorted(r for r in listed if r.startswith("ev"))
+    assert mine == [f"ev{i:02d}" for i in range(4, 10)]  # oldest 4 gone
+    assert state.get_slo_exemplar("ev00") is None
+    assert state.get_slo_exemplar("ev09")["request_id"] == "ev09"
+    # the evicted records' KV summary keys went with them
+    keys = cp.call("kv_keys", {"prefix": "slo_exemplar:ev"})
+    assert sorted(keys) == [f"slo_exemplar:ev{i:02d}" for i in range(4, 10)]
+
+
+def test_dead_worker_retracts_exemplars(slo_cluster):
+    cp = _cp()
+    from ray_tpu.util import state
+    for rid in ("dw01", "dw02"):
+        assert cp.call("report_slo_exemplar",
+                       {"record": _rec(rid, source="deadbeefcafe")})["ok"]
+    assert cp.call("report_slo_exemplar",
+                   {"record": _rec("dw03", source="aliveworker1")})["ok"]
+    assert state.get_slo_exemplar("dw01") is not None
+
+    cp.call("worker_died", {"worker_id": "deadbeefcafe",
+                            "reason": "test kill"})
+    listed = {r["request_id"] for r in state.list_slo_exemplars(limit=50)}
+    assert "dw01" not in listed and "dw02" not in listed
+    assert "dw03" in listed  # other sources untouched
+    assert state.get_slo_exemplar("dw01") is None
+    keys = cp.call("kv_keys", {"prefix": "slo_exemplar:dw"})
+    assert keys == ["slo_exemplar:dw03"]
+    # late reports from the retracted worker are rejected, like late
+    # metric flushes
+    out = cp.call("report_slo_exemplar",
+                  {"record": _rec("dw04", source="deadbeefcafe")})
+    assert not out["ok"]
+
+
+def test_slo_report_filters_by_deployment(slo_cluster):
+    cp = _cp()
+    from ray_tpu.util import state
+    assert cp.call("report_slo_exemplar",
+                   {"record": _rec("dep1", deployment="only-here",
+                                   queue_ms=200.0)})["ok"]
+    rep = state.slo_report(deployment="only-here")
+    assert rep["count"] == 1
+    assert rep["violations"] == 1
+    assert rep["stage_ms"]["queue"]["p50"] == pytest.approx(200.0, rel=0.01)
+    assert rep["dominant_stage"] == {"queue": 1}
+    assert state.slo_report(deployment="no-such")["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# end to end: SLO-violating HTTP request -> complete ordered exemplar
+
+def _http(url, payload, headers=None, timeout=120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_slo_violation_produces_ordered_exemplar(slo_cluster):
+    from ray_tpu import serve
+    from ray_tpu.models import llama
+    from ray_tpu.observability import attribution
+    from ray_tpu.serve.llm import LLMConfig, build_openai_app
+    from ray_tpu.util import state
+
+    cfg = LLMConfig(
+        model_config=llama.llama_tiny(vocab_size=512),
+        max_batch_size=4, page_size=16, num_pages=64,
+        max_prompt_len=64, max_seq_len=128, max_tokens=8,
+        # unmeetable TTFT SLO: every request is a violation exemplar
+        slo_ttft_p99_ms=0.001, slo_sample_rate=1.0)
+    serve.run(build_openai_app(cfg, route_prefix="/v1"),
+              name="llm-slo", route_prefix="/v1")
+    proxy = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{proxy.port}"
+    try:
+        # client-supplied X-Request-Id is echoed AND names the exemplar
+        with _http(f"{base}/v1/completions",
+                   {"prompt": "hello slo", "max_tokens": 4},
+                   headers={"X-Request-Id": "slotest0001"}) as r:
+            assert r.status == 200
+            assert r.headers.get("X-Request-Id") == "slotest0001"
+            json.loads(r.read())
+        # without one, the proxy mints an id on the response
+        with _http(f"{base}/v1/completions",
+                   {"prompt": "minted id", "max_tokens": 4}) as r:
+            assert r.status == 200
+            assert r.headers.get("X-Request-Id")
+        # streaming responses carry the header too
+        with _http(f"{base}/v1/completions",
+                   {"prompt": "stream slo", "max_tokens": 4,
+                    "stream": True},
+                   headers={"X-Request-Id": "slostream01"}) as r:
+            assert r.status == 200
+            assert r.headers.get("X-Request-Id") == "slostream01"
+            r.read()
+
+        # the shipper is async (daemon thread -> CP): poll for arrival
+        rec = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and rec is None:
+            rec = state.get_slo_exemplar("slotest0001")
+            if rec is None:
+                time.sleep(0.2)
+        assert rec is not None, "exemplar never reached the CP store"
+        assert rec["kind"] == "violation"
+        assert "ttft" in rec["violated"]
+        assert rec["policy"]["slo_ttft_p99_ms"] == 0.001
+        assert rec["deployment"]
+
+        names = [s["stage"] for s in rec["stages"]]
+        for want in ("ingress", "route", "queue", "prefill", "decode"):
+            assert want in names, f"stage {want!r} missing from {names}"
+        ranks = [attribution._STAGE_INDEX[n] for n in names
+                 if n in attribution._STAGE_INDEX]
+        assert ranks == sorted(ranks), f"stages out of order: {names}"
+        route = next(s for s in rec["stages"] if s["stage"] == "route")
+        assert "replica" in route["attrs"]
+        assert rec["replica"] == route["attrs"]["replica"]
+
+        # the streaming request's exemplar made it too
+        deadline = time.monotonic() + 30.0
+        srec = None
+        while time.monotonic() < deadline and srec is None:
+            srec = state.get_slo_exemplar("slostream01")
+            if srec is None:
+                time.sleep(0.2)
+        assert srec is not None
+        snames = [s["stage"] for s in srec["stages"]]
+        assert "decode" in snames and "ingress" in snames
+    finally:
+        serve.shutdown()
