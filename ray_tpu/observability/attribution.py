@@ -39,8 +39,13 @@ from typing import Any, Optional
 
 # Canonical stage order. A request's record sorts stamps by
 # (STAGES index, start time) so retries and out-of-order arrival from
-# different layers still render as one coherent waterfall.
-STAGES = ("ingress", "route", "queue", "restore", "prefill", "decode")
+# different layers still render as one coherent waterfall. ``failover``
+# sits between route and queue: a mid-stream resume re-enters the
+# pipeline (re-route + continuation admit), so its engine-side stages
+# (queue/restore/prefill/decode of the resumed leg) sort after it while
+# the original leg's stamps keep their earlier start times.
+STAGES = ("ingress", "route", "failover", "queue", "restore", "prefill",
+          "decode")
 
 _STAGE_INDEX = {s: i for i, s in enumerate(STAGES)}
 

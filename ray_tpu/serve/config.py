@@ -63,8 +63,19 @@ class RouterConfig:
     # minimum matched pages before affinity overrides pow-2
     affinity_min_match_pages: int = 1
     # spillover: a holder whose probed queue length is >= this takes no
-    # affinity traffic (the next-best holder, then pow-2, absorbs it)
+    # affinity traffic (the next-best holder, then pow-2, absorbs it).
+    # DEPRECATED (ISSUE 14 satellite): superseded by the continuous
+    # load × locality score below; kept so existing configs construct.
     affinity_spillover_qlen: int = 8
+    # load × locality: a holder's matched pages are discounted by
+    # `affinity_load_weight` per request of EXCESS queue depth over the
+    # least-loaded routable replica — score = matched − w·(q − q_min).
+    # The best positive-scoring holder wins; no positive score demotes
+    # to pow-2 (counted as a spillover). Replaces the binary
+    # affinity_spillover_qlen threshold, which let the top holder absorb
+    # traffic until saturation (ROADMAP item 2's [35, 50, 33, 10]
+    # prefill skew / 5.1 s p99 TTFT).
+    affinity_load_weight: float = 0.5
     # summaries older than this are treated as unusable (degrade to pow-2)
     affinity_summary_ttl_s: float = 10.0
     # leading page-chain digests computed at ingress per request

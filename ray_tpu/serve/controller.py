@@ -371,8 +371,10 @@ class ServeController:
         # counters per replica — plus the ISSUE-6 introspection surface
         # (per-phase p50/p95, ITL, compile events, device memory) that the
         # dashboard /profiling panel renders; anything else probes to None
-        _ENGINE_KEYS = ("steps", "prefills", "tokens_out", "shed_expired",
+        _ENGINE_KEYS = ("steps", "prefills", "tokens_out", "requests",
+                        "shed_expired",
                         "active_slots", "waiting", "free_pages",
+                        "failover_resumed", "failover_restored_tokens",
                         "prefix_hits", "prefix_misses", "prefix_hit_tokens",
                         "prefix_cached_pages", "prefix_shared_pages",
                         "prefix_evictions",
@@ -588,6 +590,17 @@ class ServeController:
                                   if self._replica_key(r) not in on_draining]
                 state.draining.extend(moving)
                 # no version bump: the routing table still contains them
+                # Drain pre-move spill (ISSUE 14): tell the moving
+                # replicas to push in-flight KV chains into the tier NOW
+                # — when the node dies, streams mid-generation there get
+                # re-dispatched as continuations and the replacements
+                # restore this work instead of recomputing it.
+                # Fire-and-forget: drain must not block on a spill.
+                for r in moving:
+                    try:
+                        r.prepare_to_move.remote()  # graftlint: fire-and-forget
+                    except Exception:  # noqa: BLE001
+                        pass
             # STARTING replicas on a draining node would come up on a node
             # about to disappear — kill now, scale-up re-places them
             doomed = [r for r in state.starting

@@ -115,6 +115,19 @@ class LLMConfig:
     kv_tier_disk_max_bytes: int = 1024 * 1024 * 1024
     kv_tier_ttl_s: float = 600.0                 # entry lifetime; <=0 = none
 
+    # Mid-stream generation failover (ISSUE 14): a replica dying
+    # mid-decode no longer drops its streams — the proxy re-dispatches
+    # each one with a continuation spec (original prompt + the tokens
+    # already generated) and the target engine admits it through the
+    # ordinary cache-aware path (local prefix match, then kv-tier
+    # restore of the dead replica's spilled pages, then suffix-only
+    # chunked prefill), resuming decode at the exact next token. Greedy
+    # continuations are bit-identical to an uninterrupted run.
+    failover_enabled: bool = True
+    # resumes allowed per request before degrading to a plain
+    # retry-from-scratch (the PR 2 retry path, minus the continuation)
+    failover_max_resumes: int = 2
+
     # Prefix-affinity routing (ISSUE 10): cap on the resident page-chain
     # digests each replica exports to the router through the controller
     # long-poll. Low chain positions win the cut (a leading page is what

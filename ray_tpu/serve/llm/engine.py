@@ -101,6 +101,14 @@ class _Request:
     # mismatch between ingress and engine must degrade to the recompute
     # path, never restore wrong KV)
     ingress_digests: Optional[list] = None
+    # mid-stream failover (ISSUE 14): number of already-generated tokens
+    # from the dead replica appended to prompt_tokens as a continuation
+    # spec. 0 = ordinary request. The admission path is unchanged — the
+    # continuation rides the same prefix-match / tier-restore / chunked
+    # suffix prefill machinery, and decode resumes at the exact next
+    # token (greedy continuations are bit-identical to an uninterrupted
+    # run: same KV prefix, same argmax).
+    resume_len: int = 0
 
 
 class LLMEngine:
@@ -176,7 +184,8 @@ class LLMEngine:
                       "spilled_pages": 0, "restored_pages": 0,
                       "tier_hit_tokens": 0,
                       "spec_rounds": 0, "spec_drafted_tokens": 0,
-                      "spec_accepted_tokens": 0}
+                      "spec_accepted_tokens": 0,
+                      "failover_resumed": 0, "failover_restored_tokens": 0}
         # Tiered KV cache (kv_tier.py): evicted cached page chains spill
         # host-side into a shm/disk tier + cluster index instead of dying,
         # and _admit extends its longest-match search past the local index
@@ -187,6 +196,10 @@ class LLMEngine:
         self._kv_tier_on = bool(cfg.kv_tier_enabled) and self._prefix_cache_on
         self._kv_tier = None
         self._tier_pending: list = []  # [(dev_k, dev_v, [(page, dig, pos)])]
+        # drain-time eager spill handshake (ISSUE 14): spill_inflight()
+        # parks one (done_event, result_box) here and the loop performs
+        # the gather+flush — the device stream has exactly one driver
+        self._spill_req: Optional[tuple] = None
         if self._kv_tier_on:
             from ray_tpu.serve.llm import kv_tier as kvt
             # cluster-index namespace: a chain digest encodes the token
@@ -554,13 +567,40 @@ class LLMEngine:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                request_id: Optional[str] = None,
-               prefix_digests: Optional[list] = None) -> str:
-        """Enqueue a request; returns its id. Tokens stream via drain()."""
+               prefix_digests: Optional[list] = None,
+               resume_tokens: Optional[list] = None) -> str:
+        """Enqueue a request; returns its id. Tokens stream via drain().
+
+        ``resume_tokens`` is a mid-stream failover continuation (ISSUE
+        14): the token ids a dead replica already generated for this
+        request. They extend the admission sequence past the prompt —
+        the cache-aware admission path (local prefix match, kv-tier
+        restore, suffix-only chunked prefill) then recovers or
+        recomputes the dead replica's KV and decode resumes at the
+        exact next token; drain() emits ONLY post-resume tokens.
+        ``max_tokens`` for a continuation is the REMAINING budget
+        (original minus the tokens already emitted)."""
         if isinstance(prompt, str):
             toks = self.tokenizer.encode(prompt)
         else:
             toks = list(prompt)
+        # the prompt cap applies BEFORE the continuation is appended:
+        # the original leg was capped the same way, so the digest chain
+        # over the prompt pages is identical across legs
         toks = toks[: self.cfg.max_prompt_len]
+        resume_len = 0
+        if resume_tokens:
+            if not self.cfg.failover_enabled:
+                raise ValueError(
+                    "continuation submit with failover_enabled=False")
+            # leave >=1 position of generation room: a continuation that
+            # would fill max_seq_len exactly still has to sample the next
+            # token to make progress (the tail is truncated, which only
+            # loses speculative room, never emitted tokens)
+            resume = list(resume_tokens)[: max(
+                0, self.cfg.max_seq_len - 1 - len(toks))]
+            resume_len = len(resume)
+            toks = toks + [int(t) for t in resume]
         req = _Request(
             request_id=request_id or uuid.uuid4().hex[:16],
             prompt_tokens=toks,
@@ -571,7 +611,8 @@ class LLMEngine:
             top_k=self.cfg.top_k if top_k is None else top_k,
             stop_token=getattr(self.tokenizer, "eos_token_id", None),
             ingress_digests=(list(prefix_digests)
-                             if prefix_digests else None))
+                             if prefix_digests else None),
+            resume_len=resume_len)
         from ray_tpu.core import deadline as request_deadline
         from ray_tpu.observability import tracing
         req.trace_ctx = tracing.inject()
@@ -588,6 +629,8 @@ class LLMEngine:
             self._requests[req.request_id] = req
             self._waiting.append(req)
             self.stats["requests"] += 1
+            if resume_len:
+                self.stats["failover_resumed"] += 1
         self._wake.set()
         return req.request_id
 
@@ -735,6 +778,24 @@ class LLMEngine:
         rid = self.submit(prompt, **kw)
         return self.result(rid)
 
+    def request_progress(self, request_id: str) -> Optional[dict]:
+        """Per-request failover journal (ISSUE 14): the progress a
+        resume needs — accepted token ids, how much of a continuation's
+        prior work was recovered from cache/tier, and the restore cost
+        (stamped into the proxy's ``failover`` attribution stage)."""
+        with self._lock:
+            req = self._requests.get(request_id)
+            if req is None:
+                return None
+            return {"prompt_tokens": len(req.prompt_tokens),
+                    "generated": list(req.generated),
+                    "resume_len": req.resume_len,
+                    "cached_tokens": req.cached_tokens,
+                    "restored_tokens": req.restored_tokens,
+                    "restore_bytes": req.restore_bytes,
+                    "restore_ms": req.restore_ms,
+                    "admitted": req.admitted_at is not None}
+
     def prefix_summary(self, max_pages: Optional[int] = None):
         """(index_version, resident page-chain digest hex list) for the
         affinity router, or None when prefix caching is off (the caller
@@ -836,6 +897,17 @@ class LLMEngine:
             else:
                 self._admit()
             chunks = self._prefill_chunks()
+            if self._spill_req is not None:
+                # drain-time eager spill (ISSUE 14): gather + flush on
+                # THIS thread, then release the waiter — its return must
+                # mean the chains are actually in the tier
+                ev, box = self._spill_req
+                self._spill_req = None
+                try:
+                    box.append(self._spill_inflight_now())
+                    self._kv_tier_flush()
+                finally:
+                    ev.set()
             # chunk dispatches count as progress: an otherwise-idle engine
             # mid-chunked-prefill must not sleep between chunks
             dispatched = self._step() or chunks > 0
@@ -989,6 +1061,11 @@ class LLMEngine:
                 # short stall before degrading to a plain miss, never a
                 # multi-second freeze of admission + active decodes.
                 self._kv_tier_restore(req, len(matched))
+            if req.resume_len:
+                # tokens of the dead replica's work recovered WITHOUT
+                # recompute (local prefix pages + tier-restored pages);
+                # the rest of the admission sequence chunk-prefills below
+                self.stats["failover_restored_tokens"] += req.cached_tokens
             suffix = len(req.prompt_tokens) - req.prefill_pos
             if req.prefill_pos > 0 or (self.cfg.prefill_chunk > 0
                                        and suffix > self.cfg.prefill_chunk):
@@ -1055,6 +1132,63 @@ class LLMEngine:
             except Exception:  # noqa: BLE001 - spill is best-effort
                 logger.warning("kv-tier spill put failed; chain evicted "
                                "without spilling", exc_info=True)
+
+    def spill_inflight(self, timeout_s: float = 5.0) -> int:
+        """Eagerly spill the computed full KV pages of every LIVE chain
+        into the tier (ISSUE 14 drain/SIGTERM path). Ordinary spill
+        waits for pool eviction; a draining or dying replica's in-flight
+        requests would take their KV with them — this pushes the chains
+        out NOW so a surviving replica can tier-restore a continuation
+        instead of recomputing it. Thread-safe: the gather runs on the
+        engine loop via a handshake (one driver per device stream), or
+        directly when the loop is not running. Returns pages spilled."""
+        if not self._kv_tier_on:
+            return 0
+        loop = self._loop_thread
+        if loop is None or not loop.is_alive():
+            n = self._spill_inflight_now()
+            self._kv_tier_flush()
+            return n
+        ev = threading.Event()
+        box: list = []
+        self._spill_req = (ev, box)
+        self._wake.set()
+        ev.wait(timeout_s)
+        return box[0] if box else 0
+
+    def _spill_inflight_now(self) -> int:
+        """Capture spill gathers for every live request's full pages
+        (slotted or mid chunked prefill). Engine-loop thread only (or
+        the caller's, when the loop is down) — the same thread also
+        frees pages, so the entries can't go stale under us."""
+        if self._kv_tier is None:
+            return 0
+        ps = self.cfg.page_size
+        ents: list = []
+        with self._lock:
+            live = [r for r in self.slot_req if r is not None and not r.done]
+            live += [r for r in self._prefilling
+                     if not r.prefill_cancelled and not r.done]
+            for req in live:
+                toks = req.prompt_tokens + req.generated
+                if req.dispatched > 0:
+                    # armed slot: prompt KV fully written; a generated
+                    # token's KV is written when it feeds the NEXT step,
+                    # so the newest recorded token may not be cached yet
+                    covered = len(req.prompt_tokens) + max(
+                        0, len(req.generated) - 1)
+                else:
+                    covered = req.prefill_pos   # mid chunked prefill
+                limit = min(covered // ps, len(req.pages))
+                digest = b""
+                for i in range(limit):
+                    digest = self._kvc._chain_digest(
+                        digest, toks[i * ps:(i + 1) * ps])
+                    ents.append((req.pages[i], digest, i))
+        if not ents:
+            return 0
+        self._spill_capture(ents)
+        return len(ents)
 
     def _chain_digests(self, toks, limit: int,
                        ingress: Optional[list]) -> list[str]:

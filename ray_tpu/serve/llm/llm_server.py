@@ -41,6 +41,9 @@ _EXPORTED_STATS = (
     "tier_prefetch_hints", "tier_prefetch_pages", "tier_prefetch_hit_pages",
     "prefix_summary_version", "prefix_summary_pages",
     "spec_rounds", "spec_drafted_tokens", "spec_accepted_tokens",
+    # mid-stream failover (ISSUE 14): continuations admitted + tokens of
+    # dead-replica work recovered without recompute (prefix + tier pages)
+    "failover_resumed", "failover_restored_tokens",
     # introspection scalars (ISSUE 6): compile tracker + memory gauges;
     # None-valued entries (no samples yet / cpu backend) are skipped
     "compile_events", "mid_traffic_compiles", "compile_s",
@@ -75,6 +78,23 @@ def _export_engine_stats(model_id: str, stats: dict) -> None:
         pass
 
 
+def _resume_plan(resume_tokens, resume_count, cfg: LLMConfig):
+    """Decide how a re-dispatched stream resumes: `(use_continuation,
+    skip)`. Continuation admits prompt+resume through the cache-aware
+    path and emits only new tokens. Past `failover_max_resumes` (or with
+    failover off) the request degrades to a plain retry-from-scratch:
+    regenerate everything and suppress the first `skip` tokens so the
+    spliced client stream still carries no duplicates (greedy regenerates
+    the identical prefix)."""
+    n = len(resume_tokens or ())
+    if not n:
+        return False, 0
+    if cfg.failover_enabled and int(resume_count or 0) <= \
+            cfg.failover_max_resumes:
+        return True, 0
+    return False, n
+
+
 def _chat_prompt(messages: list[dict]) -> str:
     """Minimal chat template (role-tagged concatenation)."""
     parts = []
@@ -95,6 +115,26 @@ class LLMServer:
         self.cfg = llm_config
         self.engine = LLMEngine(llm_config)
         self.engine.start()
+        # Eager in-flight spill on SIGTERM (ISSUE 14): a graceful kill
+        # pushes every live chain's computed pages into the KV tier
+        # before the process dies, so the failover continuation restores
+        # instead of re-prefilling. Best-effort: actors run handlers off
+        # the main thread (ValueError) and tests embed servers in-process.
+        try:
+            import signal
+
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                try:
+                    self.eager_spill()
+                finally:
+                    if callable(prev):
+                        prev(signum, frame)
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError, RuntimeError):
+            pass
 
     # ---- OpenAI-shaped endpoints --------------------------------------
     def completions(self, payload: dict) -> Any:
@@ -103,7 +143,8 @@ class LLMServer:
             prompt = prompt[0] if prompt else ""
         params = self._sampling(payload)
         if payload.get("stream"):
-            return self._stream_completion(prompt, params, chat=False)
+            return self._stream_completion(prompt, params, chat=False,
+                                           resume=self._resume_spec(payload))
         out = self.engine.generate(prompt, **params)
         return self._completion_response(out, chat=False)
 
@@ -111,7 +152,8 @@ class LLMServer:
         prompt = _chat_prompt(payload.get("messages", []))
         params = self._sampling(payload)
         if payload.get("stream"):
-            return self._stream_completion(prompt, params, chat=True)
+            return self._stream_completion(prompt, params, chat=True,
+                                           resume=self._resume_spec(payload))
         out = self.engine.generate(prompt, **params)
         return self._completion_response(out, chat=True)
 
@@ -145,6 +187,16 @@ class LLMServer:
             out["request_id"] = rid
         return out
 
+    @staticmethod
+    def _resume_spec(payload: dict):
+        """Continuation spec from a proxy re-dispatch (ISSUE 14): token
+        ids already streamed to the client + how many resumes this
+        request has burned. None for ordinary first-leg requests."""
+        toks = payload.get("resume_tokens")
+        if not toks:
+            return None
+        return ([int(t) for t in toks], int(payload.get("resume_count", 1)))
+
     def _completion_response(self, out: dict, chat: bool) -> dict:
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
         if chat:
@@ -173,10 +225,21 @@ class LLMServer:
                         "stages": out.get("stages") or []},
         }
 
-    async def _stream_completion(self, prompt: str, params: dict, chat: bool):
+    async def _stream_completion(self, prompt: str, params: dict, chat: bool,
+                                 resume=None):
         """Async generator of OpenAI stream chunks (SSE payloads minus
         framing). Async so the poll sleep yields the replica's event loop —
-        N streaming requests drain concurrently instead of serializing."""
+        N streaming requests drain concurrently instead of serializing.
+
+        `resume` (ISSUE 14) is a proxy continuation spec
+        `(token_ids, resume_count)`: within the resume cap the request is
+        admitted as prompt+tokens through the cache-aware path and emits
+        only post-resume tokens; past the cap it degrades to a plain
+        retry-from-scratch with the already-streamed prefix suppressed.
+        Every delta chunk carries `token_ids` (the proxy's emitted-token
+        journal — text deltas alone are not token-identifiable) and the
+        first chunk of a resumed leg carries restore accounting for the
+        proxy's `failover` attribution stage."""
         import asyncio
 
         import time as _time
@@ -184,11 +247,26 @@ class LLMServer:
         t0 = _time.monotonic()
         n_prompt = len(self.engine.tokenizer.encode(prompt)) \
             if isinstance(prompt, str) else len(prompt)
-        rid = self.engine.submit(prompt, **params)
+        resume_tokens, resume_count = resume if resume else ([], 0)
+        use_resume, skip = _resume_plan(resume_tokens, resume_count, self.cfg)
+        if use_resume:
+            rid = self.engine.submit(prompt, resume_tokens=resume_tokens,
+                                     **params)
+        elif skip:
+            # retry-from-scratch: the caller sent the REMAINING budget, so
+            # restore the original cap — the suppressed regenerated prefix
+            # must not eat into the tokens still owed to the client
+            p2 = dict(params)
+            if p2.get("max_tokens") is not None:
+                p2["max_tokens"] = int(p2["max_tokens"]) + skip
+            rid = self.engine.submit(prompt, **p2)
+        else:
+            rid = self.engine.submit(prompt, **params)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
         obj = "chat.completion.chunk" if chat else "text_completion"
         ntok = 0
         ttft = None
+        resume_meta_due = resume is not None
         try:
             while True:
                 d = self.engine.drain(rid)
@@ -196,18 +274,36 @@ class LLMServer:
                 # a batch to "" (byte tokenizer on unprintable ids) and the
                 # stream must still emit the chunk — TTFT is first-token
                 # time
-                if d.get("tokens"):
+                toks = list(d.get("tokens") or ())
+                text = d.get("text", "")
+                if toks and skip:
+                    drop = min(skip, len(toks))
+                    skip -= drop
+                    toks = toks[drop:]
+                    text = self.engine.tokenizer.decode(toks) if toks else ""
+                if toks:
                     if ttft is None:
                         ttft = _time.monotonic() - t0
-                    ntok += len(d.get("tokens") or ())
+                    ntok += len(toks)
                     if chat:
-                        delta = {"delta": {"content": d["text"]}, "index": 0,
+                        delta = {"delta": {"content": text}, "index": 0,
                                  "finish_reason": None}
                     else:
-                        delta = {"text": d["text"], "index": 0,
+                        delta = {"text": text, "index": 0,
                                  "finish_reason": None}
-                    yield {"id": oid, "object": obj,
-                           "model": self.cfg.model_id, "choices": [delta]}
+                    chunk = {"id": oid, "object": obj,
+                             "model": self.cfg.model_id, "choices": [delta],
+                             "token_ids": toks}
+                    if resume_meta_due:
+                        resume_meta_due = False
+                        prog = self.engine.request_progress(rid) or {}
+                        chunk["resume_meta"] = {
+                            "resumed": use_resume,
+                            "restored_tokens": prog.get("restored_tokens", 0),
+                            "restore_bytes": prog.get("restore_bytes", 0),
+                            "restore_ms": prog.get("restore_ms", 0.0),
+                            "cached_tokens": prog.get("cached_tokens", 0)}
+                    yield chunk
                 if d["done"]:
                     err = d.get("error")
                     reason = "error" if err else "stop"
@@ -254,6 +350,13 @@ class LLMServer:
         stats = self.engine.engine_stats()
         _export_engine_stats(self.cfg.model_id, stats)
         return stats
+
+    def eager_spill(self) -> dict:
+        """Drain/SIGTERM hook (ISSUE 14): spill every in-flight chain's
+        computed pages into the KV tier NOW, so continuations on
+        surviving replicas restore this replica's work instead of
+        recomputing it. No-op (0 pages) when the tier is off."""
+        return {"spilled_pages": self.engine.spill_inflight()}
 
     # ---- prefix-affinity routing (ISSUE 10) ---------------------------
     def prefix_summary(self, since: Optional[int] = None) -> dict:

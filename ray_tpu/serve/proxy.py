@@ -36,7 +36,7 @@ from ray_tpu.exceptions import DeadlineExceededError, TaskError
 from ray_tpu.observability import attribution, tracing
 from ray_tpu.serve import affinity as _affinity
 from ray_tpu.serve.config import RouterConfig
-from ray_tpu.serve.router import Router
+from ray_tpu.serve.router import Router, is_replica_fault
 from ray_tpu.util import metrics as _metrics
 
 _SSE_DONE = object()  # sentinel: streaming generator exhausted
@@ -88,7 +88,7 @@ class HTTPProxy:
         # mutated only on the proxy event loop — no lock needed
         self.stats = {"ok": 0, "errors": 0, "shed_expired": 0,
                       "shed_overload": 0, "deadline_exceeded": 0,
-                      "retries": 0}
+                      "retries": 0, "stream_resumes": 0}
 
     # ---- lifecycle -----------------------------------------------------
     def start(self):
@@ -436,14 +436,27 @@ class HTTPProxy:
                              n_digests=len(digests or ()))
                 pctx = contextvars.copy_context()
                 if streaming:
-                    ref = await loop.run_in_executor(
+                    ref, replica = await loop.run_in_executor(
                         None, lambda: pctx.run(
-                            router.assign, call[0], call[1], call[2], kwargs,
-                            streaming=True, prefix_digests=digests))
+                            router.assign_info, call[0], call[1], call[2],
+                            kwargs, streaming=True, prefix_digests=digests))
                     if hasattr(ref, "__next__"):
+                        # Mid-stream failover context (ISSUE 14): enough
+                        # to re-dispatch this stream as a continuation if
+                        # its replica dies — only multi-route (LLM-shaped)
+                        # dict payloads can carry a continuation spec
+                        resume_ctx = None
+                        if wants_dispatch and isinstance(payload, dict):
+                            resume_ctx = {
+                                "router": router, "deployment": deployment,
+                                "subpath": subpath,
+                                "http_method": request.method,
+                                "payload": payload, "kwargs": kwargs,
+                                "digests": digests, "replica": replica}
                         resp = await self._stream_sse(
                             request, ref, dl, sp, rid=rid, tl=tl,
-                            policy=slo_policy, t0=t0)
+                            policy=slo_policy, t0=t0, router=router,
+                            resume_ctx=resume_ctx)
                         self._observe_request(
                             deployment, prefix, resp.status, t0)
                         return resp
@@ -511,14 +524,28 @@ class HTTPProxy:
 
     async def _stream_sse(self, request, ref, dl: float, sp, *,
                           rid: str = "", tl=None, policy: Optional[dict] = None,
-                          t0: Optional[float] = None):
+                          t0: Optional[float] = None, router=None,
+                          resume_ctx: Optional[dict] = None):
         """ObjectRefGenerator: stream each chunk to the client the moment
         the replica yields it (SSE framing; reference: proxy ASGI
         streaming). First byte goes out at first token, not at completion.
         Once the response is prepared, errors must be delivered IN-STREAM
         (an SSE error event + [DONE]) — aiohttp cannot start a second
         response. Chunk reads are bounded by the REMAINING deadline, not a
-        constant: an expired stream ends with an in-stream timeout error."""
+        constant: an expired stream ends with an in-stream timeout error.
+
+        Mid-stream failover (ISSUE 14): when `resume_ctx` is set and a
+        chunk read dies with a REPLICA fault (dead actor/worker/node —
+        never a user error or deadline), the stream is re-dispatched to a
+        surviving replica with a continuation spec (the function-local
+        journal of token ids already written to this client), gated by the
+        router's retry budget. The replica emits only post-resume tokens
+        (or suppresses the regenerated prefix past the resume cap), so the
+        splice has zero duplicated/missing tokens; the client sees one
+        `event: resumed` frame per failover, same X-Request-Id, and the
+        deadline keeps binding across the handoff (the re-dispatch runs
+        under the ambient scope). A `failover` stage lands in the
+        attribution timeline with the target's restore accounting."""
         from aiohttp import web
         loop = asyncio.get_event_loop()
         headers = {"Content-Type": "text/event-stream",
@@ -532,6 +559,11 @@ class HTTPProxy:
         first_chunk_at: Optional[float] = None
         engine_meta: Optional[dict] = None
         stream_error: Optional[str] = None
+        # emitted-token journal + resume state: function-local on purpose
+        # (one stream's lifetime, freed with the coroutine)
+        emitted_tokens: list = []
+        resumes = 0
+        failover_at: Optional[float] = None  # fault ts awaiting its stamp
 
         def _next_chunk():
             # bounded: a hung replica must not pin an executor thread (and
@@ -542,24 +574,98 @@ class HTTPProxy:
             except StopIteration:
                 return _SSE_DONE
 
+        def _redispatch():
+            # continuation spec: original payload + every token id already
+            # written to the client; the replica decides continuation vs
+            # retry-from-scratch (resume cap) — either way it emits only
+            # tokens this client has NOT seen. max_tokens becomes the
+            # REMAINING budget so the spliced stream matches an
+            # uninterrupted run's length.
+            ctx = resume_ctx
+            payload = dict(ctx["payload"])
+            payload["resume_tokens"] = list(emitted_tokens)
+            payload["resume_count"] = resumes
+            if payload.get("max_tokens") is not None:
+                payload["max_tokens"] = max(
+                    1, int(payload["max_tokens"]) - len(emitted_tokens))
+            return ctx["router"].assign_info(
+                ctx["deployment"], "handle_http",
+                (ctx["subpath"], ctx["http_method"], payload),
+                dict(ctx["kwargs"]), streaming=True,
+                prefix_digests=ctx["digests"])
+
         try:
             while True:
                 if time.time() >= dl:
                     raise DeadlineExceededError(
                         "stream deadline exceeded mid-response")
-                chunk = await loop.run_in_executor(None, _next_chunk)
+                try:
+                    chunk = await loop.run_in_executor(None, _next_chunk)
+                except (ConnectionResetError, asyncio.CancelledError):
+                    raise
+                except Exception as e:  # noqa: BLE001 — classify below
+                    if resume_ctx is None or not is_replica_fault(e) \
+                            or time.time() >= dl:
+                        raise
+                    rtr = resume_ctx["router"]
+                    rtr.record_replica_fault(resume_ctx["deployment"],
+                                             resume_ctx["replica"])
+                    if not rtr.stream_withdraw(resume_ctx["deployment"]):
+                        raise  # budget empty: fail rather than storm
+                    resumes += 1
+                    t_fault = time.time()
+                    # re-dispatch under the ambient deadline/timeline
+                    # context (copy_context carries both to the executor)
+                    pctx = contextvars.copy_context()
+                    new_ref, new_replica = await loop.run_in_executor(
+                        None, lambda: pctx.run(_redispatch))
+                    resume_ctx["replica"] = new_replica
+                    gen = iter(new_ref)
+                    failover_at = t_fault
+                    self.stats["stream_resumes"] += 1
+                    if sp is not None:
+                        sp["attrs"]["stream_resumes"] = resumes
+                    await resp.write(
+                        b"event: resumed\ndata: " + json.dumps(
+                            {"resume_count": resumes,
+                             "resume_tokens": len(emitted_tokens)}).encode()
+                        + b"\n\n")
+                    continue
                 if chunk is _SSE_DONE:
                     break
                 if first_chunk_at is None:
                     first_chunk_at = time.monotonic()
-                if isinstance(chunk, dict) and chunk.get("ray_tpu"):
-                    # the final chunk carries the engine's attribution
-                    # payload (queue wait + stage timeline); last one wins
-                    engine_meta = chunk["ray_tpu"]
+                if isinstance(chunk, dict):
+                    toks = chunk.pop("token_ids", None)
+                    if toks:
+                        emitted_tokens.extend(int(t) for t in toks)
+                    rmeta = chunk.pop("resume_meta", None)
+                    if rmeta is not None and failover_at is not None:
+                        # failover stage: fault -> first resumed token,
+                        # with the target engine's restore accounting
+                        if tl is not None:
+                            tl.stamp(
+                                "failover", failover_at, time.time(),
+                                attempt=resumes,
+                                resumed=bool(rmeta.get("resumed")),
+                                restored_tokens=rmeta.get(
+                                    "restored_tokens", 0),
+                                restore_bytes=rmeta.get("restore_bytes", 0),
+                                restore_ms=rmeta.get("restore_ms", 0.0))
+                        failover_at = None
+                    if chunk.get("ray_tpu"):
+                        # the final chunk carries the engine's attribution
+                        # payload (queue wait + stage timeline); last wins
+                        engine_meta = chunk["ray_tpu"]
                 data = json.dumps(chunk) \
                     if not isinstance(chunk, str) else chunk
                 await resp.write(f"data: {data}\n\n".encode())
             self.stats["ok"] += 1
+            if router is not None:
+                # streaming retry-budget accounting (ISSUE 14 satellite):
+                # completed streams FUND the budget — without this a
+                # mostly-SSE fleet could never afford a mid-stream resume
+                router.stream_deposit()
         except (ConnectionResetError, asyncio.CancelledError):
             raise  # client went away: nothing left to tell it
         except Exception as e:  # noqa: BLE001 — stream error

@@ -217,8 +217,35 @@ class ServeReplica:
                 await result
         return True
 
+    def _eager_spill(self) -> None:
+        """Best-effort pre-death spill (ISSUE 14): callables that journal
+        resumable work (LLMServer) push in-flight KV into the tier NOW,
+        so failover continuations restore this replica's progress instead
+        of recomputing it. Runs on the pool so a slow spill can't stall
+        the actor loop's health checks."""
+        spill = getattr(self._callable, "eager_spill", None)
+        if spill is None:
+            return
+        try:
+            spill()
+        except Exception:  # noqa: BLE001 — drain must not fail on spill
+            pass
+
+    async def prepare_to_move(self) -> bool:
+        """Controller drain pre-move hook: spill in-flight state before
+        the replacement replica starts, WITHOUT waiting for ongoing
+        requests — the node is going away and continuations on the new
+        placement want the freshest chains in the tier."""
+        await asyncio.get_running_loop().run_in_executor(
+            self._exec, self._eager_spill)
+        return True
+
     async def prepare_for_shutdown(self, timeout_s: float = 20.0) -> bool:
-        """Graceful drain: wait for ongoing requests to finish."""
+        """Graceful drain: spill in-flight state FIRST (so even a
+        wait-timeout kill leaves resumable chains in the KV tier), then
+        wait for ongoing requests to finish."""
+        await asyncio.get_running_loop().run_in_executor(
+            self._exec, self._eager_spill)
         deadline = time.monotonic() + timeout_s
         while self._ongoing > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.02)

@@ -202,11 +202,16 @@ class ReplicaSet:
 
     def record_failure(self, replica) -> bool:
         """Count a replica-fault failure; returns True if this ejected the
-        replica from routing."""
+        replica from routing. Always poisons the queue-length probe cache
+        for the replica: a fresh pre-fault probe can make a dead replica
+        look idle for up to queue_len_staleness_s, and a mid-stream
+        failover redispatch must land on a SURVIVOR on the first try, not
+        spend a retry-budget token rediscovering the corpse."""
         key = self._key(replica)
         with self._cb_lock:
             n = self._fails.get(key, 0) + 1
             self._fails[key] = n
+            self._qlen[key] = (time.monotonic(), self._QLEN_DEAD)
             if n >= self.config.ejection_threshold \
                     and key not in self._ejected:
                 self._ejected[key] = time.monotonic()
@@ -265,8 +270,16 @@ class ReplicaSet:
             qlen = ray_tpu.get(replica.get_queue_len.remote(),
                                timeout=request_deadline.bound(
                                    self.config.queue_probe_timeout_s))
-        except Exception:  # noqa: BLE001 - dead replica looks busy
+        except Exception as e:  # noqa: BLE001 - dead replica looks busy
             qlen = self._QLEN_DEAD
+            if is_replica_fault(e):
+                # a probe that died with an actor fault is the same
+                # signal as a failed call: charge the breaker so a corpse
+                # is eventually EJECTED instead of re-probed (one probe
+                # timeout burned) every staleness window forever. A plain
+                # probe timeout is NOT charged — a busy-but-alive replica
+                # must only look busy, never accrue toward ejection.
+                self.record_failure(replica)
         self._qlen[key] = (now, qlen)
         return qlen
 
@@ -347,18 +360,33 @@ class ReplicaSet:
                 if m >= self.config.affinity_min_match_pages:
                     scored.append((m, r, key))
             if scored:
-                # best holder first; a saturated one spills to the next
-                # holder, and only when EVERY holder is saturated does the
-                # request demote to pow-2 (load wins over locality)
+                # load × locality (ISSUE 14 satellite): each holder's
+                # matched pages are discounted by its EXCESS queue depth
+                # over the least-loaded routable replica — score =
+                # matched − w·(q − q_min). Continuous, so equal holders
+                # split by live load instead of the old binary
+                # affinity_spillover_qlen threshold letting the top
+                # holder absorb everything until saturation. Probes are
+                # cached (queue_len_staleness_s), so the q_min scan costs
+                # at most one probe sweep per staleness window.
+                qlens = {key: self._probe(r, key) for r, key in candidates}
+                q_min = min(qlens.values())
+                w = self.config.affinity_load_weight
                 scored.sort(key=lambda t: t[0], reverse=True)
+                best, best_score = None, 0.0
                 for m, r, key in scored:
-                    if self._probe(r, key) < \
-                            self.config.affinity_spillover_qlen:
-                        self.affinity_hits += 1
-                        _AFFINITY_HITS.inc(tags={"deployment": self.name})
-                        _AFFINITY_MATCHED_PAGES.observe(
-                            m, tags={"deployment": self.name})
-                        return r, m
+                    s = m - w * (qlens[key] - q_min)
+                    if s > best_score:
+                        best, best_score = (m, r, key), s
+                if best is not None:
+                    m, r, key = best
+                    self.affinity_hits += 1
+                    _AFFINITY_HITS.inc(tags={"deployment": self.name})
+                    _AFFINITY_MATCHED_PAGES.observe(
+                        m, tags={"deployment": self.name})
+                    return r, m
+                # no holder's locality survives its load: demote to pow-2
+                # (an idle non-holder beats every loaded holder)
                 self.affinity_spillovers += 1
                 _AFFINITY_SPILLOVERS.inc(tags={"deployment": self.name})
                 attribution.note(demotion="spillover")
@@ -574,6 +602,18 @@ class Router:
 
         No retries — the caller owns the ref (DeploymentHandle path).
         `call()` is the retrying variant for request/response traffic."""
+        return self.assign_info(
+            deployment, method, args, kwargs, streaming=streaming,
+            timeout_s=timeout_s, multiplexed_model_id=multiplexed_model_id,
+            prefix_digests=prefix_digests)[0]
+
+    def assign_info(self, deployment: str, method: str, args: tuple,
+                    kwargs: dict, *, streaming: bool = False,
+                    timeout_s: float = 30.0, multiplexed_model_id: str = "",
+                    prefix_digests: Optional[list] = None) -> tuple:
+        """`assign` returning (ref, replica): callers that own the stream
+        (the proxy's SSE path) need the replica handle to charge the
+        circuit breaker when the stream dies mid-flight (ISSUE 14)."""
         t_route = time.time()
         rs, replica, matched = self._pick(deployment, multiplexed_model_id,
                                           timeout_s, prefix_digests)
@@ -589,7 +629,38 @@ class Router:
         # the end is the moment the replica actor owns the request
         attribution.note(replica=rs._key(replica)[:12], matched_pages=matched)
         attribution.stamp("route", t_route, time.time())
-        return ref
+        return ref, replica
+
+    # ---- streaming retry-budget accounting (ISSUE 14 satellite) ---------
+    # Streaming requests never pass through call(), so a mostly-SSE fleet
+    # used to neither fund nor spend the retry budget: the proxy deposits
+    # when a stream COMPLETES and withdraws for each mid-stream
+    # re-dispatch (failover continuation or retry-from-scratch).
+
+    def stream_deposit(self) -> None:
+        """A stream ran to completion: fund the retry budget, exactly as
+        a completed unary call() does."""
+        self._bump("requests")
+        self._budget.deposit()
+
+    def stream_withdraw(self, deployment: str) -> bool:
+        """Spend one retry token for a mid-stream re-dispatch. False =
+        budget empty: the caller must fail the stream instead of storming
+        a degraded fleet with continuations."""
+        if not self._budget.withdraw():
+            self._bump("retries_denied")
+            return False
+        self._bump("retries")
+        _RETRY_SPEND.inc(tags={"deployment": deployment})
+        return True
+
+    def record_replica_fault(self, deployment: str, replica) -> None:
+        """Charge the circuit breaker for a replica fault observed OUTSIDE
+        call() (a stream that died mid-flight)."""
+        with self._lock:
+            rs = self._sets.get(deployment)
+        if rs is not None and rs.record_failure(replica):
+            _EJECTION_COUNTER.inc(tags={"deployment": deployment})
 
     def call(self, deployment: str, method: str, args: tuple, kwargs: dict,
              *, timeout_s: Optional[float] = None,

@@ -177,11 +177,17 @@ class FaultSchedule:
       rpc_delay    stall matched RPC handlers via testing_rpc_failure
                    ({"spec": "*:0:0:DELAY", "duration_s": S})
       rpc_drop     drop matched RPCs ({"spec": "*:PROB", "duration_s": S})
+      replica_kill kill a serve REPLICA actor (ISSUE 14): named via
+                   {"app", "deployment"}, picked by {"replica_index"} or
+                   {"busiest": True} (live queue-length probe), else a
+                   random one; {"prepare": True} first runs a short
+                   prepare_for_shutdown (SIGTERM-with-grace: the replica
+                   eager-spills in-flight KV chains) before the hard kill
 
     Every event appends {"t", "kind", "ok", "detail"} to `report`."""
 
     KINDS = ("worker_kill", "node_kill", "node_drain", "cp_restart",
-             "rpc_delay", "rpc_drop")
+             "rpc_delay", "rpc_drop", "replica_kill")
 
     def __init__(self, cluster, events, *, seed: int = 0):
         for _, kind, _kw in events:
@@ -263,6 +269,57 @@ class FaultSchedule:
 
     def _do_rpc_drop(self, kw) -> str:
         return self._rpc_fault(kw, "*:0.3")
+
+    def _do_replica_kill(self, kw) -> str:
+        import ray_tpu
+        ctl = ray_tpu.get_actor("_serve_controller", timeout=2.0)
+        app, dep = kw.get("app"), kw.get("deployment")
+        if app is None or dep is None:
+            status = ray_tpu.get(ctl.status.remote(), timeout=5.0)
+            for full in status:          # full names are "app#deployment"
+                a, d = full.split("#", 1)
+                if (app is None or a == app) and (dep is None or d == dep):
+                    app, dep = a, d
+                    break
+        if app is None or dep is None:
+            return "no serve deployments to target"
+        table = ray_tpu.get(ctl.get_routing_table.remote(app), timeout=5.0)
+        entry = table.get(dep)
+        if not entry or not entry[0]:
+            return f"no replicas for {app}#{dep}"
+        replicas = list(entry[0])
+        idx = kw.get("replica_index")
+        if idx is not None:
+            victim = replicas[int(idx) % len(replicas)]
+        elif kw.get("busiest"):
+            # live probe: the replica holding the most in-flight work is
+            # exactly the one whose death exercises mid-stream failover
+            qlens = []
+            for r in replicas:
+                try:
+                    qlens.append(int(ray_tpu.get(r.get_queue_len.remote(),
+                                                 timeout=2.0)))
+                except Exception:  # noqa: BLE001 — dead looks idle
+                    qlens.append(-1)
+            victim = replicas[max(range(len(replicas)),
+                                  key=lambda i: qlens[i])]
+        else:
+            victim = self._rng.choice(replicas)
+        prepared = ""
+        if kw.get("prepare"):
+            # SIGTERM-with-grace: a short prepare window lets the replica
+            # eager-spill its in-flight KV chains before the hard kill
+            try:
+                ray_tpu.get(victim.prepare_for_shutdown.remote(
+                    timeout_s=float(kw.get("prepare_timeout_s", 0.2))),
+                    timeout=10.0)
+                prepared = " (prepared)"
+            except Exception:  # noqa: BLE001 — kill regardless
+                pass
+        ray_tpu.kill(victim)
+        aid = getattr(victim, "_actor_id", None)
+        aid = aid.hex()[:8] if hasattr(aid, "hex") else "?"
+        return f"killed replica {app}#{dep}[{aid}]{prepared}"
 
     # ---- driver --------------------------------------------------------
     def _loop(self):

@@ -383,3 +383,126 @@ def test_slo_violation_produces_ordered_exemplar(slo_cluster):
         assert "decode" in snames and "ingress" in snames
     finally:
         serve.shutdown()
+
+
+def test_request_id_stable_across_midstream_failover(slo_cluster):
+    """ISSUE 14 regression: a mid-stream failover must not re-mint the
+    request identity — the client-supplied X-Request-Id survives the
+    re-dispatch (response header), names the SLO exemplar record, and
+    the exemplar's timeline carries an ordered `failover` stage."""
+    import threading
+    import uuid
+
+    from ray_tpu import serve
+    from ray_tpu.observability import attribution
+    from ray_tpu.serve.controller import get_or_create_controller
+    from ray_tpu.util import state
+
+    serve.shutdown()
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.2,
+                      health_check_failure_threshold=3,
+                      # unmeetable TTFT: the resumed stream must still
+                      # ship a violation exemplar under its original id
+                      slo_ttft_p99_ms=0.001, slo_sample_rate=1.0)
+    class FlakyStream:
+        def __init__(self):
+            self._uid = uuid.uuid4().hex[:8]
+
+        def whoami(self):
+            return self._uid
+
+        def handle_http(self, path, method, payload):
+            if isinstance(payload, dict) and payload.get("stream"):
+                return self._gen(payload)
+            return {"uid": self._uid}
+
+        async def _gen(self, payload):
+            import asyncio
+            start = len(payload.get("resume_tokens") or [])
+            first = True
+            for i in range(start, 12):
+                chunk = {"choices": [{"text": f"t{i};", "index": 0,
+                                      "finish_reason": None}],
+                         "token_ids": [i], "rep": self._uid}
+                if first and payload.get("resume_count"):
+                    chunk["resume_meta"] = {
+                        "resumed": True, "restored_tokens": start,
+                        "restore_bytes": 0, "restore_ms": 0.0,
+                        "cached_tokens": 0}
+                first = False
+                yield chunk
+                await asyncio.sleep(0.15)
+            yield {"choices": [{"text": "", "index": 0,
+                                "finish_reason": "stop"}],
+                   "ray_tpu": {"ttft_s": 0.01}}
+
+    serve.run(FlakyStream.bind(), name="fo-rid", route_prefix="/forid")
+    proxy = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{proxy.port}"
+    rid = "foridstream01"
+    chunks: list = []
+    outcome: list = []
+
+    def _stream():
+        try:
+            req = urllib.request.Request(
+                f"{base}/forid/x", data=json.dumps(
+                    {"stream": True}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": rid})
+            with urllib.request.urlopen(req, timeout=120.0) as r:
+                hdr = r.headers.get("X-Request-Id")
+                for raw in r:
+                    line = raw.decode().strip()
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunks.append(json.loads(line[len("data: "):]))
+            outcome.append(hdr)
+        except Exception as e:  # noqa: BLE001 — asserted below
+            outcome.append(e)
+
+    try:
+        t = threading.Thread(target=_stream, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and sum(
+                1 for c in list(chunks) if c.get("rep")) < 3:
+            time.sleep(0.05)
+        serving = next(c["rep"] for c in chunks if c.get("rep"))
+        ctl = get_or_create_controller()
+        import ray_tpu as _rt
+        table = _rt.get(ctl.get_routing_table.remote("fo-rid"),
+                        timeout=10.0)
+        victim = None
+        for entry in table.values():
+            for h in entry[0]:
+                if _rt.get(h.handle_request.remote("whoami", (), {}),
+                           timeout=10.0) == serving:
+                    victim = h
+        assert victim is not None
+        _rt.kill(victim)
+
+        t.join(timeout=120.0)
+        assert outcome and not isinstance(outcome[0], Exception), \
+            f"stream failed: {outcome}"
+        assert outcome[0] == rid  # header stable across the handoff
+
+        # the exemplar lands under the SAME id, with a failover stage
+        rec = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and rec is None:
+            rec = state.get_slo_exemplar(rid)
+            if rec is None:
+                time.sleep(0.2)
+        assert rec is not None, "resumed stream's exemplar never arrived"
+        assert rec["request_id"] == rid
+        names = [s["stage"] for s in rec["stages"]]
+        assert "failover" in names, names
+        ranks = [attribution._STAGE_INDEX[n] for n in names
+                 if n in attribution._STAGE_INDEX]
+        assert ranks == sorted(ranks), f"stages out of order: {names}"
+        fo = next(s for s in rec["stages"] if s["stage"] == "failover")
+        assert fo["attrs"]["resumed"] is True
+        assert fo["attrs"]["attempt"] == 1
+    finally:
+        serve.shutdown()

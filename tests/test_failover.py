@@ -1,0 +1,473 @@
+"""Mid-stream generation failover (ISSUE 14): token-identical resumption
+of in-flight LLM requests after replica death.
+
+Pins the PR's acceptance invariants:
+- a continuation submit (original prompt + already-generated tokens) is
+  admitted through the cache-aware path and the resumed decode is
+  bit-identical to an uninterrupted greedy run, on all three admission
+  paths: local prefix hit, kv-tier restore of another engine's eager
+  spill, and cold recompute (no cache at all);
+- `spill_inflight` pushes every LIVE chain's computed pages into the
+  tier NOW (drain/SIGTERM path), so a surviving replica restores the
+  dead replica's progress instead of recomputing it;
+- past the resume cap (or with failover disabled) the server degrades to
+  a plain retry-from-scratch with the already-streamed prefix
+  suppressed — never a duplicated or missing token;
+- the ambient request deadline binds across the handoff: an expired
+  continuation is shed, not computed;
+- the proxy splices a resumed stream with zero duplicated/missing
+  tokens, emits a single `event: resumed` frame, keeps the X-Request-Id,
+  and lands an ordered `failover` stage in the attribution timeline.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+import ray_tpu
+
+
+def _cfg(**kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+
+    d = dict(model_config=llama.llama_tiny(vocab_size=512),
+             max_batch_size=4, page_size=16, num_pages=64,
+             max_prompt_len=96, max_seq_len=160, max_tokens=8)
+    d.update(kw)
+    return LLMConfig(**d)
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog"   # 43 byte-tokens
+LONG = PROMPT + " " + PROMPT                             # 87 -> 5 full pages
+
+_WANT: dict = {}
+
+
+def _want_tokens(prompt, max_tokens=8):
+    """Greedy ground truth from a cache-off, tier-off engine (memoized —
+    engine startup dominates this suite's runtime)."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    key = (prompt, max_tokens)
+    if key not in _WANT:
+        off = LLMEngine(_cfg(prefix_cache_enabled=False), rng_seed=0)
+        off.start()
+        try:
+            _WANT[key] = off.generate(prompt, max_tokens=max_tokens,
+                                      temperature=0.0)["tokens"]
+        finally:
+            off.shutdown()
+    return _WANT[key]
+
+
+def _wait(pred, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# unit: continuation-vs-degrade gating (llm_server policy)
+# ---------------------------------------------------------------------------
+
+
+def test_resume_plan_gating():
+    """Within the cap a resumed leg is a continuation (skip 0); past the
+    cap — or with failover off — it degrades to retry-from-scratch with
+    the full already-streamed prefix suppressed."""
+    from ray_tpu.serve.llm.llm_server import _resume_plan
+
+    cfg = _cfg()
+    assert _resume_plan([], 0, cfg) == (False, 0)
+    assert _resume_plan(None, 0, cfg) == (False, 0)
+    assert _resume_plan([1, 2, 3], 1, cfg) == (True, 0)
+    assert _resume_plan([1, 2, 3], cfg.failover_max_resumes, cfg) == (True, 0)
+    assert _resume_plan([1, 2, 3], cfg.failover_max_resumes + 1,
+                        cfg) == (False, 3)
+    off = _cfg(failover_enabled=False)
+    assert _resume_plan([1, 2], 1, off) == (False, 2)
+
+
+def test_continuation_submit_rejected_when_disabled():
+    """The engine refuses continuation admission when the operator turned
+    failover off — the caller must fall back to retry-from-scratch."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_cfg(failover_enabled=False), rng_seed=0)
+    try:
+        with pytest.raises(ValueError, match="failover_enabled"):
+            eng.submit(PROMPT, resume_tokens=[1, 2, 3])
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine: continuation admission token identity
+# ---------------------------------------------------------------------------
+
+
+def test_continuation_cold_recompute_token_identity():
+    """No cache anywhere: the continuation chunk-prefills prompt+resume
+    from scratch and decode still resumes at the exact next token."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(PROMPT, 8)
+    eng = LLMEngine(_cfg(prefix_cache_enabled=False), rng_seed=0)
+    eng.start()
+    try:
+        for k in (1, 4, 7):
+            rid = eng.submit(PROMPT, resume_tokens=want[:k],
+                             max_tokens=8 - k, temperature=0.0)
+            out = eng.result(rid, timeout=180.0)
+            assert out["error"] is None, out
+            assert out["tokens"] == want[k:], f"diverged at resume k={k}"
+        st = eng.engine_stats()
+        assert st["failover_resumed"] == 3
+        assert st["failover_restored_tokens"] == 0  # nothing to recover
+    finally:
+        eng.shutdown()
+
+
+def test_continuation_local_prefix_token_identity():
+    """Same-replica resume: the original leg's prompt pages are resident,
+    so the continuation admits over the local prefix match and only the
+    resume suffix is prefilled."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG, 8)
+    eng = LLMEngine(_cfg(), rng_seed=0)
+    eng.start()
+    try:
+        assert eng.generate(LONG, temperature=0.0)["tokens"] == want
+        k = 5
+        rid = eng.submit(LONG, resume_tokens=want[:k],
+                         max_tokens=8 - k, temperature=0.0)
+        out = eng.result(rid, timeout=180.0)
+        assert out["error"] is None, out
+        assert out["tokens"] == want[k:]
+        st = eng.engine_stats()
+        assert st["failover_resumed"] == 1
+        # LONG's 5 full prompt pages were resident from the first leg
+        assert st["failover_restored_tokens"] >= 4 * 16
+    finally:
+        eng.shutdown()
+
+
+def test_request_progress_journal():
+    """request_progress exposes the per-request journal the failover
+    path re-dispatches from; unknown ids answer None."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_cfg(), rng_seed=0)
+    eng.start()
+    try:
+        assert eng.request_progress("no-such-request") is None
+        rid = eng.submit(LONG, max_tokens=8, temperature=0.0)
+        assert _wait(lambda: bool(
+            (eng.request_progress(rid) or {}).get("generated")))
+        prog = eng.request_progress(rid)
+        assert prog["prompt_tokens"] == len(eng.tokenizer.encode(LONG))
+        assert prog["resume_len"] == 0
+        assert prog["admitted"] is True
+        out = eng.result(rid, timeout=180.0)
+        assert out["error"] is None
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine: eager in-flight spill (drain/SIGTERM path)
+# ---------------------------------------------------------------------------
+
+
+def test_eager_spill_inflight_pushes_live_chains():
+    """spill_inflight spills the computed full pages of LIVE requests
+    (ordinary spill only fires at pool eviction); a tier-off engine
+    answers 0."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    off = LLMEngine(_cfg(), rng_seed=0)
+    try:
+        assert off.spill_inflight() == 0
+    finally:
+        off.shutdown()
+
+    eng = LLMEngine(_cfg(kv_tier_enabled=True), rng_seed=0)
+    eng.start()
+    try:
+        rid = eng.submit(LONG, max_tokens=64, temperature=0.0)
+        assert _wait(lambda: len(
+            (eng.request_progress(rid) or {}).get("generated") or ()) >= 2,
+            timeout=120.0)
+        n = eng.spill_inflight()
+        # 5 full prompt pages are computed the moment decode starts
+        assert n >= 5, f"spilled only {n} pages for a live 5-page prompt"
+        assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 5)
+        out = eng.result(rid, timeout=180.0)
+        assert out["error"] is None
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine: deadline carried across the handoff
+# ---------------------------------------------------------------------------
+
+
+def test_expired_deadline_sheds_continuation():
+    """The proxy re-dispatches under the ambient deadline scope: a
+    continuation whose deadline already passed must be shed by the
+    engine, not silently recomputed."""
+    from ray_tpu.core import deadline as request_deadline
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_cfg(), rng_seed=0)
+    eng.start()
+    try:
+        with request_deadline.scope(time.time() - 0.5):
+            rid = eng.submit(PROMPT, resume_tokens=[5, 6, 7], max_tokens=4,
+                             temperature=0.0)
+        out = eng.result(rid, timeout=60.0)
+        assert out["error"] == "deadline exceeded"
+        assert out["tokens"] == []
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# attribution: the failover stage is a first-class ordered stage
+# ---------------------------------------------------------------------------
+
+
+def test_failover_stage_ordered_in_timeline():
+    from ray_tpu.observability import attribution
+    from ray_tpu.observability.attribution import Timeline
+
+    assert "failover" in attribution.STAGES
+    idx = attribution._STAGE_INDEX
+    assert idx["route"] < idx["failover"] < idx["queue"]
+
+    tl = Timeline("fo-tl")
+    # stamped in arrival order: the failover stamp lands when the FIRST
+    # resumed chunk arrives, after the engine stages of the dead leg
+    tl.stamp("ingress", 1.0, 1.001)
+    tl.stamp("route", 1.001, 1.002)
+    tl.extend([
+        {"stage": "queue", "start": 1.3, "end": 1.31, "attrs": {}},
+        {"stage": "restore", "start": 1.31, "end": 1.35,
+         "attrs": {"restored_tokens": 96}},
+        {"stage": "prefill", "start": 1.35, "end": 1.4, "attrs": {}},
+        {"stage": "decode", "start": 1.4, "end": 1.6, "attrs": {}},
+    ])
+    tl.stamp("failover", 1.1, 1.35, attempt=1, resumed=True,
+             restored_tokens=96, restore_bytes=12288, restore_ms=40.0)
+    names = [s["stage"] for s in tl.ordered_stages()]
+    assert names == ["ingress", "route", "failover", "queue", "restore",
+                     "prefill", "decode"]
+    fo = next(s for s in tl.ordered_stages() if s["stage"] == "failover")
+    assert fo["attrs"]["restored_tokens"] == 96
+    assert fo["attrs"]["resumed"] is True
+
+    rec = {"request_id": "fo-agg", "ts": time.time(), "app": "a",
+           "deployment": "d", "replica": "rep-a", "source": "src",
+           "kind": "violation", "violated": ["e2e"], "ttft_ms": 10.0,
+           "e2e_ms": 600.0, "policy": {}, "error": None, "trace_id": "",
+           "stages": tl.ordered_stages()}
+    rep = attribution.aggregate_report([rec])
+    assert rep["stage_ms"]["failover"]["count"] == 1
+    assert rep["stage_ms"]["failover"]["p50"] == pytest.approx(250.0)
+
+
+# ---------------------------------------------------------------------------
+# cluster: cross-engine tier restore of an eagerly spilled in-flight chain
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def failover_cluster(ray_start_module):
+    yield ray_start_module
+
+
+def test_tier_restore_continuation_cross_engine(failover_cluster):
+    """The full failover KV path: engine A eagerly spills a LIVE chain
+    (prompt + generated pages), engine B admits the continuation via the
+    CP index + object plane and resumes token-identically — the dead
+    replica's decode progress is restored, not recomputed."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    # 72 tokens (the whole remaining seq budget): with a warm in-process
+    # jit cache the decode runs at ~ms/token, and a shorter run can
+    # FINISH between wait-polls — a completed chain is no longer
+    # in-flight and spill_inflight() would correctly find nothing
+    want = _want_tokens(LONG, 72)
+    cfg = _cfg(kv_tier_enabled=True)
+    a = LLMEngine(cfg, rng_seed=0)
+    a.start()
+    b = None
+    try:
+        rid = a.submit(LONG, max_tokens=72, temperature=0.0)
+        # wait until the chain covers a full page PAST the prompt, so the
+        # spill includes generated-region KV (covered = 87 + gen-1 >= 96)
+        assert _wait(lambda: len(
+            (a.request_progress(rid) or {}).get("generated") or ()) >= 12,
+            timeout=120.0)
+        n = a.spill_inflight()
+        assert n >= 6, f"expected prompt+generated pages spilled, got {n}"
+        assert _wait(lambda: a.engine_stats()["spilled_pages"] >= 6)
+
+        b = LLMEngine(cfg, rng_seed=0)
+        b.start()
+        k = 12
+        rid_b = b.submit(LONG, resume_tokens=want[:k],
+                         max_tokens=72 - k, temperature=0.0)
+        out = b.result(rid_b, timeout=180.0)
+        assert out["error"] is None, out
+        assert out["tokens"] == want[k:], "resumed decode diverged"
+        st = b.engine_stats()
+        assert st["failover_resumed"] == 1
+        assert st["restored_pages"] >= 6        # includes a generated page
+        assert st["failover_restored_tokens"] >= 6 * 16
+    finally:
+        a.shutdown()
+        if b is not None:
+            b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# cluster: proxy splice — kill the serving replica mid-stream
+# ---------------------------------------------------------------------------
+
+
+def _read_sse(base, path, payload, rid, events, done):
+    """Stream an SSE response, appending ("event", name) / ("data", obj)
+    tuples to `events`; `done` carries the response headers or error."""
+    try:
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": rid})
+        with urllib.request.urlopen(req, timeout=120.0) as r:
+            hdr = dict(r.headers)
+            for raw in r:
+                line = raw.decode().strip()
+                if line.startswith("event: "):
+                    events.append(("event", line[len("event: "):]))
+                elif line.startswith("data: "):
+                    body = line[len("data: "):]
+                    if body == "[DONE]":
+                        break
+                    events.append(("data", json.loads(body)))
+        done.append(hdr)
+    except Exception as e:  # noqa: BLE001 — the test asserts on this
+        done.append(e)
+
+
+def test_proxy_splices_stream_across_replica_death(failover_cluster):
+    """End-to-end resume plumbing without an engine: a scripted streaming
+    ingress on 2 replicas, the serving replica hard-killed mid-stream.
+    The client must see every token exactly once, one `event: resumed`
+    frame, the same X-Request-Id, and a normal finish."""
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import get_or_create_controller
+
+    serve.shutdown()
+    n_tokens = 16
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.2,
+                      health_check_failure_threshold=3)
+    class ScriptedStream:
+        def __init__(self):
+            self._uid = uuid.uuid4().hex[:8]
+
+        def whoami(self):
+            return self._uid
+
+        def handle_http(self, path, method, payload):
+            if isinstance(payload, dict) and payload.get("stream"):
+                return self._gen(payload)
+            return {"uid": self._uid}
+
+        async def _gen(self, payload):
+            import asyncio
+            resume = payload.get("resume_tokens") or []
+            start = len(resume)
+            total = start + int(payload.get("max_tokens") or n_tokens)
+            first = True
+            for i in range(start, total):
+                chunk = {"choices": [{"text": f"t{i};", "index": 0,
+                                      "finish_reason": None}],
+                         "token_ids": [i], "rep": self._uid}
+                if first and payload.get("resume_count"):
+                    chunk["resume_meta"] = {
+                        "resumed": True, "restored_tokens": start,
+                        "restore_bytes": 0, "restore_ms": 0.0,
+                        "cached_tokens": 0}
+                first = False
+                yield chunk
+                await asyncio.sleep(0.15)
+            yield {"choices": [{"text": "", "index": 0,
+                                "finish_reason": "stop"}],
+                   "ray_tpu": {"ttft_s": 0.01}}
+
+    serve.run(ScriptedStream.bind(), name="fo-scripted",
+              route_prefix="/fo")
+    proxy = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{proxy.port}"
+    rid = "fostream0001"
+    events: list = []
+    finished: list = []
+    try:
+        t = threading.Thread(
+            target=_read_sse, args=(base, "/fo/stream",
+                                    {"stream": True,
+                                     "max_tokens": n_tokens},
+                                    rid, events, finished), daemon=True)
+        t.start()
+        # let a few chunks reach the client, then kill the serving replica
+        assert _wait(lambda: sum(1 for k, v in list(events)
+                                 if k == "data" and v.get("rep")) >= 3,
+                     timeout=60.0)
+        serving = next(v["rep"] for k, v in events
+                       if k == "data" and v.get("rep"))
+        ctl = get_or_create_controller()
+        table = ray_tpu.get(ctl.get_routing_table.remote("fo-scripted"),
+                            timeout=10.0)
+        victim = None
+        for entry in table.values():
+            for h in entry[0]:
+                uid = ray_tpu.get(
+                    h.handle_request.remote("whoami", (), {}), timeout=10.0)
+                if uid == serving:
+                    victim = h
+        assert victim is not None, f"serving replica {serving} not in table"
+        ray_tpu.kill(victim)
+
+        t.join(timeout=120.0)
+        assert not t.is_alive(), "stream never finished after the kill"
+        assert finished and not isinstance(finished[0], Exception), \
+            f"stream failed: {finished}"
+        assert finished[0].get("X-Request-Id") == rid  # stable across legs
+
+        texts = [c["choices"][0]["text"] for k, c in events
+                 if k == "data" and c.get("choices")]
+        assert "".join(texts) == "".join(f"t{i};" for i in range(n_tokens)), \
+            f"spliced stream has duplicated/missing tokens: {texts}"
+        resumed = [v for k, v in events if k == "event" and v == "resumed"]
+        assert len(resumed) == 1, f"expected one resumed frame: {events}"
+        # the resumed leg ran on the OTHER replica
+        reps = {c["rep"] for k, c in events if k == "data" and c.get("rep")}
+        assert len(reps) == 2, f"resume stayed on the dead replica: {reps}"
+        # the wire never leaks the internal journal keys
+        assert all("token_ids" not in c and "resume_meta" not in c
+                   for k, c in events if k == "data")
+        assert proxy.stats.get("stream_resumes", 0) >= 1
+    finally:
+        serve.shutdown()

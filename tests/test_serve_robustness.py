@@ -421,10 +421,12 @@ def test_router_retry_absorbs_dead_replica(serve_shutdown):
     serve.run(echo.bind(), name="appretry", route_prefix=None)
     from ray_tpu.serve.controller import get_or_create_controller
     ctl = get_or_create_controller()
-    # wide staleness: the queue-len cache keeps the dead replica lookin
-    # routable, forcing the retry path (probes would otherwise dodge it)
+    # staleness wide enough that the first post-kill call still sees the
+    # warmup probe's idle entry and PICKS the corpse (forcing the retry
+    # path), short enough that the fault-poisoned entry later expires and
+    # the re-probe's actor fault can finish ejecting it
     router = Router(ctl, "appretry", RouterConfig(
-        queue_len_staleness_s=60.0, ejection_threshold=2,
+        queue_len_staleness_s=1.0, ejection_threshold=2,
         ejection_cooldown_s=60.0))
     try:
         for i in range(5):  # warm the routing table + qlen cache
@@ -444,6 +446,16 @@ def test_router_retry_absorbs_dead_replica(serve_shutdown):
         stats = router.stats_snapshot()
         assert stats["requests"] == 25
         assert stats["retries"] >= 1, f"no retry recorded: {stats}"
+        # the recorded fault poisoned the corpse's qlen-cache entry, so
+        # every call since landed on the survivor FIRST try (ISSUE 14:
+        # a failover redispatch must not rediscover the corpse). Once
+        # the poison expires, the next selection re-probes it, the
+        # probe's actor fault charges the breaker, and it is ejected.
+        time.sleep(1.1)
+        for i in range(3):
+            out, _ = router.call("echo", "__call__", (i,), {}, timeout_s=30)
+            assert out == i
+        stats = router.stats_snapshot()
         assert stats["ejections"] >= 1, f"dead replica never ejected: {stats}"
     finally:
         router.stop()
