@@ -1237,6 +1237,7 @@ def main():
             # cross-restore stress test — exactly the coverage a kv-tier
             # perf number needs behind it
             preflight_tests.append("tests/test_kv_tier.py")
+            preflight_tests.append("tests/test_kv_codec.py")
         rc = subprocess.run(
             [sys.executable, "-m", "pytest", "-q", *preflight_tests],
             cwd=repo, env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
@@ -1712,12 +1713,14 @@ def main():
                 "spec-off completions differ — the accept/rollback path is "
                 "broken, not benchmarking it")
 
-    # tiered-KV-cache A/B (ISSUE 7): shared-prefix greedy completions
-    # against three engines — a tier-off control (cold-prefill TTFT), a
-    # tier-on replica A that seeds and spills the prefix chains, and a
-    # COLD tier-on replica B that has never seen the prompts and must
-    # restore A's spilled pages through the CP index + object plane.
-    # Token identity is a HARD assert: restore must be a pure perf knob.
+    # tiered-KV-cache A/B (ISSUE 7, codec arms ISSUE 15): shared-prefix
+    # greedy completions against a tier-off control (cold-prefill TTFT)
+    # and, per codec arm, a tier-on replica A that seeds and spills the
+    # prefix chains plus a COLD tier-on replica B that has never seen the
+    # prompts and must STREAM A's spilled pages back through the CP index
+    # + object plane. Arms: "none" (the PR 7 raw wire format), "lossless"
+    # (identity is a HARD assert), "int8" (identity NOT asserted —
+    # divergence recorded; its ratio is the >=3x capacity claim).
     # Runs the deeper cpu-tiny model (like --spec-ab) so prefill is
     # weights-bound and the restored-scatter-vs-recompute delta is real.
     kv_tier = None
@@ -1740,8 +1743,8 @@ def main():
         shared = "shared context " * 40             # 600 tokens ~ 18 pages
         kv_prompts = [shared + f"Q{i}: " for i in range(4)]
 
-        def kvt_run(eng) -> tuple[list, list]:
-            ttfts, comps = [], []
+        def kvt_run(eng) -> tuple[list, list, list]:
+            ttfts, comps, restores = [], [], []
             for p in kv_prompts:
                 out = eng.generate(p, max_tokens=16, temperature=0.0)
                 if out["error"]:
@@ -1749,45 +1752,91 @@ def main():
                                      f"{out['error']}")
                 ttfts.append(out["ttft_s"])
                 comps.append((out["text"], len(out["tokens"])))
-            return ttfts, comps
+                restores += [s["attrs"] for s in out.get("stages") or ()
+                             if s["stage"] == "restore"]
+            return ttfts, comps, restores
+
+        def kvt_pair(codec: str) -> dict:
+            """One seeding replica A + one cold restoring replica B under
+            ``codec``; A stays alive while B restores (its shutdown
+            retracts the index entries and drops the blobs B streams)."""
+            cfg = _dc.replace(kvt_cfg, kv_tier_codec=codec)
+            a_eng = LLMEngine(cfg, rng_seed=0)
+            a_eng.start()
+            b_eng = None
+            try:
+                _a_ttfts, a_comps, _ = kvt_run(a_eng)
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline and \
+                        a_eng.engine_stats()["spilled_pages"] < 1:
+                    time.sleep(0.05)
+                a_st = a_eng.engine_stats()
+                if a_st["spilled_pages"] < 1:
+                    raise SystemExit(
+                        f"kv-tier A/B [{codec}]: replica A spilled "
+                        f"nothing — eviction->spill path inert, not "
+                        f"benchmarking it")
+                b_eng = LLMEngine(cfg, rng_seed=0)
+                b_eng.start()
+                b_ttfts, b_comps, b_restores = kvt_run(b_eng)
+                b_st = b_eng.engine_stats()
+            finally:
+                a_eng.shutdown()
+                if b_eng is not None:
+                    b_eng.shutdown()
+            if b_st["restored_pages"] < 1:
+                raise SystemExit(
+                    f"kv-tier A/B [{codec}]: cold replica B restored "
+                    f"nothing — the CP index/object-plane path is inert, "
+                    f"not benchmarking it")
+            p50_warm = statistics.median(b_ttfts) * 1e3
+            # restore-stall breakdown from B's attribution stages: wall
+            # restore time, how much of it overlapped other work instead
+            # of blocking the loop, codec decode cost, encoded wire bytes
+            n_r = max(1, len(b_restores))
+            return {
+                "codec": codec,
+                "a_completions": a_comps, "b_completions": b_comps,
+                "spilled_pages_a": a_st["spilled_pages"],
+                "codec_ratio_a": a_st["tier_codec_ratio"],
+                "encode_ms_p50_a": a_st["tier_encode_ms_p50"],
+                "restored_pages_b": b_st["restored_pages"],
+                "restore_partial_b": b_st["restore_partial"],
+                "tier_hit_tokens_b": b_st["tier_hit_tokens"],
+                "decode_ms_p50_b": b_st["tier_decode_ms_p50"],
+                "p50_ttft_warm_b_ms": round(p50_warm, 2),
+                "restore_ms_mean": round(sum(
+                    r["restore_ms"] for r in b_restores) / n_r, 2),
+                "overlap_ms_mean": round(sum(
+                    r["overlap_ms"] for r in b_restores) / n_r, 2),
+                "decode_ms_mean": round(sum(
+                    r["decode_ms"] for r in b_restores) / n_r, 2),
+                "bytes_wire_b": sum(r["bytes_wire"] for r in b_restores),
+                "bytes_raw_b": sum(r["restore_bytes"]
+                                   for r in b_restores),
+            }
 
         cold_eng = LLMEngine(_dc.replace(kvt_cfg, kv_tier_enabled=False,
                                          prefix_cache_enabled=False),
                              rng_seed=0)
         cold_eng.start()
         try:
-            cold_ttfts, want = kvt_run(cold_eng)
+            cold_ttfts, want, _ = kvt_run(cold_eng)
         finally:
             cold_eng.shutdown()
 
-        # A must stay alive while B restores: its shutdown retracts the
-        # index entries and drops the shm blobs B fetches
-        a_eng = LLMEngine(kvt_cfg, rng_seed=0)
-        a_eng.start()
-        b_eng = None
-        try:
-            _a_ttfts, a_comps = kvt_run(a_eng)
-            deadline = time.monotonic() + 120
-            while time.monotonic() < deadline and \
-                    a_eng.engine_stats()["spilled_pages"] < 1:
-                time.sleep(0.05)
-            a_st = a_eng.engine_stats()
-            if a_st["spilled_pages"] < 1:
-                raise SystemExit("kv-tier A/B: replica A spilled nothing "
-                                 "— eviction->spill path inert, not "
-                                 "benchmarking it")
-            b_eng = LLMEngine(kvt_cfg, rng_seed=0)
-            b_eng.start()
-            b_ttfts, b_comps = kvt_run(b_eng)
-            b_st = b_eng.engine_stats()
-        finally:
-            a_eng.shutdown()
-            if b_eng is not None:
-                b_eng.shutdown()
-
-        identical = want == a_comps == b_comps
+        arms = {c: kvt_pair(c) for c in ("none", "lossless", "int8")}
+        lossless, raw, int8 = arms["lossless"], arms["none"], arms["int8"]
+        identical = want == lossless["a_completions"] \
+            == lossless["b_completions"]
+        raw_identical = want == raw["a_completions"] == raw["b_completions"]
+        int8_diverged = sum(1 for w, got in zip(want, int8["b_completions"])
+                            if got != w)
         p50_cold = statistics.median(cold_ttfts) * 1e3
-        p50_warm = statistics.median(b_ttfts) * 1e3
+        p50_warm = lossless["p50_ttft_warm_b_ms"]
+        for arm in arms.values():
+            arm.pop("a_completions")
+            arm.pop("b_completions")
         kv_tier = {
             "label": "kv_tier_cross_replica",
             "model": kvt_cfg.model_id,
@@ -1795,25 +1844,29 @@ def main():
             "requests": len(kv_prompts),
             "shared_prefix_tokens": len(shared),
             "greedy_identical": identical,
-            "spilled_pages_a": a_st["spilled_pages"],
-            "restored_pages_b": b_st["restored_pages"],
-            "tier_hit_tokens_b": b_st["tier_hit_tokens"],
+            "int8_diverged_completions": int8_diverged,
             "p50_ttft_cold_ms": round(p50_cold, 2),
-            "p50_ttft_warm_b_ms": round(p50_warm, 2),
+            "p50_ttft_warm_b_ms": p50_warm,
             "ttft_speedup": round(p50_cold / p50_warm, 2)
             if p50_warm else None,
+            "ttft_vs_raw": round(
+                p50_warm / raw["p50_ttft_warm_b_ms"], 3)
+            if raw["p50_ttft_warm_b_ms"] else None,
+            "codec_arms": arms,
         }
-        if not identical:
+        if not (identical and raw_identical):
             print(json.dumps({"kv_tier": kv_tier}))
             raise SystemExit(
                 "kv-tier restore changed greedy output: tier-restored "
                 "completions differ from cold prefill — the spill/restore "
                 "path is corrupting KV, not benchmarking it")
-        if b_st["restored_pages"] < 1:
+        if int8["codec_ratio_a"] < 3.0:
             print(json.dumps({"kv_tier": kv_tier}))
             raise SystemExit(
-                "kv-tier A/B: cold replica B restored nothing — the CP "
-                "index/object-plane path is inert, not benchmarking it")
+                f"kv-tier A/B: int8 codec ratio "
+                f"{int8['codec_ratio_a']}x < 3x on the tiny-model tier — "
+                f"the quantized width cut is not reaching the stored "
+                f"bytes")
 
     serve.shutdown()
 

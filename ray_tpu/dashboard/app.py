@@ -570,8 +570,25 @@ class Dashboard:
                 return state.list_slo_exemplars(limit=100)
             if section == "kvtier":
                 # tiered-KV prefix index rows (same CP query `ray-tpu
-                # kvtier` renders); the generic section loop tables them
-                return (state.list_kv_tier() or {}).get("entries") or []
+                # kvtier` renders); the generic section loop tables them.
+                # Leading summary rows give stored-vs-raw bytes per tier
+                # and the effective codec ratio (= capacity multiplier
+                # on the tier byte caps)
+                ents = (state.list_kv_tier() or {}).get("entries") or []
+                agg: dict = {}
+                for e in ents:
+                    a = agg.setdefault(e.get("tier", "?"),
+                                       {"entries": 0, "enc": 0, "raw": 0})
+                    a["entries"] += 1
+                    a["enc"] += int(e.get("nbytes") or 0)
+                    a["raw"] += int(e.get("raw") or e.get("nbytes") or 0)
+                summary = [
+                    {"tier": t, "entries": a["entries"],
+                     "bytes_stored": a["enc"], "bytes_raw": a["raw"],
+                     "codec_ratio": round(a["raw"] / a["enc"], 3)
+                     if a["enc"] else 0.0}
+                    for t, a in sorted(agg.items())]
+                return summary + ents
             if section == "timeseries":
                 return self._timeseries.snapshot()
             if section == "logs":

@@ -163,6 +163,9 @@ def engine_stages(*, submitted_wall: float, submitted_at: float,
                   finished_at: Optional[float],
                   cached_tokens: int = 0, restored_tokens: int = 0,
                   restore_bytes: int = 0, restore_ms: float = 0.0,
+                  restore_wire_bytes: int = 0,
+                  restore_decode_ms: float = 0.0,
+                  restore_overlap_ms: float = 0.0,
                   prompt_tokens: int = 0, generated_tokens: int = 0,
                   itl_s: Optional[float] = None) -> list[dict]:
     """Build ordered stage dicts from the engine's raw per-request
@@ -193,7 +196,17 @@ def engine_stages(*, submitted_wall: float, submitted_at: float,
                     "end": restore_end,
                     "attrs": {"restored_tokens": int(restored_tokens),
                               "restore_bytes": int(restore_bytes),
-                              "restore_ms": round(float(restore_ms), 3)}})
+                              "restore_ms": round(float(restore_ms), 3),
+                              # streaming split (ISSUE 15): encoded bytes
+                              # actually moved, codec decode cost, and
+                              # how much of the wall hid under other
+                              # requests' compute instead of blocking
+                              # this one
+                              "bytes_wire": int(restore_wire_bytes),
+                              "decode_ms": round(
+                                  float(restore_decode_ms), 3),
+                              "overlap_ms": round(
+                                  float(restore_overlap_ms), 3)}})
     if first_token_at is not None:
         ft_wall = wall(first_token_at)
         prefilled = max(0, int(prompt_tokens) - int(cached_tokens))

@@ -395,9 +395,12 @@ def cmd_kvtier(args) -> None:
     by_node: dict[str, int] = {}
     for e in entries:
         t = by_tier.setdefault(e.get("tier", "?"),
-                               {"entries": 0, "bytes": 0})
+                               {"entries": 0, "bytes": 0, "raw": 0})
         t["entries"] += 1
         t["bytes"] += int(e.get("nbytes") or 0)
+        # pre-codec size; raw-format entries (codec "none", pre-codec
+        # publishers) carry no "raw" field — stored == raw there
+        t["raw"] += int(e.get("raw") or e.get("nbytes") or 0)
         node = (e.get("node") or "?")[:8]
         by_node[node] = by_node.get(node, 0) + 1
         print(json.dumps({
@@ -405,12 +408,16 @@ def cmd_kvtier(args) -> None:
             "tier": e.get("tier"), "node": node,
             "owner": (e.get("owner") or "")[:8],
             "tokens": e.get("tokens"), "nbytes": e.get("nbytes"),
+            "raw": e.get("raw"),
             "age_s": round(time.time() - e["ts"], 1)
             if e.get("ts") else None}))
     print(f"# {len(entries)} indexed pages", file=sys.stderr)
     for tier, agg in sorted(by_tier.items()):
+        ratio = (agg["raw"] / agg["bytes"]) if agg["bytes"] else 0.0
         print(f"#   tier={tier}: {agg['entries']} entries "
-              f"{agg['bytes']} bytes", file=sys.stderr)
+              f"{agg['bytes']} bytes stored / {agg['raw']} raw "
+              f"(codec ratio {ratio:.2f}x => holds {ratio:.2f}x the "
+              f"prefix tokens per byte cap)", file=sys.stderr)
     for node, n in sorted(by_node.items()):
         print(f"#   node={node}: {n} entries", file=sys.stderr)
     c = res.get("counters") or {}

@@ -379,8 +379,12 @@ class ServeController:
                         "prefix_cached_pages", "prefix_shared_pages",
                         "prefix_evictions",
                         "spilled_pages", "restored_pages",
+                        "restore_partial", "restoring",
                         "tier_hit_tokens", "tier_bytes_shm",
                         "tier_bytes_disk",
+                        "tier_bytes_shm_raw", "tier_bytes_disk_raw",
+                        "tier_codec_ratio",
+                        "tier_encode_ms_p50", "tier_decode_ms_p50",
                         "tier_prefetch_hints", "tier_prefetch_pages",
                         "tier_prefetch_hit_pages",
                         "prefix_summary_version", "prefix_summary_pages",

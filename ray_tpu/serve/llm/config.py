@@ -114,6 +114,23 @@ class LLMConfig:
     kv_tier_disk_dir: Optional[str] = None       # None = disk tier off
     kv_tier_disk_max_bytes: int = 1024 * 1024 * 1024
     kv_tier_ttl_s: float = 600.0                 # entry lifetime; <=0 = none
+    # Page codec (serve/llm/kv_codec.py): pages are stored in the tiers
+    # and shipped over the object plane ENCODED, so both byte caps hold
+    # codec-ratio more prefix tokens and restores move fewer wire bytes.
+    # "lossless" (byte-plane shuffle + DEFLATE) keeps greedy outputs
+    # bit-identical; "int8" (per layer/kv-head scale quantization, ~4x
+    # on fp32 before entropy coding) trades bounded reconstruction
+    # error for ratio — opt-in, divergence measured by
+    # `bench_serve.py --kv-tier-ab`; "none" is the raw PR 7 wire format.
+    kv_tier_codec: str = "lossless"              # "none"|"lossless"|"int8"
+    # Streaming restore: pages land chunk-by-chunk and inject while
+    # later chunks are still in flight. chunk_pages is the fetch
+    # granularity; the PR 7 fetch budget applies PER CHUNK (one dead
+    # peer = one chunk stall -> partial restore, landed pages kept);
+    # the landed-but-uninjected buffer is byte-bounded by the window.
+    kv_tier_chunk_pages: int = 8
+    kv_tier_chunk_timeout_s: float = 2.0
+    kv_tier_stream_window_bytes: int = 8 * 1024 * 1024
 
     # Mid-stream generation failover (ISSUE 14): a replica dying
     # mid-decode no longer drops its streams — the proxy re-dispatches

@@ -74,10 +74,25 @@ class _Request:
     # submit→admit gap is the queue wait surfaced in result()/engine_stats
     admitted_at: Optional[float] = None
     # KV-tier restore accounting (ISSUE 12 attribution): tokens whose KV
-    # came back from the tier, payload size, and the blocking restore time
+    # came back from the tier, decoded payload size, and the restore
+    # wall time (stream open -> finalize; the stream overlaps other
+    # requests' work, so wall != loop time — see restore_blocked_ms)
     restored_tokens: int = 0
     restore_bytes: int = 0
     restore_ms: float = 0.0
+    # streaming restore (ISSUE 15): the live ChainStream while this
+    # request sits in _restoring, plus its attribution split — encoded
+    # bytes off the wire, codec decode time, loop time actually spent
+    # on this stream (take/decode/inject); overlap = wall - blocked,
+    # i.e. how much restore latency hid under other engine work
+    restore_stream: Any = None
+    restore_started: float = 0.0        # perf_counter at stream open
+    restore_page0: int = 0              # first chain slot the stream fills
+    restore_pages: int = 0              # pages injected so far
+    restore_wire_bytes: int = 0
+    restore_decode_ms: float = 0.0
+    restore_blocked_ms: float = 0.0
+    restore_overlap_ms: float = 0.0
     first_token_at: Optional[float] = None
     # inter-token latency: host record-time of the last token plus the
     # per-token gaps (pipelined harvests record blocks in bursts, so the
@@ -172,6 +187,11 @@ class LLMEngine:
         # interleaved with decode blocks, so a long admission never stalls
         # active generations for its whole prompt pass
         self._prefilling: list[_Request] = []
+        # streaming tier restore (ISSUE 15): admitted (slot+pages held),
+        # restore stream open — the loop decodes+injects landed chunks
+        # (_restore_steps) and routes each request on to its suffix
+        # prefill when the stream ends (fully or partially)
+        self._restoring: list[_Request] = []
         self._requests: dict[str, _Request] = {}
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -182,7 +202,7 @@ class LLMEngine:
                       "prefix_hits": 0, "prefix_misses": 0,
                       "prefix_hit_tokens": 0,
                       "spilled_pages": 0, "restored_pages": 0,
-                      "tier_hit_tokens": 0,
+                      "tier_hit_tokens": 0, "restore_partial": 0,
                       "spec_rounds": 0, "spec_drafted_tokens": 0,
                       "spec_accepted_tokens": 0,
                       "failover_resumed": 0, "failover_restored_tokens": 0}
@@ -215,13 +235,21 @@ class LLMEngine:
                 repr(self.model_cfg),
                 str(cfg.page_size),
                 str(self.kv["k"].dtype)])
+            if cfg.kv_tier_codec == "int8":
+                # lossy pages are NOT interchangeable with exact ones: a
+                # lossless replica restoring quantized KV would silently
+                # break its bit-identity guarantee, so quantized stores
+                # index under their own namespace. none<->lossless mix
+                # freely (both decode to identical bytes).
+                ident += "|int8"
             self._kv_tier = kvt.KVTierStore(
                 max_bytes=cfg.kv_tier_max_bytes,
                 disk_dir=cfg.kv_tier_disk_dir,
                 disk_max_bytes=cfg.kv_tier_disk_max_bytes,
                 ttl_s=cfg.kv_tier_ttl_s,
                 page_size=cfg.page_size,
-                namespace=hashlib.sha256(ident.encode()).hexdigest()[:16])
+                namespace=hashlib.sha256(ident.encode()).hexdigest()[:16],
+                codec=cfg.kv_tier_codec)
             self.allocator.spill_hook = self._spill_capture
             # restore scatter at ONE fixed shape (max_pages_per_seq,
             # trash-page padded) — same donated-pool pattern as disagg's
@@ -552,6 +580,14 @@ class LLMEngine:
                 self._harvest_one()
         except Exception:  # noqa: BLE001 - device may already be gone
             self._pending.clear()
+        # restore streams have their own worker threads; cut them before
+        # the tier closes underneath them
+        with self._lock:
+            restoring = list(self._restoring)
+        for req in restoring:
+            if req.restore_stream is not None:
+                req.restore_stream.abort()
+                req.restore_stream = None
         if self._kv_tier is not None:
             # flush captured spills, then drop the tier's blobs and
             # retract our cluster-index entries — a clean shutdown must
@@ -653,10 +689,11 @@ class LLMEngine:
                 # leave it blocking to its full timeout
                 req.done_event.set()
                 return
-            if req in self._prefilling:
-                # mid chunked prefill: flag it and let the LOOP free the
-                # slot/pages (_abort_prefilling) — the loop may be building
-                # a chunk dispatch from req.pages on the host right now, so
+            if req in self._prefilling or req in self._restoring:
+                # mid chunked prefill (or mid tier-restore stream): flag
+                # it and let the LOOP free the slot/pages
+                # (_abort_prefilling) — the loop may be building a chunk
+                # dispatch from req.pages on the host right now, so
                 # freeing here could hand those pages to a later admission
                 # while this one still writes them. Without this branch the
                 # request would chunk-prefill its ENTIRE remaining prompt,
@@ -768,6 +805,9 @@ class LLMEngine:
                 restored_tokens=req.restored_tokens,
                 restore_bytes=req.restore_bytes,
                 restore_ms=req.restore_ms,
+                restore_wire_bytes=req.restore_wire_bytes,
+                restore_decode_ms=req.restore_decode_ms,
+                restore_overlap_ms=req.restore_overlap_ms,
                 prompt_tokens=len(req.prompt_tokens),
                 generated_tokens=len(req.generated),
                 itl_s=gaps[len(gaps) // 2] if gaps else None),
@@ -823,12 +863,14 @@ class LLMEngine:
             active = sum(1 for r in self.slot_req if r is not None)
             waiting = len(self._waiting)
             prefilling = len(self._prefilling)
-        # mid-chunked-prefill requests hold a slot + pages but are not yet
-        # in slot_req: load monitoring must see them (as waiting) or
-        # autoscaling under-counts
+            restoring = len(self._restoring)
+        # mid-chunked-prefill and mid-restore-stream requests hold a slot
+        # + pages but are not yet in slot_req: load monitoring must see
+        # them (as waiting) or autoscaling under-counts
         free = self.allocator.available()
         out = {**self.stats, "active_slots": active,
-               "waiting": waiting + prefilling, "prefilling": prefilling,
+               "waiting": waiting + prefilling + restoring,
+               "prefilling": prefilling, "restoring": restoring,
                "free_pages": free,
                # gauges: the decode-block tier actually dispatched last
                # (1 / pressure_decode_block / decode_block — admission
@@ -867,6 +909,14 @@ class LLMEngine:
         ts = self._kv_tier.stats() if self._kv_tier is not None else {}
         out["tier_bytes_shm"] = ts.get("shm_bytes", 0)
         out["tier_bytes_disk"] = ts.get("disk_bytes", 0)
+        # page codec (ISSUE 15): raw-byte twins of the tier gauges plus
+        # the cumulative ratio (= capacity multiplier on both byte caps)
+        # and the per-page codec cost medians
+        out["tier_bytes_shm_raw"] = ts.get("shm_bytes_raw", 0)
+        out["tier_bytes_disk_raw"] = ts.get("disk_bytes_raw", 0)
+        out["tier_codec_ratio"] = ts.get("codec_ratio", 0.0)
+        out["tier_encode_ms_p50"] = ts.get("encode_ms_p50", 0.0)
+        out["tier_decode_ms_p50"] = ts.get("decode_ms_p50", 0.0)
         # affinity-routing surface (ISSUE 10), same stable-key contract:
         # summary export state + hinted-prefetch effectiveness
         out["tier_prefetch_hints"] = ts.get("prefetch_hints", 0)
@@ -896,6 +946,11 @@ class LLMEngine:
                     prof.record("admit", time.perf_counter() - t0)
             else:
                 self._admit()
+            # streaming tier restores first: a chunk that landed since
+            # the last pass injects before this pass's prefill chunks
+            # dispatch, and a stream that just finished routes its
+            # request into _prefilling in time for THIS pass
+            restored = self._restore_steps() if self._kv_tier_on else 0
             chunks = self._prefill_chunks()
             if self._spill_req is not None:
                 # drain-time eager spill (ISSUE 14): gather + flush on
@@ -909,8 +964,11 @@ class LLMEngine:
                 finally:
                     ev.set()
             # chunk dispatches count as progress: an otherwise-idle engine
-            # mid-chunked-prefill must not sleep between chunks
-            dispatched = self._step() or chunks > 0
+            # mid-chunked-prefill must not sleep between chunks. Restore
+            # progress counts too; a stream WAITING on fetches does not —
+            # the idle wait below parks on _wake, which the stream's
+            # on_ready sets the moment new pages land
+            dispatched = self._step() or chunks > 0 or restored > 0
             if self._kv_tier_on:
                 # spill gathers captured by evictions this pass: their
                 # device->host copies were started at dispatch, so this
@@ -970,7 +1028,7 @@ class LLMEngine:
         tightly. Lock held. Subclasses with extra admission queues extend
         this."""
         return (bool(self._waiting) and bool(self.free_slots)) \
-            or bool(self._prefilling)
+            or bool(self._prefilling) or bool(self._restoring)
 
     def _bucket_width(self, n: int) -> int:
         """Packed decode width: smallest power-of-two ≥ n (floor 4), capped
@@ -1049,38 +1107,46 @@ class LLMEngine:
             # never run under the engine lock (graftlint lock-discipline)
             self._prof.record("queue_wait",
                               req.admitted_at - req.submitted_at)
-            if self._kv_tier_on:
-                # extend the match past the local index into the KV tier:
-                # restored pages scatter into this request's fresh pages
-                # and the suffix prefill starts past them. Outside the
-                # lock — a remote fetch replaces a whole prefill, but it
-                # must not serialize other submitters. The fetch itself
-                # runs on this loop thread, so the tier bounds every
-                # blocking load to ~2s (kv_tier._REMOTE_FETCH_TIMEOUT_S):
-                # a dead peer or stale index entry costs at most one
-                # short stall before degrading to a plain miss, never a
-                # multi-second freeze of admission + active decodes.
-                self._kv_tier_restore(req, len(matched))
+            if self._kv_tier_on and self._kv_tier_begin_restore(
+                    req, len(matched)):
+                # pipelined streaming restore (ISSUE 15): the stream's
+                # worker plans sources (local walk + ONE CP match) and
+                # fetches chunk-by-chunk off this thread; the loop's
+                # _restore_steps decodes+injects chunks as they land and
+                # routes the request on to its suffix prefill when the
+                # stream ends. Admission never blocks on tier I/O — a
+                # dead peer stalls ONE chunk of ONE request (per-chunk
+                # budget), and everything landed before the stall is
+                # kept (partial restore), never a whole-chain miss.
+                with self._lock:
+                    self._restoring.append(req)
+                admitted += 1
+                continue
             if req.resume_len:
                 # tokens of the dead replica's work recovered WITHOUT
-                # recompute (local prefix pages + tier-restored pages);
-                # the rest of the admission sequence chunk-prefills below
+                # recompute (local prefix pages; the tier-restore leg
+                # accounts its share when its stream finalizes)
                 self.stats["failover_restored_tokens"] += req.cached_tokens
-            suffix = len(req.prompt_tokens) - req.prefill_pos
-            if req.prefill_pos > 0 or (self.cfg.prefill_chunk > 0
-                                       and suffix > self.cfg.prefill_chunk):
-                # long prompt OR cached prefix: prefill the (remaining)
-                # suffix in chunks interleaved with decode blocks (the loop
-                # drives _prefill_chunks). A cached prefix MUST go through
-                # the chunk program — paged_prefill writes from position 0
-                # and would scribble on the shared pages; the chunk pass
-                # starts at prefill_pos and reads the cached prefix back
-                # through the page table.
-                with self._lock:
-                    self._prefilling.append(req)
-            else:
-                self._prefill(req)
+            self._route_admitted(req)
             admitted += 1
+
+    def _route_admitted(self, req: _Request) -> None:
+        """Send an admitted request (prefix matched, tier restore — if
+        any — finished) to its prompt pass."""
+        suffix = len(req.prompt_tokens) - req.prefill_pos
+        if req.prefill_pos > 0 or (self.cfg.prefill_chunk > 0
+                                   and suffix > self.cfg.prefill_chunk):
+            # long prompt OR cached prefix: prefill the (remaining)
+            # suffix in chunks interleaved with decode blocks (the loop
+            # drives _prefill_chunks). A cached prefix MUST go through
+            # the chunk program — paged_prefill writes from position 0
+            # and would scribble on the shared pages; the chunk pass
+            # starts at prefill_pos and reads the cached prefix back
+            # through the page table.
+            with self._lock:
+                self._prefilling.append(req)
+        else:
+            self._prefill(req)
 
     # ---- tiered KV cache (kv_tier.py) ---------------------------------
     _SPILL_GATHER_WIDTH = 8  # fixed gather width: one compiled shape
@@ -1169,6 +1235,10 @@ class LLMEngine:
             live = [r for r in self.slot_req if r is not None and not r.done]
             live += [r for r in self._prefilling
                      if not r.prefill_cancelled and not r.done]
+            # mid-restore-stream requests hold pages too; their injected
+            # frontier is prefill_pos, same as the chunked-prefill case
+            live += [r for r in self._restoring
+                     if not r.prefill_cancelled and not r.done]
             for req in live:
                 toks = req.prompt_tokens + req.generated
                 if req.dispatched > 0:
@@ -1216,54 +1286,150 @@ class LLMEngine:
                 "this proxy will miss — using local digests")
         return digs
 
-    def _kv_tier_restore(self, req: _Request, m_loc: int) -> int:
-        """Restore tier-held chain pages into this request's freshly
-        allocated pages: local-shm/disk hits load from this process,
-        remote hits fetch through the object plane via the CP index.
-        Returns restored page count; ANY failure degrades to a plain
-        miss (the pages just get prefilled normally)."""
-        t0 = time.perf_counter()
+    def _kv_tier_begin_restore(self, req: _Request, m_loc: int) -> bool:
+        """Open a pipelined restore stream for the tier-held chain pages
+        past the local match. Returns False when there is nothing past
+        the local match worth probing (or the stream could not open) —
+        the caller then routes straight to prefill. True parks the
+        request in _restoring; _restore_steps drives it from there."""
         try:
             ps = self.cfg.page_size
             toks = req.prompt_tokens
             limit = min((len(toks) - 1) // ps, len(req.pages))
             if limit <= m_loc:
-                return 0
+                return False
             digs = self._chain_digests(toks, limit, req.ingress_digests)
-            t, k_np, v_np = self._kv_tier.fetch_chain(digs, start=m_loc)
-            t = min(t, limit - m_loc)
-            if t <= 0:
-                return 0
-            jnp = self._jnp
-            mp = self.max_pages_per_seq
-            shape = k_np.shape
-            pad = np.zeros(shape[:2] + (mp - t,) + shape[3:], k_np.dtype)
-            pages_vec = jnp.asarray(
-                list(req.pages[m_loc:m_loc + t]) + [0] * (mp - t),
-                jnp.int32)
-            with self._prof.compile_scope(
-                    "kv_tier_inject", ("kv_tier_inject", mp),
-                    mid_traffic=self.stats["requests"] > 0):
-                self.kv = self._tier_inject(
-                    self.kv,
-                    jnp.asarray(np.concatenate([k_np[:, :, :t], pad],
-                                               axis=2)),
-                    jnp.asarray(np.concatenate([v_np[:, :, :t], pad],
-                                               axis=2)),
-                    pages_vec)
-            req.cached_tokens = (m_loc + t) * ps
-            req.prefill_pos = req.cached_tokens
-            req.restored_tokens = t * ps
-            req.restore_bytes = int(k_np[:, :, :t].nbytes
-                                    + v_np[:, :, :t].nbytes)
-            req.restore_ms = (time.perf_counter() - t0) * 1e3
-            self.stats["restored_pages"] += t
-            self.stats["tier_hit_tokens"] += t * ps
-            return t
+            # floor the prefetch window at two raw chunks (raw bounds
+            # the encoded wire bytes the window counts): a window
+            # narrower than one chunk serializes the worker to sub-chunk
+            # progress — it parks before every landing
+            window = max(
+                self.cfg.kv_tier_stream_window_bytes,
+                2 * self.cfg.kv_tier_chunk_pages
+                * self._kvc.page_raw_nbytes(self.model_cfg, ps))
+            req.restore_stream = self._kv_tier.open_stream(
+                digs, m_loc,
+                chunk_pages=self.cfg.kv_tier_chunk_pages,
+                window_bytes=window,
+                timeout_s=self.cfg.kv_tier_chunk_timeout_s,
+                on_ready=self._wake.set)
         except Exception:  # noqa: BLE001 - restore degrades to a miss
-            logger.warning("kv-tier restore failed; cold prefill instead",
-                           exc_info=True)
+            logger.warning("kv-tier restore stream failed to open; cold "
+                           "prefill instead", exc_info=True)
+            req.restore_stream = None
+            return False
+        req.restore_started = time.perf_counter()
+        req.restore_page0 = m_loc
+        req.restore_pages = 0
+        return True
+
+    def _restore_steps(self) -> int:
+        """Drive active restore streams (loop thread): take landed
+        chunks, decode + scatter them into the request's pages, enforce
+        the per-chunk budget, and finalize — full or PARTIAL — routing
+        the request on to its suffix prefill. Decode+inject of landed
+        chunks runs here while the streams' workers fetch ahead and the
+        rest of this loop iteration prefills/decodes other requests:
+        that concurrency is the restore latency the old fetch-then-
+        inject path spent blocked."""
+        with self._lock:
+            active = list(self._restoring)
+        if not active:
             return 0
+        progressed = 0
+        now_w = time.time()
+        budget_s = max(self.cfg.kv_tier_chunk_timeout_s, 0.1)
+        for req in active:
+            stream = req.restore_stream
+            if req.prefill_cancelled or (req.deadline is not None
+                                         and now_w >= req.deadline):
+                self._abort_prefilling(req)
+                progressed += 1
+                continue
+            t0 = time.perf_counter()
+            injected = 0
+            try:
+                pairs, wire, dec_ms = stream.take(
+                    max_pages=self.max_pages_per_seq)
+                if pairs:
+                    injected = self._inject_pages(req, pairs)
+                    req.restore_wire_bytes += wire
+                    req.restore_decode_ms += dec_ms
+            except Exception:  # noqa: BLE001 - degrade to partial/miss
+                logger.warning("kv-tier chunk inject failed; keeping "
+                               "landed pages, prefilling the rest",
+                               exc_info=True)
+                stream.abort()
+            req.restore_blocked_ms += (time.perf_counter() - t0) * 1e3
+            progressed += injected
+            if stream.exhausted:
+                self._finalize_restore(req)
+                progressed += 1
+            elif (time.monotonic() - stream.last_progress) > budget_s * 1.5:
+                # per-chunk budget watchdog: the worker's own gets are
+                # timeout-bounded, but a wedged load must not park the
+                # request forever — cut the stream, keep what landed
+                stream.abort()
+        return progressed
+
+    def _inject_pages(self, req: _Request, pairs: list) -> int:
+        """Scatter decoded chain pages (in chain order, continuing at
+        restore_page0 + restore_pages) into this request's pool pages —
+        the same ONE fixed-shape donated-pool program as the old whole-
+        chain restore, chunk-sized input zero-padded to it."""
+        jnp = self._jnp
+        ps = self.cfg.page_size
+        mp = self.max_pages_per_seq
+        pos0 = req.restore_page0 + req.restore_pages
+        t = min(len(pairs), len(req.pages) - pos0)
+        if t <= 0:
+            return 0
+        k_np = np.concatenate([k for k, _ in pairs[:t]], axis=2)
+        v_np = np.concatenate([v for _, v in pairs[:t]], axis=2)
+        shape = k_np.shape
+        pad = np.zeros(shape[:2] + (mp - t,) + shape[3:], k_np.dtype)
+        pages_vec = jnp.asarray(
+            list(req.pages[pos0:pos0 + t]) + [0] * (mp - t), jnp.int32)
+        with self._prof.compile_scope(
+                "kv_tier_inject", ("kv_tier_inject", mp),
+                mid_traffic=self.stats["requests"] > 0):
+            self.kv = self._tier_inject(
+                self.kv,
+                jnp.asarray(np.concatenate([k_np, pad], axis=2)),
+                jnp.asarray(np.concatenate([v_np, pad], axis=2)),
+                pages_vec)
+        req.restore_pages += t
+        req.cached_tokens = (pos0 + t) * ps
+        req.prefill_pos = req.cached_tokens
+        req.restored_tokens += t * ps
+        req.restore_bytes += int(k_np.nbytes) + int(v_np.nbytes)
+        self.stats["restored_pages"] += t
+        self.stats["tier_hit_tokens"] += t * ps
+        return t
+
+    def _finalize_restore(self, req: _Request) -> None:
+        """Stream over (fully, partially, or not at all): stamp the
+        attribution split, count a partial restore, and send the request
+        to its suffix prefill — which starts exactly at the restored
+        frontier, so a mid-chain fault costs recompute of the TAIL only,
+        never of what already landed."""
+        stream = req.restore_stream
+        req.restore_stream = None
+        req.restore_ms = (time.perf_counter()
+                          - req.restore_started) * 1e3
+        req.restore_overlap_ms = max(
+            0.0, req.restore_ms - req.restore_blocked_ms)
+        planned = stream.planned or 0
+        if 0 < req.restore_pages < planned:
+            self.stats["restore_partial"] += 1
+        if req.resume_len:
+            # the continuation's recovered-without-recompute accounting,
+            # deferred from _admit until the restored frontier is final
+            self.stats["failover_restored_tokens"] += req.cached_tokens
+        with self._lock:
+            if req in self._restoring:
+                self._restoring.remove(req)
+        self._route_admitted(req)
 
     def _prefill(self, req: _Request):
         """Dispatch prefill WITHOUT waiting for it: the sampled first token
@@ -1378,9 +1544,16 @@ class LLMEngine:
         armed, so its device page-table row is still the zeros its
         previous occupant left."""
         expired = not getattr(req, "abandoned", False)
+        if req.restore_stream is not None:
+            # cut the stream first: its worker must stop landing chunks
+            # for pages we are about to hand back to the pool
+            req.restore_stream.abort()
+            req.restore_stream = None
         with self._lock:
             if req in self._prefilling:
                 self._prefilling.remove(req)
+            if req in self._restoring:
+                self._restoring.remove(req)
             if req.slot >= 0:
                 self.free_slots.append(req.slot)
                 req.slot = -1

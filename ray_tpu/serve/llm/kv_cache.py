@@ -58,6 +58,15 @@ def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int):
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
+def page_raw_nbytes(cfg: LlamaConfig, page_size: int) -> int:
+    """Pre-codec bytes ONE pool page holds across all layers, k + v —
+    the unit the tier spills and the restore stream lands. Derived from
+    the pool spec (not a live array) so byte-budget callers (stream
+    prefetch window, chunk sizing) can size before any page exists."""
+    per = cfg.n_layers * cfg.n_kv_heads * page_size * cfg.head_dim
+    return 2 * per * np.dtype(cfg.dtype).itemsize
+
+
 def _chain_digest(parent: bytes, chunk) -> bytes:
     """Hash-chain node key for one FULL page of prompt tokens: digest of
     (parent page's digest, this page's token ids). Chaining makes the key

@@ -1,0 +1,125 @@
+"""KV page codec: compressed pages across tiers and the object-plane wire.
+
+The KV tier ships raw pages — fp32/bf16 tensors whose size, not the
+prefill FLOPs they replace, bounds how many prefix tokens the shm/disk
+tiers hold and how long a cross-replica restore spends on the wire.
+CacheGen (PAPERS.md) showed codec-compressed KV beats both recompute and
+raw transfer; this module is the per-page codec the tier applies at
+spill time and undoes at restore:
+
+- ``lossless`` (the engine default): byte-plane shuffle + DEFLATE. The
+  page's bytes are regrouped so every element's Nth byte is contiguous
+  — for floating KV that clusters the sign/exponent bytes (low entropy:
+  activations live in a narrow dynamic range) away from the near-random
+  mantissa bytes, which is what gives a generic entropy coder runs to
+  work with. Decoding is bit-exact by construction, so the greedy
+  token-identity invariant every KV feature has shipped with holds
+  unchanged. The ratio is data-dependent: narrow-range bf16 KV
+  compresses hard, full-mantissa fp32 from random-init weights is
+  entropy-bound near 1x on its mantissa planes.
+- ``int8`` (opt-in, divergence measured in ``bench_serve --kv-tier-ab``):
+  per-(layer, kv-head) symmetric scale quantization to int8, then
+  DEFLATE over the quantized planes. 4x from the width cut on fp32
+  before entropy coding; reconstruction error is bounded per element by
+  ``amax / 127`` within its (layer, head) group. NOT bit-exact — greedy
+  outputs can diverge, which is why it is off by default and the bench
+  records the divergence instead of asserting identity.
+- ``none``: identity passthrough (the PR 7 raw-page wire format). Kept
+  so a codec rollout can mix replicas: the tier's read path accepts
+  both raw and encoded blobs regardless of its own write mode.
+
+Pages encode independently (one call per [L, Hkv, 1, page, D] slice) so
+a chunked restore stream can decode exactly the pages that landed.
+Everything here is host-side numpy + zlib — no device work, no locks;
+callers keep codec work off the engine and store locks.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+MODES = ("none", "lossless", "int8")
+
+# DEFLATE effort. Level 1 is ~5x faster than the default 6 and within a
+# few percent of its ratio on byte-plane-shuffled KV: the shuffle, not
+# the match search, is what exposes the redundancy. Encode runs on the
+# spill path (engine loop adjacent) so speed wins.
+_ZLEVEL = 1
+
+
+def _dtype(name: str) -> np.dtype:
+    """Resolve a stored dtype name, including the ml_dtypes extension
+    types (bfloat16 etc.) numpy alone can't name."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _planes(a: np.ndarray) -> bytes:
+    """Byte-plane shuffle: element-major bytes -> plane-major bytes."""
+    buf = np.frombuffer(a.tobytes(), np.uint8)
+    return np.ascontiguousarray(
+        buf.reshape(-1, a.dtype.itemsize).T).tobytes()
+
+
+def _unplanes(data: bytes, dt: np.dtype) -> bytes:
+    planes = np.frombuffer(data, np.uint8).reshape(dt.itemsize, -1)
+    return np.ascontiguousarray(planes.T).tobytes()
+
+
+def encode_page(arr: np.ndarray, mode: str) -> dict:
+    """Encode one page array. Returns a self-describing dict payload
+    (what the tier stores and ships): ``mode``, ``data`` (compressed
+    bytes), ``shape``, ``dtype`` (name), ``raw`` (original nbytes), and
+    for int8 the per-group ``scale`` bytes + ``sshape``."""
+    if mode not in MODES:
+        raise ValueError(f"unknown KV codec mode {mode!r}")
+    a = np.ascontiguousarray(arr)
+    base = {"shape": tuple(a.shape), "dtype": str(a.dtype),
+            "raw": int(a.nbytes)}
+    if mode == "int8" and np.issubdtype(a.dtype, np.floating):
+        f = a.astype(np.float32)
+        # one symmetric scale per (layer, kv-head) group: page values
+        # within a head share dynamic range, across heads they don't
+        red = tuple(range(2, f.ndim)) if f.ndim > 2 \
+            else tuple(range(f.ndim))
+        s = np.max(np.abs(f), axis=red, keepdims=True)
+        s = np.where(s == 0.0, 1.0, s).astype(np.float32)
+        q = np.clip(np.rint(f / s * 127.0), -127, 127).astype(np.int8)
+        return {**base, "mode": "int8",
+                "data": zlib.compress(q.tobytes(), _ZLEVEL),
+                "scale": s.tobytes(), "sshape": tuple(s.shape)}
+    if mode == "int8":
+        mode = "lossless"   # integer KV: quantization buys nothing
+    if mode == "lossless":
+        return {**base, "mode": "lossless",
+                "data": zlib.compress(_planes(a), _ZLEVEL)}
+    return {**base, "mode": "none", "data": a.tobytes()}
+
+
+def decode_page(enc: dict) -> np.ndarray:
+    """Invert :func:`encode_page`. Bit-exact for none/lossless; int8
+    reconstructs within ``scale/127`` per element."""
+    dt = _dtype(enc["dtype"])
+    shape = tuple(enc["shape"])
+    mode = enc["mode"]
+    if mode == "none":
+        return np.frombuffer(enc["data"], dt).reshape(shape)
+    if mode == "lossless":
+        return np.frombuffer(
+            _unplanes(zlib.decompress(enc["data"]), dt), dt).reshape(shape)
+    if mode == "int8":
+        q = np.frombuffer(zlib.decompress(enc["data"]),
+                          np.int8).reshape(shape)
+        s = np.frombuffer(enc["scale"], np.float32).reshape(enc["sshape"])
+        return (q.astype(np.float32) * (s / 127.0)).astype(dt)
+    raise ValueError(f"unknown KV codec mode {mode!r}")
+
+
+def encoded_nbytes(enc: dict) -> int:
+    """Stored/wire footprint of one encoded page payload."""
+    return len(enc["data"]) + len(enc.get("scale") or b"")

@@ -33,6 +33,23 @@ LRU; every entry carries a TTL. All failure paths degrade: a failed
 spill leaves eviction a plain free, a failed restore is a plain cache
 miss.
 
+Pages are stored and shipped ENCODED (kv_codec.py) when the store runs
+with a codec: put() encodes each page outside every lock, the byte caps
+and LRU demotion account encoded bytes (compression multiplies the
+effective tier capacity), and the CP index entries carry both sizes
+(``nbytes`` encoded, ``raw`` decoded). The read path accepts both the
+raw PR 7 blob layout and the encoded layout regardless of its own
+write mode, so mixed-codec replicas interoperate during a rollout.
+
+Restore is chunked and pipelined (:class:`ChainStream`): instead of
+one fetch_chain call landing the whole chain before any KV injects,
+open_stream() plans the chain's sources once and a background worker
+fetches chunk_pages at a time — each object-plane get bounded by the
+PR 7 fetch budget PER CHUNK, the landed-but-unconsumed buffer bounded
+by window_bytes — while the consumer (the engine loop) takes, decodes
+and injects pages as they land. A dead peer now costs one chunk stall
+and a partial restore, not a whole-chain miss.
+
 Concurrency: ``self._lock`` guards only in-memory bookkeeping — never
 I/O. Disk writes (demotion), disk reads and object-plane gets (restore)
 run on snapshots taken under the lock, so a slow tier never serializes
@@ -53,10 +70,12 @@ import queue
 import threading
 import time
 import uuid
-from collections import OrderedDict
-from typing import Optional
+from collections import OrderedDict, deque
+from typing import Callable, Optional
 
 import numpy as np
+
+from ray_tpu.serve.llm import kv_codec
 
 logger = logging.getLogger(__name__)
 
@@ -102,13 +121,16 @@ class KVTierStore:
 
     def __init__(self, max_bytes: int, disk_dir: Optional[str],
                  disk_max_bytes: int, ttl_s: float, page_size: int,
-                 namespace: str = ""):
+                 namespace: str = "", codec: str = "none"):
+        if codec not in kv_codec.MODES:
+            raise ValueError(f"unknown KV codec {codec!r}")
         self.max_bytes = int(max_bytes)
         self.disk_dir = disk_dir
         self.disk_max_bytes = int(disk_max_bytes)
         self.ttl_s = float(ttl_s)
         self.page_size = int(page_size)
         self.namespace = str(namespace)
+        self.codec = str(codec)
         # distinct from the worker id: several engines (serve replicas,
         # tests) can share one worker process, and "is this entry mine"
         # must mean THIS store, while death-GC keys on the worker
@@ -118,13 +140,28 @@ class KVTierStore:
         # records stay members but carry tier="disk")
         self._blobs: OrderedDict[str, dict] = OrderedDict()
         self._by_digest: dict[str, tuple[str, int]] = {}  # digest -> (blob, off)
+        # byte gauges per tier, encoded (caps/LRU currency) + raw (what
+        # the bytes decode back to — the capacity-multiplier numerator)
         self._shm_bytes = 0
         self._disk_bytes = 0
+        self._shm_raw = 0
+        self._disk_raw = 0
         self.counters = {"put_blobs": 0, "put_pages": 0, "demoted_blobs": 0,
                          "dropped_blobs": 0, "expired_blobs": 0,
                          "local_hits": 0, "remote_hits": 0,
+                         "put_bytes_raw": 0, "put_bytes_enc": 0,
                          "prefetch_hints": 0, "prefetch_pages": 0,
                          "prefetch_hit_pages": 0, "prefetch_dropped": 0}
+        # codec cost samples (bounded rings -> p50 in stats()); appended
+        # per put/fetch, one per-page-averaged sample each
+        self._enc_ms: deque = deque(maxlen=256)
+        self._dec_ms: deque = deque(maxlen=256)
+        # live restore streams: registered at open_stream, removed by the
+        # stream's own worker exit — close() aborts whatever is left
+        self._streams: set = set()
+        # test seam: fn(chunk_idx) invoked before each stream chunk
+        # fetch; raising fails that chunk (-> partial restore downstream)
+        self._chunk_fault: Optional[Callable[[int], None]] = None
         # ordered cluster-index publisher (see module docstring)
         self._pub_q: queue.Queue = queue.Queue()
         self._pub_thread: Optional[threading.Thread] = None
@@ -160,16 +197,39 @@ class KVTierStore:
         arrays shaped [L, Hkv, n, page, D]; ``digests[i]``/``tokens[i]``
         are page i's chain digest (hex) and its cumulative token length.
         Returns how many pages were registered (0 when the batch doesn't
-        fit the shm cap at all)."""
-        nbytes = int(k_np.nbytes) + int(v_np.nbytes)
-        if nbytes > self.max_bytes or not digests:
+        fit the shm cap at all). With a codec configured the pages are
+        encoded HERE — outside every lock, per page so a chunked restore
+        can decode them independently — and all caps, LRU accounting and
+        index entries run on encoded bytes."""
+        raw_nbytes = int(k_np.nbytes) + int(v_np.nbytes)
+        if not digests:
             return 0
-        blob = {"k": k_np, "v": v_np, "page_size": self.page_size,
-                "digests": list(digests), "tokens": list(tokens)}
+        n = len(digests)
+        if self.codec == "none":
+            blob = {"k": k_np, "v": v_np, "page_size": self.page_size,
+                    "digests": list(digests), "tokens": list(tokens)}
+            nbytes = raw_nbytes
+            sizes = [raw_nbytes // n] * n
+            enc_ms = None
+        else:
+            t0 = time.perf_counter()
+            pages = [(kv_codec.encode_page(k_np[:, :, i:i + 1], self.codec),
+                      kv_codec.encode_page(v_np[:, :, i:i + 1], self.codec))
+                     for i in range(n)]
+            enc_ms = (time.perf_counter() - t0) * 1e3 / n
+            sizes = [kv_codec.encoded_nbytes(ek) + kv_codec.encoded_nbytes(ev)
+                     for ek, ev in pages]
+            nbytes = sum(sizes)
+            blob = {"codec": self.codec, "page_size": self.page_size,
+                    "digests": list(digests), "tokens": list(tokens),
+                    "pages": pages}
+        if nbytes > self.max_bytes:
+            return 0
         bid = uuid.uuid4().hex[:16]
         rt = self._runtime()
         ref = rt.put(blob) if rt is not None else None
-        rec = {"id": bid, "nbytes": nbytes, "tier": "shm", "ts": _now(),
+        rec = {"id": bid, "nbytes": nbytes, "raw": raw_nbytes,
+               "sizes": sizes, "tier": "shm", "ts": _now(),
                "digests": list(digests), "tokens": list(tokens),
                "ref": ref, "data": blob if ref is None else None,
                "path": None}
@@ -180,12 +240,17 @@ class KVTierStore:
         with self._lock:
             self._blobs[bid] = rec
             self._shm_bytes += nbytes
+            self._shm_raw += raw_nbytes
             for i, d in enumerate(digests):
                 self._by_digest[d] = (bid, i)
             self.counters["put_blobs"] += 1
-            self.counters["put_pages"] += len(digests)
+            self.counters["put_pages"] += n
+            self.counters["put_bytes_raw"] += raw_nbytes
+            self.counters["put_bytes_enc"] += nbytes
+            if enc_ms is not None:
+                self._enc_ms.append(enc_ms)
             self._pub_enqueue_locked("register", rec)
-        return len(digests)
+        return n
 
     # ---- cluster-index publisher ----------------------------------------
     def _pub_enqueue_locked(self, op: str, rec: dict) -> None:
@@ -195,6 +260,7 @@ class KVTierStore:
         and queue order == mutation order (a retract can't overtake the
         register it supersedes)."""
         snap = {"id": rec["id"], "nbytes": rec["nbytes"],
+                "raw": rec["raw"], "sizes": list(rec["sizes"]),
                 "tier": rec["tier"], "ts": rec["ts"],
                 "digests": list(rec["digests"]),
                 "tokens": list(rec["tokens"]), "ref": rec["ref"]}
@@ -241,12 +307,16 @@ class KVTierStore:
             ref_hex = (pickle.dumps(snap["ref"]).hex()
                        if snap["tier"] == "shm" and snap["ref"] is not None
                        else None)
-            per_page = snap["nbytes"] // max(1, len(snap["digests"]))
+            per_raw = snap["raw"] // max(1, len(snap["digests"]))
             for i, d in enumerate(snap["digests"]):
+                # nbytes = encoded (what travels the wire / fills the
+                # tier), raw = decoded — the CLI/dashboard ratio columns
+                # and the stream's window accounting read both
                 entry = {"owner": whex, "node": nhex,
                          "store": self.store_id, "blob": snap["id"],
                          "off": i, "tokens": snap["tokens"][i],
-                         "nbytes": per_page, "tier": snap["tier"],
+                         "nbytes": snap["sizes"][i], "raw": per_raw,
+                         "tier": snap["tier"],
                          "ts": snap["ts"], "ttl_s": self.ttl_s,
                          "ref": ref_hex, "ns": self.namespace}
                 self._cp_call("kv_put", {
@@ -331,6 +401,8 @@ class KVTierStore:
                     rec.update(tier="disk", path=path, ref=None, data=None)
                     self._shm_bytes -= rec["nbytes"]
                     self._disk_bytes += rec["nbytes"]
+                    self._shm_raw -= rec["raw"]
+                    self._disk_raw += rec["raw"]
                     self.counters["demoted_blobs"] += 1
                     # remote replicas must stop trying to fetch the gone
                     # object ref — re-register (queue order keeps this
@@ -352,8 +424,10 @@ class KVTierStore:
             return
         if rec["tier"] == "shm":
             self._shm_bytes -= rec["nbytes"]
+            self._shm_raw -= rec["raw"]
         else:
             self._disk_bytes -= rec["nbytes"]
+            self._disk_raw -= rec["raw"]
             if rec["path"]:
                 try:
                     os.unlink(rec["path"])
@@ -378,6 +452,22 @@ class KVTierStore:
         if rt is None:
             raise RuntimeError("kv-tier blob held by ref but no runtime")
         return rt.get([handle["ref"]], timeout=_LOCAL_REF_TIMEOUT_S)[0]
+
+    @staticmethod
+    def _blob_page(blob: dict, off: int):
+        """Decoded ``(k, v)`` [L, Hkv, 1, page, D] page ``off`` of a blob
+        in EITHER wire layout: per-page codec payloads ("pages") or the
+        raw PR 7 arrays. Pure host compute — callers run it outside the
+        store lock."""
+        pages = blob.get("pages")
+        if pages is not None:
+            ek, ev = pages[off]
+            return kv_codec.decode_page(ek), kv_codec.decode_page(ev)
+        return blob["k"][:, :, off:off + 1], blob["v"][:, :, off:off + 1]
+
+    def _note_decode(self, ms_per_page: float) -> None:
+        with self._lock:
+            self._dec_ms.append(ms_per_page)
 
     # ---- restore ---------------------------------------------------------
     def fetch_chain(self, digests: list[str], start: int):
@@ -412,14 +502,17 @@ class KVTierStore:
             try:
                 blobs = {bid: self._load_handle(h)
                          for bid, h in handles.items()}
-                parts_k = [blobs[bid]["k"][:, :, off:off + 1]
-                           for bid, off in run]
-                parts_v = [blobs[bid]["v"][:, :, off:off + 1]
-                           for bid, off in run]
+                t0 = time.perf_counter()
+                pairs = [self._blob_page(blobs[bid], off)
+                         for bid, off in run]
+                dec_ms = (time.perf_counter() - t0) * 1e3 / len(run)
                 with self._lock:
                     self.counters["local_hits"] += len(run)
-                return (len(run), np.concatenate(parts_k, axis=2),
-                        np.concatenate(parts_v, axis=2))
+                    if any("pages" in b for b in blobs.values()):
+                        self._dec_ms.append(dec_ms)
+                return (len(run), np.concatenate([k for k, _ in pairs],
+                                                 axis=2),
+                        np.concatenate([v for _, v in pairs], axis=2))
             except Exception:
                 # the blob moved (dropped/demoted, ref freed, file gone)
                 # between snapshot and load: treat as a local miss and
@@ -529,12 +622,18 @@ class KVTierStore:
                 while len(self._hints) > _HINT_MAX_PAGES:
                     self._hints.popitem(last=False)
 
-    def _fetch_remote(self, digests: list[str], start: int):
-        rt = self._runtime()
-        if rt is None:
-            return 0, None, None
+    def _match_entries(self, digests: list[str], start: int,
+                       timeout: float = 5.0) -> list[dict]:
+        """CP chain match + client-side filter. Contiguity is preserved
+        (stop at the first unusable entry): disk-tier entries are
+        owner-local; our own stale entries (already missed the local
+        probe) are unusable too; a namespace mismatch (pre-namespace
+        entry, hash collision) would hand us another model's KV."""
+        if self._runtime() is None:
+            return []
         resp = self._cp_call("kv_tier_match", {"digests": digests[start:],
-                                               "ns": self.namespace})
+                                               "ns": self.namespace},
+                             timeout=timeout)
         raw = (resp or {}).get("entries") or []
         entries = []
         for v in raw:
@@ -542,15 +641,18 @@ class KVTierStore:
                 e = json.loads(v.decode() if isinstance(v, bytes) else v)
             except (ValueError, AttributeError):
                 break
-            # disk-tier entries are owner-local; our own stale entries
-            # (already missed the local probe above) are unusable too;
-            # a namespace mismatch (pre-namespace entry, hash collision)
-            # would hand us another model's KV
             if e.get("tier") != "shm" or not e.get("ref") \
                     or e.get("store") == self.store_id \
                     or e.get("ns", "") != self.namespace:
                 break
             entries.append(e)
+        return entries
+
+    def _fetch_remote(self, digests: list[str], start: int):
+        rt = self._runtime()
+        if rt is None:
+            return 0, None, None
+        entries = self._match_entries(digests, start)
         if not entries:
             return 0, None, None
         refs: dict[str, object] = {}
@@ -560,32 +662,74 @@ class KVTierStore:
         fetched = rt.get(list(refs.values()),
                          timeout=_REMOTE_FETCH_TIMEOUT_S)
         blobs = dict(zip(refs.keys(), fetched))
-        parts_k, parts_v = [], []
-        for e in entries:
-            blob = blobs[e["ref"]]
-            off = int(e["off"])
-            parts_k.append(blob["k"][:, :, off:off + 1])
-            parts_v.append(blob["v"][:, :, off:off + 1])
+        t0 = time.perf_counter()
+        pairs = [self._blob_page(blobs[e["ref"]], int(e["off"]))
+                 for e in entries]
+        dec_ms = (time.perf_counter() - t0) * 1e3 / len(entries)
         with self._lock:
             self.counters["remote_hits"] += len(entries)
-        return (len(entries), np.concatenate(parts_k, axis=2),
-                np.concatenate(parts_v, axis=2))
+            if any("pages" in b for b in blobs.values()):
+                self._dec_ms.append(dec_ms)
+        return (len(entries), np.concatenate([k for k, _ in pairs], axis=2),
+                np.concatenate([v for _, v in pairs], axis=2))
+
+    # ---- streaming restore (see ChainStream) -----------------------------
+    def open_stream(self, digests: list[str], start: int, *,
+                    chunk_pages: int = 8,
+                    window_bytes: int = 8 * 1024 * 1024,
+                    timeout_s: float = _REMOTE_FETCH_TIMEOUT_S,
+                    on_ready=None) -> "ChainStream":
+        """Begin a pipelined chunked restore of ``digests[start:]``.
+        Returns immediately — planning (including the CP chain match)
+        and all fetches run on the stream's worker; the caller polls
+        ``take()``/``exhausted``. ``on_ready`` fires (from the worker)
+        whenever new pages land or the stream ends."""
+        s = ChainStream(self, digests, start, chunk_pages=chunk_pages,
+                        window_bytes=window_bytes, timeout_s=timeout_s,
+                        on_ready=on_ready)
+        with self._lock:
+            self._streams.add(s)
+        s._start()
+        return s
+
+    def _stream_exit(self, s: "ChainStream") -> None:
+        with self._lock:
+            self._streams.discard(s)
 
     # ---- observability / lifecycle --------------------------------------
     def stats(self) -> dict:
         with self._lock:
             shm = sum(1 for r in self._blobs.values() if r["tier"] == "shm")
+            enc = sorted(self._enc_ms)
+            dec = sorted(self._dec_ms)
+            pr = self.counters["put_bytes_raw"]
+            pe = self.counters["put_bytes_enc"]
             return {**self.counters,
                     "shm_bytes": self._shm_bytes,
                     "disk_bytes": self._disk_bytes,
+                    "shm_bytes_raw": self._shm_raw,
+                    "disk_bytes_raw": self._disk_raw,
+                    "codec": self.codec,
+                    # cumulative raw/encoded put ratio == the effective
+                    # capacity multiplier every tier byte cap gains
+                    "codec_ratio": round(pr / pe, 3) if pe else 0.0,
+                    "encode_ms_p50": round(enc[len(enc) // 2], 3)
+                    if enc else 0.0,
+                    "decode_ms_p50": round(dec[len(dec) // 2], 3)
+                    if dec else 0.0,
                     "blobs_shm": shm,
                     "blobs_disk": len(self._blobs) - shm,
                     "indexed_pages": len(self._by_digest),
-                    "hint_pages": len(self._hints)}
+                    "hint_pages": len(self._hints),
+                    "streams": len(self._streams)}
 
     def close(self) -> None:
         """Drop every blob and retract our index entries (clean engine
         shutdown; crash cleanup is the CP's worker-death GC)."""
+        with self._lock:
+            streams = list(self._streams)
+        for s in streams:
+            s.abort()   # wakes parked workers; they exit on their own
         with self._lock:
             for bid in list(self._blobs):
                 self._drop_locked(bid, reason="dropped")
@@ -601,3 +745,265 @@ class KVTierStore:
             t.join(timeout=5.0)
         if pt is not None and pt.is_alive():
             pt.join(timeout=5.0)
+
+
+class ChainStream:
+    """One pipelined chunked restore (see KVTierStore.open_stream).
+
+    A background worker plans the chain's page sources once — local tier
+    walk under the store lock (handles snapshotted), hint-buffer
+    continuation, then ONE CP chain match for the remote tail — and
+    fetches ``chunk_pages`` pages at a time in chain order. Unlike
+    fetch_chain, a local run may CONTINUE into a remote run: delivery is
+    page-granular, so mixing sources can no longer tear a concatenated
+    batch.
+
+    Bounds: every object-plane get is capped by ``timeout_s`` (the PR 7
+    fetch budget applied PER CHUNK — a dead peer costs one chunk stall,
+    not a whole-chain miss) and the landed-but-untaken buffer is capped
+    by ``window_bytes`` (backpressure parks the worker; the buffer never
+    grows past the window). A chunk failure ends the stream at that
+    chunk boundary; pages already landed stay takeable, which is what
+    turns a mid-chain fault into a PARTIAL restore downstream.
+
+    Thread model: one daemon worker per stream. ``take()``/``abort()``
+    are consumer-side (the engine loop). Store-lock work is bounded
+    bookkeeping only; loads, object-plane gets and codec work all run
+    outside both the store lock and the stream condition.
+    """
+
+    def __init__(self, store: KVTierStore, digests: list[str], start: int,
+                 *, chunk_pages: int, window_bytes: int, timeout_s: float,
+                 on_ready=None):
+        self._store = store
+        self._digests = list(digests)
+        self._first = int(start)
+        self._chunk_pages = max(1, int(chunk_pages))
+        self._window_bytes = max(1, int(window_bytes))
+        self.timeout_s = float(timeout_s)
+        self._on_ready = on_ready
+        self._cond = threading.Condition()
+        # landed, untaken pages: (payload_k, payload_v, encoded?, wire
+        # bytes, source) in chain order; byte-bounded by _window_wait
+        self._ready: deque = deque()
+        self._ready_bytes = 0
+        self._aborted = False
+        self._worker_done = False
+        self.failed = False
+        self.error: Optional[str] = None
+        self.planned: Optional[int] = None  # pages the plan covers
+        self.landed = 0                     # pages fetched by the worker
+        self.taken = 0                      # pages handed to take()
+        self.wire_bytes = 0                 # encoded bytes fetched
+        self.last_progress = time.monotonic()
+
+    def _start(self) -> None:
+        threading.Thread(target=self._run, daemon=True,
+                         name="kv-tier-stream").start()
+
+    # ---- consumer side ---------------------------------------------------
+    def take(self, max_pages: Optional[int] = None):
+        """Pop landed pages in chain order and decode them. Returns
+        ``(pairs, wire_bytes, decode_ms)``: decoded (k, v) page arrays,
+        their wire footprint, and the codec time spent HERE — on the
+        consumer's thread, deliberately, so decode overlaps the worker's
+        next chunk fetch and stays off the store lock."""
+        grabbed = []
+        with self._cond:
+            while self._ready and (max_pages is None
+                                   or len(grabbed) < max_pages):
+                item = self._ready.popleft()
+                self._ready_bytes -= item[3]
+                grabbed.append(item)
+            if grabbed:
+                self.taken += len(grabbed)
+                self._cond.notify_all()   # window space freed
+        if not grabbed:
+            return [], 0, 0.0
+        t0 = time.perf_counter()
+        pairs = []
+        wire = 0
+        n_enc = 0
+        for pk, pv, enc, nb, _src in grabbed:
+            if enc:
+                pairs.append((kv_codec.decode_page(pk),
+                              kv_codec.decode_page(pv)))
+                n_enc += 1
+            else:
+                pairs.append((pk, pv))
+            wire += nb
+        dec_ms = (time.perf_counter() - t0) * 1e3
+        if n_enc:
+            self._store._note_decode(dec_ms / n_enc)
+        return pairs, wire, dec_ms
+
+    @property
+    def exhausted(self) -> bool:
+        """Nothing more will land AND everything landed was taken — the
+        consumer's cue to finalize (full or partial) and move on."""
+        with self._cond:
+            return (self._worker_done or self._aborted) \
+                and not self._ready
+
+    def abort(self) -> None:
+        with self._cond:
+            self._aborted = True
+            self._cond.notify_all()
+
+    # ---- worker side -----------------------------------------------------
+    def _run(self) -> None:
+        st = self._store
+        try:
+            plan = self._plan()
+        except Exception as e:  # noqa: BLE001 — restore degrades to miss
+            self._finish(failed=True, error=repr(e))
+            return
+        with self._cond:
+            self.planned = len(plan)
+            self.last_progress = time.monotonic()
+        blobs: dict = {}   # source blob cache, one load/get per blob
+        for ci in range(0, len(plan), self._chunk_pages):
+            chunk = plan[ci:ci + self._chunk_pages]
+            if not self._window_wait():
+                break
+            try:
+                fault = st._chunk_fault
+                if fault is not None:
+                    fault(ci // self._chunk_pages)
+                items = self._fetch_chunk(chunk, blobs)
+            except Exception as e:  # noqa: BLE001 — chunk -> partial
+                self._finish(failed=True, error=repr(e))
+                return
+            with self._cond:
+                if self._aborted:
+                    break
+                self._ready.extend(items)
+                self._ready_bytes += sum(it[3] for it in items)
+                self.landed += len(items)
+                self.wire_bytes += sum(it[3] for it in items)
+                self.last_progress = time.monotonic()
+                self._cond.notify_all()
+            local_n = sum(1 for it in items if it[4] == "local")
+            remote_n = sum(1 for it in items if it[4] == "remote")
+            if local_n or remote_n:
+                with st._lock:
+                    st.counters["local_hits"] += local_n
+                    st.counters["remote_hits"] += remote_n
+            self._notify_ready()
+        self._finish()
+
+    def _finish(self, failed: bool = False,
+                error: Optional[str] = None) -> None:
+        if failed:
+            logger.debug("kv-tier: stream ended at a chunk fault: %s",
+                         error)
+        with self._cond:
+            self.failed = self.failed or failed
+            if error and not self.error:
+                self.error = error
+            self._worker_done = True
+            self.last_progress = time.monotonic()
+            self._cond.notify_all()
+        self._store._stream_exit(self)
+        self._notify_ready()
+
+    def _notify_ready(self) -> None:
+        if self._on_ready is not None:
+            try:
+                self._on_ready()
+            except Exception:  # noqa: BLE001 — wake is best-effort
+                pass
+
+    def _window_wait(self) -> bool:
+        """Park until the landed-but-untaken bytes fit the window.
+        False = aborted, or the consumer stopped taking for 60s (an
+        abandoned stream must not pin its worker forever)."""
+        deadline = time.monotonic() + 60.0
+        with self._cond:
+            while self._ready_bytes >= self._window_bytes:
+                if self._aborted or time.monotonic() > deadline:
+                    self._aborted = True
+                    return False
+                self.last_progress = time.monotonic()
+                self._cond.wait(timeout=0.5)
+            return not self._aborted
+
+    def _plan(self) -> list[tuple]:
+        """Ordered per-page source descriptors, contiguous from the
+        stream's first page: ("blob", bid, off, handle) local tiers,
+        ("page", k, v) hint-buffer pages (already decoded), ("ref",
+        ref_hex, off) remote object-plane pages. The only RPC here is
+        the single CP chain match for the remote tail."""
+        st = self._store
+        digs = self._digests
+        plan: list[tuple] = []
+        i = self._first
+        with st._lock:
+            st._expire_locked()
+            while i < len(digs):
+                loc = st._by_digest.get(digs[i])
+                if loc is None:
+                    break
+                bid, off = loc
+                st._blobs.move_to_end(bid)
+                rec = st._blobs[bid]
+                plan.append(("blob", bid, off,
+                             {"data": rec["data"], "path": rec["path"],
+                              "ref": rec["ref"]}))
+                i += 1
+            st._expire_hints_locked()
+            hint_n = 0
+            while i < len(digs):
+                h = st._hints.get(digs[i])
+                if h is None:
+                    break
+                plan.append(("page", h["k"], h["v"]))
+                hint_n += 1
+                i += 1
+            if hint_n:
+                st.counters["prefetch_hit_pages"] += hint_n
+        if i < len(digs):
+            for e in st._match_entries(digs, i, timeout=self.timeout_s):
+                plan.append(("ref", e["ref"], int(e["off"])))
+        return plan
+
+    def _fetch_chunk(self, chunk: list[tuple], blobs: dict) -> list:
+        """Load one chunk's pages (outside every lock). Each distinct
+        source blob is loaded/fetched once per stream and cached in
+        ``blobs`` (bounded by the chain's source-blob count); every
+        object-plane get is capped by ``timeout_s`` — the per-chunk
+        budget."""
+        st = self._store
+        items = []
+        for src in chunk:
+            if src[0] == "page":
+                _, k, v = src
+                items.append((k, v, False, 0, "hint"))
+                continue
+            if src[0] == "blob":
+                _, bid, off, handle = src
+                if bid not in blobs:
+                    blobs[bid] = st._load_handle(handle)
+                blob, source = blobs[bid], "local"
+            else:
+                _, ref_hex, off = src
+                if ref_hex not in blobs:
+                    rt = st._runtime()
+                    if rt is None:
+                        raise RuntimeError("remote page but no runtime")
+                    ref = pickle.loads(bytes.fromhex(ref_hex))
+                    blobs[ref_hex] = rt.get(
+                        [ref], timeout=self.timeout_s)[0]
+                blob, source = blobs[ref_hex], "remote"
+            pages = blob.get("pages")
+            if pages is not None:
+                ek, ev = pages[off]
+                wire = kv_codec.encoded_nbytes(ek) \
+                    + kv_codec.encoded_nbytes(ev)
+                items.append((ek, ev, True, wire, source))
+            else:
+                pk = blob["k"][:, :, off:off + 1]
+                pv = blob["v"][:, :, off:off + 1]
+                items.append((pk, pv, False,
+                              int(pk.nbytes) + int(pv.nbytes), source))
+        return items

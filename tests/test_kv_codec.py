@@ -1,0 +1,410 @@
+"""KV page codec + streaming restore (serve/llm/kv_codec.py, ISSUE 15).
+
+Pins the PR's acceptance invariants:
+- lossless encode/decode is bit-exact for every KV dtype the engine can
+  run (fp32, fp16, bf16) — the greedy token-identity invariant's
+  foundation — and int8 reconstruction error is bounded by the
+  per-(layer, head) scale;
+- the tier stores/ships pages ENCODED: byte caps and CP entries account
+  encoded bytes, raw-byte twins expose the capacity multiplier, and
+  fetch_chain/ChainStream decode back bit-exactly;
+- chunked streaming restore delivers the same pages fetch_chain did,
+  and a chunk fault mid-chain degrades to a PARTIAL restore: landed
+  pages kept, `restore_partial` counted, completion token-identical;
+- a mid-stream failover continuation (PR 14) resumes token-identically
+  over a compressed eager-spilled chain, cross-engine via the CP index.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.llm import kv_codec
+from ray_tpu.serve.llm.kv_cache import _chain_digest, page_raw_nbytes
+from ray_tpu.serve.llm.kv_tier import KVTierStore
+
+
+def _tier_cfg(**kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+
+    # same deterministic-spill shape as test_kv_tier: cap 2 parked pages
+    # so a drained 5-full-page prompt evicts (spills) its chain head
+    d = dict(model_config=llama.llama_tiny(vocab_size=512),
+             max_batch_size=4, page_size=16, num_pages=64,
+             max_prompt_len=96, max_seq_len=160, max_tokens=8,
+             prefix_cache_max_pages=2, kv_tier_enabled=True)
+    d.update(kw)
+    return LLMConfig(**d)
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog"   # 43 byte-tokens
+LONG = PROMPT + " " + PROMPT                             # 87 -> 5 full pages
+
+_WANT: dict = {}
+
+
+def _want_tokens(prompt, max_tokens=8):
+    from ray_tpu.serve.llm import LLMEngine
+
+    key = (prompt, max_tokens)
+    if key not in _WANT:
+        off = LLMEngine(_tier_cfg(kv_tier_enabled=False,
+                                  prefix_cache_enabled=False), rng_seed=0)
+        off.start()
+        try:
+            _WANT[key] = off.generate(prompt, max_tokens=max_tokens,
+                                      temperature=0.0)["tokens"]
+        finally:
+            off.shutdown()
+    return _WANT[key]
+
+
+def _wait(pred, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# codec unit: roundtrips per dtype, int8 bound, footprint
+# ---------------------------------------------------------------------------
+
+
+def _page(dtype, seed=0, shape=(2, 2, 1, 4, 8)):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal(shape)
+    return a.astype(dtype)
+
+
+def test_lossless_roundtrip_bit_exact_per_dtype():
+    import ml_dtypes
+    for dt in (np.float32, np.float16, ml_dtypes.bfloat16, np.int32):
+        a = _page(dt)
+        for mode in ("none", "lossless"):
+            enc = kv_codec.encode_page(a, mode)
+            out = kv_codec.decode_page(enc)
+            assert out.dtype == a.dtype and out.shape == a.shape
+            # bit-exact, not just allclose: the greedy-identity
+            # invariant rides on byte equality of the restored KV
+            assert out.tobytes() == a.tobytes(), (dt, mode)
+            assert enc["raw"] == a.nbytes
+
+
+def test_int8_divergence_bounded_per_group():
+    a = _page(np.float32, seed=3)
+    enc = kv_codec.encode_page(a, "int8")
+    out = kv_codec.decode_page(enc)
+    assert out.dtype == a.dtype and out.shape == a.shape
+    # error bound: half a quantization step per (layer, kv-head) group
+    s = np.max(np.abs(a), axis=(2, 3, 4), keepdims=True)
+    assert np.all(np.abs(out - a) <= s / 127.0 + 1e-7)
+    # a random-sign fp32 page quantizes to ~1/4 the bytes even before
+    # entropy coding helps
+    assert kv_codec.encoded_nbytes(enc) < a.nbytes // 2
+
+
+def test_int8_on_integer_kv_falls_back_lossless():
+    a = _page(np.int32, seed=5)
+    enc = kv_codec.encode_page(a, "int8")
+    assert enc["mode"] == "lossless"
+    assert kv_codec.decode_page(enc).tobytes() == a.tobytes()
+
+
+def test_lossless_compresses_structured_pages():
+    # narrow-range KV (what real activations look like): the byte-plane
+    # shuffle groups the near-constant exponent bytes and DEFLATE eats
+    # them
+    a = (_page(np.float32, seed=7) * 1e-2 + 1.0).astype(np.float32)
+    enc = kv_codec.encode_page(a, "lossless")
+    assert kv_codec.decode_page(enc).tobytes() == a.tobytes()
+    assert kv_codec.encoded_nbytes(enc) < a.nbytes
+    assert kv_codec.encoded_nbytes(enc) < len(enc["data"]) + 1  # no scale
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(ValueError):
+        kv_codec.encode_page(_page(np.float32), "gzip9")
+    with pytest.raises(ValueError):
+        KVTierStore(max_bytes=1 << 20, disk_dir=None, disk_max_bytes=0,
+                    ttl_s=600.0, page_size=4, codec="gzip9")
+
+
+def test_page_raw_nbytes_matches_pool_slice():
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm.kv_cache import init_paged_cache
+
+    cfg = llama.llama_tiny(vocab_size=512)
+    kv = init_paged_cache(cfg, num_pages=4, page_size=16)
+    one = np.asarray(kv["k"][:, :, 0:1])
+    assert page_raw_nbytes(cfg, 16) == 2 * one.nbytes
+
+
+# ---------------------------------------------------------------------------
+# store: encoded tiers, raw accounting, streaming restore
+# ---------------------------------------------------------------------------
+
+
+def _blob(n_pages, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (2, 2, n_pages, 4, 8)
+    # narrow-range values so the lossless ratio is visibly > 1
+    k = (rng.standard_normal(shape) * 1e-2 + 0.5).astype(np.float32)
+    v = (rng.standard_normal(shape) * 1e-2 - 0.5).astype(np.float32)
+    digest = b"" if seed == 0 else b"seed%d" % seed
+    digs = []
+    for i in range(n_pages):
+        digest = _chain_digest(digest, [seed * 100 + i])
+        digs.append(digest.hex())
+    return k, v, digs, [(i + 1) * 4 for i in range(n_pages)]
+
+
+def _codec_store(**kw):
+    d = dict(max_bytes=1 << 20, disk_dir=None, disk_max_bytes=0,
+             ttl_s=600.0, page_size=4, codec="lossless")
+    d.update(kw)
+    return KVTierStore(**d)
+
+
+def test_store_encoded_roundtrip_and_raw_accounting():
+    s = _codec_store()
+    k, v, digs, toks = _blob(3)
+    assert s.put(k, v, digs, toks) == 3
+    st = s.stats()
+    assert st["codec"] == "lossless"
+    assert st["shm_bytes_raw"] == k.nbytes + v.nbytes
+    assert 0 < st["shm_bytes"] < st["shm_bytes_raw"]  # stored encoded
+    assert st["codec_ratio"] > 1.0
+    assert st["encode_ms_p50"] > 0.0
+    # decode path is bit-exact through fetch_chain, full and partial
+    t, gk, gv = s.fetch_chain(digs, start=0)
+    assert t == 3
+    np.testing.assert_array_equal(gk, k)
+    np.testing.assert_array_equal(gv, v)
+    t, gk, gv = s.fetch_chain(digs, start=1)
+    assert t == 2
+    np.testing.assert_array_equal(gk, k[:, :, 1:])
+    assert s.stats()["decode_ms_p50"] >= 0.0
+
+
+def test_store_demotion_moves_raw_accounting(tmp_path):
+    k, v, digs, toks = _blob(3, seed=1)
+    s = _codec_store(disk_dir=str(tmp_path), disk_max_bytes=1 << 20)
+    assert s.put(k, v, digs, toks) == 3
+    first = s.stats()
+    # a second put over the shm cap demotes the first blob to disk with
+    # its raw bytes following the encoded bytes tier-for-tier
+    s.max_bytes = first["shm_bytes"] + 1
+    k2, v2, digs2, toks2 = _blob(3, seed=2)
+    assert s.put(k2, v2, digs2, toks2) == 3
+    st = s.stats()
+    assert st["disk_bytes"] > 0 and st["disk_bytes_raw"] == k.nbytes + v.nbytes
+    assert st["shm_bytes_raw"] == k2.nbytes + v2.nbytes
+    # disk-tier restore still decodes bit-exactly
+    t, gk, _gv = s.fetch_chain(digs, start=0)
+    assert t == 3
+    np.testing.assert_array_equal(gk, k)
+
+
+def test_stream_chunked_restore_bit_exact():
+    s = _codec_store()
+    k, v, digs, toks = _blob(6, seed=4)
+    assert s.put(k, v, digs, toks) == 6
+    stream = s.open_stream(digs, 0, chunk_pages=2, timeout_s=2.0)
+    got = []
+    deadline = time.monotonic() + 30.0
+    while not stream.exhausted:
+        pairs, wire, _dec = stream.take()
+        got.extend(pairs)
+        if not pairs:
+            assert time.monotonic() < deadline, "stream stalled"
+            time.sleep(0.005)
+    assert stream.planned == 6 and stream.landed == 6
+    assert not stream.failed
+    assert stream.wire_bytes < k.nbytes + v.nbytes  # moved encoded
+    np.testing.assert_array_equal(np.concatenate([p[0] for p in got],
+                                                 axis=2), k)
+    np.testing.assert_array_equal(np.concatenate([p[1] for p in got],
+                                                 axis=2), v)
+    assert s.stats()["streams"] == 0   # worker deregistered itself
+
+
+def test_stream_chunk_fault_yields_partial():
+    s = _codec_store()
+    k, v, digs, toks = _blob(6, seed=6)
+    assert s.put(k, v, digs, toks) == 6
+
+    def fault(ci):
+        if ci >= 1:
+            raise RuntimeError("injected chunk fault")
+
+    s._chunk_fault = fault
+    stream = s.open_stream(digs, 0, chunk_pages=2, timeout_s=2.0)
+    got = []
+    deadline = time.monotonic() + 30.0
+    while not stream.exhausted:
+        pairs, _w, _d = stream.take()
+        got.extend(pairs)
+        if not pairs:
+            assert time.monotonic() < deadline, "stream stalled"
+            time.sleep(0.005)
+    # chunk 0 landed before the fault: partial, first pages intact
+    assert stream.failed and stream.planned == 6
+    assert len(got) == 2
+    np.testing.assert_array_equal(
+        np.concatenate([p[0] for p in got], axis=2), k[:, :, :2])
+
+
+# ---------------------------------------------------------------------------
+# engine: greedy identity under the codec, partial restore, int8 opt-in
+# ---------------------------------------------------------------------------
+
+
+def test_engine_codec_restore_greedy_identity():
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG)
+    eng = LLMEngine(_tier_cfg(), rng_seed=0)   # codec defaults lossless
+    eng.start()
+    try:
+        assert eng.generate(LONG, temperature=0.0)["tokens"] == want
+        assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 3)
+        hot = eng.generate(LONG, temperature=0.0)["tokens"]
+        assert hot == want, "codec restore diverged from cold prefill"
+        st = eng.engine_stats()
+        assert st["restored_pages"] >= 3
+        assert st["restore_partial"] == 0
+        assert st["tier_codec_ratio"] > 1.0
+        assert 0 < st["tier_bytes_shm"] < st["tier_bytes_shm_raw"]
+        assert st["tier_decode_ms_p50"] >= 0.0
+    finally:
+        eng.shutdown()
+
+
+def test_engine_chunk_fault_partial_restore_identity():
+    """ISSUE 15 acceptance: a chunk-fetch fault mid-restore completes
+    the request via PARTIAL restore — landed pages kept, the tail
+    prefilled, `restore_partial` counted, tokens identical."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG)
+    eng = LLMEngine(_tier_cfg(kv_tier_chunk_pages=1), rng_seed=0)
+    eng.start()
+    try:
+        assert eng.generate(LONG, temperature=0.0)["tokens"] == want
+        assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 3)
+
+        def fault(ci):
+            if ci >= 1:
+                raise RuntimeError("injected chunk fault")
+
+        eng._kv_tier._chunk_fault = fault
+        hot = eng.generate(LONG, temperature=0.0)["tokens"]
+        assert hot == want, "partial restore diverged from cold prefill"
+        st = eng.engine_stats()
+        assert st["restore_partial"] >= 1
+        # page 0 landed before the fault and stayed restored; the two
+        # faulted pages were prefilled, not restored
+        assert 1 <= st["restored_pages"] < 3
+    finally:
+        eng.shutdown()
+
+
+def test_engine_int8_codec_opt_in_completes():
+    """int8 is NOT bit-exact — the engine must still complete restores
+    (bounded-error KV, full-length output); identity is deliberately not
+    asserted here, the bench records the divergence instead."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_tier_cfg(kv_tier_codec="int8"), rng_seed=0)
+    eng.start()
+    try:
+        cold = eng.generate(LONG, temperature=0.0)
+        assert cold["error"] is None and len(cold["tokens"]) == 8
+        assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 3)
+        hot = eng.generate(LONG, temperature=0.0)
+        assert hot["error"] is None and len(hot["tokens"]) == 8
+        st = eng.engine_stats()
+        assert st["restored_pages"] >= 3
+        # fp32 quantized to int8: ~4x before DEFLATE
+        assert st["tier_codec_ratio"] > 3.0
+    finally:
+        eng.shutdown()
+
+
+def test_engine_restore_stage_attrs_in_attribution():
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG)
+    eng = LLMEngine(_tier_cfg(), rng_seed=0)
+    eng.start()
+    try:
+        assert eng.generate(LONG, temperature=0.0)["tokens"] == want
+        assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 3)
+        out = eng.generate(LONG, temperature=0.0)
+        assert out["tokens"] == want
+        restore = next(s for s in out["stages"]
+                       if s["stage"] == "restore")
+        # wire bytes moved encoded: fewer than the decoded KV bytes
+        assert 0 < restore["attrs"]["bytes_wire"]
+        assert restore["attrs"]["bytes_wire"] \
+            < restore["attrs"]["restore_bytes"]
+        assert restore["attrs"]["decode_ms"] >= 0.0
+        assert restore["attrs"]["overlap_ms"] >= 0.0
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# cluster: failover resume over a compressed eager-spilled chain
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def codec_cluster(ray_start_module):
+    yield ray_start_module
+
+
+def test_failover_resume_over_compressed_chain(codec_cluster):
+    """PR 14's mid-stream failover over PR 15's encoded wire: engine A
+    eagerly spills a LIVE (prompt + generated) chain encoded, engine B
+    streams it back through the CP index + object plane chunk-by-chunk
+    and resumes token-identically."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG, 72)
+    cfg = _tier_cfg(prefix_cache_max_pages=0, max_tokens=8)
+    a = LLMEngine(cfg, rng_seed=0)
+    a.start()
+    b = None
+    try:
+        rid = a.submit(LONG, max_tokens=72, temperature=0.0)
+        assert _wait(lambda: len(
+            (a.request_progress(rid) or {}).get("generated") or ()) >= 12,
+            timeout=120.0)
+        n = a.spill_inflight()
+        assert n >= 6, f"expected prompt+generated pages spilled, got {n}"
+        assert _wait(lambda: a.engine_stats()["spilled_pages"] >= 6)
+        assert a.engine_stats()["tier_codec_ratio"] > 1.0
+
+        b = LLMEngine(cfg, rng_seed=0)
+        b.start()
+        k = 12
+        rid_b = b.submit(LONG, resume_tokens=want[:k],
+                         max_tokens=72 - k, temperature=0.0)
+        out = b.result(rid_b, timeout=180.0)
+        assert out["error"] is None, out
+        assert out["tokens"] == want[k:], "resumed decode diverged"
+        st = b.engine_stats()
+        assert st["failover_resumed"] == 1
+        assert st["restored_pages"] >= 6
+        assert st["restore_partial"] == 0
+        assert b._kv_tier.counters["remote_hits"] >= 6
+    finally:
+        a.shutdown()
+        if b is not None:
+            b.shutdown()

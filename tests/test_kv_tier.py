@@ -311,10 +311,10 @@ def test_engine_restore_failure_degrades_to_miss():
         assert eng.generate(LONG, temperature=0.0)["tokens"] == want
         assert _wait(lambda: eng.engine_stats()["spilled_pages"] >= 3)
 
-        def boom(digests, start):
+        def boom(digests, start, **kw):
             raise RuntimeError("injected restore failure")
 
-        eng._kv_tier.fetch_chain = boom
+        eng._kv_tier.open_stream = boom
         # plain cold prefill, same tokens, engine keeps serving
         assert eng.generate(LONG, temperature=0.0)["tokens"] == want
         assert eng.engine_stats()["restored_pages"] == 0
