@@ -488,6 +488,17 @@ class ControlPlane:
                 return True
             return False
 
+    def _h_kv_mput(self, body):
+        """Batched kv_put: one RPC registers many keys. The kv-tier
+        publisher uses it to index a whole spilled chain (one entry per
+        page) per round trip — per-page kv_put serializes a long-prompt
+        disagg handoff behind O(pages) RPCs on the publisher thread."""
+        with self._lock:
+            for key, value in body["items"]:
+                self._kv[key] = value
+                self._store.save("kv", key.encode(), value)
+        return True
+
     def _h_kv_get(self, body):
         with self._lock:
             return self._kv.get(body["key"])

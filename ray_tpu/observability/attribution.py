@@ -44,8 +44,11 @@ from typing import Any, Optional
 # pipeline (re-route + continuation admit), so its engine-side stages
 # (queue/restore/prefill/decode of the resumed leg) sort after it while
 # the original leg's stamps keep their earlier start times.
-STAGES = ("ingress", "route", "failover", "queue", "restore", "prefill",
-          "decode")
+# ``prefill_remote`` (ISSUE 16 disagg) sits between failover and queue:
+# the proxy runs the remote prefill BEFORE dispatching the decode leg,
+# so the decode replica's queue/restore/decode stages sort after it.
+STAGES = ("ingress", "route", "failover", "prefill_remote", "queue",
+          "restore", "prefill", "decode")
 
 _STAGE_INDEX = {s: i for i, s in enumerate(STAGES)}
 
@@ -166,6 +169,7 @@ def engine_stages(*, submitted_wall: float, submitted_at: float,
                   restore_wire_bytes: int = 0,
                   restore_decode_ms: float = 0.0,
                   restore_overlap_ms: float = 0.0,
+                  restore_partial: bool = False,
                   prompt_tokens: int = 0, generated_tokens: int = 0,
                   itl_s: Optional[float] = None) -> list[dict]:
     """Build ordered stage dicts from the engine's raw per-request
@@ -206,7 +210,11 @@ def engine_stages(*, submitted_wall: float, submitted_at: float,
                               "decode_ms": round(
                                   float(restore_decode_ms), 3),
                               "overlap_ms": round(
-                                  float(restore_overlap_ms), 3)}})
+                                  float(restore_overlap_ms), 3),
+                              # stream cut short (peer death / chunk
+                              # timeout): landed pages were kept, the
+                              # tail was re-prefilled (ISSUE 16)
+                              "partial": bool(restore_partial)}})
     if first_token_at is not None:
         ft_wall = wall(first_token_at)
         prefilled = max(0, int(prompt_tokens) - int(cached_tokens))

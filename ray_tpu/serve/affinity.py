@@ -95,6 +95,27 @@ def digests_for_http(subpath: str, payload, meta: dict,
     return compute_prefix_digests(prompt, meta, max_digests)
 
 
+def prompt_tokens_for_http(subpath: str, payload, meta: dict) -> int:
+    """Tokenized (and max_prompt_len-capped) prompt length for one HTTP
+    request under the deployment's affinity ``meta`` — the number the
+    disagg threshold decision (ISSUE 16) compares against. 0 on non-LLM
+    routes or any failure (0 never crosses a positive threshold, so
+    failures degrade to colocated serving)."""
+    try:
+        prompt = prompt_from_payload(subpath, payload)
+        if prompt is None:
+            return 0
+        tok = _get_tokenizer(str(meta["tokenizer"]))
+        toks = tok.encode(prompt)
+        max_len = int(meta.get("max_prompt_len") or 0)
+        if max_len > 0:
+            toks = toks[:max_len]
+        return len(toks)
+    except Exception:  # noqa: BLE001 — sizing is advisory, same degrade
+        # contract as the digests above
+        return 0
+
+
 def compute_prefix_digests(prompt: str, meta: dict,
                            max_digests: int) -> Optional[list]:
     """Leading page-chain digests (hex) for ``prompt`` under the

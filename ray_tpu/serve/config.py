@@ -112,6 +112,13 @@ class DeploymentConfig:
     # fraction of non-violating requests shipped as baseline exemplars
     # for contrast in the fleet breakdown
     slo_sample_rate: float = 0.01
+    # ---- fleet disaggregation (ISSUE 16) -------------------------------
+    # Deployment role in a disaggregated fleet: "prefill" replicas run
+    # only prompt passes and stream KV through the tier index; "decode"
+    # replicas own the token loops. None = ordinary colocated
+    # deployment. Surfaced in controller status so the CLI/dashboard can
+    # tell the pools apart; set by disagg.build_disagg_fleet_app.
+    role: Optional[str] = None
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
 
     def target_replicas(self) -> int:

@@ -349,6 +349,7 @@ class ServeController:
                 "target": state.target,
                 "version": state.version,
                 "app": state.app,
+                "role": state.config.role,
             }
             for state in self._deployments.values()
         }
@@ -380,6 +381,8 @@ class ServeController:
                         "prefix_evictions",
                         "spilled_pages", "restored_pages",
                         "restore_partial", "restoring",
+                        "disagg_prefills", "handoff_bytes_wire",
+                        "handoff_overlap_ms",
                         "tier_hit_tokens", "tier_bytes_shm",
                         "tier_bytes_disk",
                         "tier_bytes_shm_raw", "tier_bytes_disk_raw",
@@ -422,6 +425,7 @@ class ServeController:
                 *(probe_engine(r) for r in state.replicas)))
             out[state.full_name()] = {
                 "app": state.app,
+                "role": state.config.role,
                 "replicas": len(state.replicas),
                 "starting": len(state.starting),
                 "draining": len(state.draining),

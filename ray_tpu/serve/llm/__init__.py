@@ -14,6 +14,9 @@ Public surface:
 - LLMEngine            — the engine itself (usable standalone, e.g. bench)
 - build_disagg_openai_app — prefill/decode-disaggregated application
   (prefill replicas hand KV pages to decode replicas; serve/llm/disagg.py)
+- build_disagg_fleet_app — fleet-level disaggregation on the streamed KV
+  plane (prefill pool spills through the tier codec + CP index; decode
+  replicas restore via ChainStream — serve/llm/disagg.py, ISSUE 16)
 - NGramProposer         — n-gram draft proposer for speculative decoding
   (serve/llm/spec_decode.py; enabled via LLMConfig.spec_decode_enabled)
 """
@@ -22,7 +25,9 @@ from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.disagg import (
     DecodeEngine,
     DisaggLLMServer,
+    FleetDecodeServer,
     PrefillServer,
+    build_disagg_fleet_app,
     build_disagg_openai_app,
     prefill_only,
 )
@@ -33,6 +38,7 @@ from ray_tpu.serve.llm.spec_decode import NGramProposer
 
 __all__ = [
     "LLMConfig", "LLMEngine", "LLMServer", "build_llm_deployment",
-    "build_openai_app", "build_disagg_openai_app", "PrefillServer",
-    "DisaggLLMServer", "DecodeEngine", "prefill_only", "NGramProposer",
+    "build_openai_app", "build_disagg_openai_app", "build_disagg_fleet_app",
+    "PrefillServer", "DisaggLLMServer", "FleetDecodeServer", "DecodeEngine",
+    "prefill_only", "NGramProposer",
 ]

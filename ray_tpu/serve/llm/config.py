@@ -145,6 +145,31 @@ class LLMConfig:
     # retry-from-scratch (the PR 2 retry path, minus the continuation)
     failover_max_resumes: int = 2
 
+    # Fleet prefill/decode disaggregation (ISSUE 16): long-prompt
+    # requests are prefilled on a dedicated prefill pool, the KV chain
+    # spills through the tier codec into the CP `kv_tier:` index, and
+    # the decode replica restores it as a streamed ChainStream — decode
+    # starts while later chunks are still on the wire. The proxy/router
+    # take the disagg branch when the request's estimated prefill
+    # tokens (prompt minus the best resident prefix match in the decode
+    # pool) exceed the threshold; 0 disables the mode entirely. Set by
+    # build_disagg_fleet_app on the DECODE deployment's config.
+    disagg_prompt_threshold: int = 0
+    # serve deployment name of the paired prefill pool (set by the fleet
+    # builder on decode configs; None on standalone deployments)
+    disagg_prefill_deployment: Optional[str] = None
+    # Codec for the disagg handoff wire specifically (the compiled-
+    # pipeline channel blobs in disagg.py; the streamed fleet path uses
+    # kv_tier_codec so prefill and decode share a tier namespace).
+    # "int8" here is governed by the quality policy below.
+    disagg_wire_codec: str = "lossless"          # "none"|"lossless"|"int8"
+    # Quality policy gating int8 on the disagg wire: the bench A/B arm
+    # measures greedy-output divergence (fraction of positions where the
+    # int8-wire output differs from lossless) and int8 is only policy-
+    # approved when measured divergence <= this bound. 0.0 = int8 must
+    # be bit-identical to pass (i.e. effectively requires lossless).
+    disagg_int8_max_divergence: float = 0.0
+
     # Prefix-affinity routing (ISSUE 10): cap on the resident page-chain
     # digests each replica exports to the router through the controller
     # long-poll. Low chain positions win the cut (a leading page is what

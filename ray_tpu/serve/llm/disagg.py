@@ -24,6 +24,22 @@ Pieces:
 - ``build_disagg_openai_app``       — OpenAI ingress whose completions
   path is prefill-replica → KV blob → local decode engine.
 
+Fleet path (ISSUE 16): ``build_disagg_fleet_app`` lifts the handoff onto
+the STREAMED object plane instead of a whole-blob transfer. Prefill
+replicas gain ``prefill_stream``: the prompt pass's full KV pages spill
+through the tier codec into a local KVTierStore and register in the CP
+``kv_tier:`` index (namespace shared with decode engines via
+``engine.kv_tier_namespace``); what returns is a LIGHT descriptor, not
+the KV. The decode pool is plain tier-enabled ``LLMServer`` replicas
+(``FleetDecodeServer``): an ordinary submit finds the prefill-registered
+chain, opens a ``ChainStream`` and starts decoding as pages land — the
+PR 15 ``_restoring`` machinery IS the handoff, so a dead prefill replica
+mid-stream degrades to a partial restore + tail prefill instead of
+failing the request. The proxy/router pick the branch per request
+(``Router.disagg_plan`` when estimated prefill tokens exceed
+``disagg_prompt_threshold``) and stamp an ordered ``prefill_remote``
+attribution stage.
+
 Prefix caching: the disagg path BYPASSES the prefix-cache index by
 decision (``_disable_prefix_cache``), not by accident. Prefill replicas
 allocate and free their pages inside one call, so nothing survives to
@@ -45,6 +61,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ray_tpu.serve.llm import llm_server as _llm_server
 from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.engine import LLMEngine, _Request
 
@@ -67,6 +84,67 @@ def _disable_spec_decode(cfg: LLMConfig) -> LLMConfig:
     if not cfg.spec_decode_enabled:
         return cfg
     return dataclasses.replace(cfg, spec_decode_enabled=False)
+
+
+# ---------------------------------------------------------------------------
+# handoff wire codec (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def _encode_state(state: dict, mode: str) -> dict:
+    """Encode a handoff blob's KV pages for the wire (compiled-pipeline
+    channel or object-plane task return). Pages encode independently —
+    the same per-page layout the tier stores — so the decode side can
+    reuse the one codec. ``none`` passes through untouched."""
+    if mode == "none" or "kv_k" not in state:
+        return state
+    from ray_tpu.serve.llm import kv_codec
+    n = int(state["n_pages"])
+    pages = [(kv_codec.encode_page(state["kv_k"][:, :, i:i + 1], mode),
+              kv_codec.encode_page(state["kv_v"][:, :, i:i + 1], mode))
+             for i in range(n)]
+    out = {k: v for k, v in state.items() if k not in ("kv_k", "kv_v")}
+    out["enc_pages"] = pages
+    out["wire_bytes"] = sum(
+        kv_codec.encoded_nbytes(ek) + kv_codec.encoded_nbytes(ev)
+        for ek, ev in pages)
+    return out
+
+
+def _decode_state(state: dict) -> dict:
+    """Invert :func:`_encode_state`; raw blobs pass through (mixed-codec
+    rollouts: the decode side accepts both shapes regardless of its own
+    wire setting)."""
+    if "enc_pages" not in state:
+        return state
+    from ray_tpu.serve.llm import kv_codec
+    ks = [kv_codec.decode_page(ek) for ek, _ in state["enc_pages"]]
+    vs = [kv_codec.decode_page(ev) for _, ev in state["enc_pages"]]
+    out = {k: v for k, v in state.items() if k != "enc_pages"}
+    out["kv_k"] = np.concatenate(ks, axis=2)
+    out["kv_v"] = np.concatenate(vs, axis=2)
+    return out
+
+
+def int8_wire_divergence(ref_tokens, got_tokens) -> float:
+    """Greedy-output divergence between a lossless-wire reference and an
+    int8-wire run: fraction of positions that differ (length mismatch
+    counts every unmatched position). The bench A/B arm feeds this to
+    :func:`int8_wire_allowed`."""
+    ref = list(ref_tokens or [])
+    got = list(got_tokens or [])
+    n = max(len(ref), len(got), 1)
+    diff = sum(1 for a, b in zip(ref, got) if a != b) \
+        + abs(len(ref) - len(got))
+    return diff / n
+
+
+def int8_wire_allowed(cfg: LLMConfig, measured_divergence: float) -> bool:
+    """Per-deployment quality policy gating int8 on the disagg wire: the
+    lossy codec is only policy-approved when the MEASURED divergence
+    stays within the deployment's bound. The default bound (0.0) demands
+    bit-identity — int8 never silently defaults on."""
+    return float(measured_divergence) <= max(
+        0.0, float(cfg.disagg_int8_max_divergence))
 
 
 # ---------------------------------------------------------------------------
@@ -156,6 +234,7 @@ class DecodeEngine(LLMEngine):
     def submit_prefilled(self, state: dict, *,
                          max_tokens: Optional[int] = None,
                          request_id: Optional[str] = None) -> str:
+        state = _decode_state(state)  # wire-encoded blobs decode HERE
         toks = list(state["prompt_tokens"])
         req = _Request(
             request_id=request_id or uuid.uuid4().hex[:16],
@@ -265,12 +344,39 @@ class PrefillServer:
         # docstring / _disable_spec_decode)
         self.engine = LLMEngine(
             _disable_spec_decode(_disable_prefix_cache(llm_config)))
+        # streamed-handoff tier store (ISSUE 16), built on first
+        # prefill_stream: the engine's own tier requires the prefix
+        # cache (off here by decision), so the prefill role spills
+        # through a store of its own — SAME namespace as the decode
+        # engines (kv_tier_namespace over the same config), which is
+        # what makes the registrations restorable over there
+        self._tier = None
+        self._tier_lock = threading.Lock()
+
+    def _tier_store(self):
+        with self._tier_lock:
+            if self._tier is None:
+                from ray_tpu.serve.llm import kv_tier as kvt
+                from ray_tpu.serve.llm.engine import kv_tier_namespace
+                cfg = self.cfg
+                self._tier = kvt.KVTierStore(
+                    max_bytes=cfg.kv_tier_max_bytes,
+                    disk_dir=None,  # handoffs are transient; no disk tier
+                    disk_max_bytes=0,
+                    ttl_s=cfg.kv_tier_ttl_s,
+                    page_size=cfg.page_size,
+                    namespace=kv_tier_namespace(
+                        cfg, self.engine.model_cfg,
+                        self.engine.kv["k"].dtype),
+                    codec=cfg.kv_tier_codec)
+            return self._tier
 
     def prefill(self, prompt, sampling: dict) -> dict:
-        return prefill_only(
+        state = prefill_only(
             self.engine, prompt,
             temperature=sampling.get("temperature"),
             top_k=sampling.get("top_k"))
+        return _encode_state(state, self.cfg.disagg_wire_codec)
 
     def prefill_one(self, req: dict) -> dict:
         """Single-argument stage entry for the compiled pipeline (the KV
@@ -280,21 +386,107 @@ class PrefillServer:
                 "state": self.prefill(req["prompt"],
                                       req.get("sampling") or {})}
 
+    def prefill_stream(self, subpath: str, payload: dict) -> dict:
+        """Streamed fleet handoff (ISSUE 16): run the prompt pass, spill
+        the full KV pages through the tier codec into this replica's
+        store, and register them in the CP ``kv_tier:`` index. Returns a
+        LIGHT descriptor — the KV itself travels later, chunk by chunk,
+        when the decode replica's ``ChainStream`` pulls it.
+
+        ``flush_index`` is the handshake that makes the return value
+        mean something: once this call returns, the decode side's
+        ``_match_entries`` can see every page, so the proxy may dispatch
+        the decode leg immediately. KV pages are sampling-independent,
+        so the decode leg re-applies the request's own sampling params.
+        """
+        from ray_tpu.serve import affinity
+        prompt = affinity.prompt_from_payload(subpath, payload)
+        if prompt is None:
+            raise ValueError(f"no prompt in disagg prefill payload "
+                             f"for route {subpath!r}")
+        state = prefill_only(self.engine, prompt, temperature=0.0)
+        ps = self.cfg.page_size
+        toks = state["prompt_tokens"]
+        full = len(toks) // ps
+        registered = 0
+        wire = 0
+        if full > 0:
+            tier = self._tier_store()
+            digest = b""
+            digs, tokens = [], []
+            for i in range(full):
+                digest = self.engine._kvc._chain_digest(
+                    digest, toks[i * ps:(i + 1) * ps])
+                digs.append(digest.hex())
+                tokens.append((i + 1) * ps)
+            with self._tier_lock:
+                enc0 = tier.counters["put_bytes_enc"]
+                registered = tier.put(
+                    state["kv_k"][:, :, :full], state["kv_v"][:, :, :full],
+                    digests=digs, tokens=tokens)
+                wire = tier.counters["put_bytes_enc"] - enc0
+            tier.flush_index(2.0)
+        return {"plen": state["plen"], "pages_registered": int(registered),
+                "wire_bytes": int(wire),
+                "prefill_ttft_s": state["prefill_ttft_s"]}
+
+    def wire_ratio_probe(self) -> float:
+        """Measured raw/encoded ratio of this model's real prefill KV
+        under the wire codec (one deterministic max-length prompt pass).
+        Feeds `_handoff_channel_capacity`'s encoded sizing — a guess
+        would either re-over-provision the channel or overflow it."""
+        mode = self.cfg.disagg_wire_codec
+        if mode == "none":
+            return 1.0
+        from ray_tpu.serve.llm import kv_codec
+        vocab = max(2, int(getattr(self.engine.model_cfg,
+                                   "vocab_size", 2)))
+        toks = [(i * 37 + 11) % vocab
+                for i in range(max(1, self.cfg.max_prompt_len))]
+        state = prefill_only(self.engine, toks, temperature=0.0)
+        raw = int(state["kv_k"].nbytes) + int(state["kv_v"].nbytes)
+        enc = 0
+        for i in range(state["n_pages"]):
+            for a in (state["kv_k"], state["kv_v"]):
+                enc += kv_codec.encoded_nbytes(
+                    kv_codec.encode_page(a[:, :, i:i + 1], mode))
+        return raw / max(1, enc)
+
+    def engine_stats(self) -> dict:
+        stats = {**self.engine.engine_stats(), "mode": "prefill"}
+        if self._tier is not None:
+            stats["handoff_bytes_wire"] = int(
+                self._tier.counters["put_bytes_enc"])
+        return stats
+
     def check_health(self) -> bool:
         return True
 
 
-def _handoff_channel_capacity(cfg: LLMConfig) -> int:
+def _handoff_channel_capacity(cfg: LLMConfig,
+                              measured_ratio: float | None = None) -> int:
     """Channel capacity sized for the largest KV handoff blob this config
     can produce (a max_prompt_len prompt's pages), not the default 8 MiB:
     k+v arrays are [L, Hkv, n_pages, page, D] in the model dtype, and
     Channel.write hard-fails on overflow — an undersized pipe would poison
-    every later request on it."""
+    every later request on it.
+
+    Since PR 15 the blob travels ENCODED (``disagg_wire_codec``), so raw
+    model-dtype sizing over-provisions the channel by the codec ratio
+    (~4–9× on bf16 KV). With a ``measured_ratio`` (raw/encoded, from
+    ``PrefillServer.wire_ratio_probe`` on the real model) the capacity
+    shrinks accordingly — but only trusting HALF the measured ratio and
+    never dropping below raw sizing: the probe samples one prompt, other
+    prompts compress worse, and overflow poisons the pipe while idle
+    headroom only costs shm."""
     mc = cfg.llama()
     pages = -(-cfg.max_prompt_len // cfg.page_size)
     itemsize = np.dtype(getattr(mc, "dtype", np.float32)).itemsize
     kv_bytes = 2 * mc.n_layers * mc.n_kv_heads * pages * cfg.page_size \
         * mc.head_dim * itemsize  # k+v in the model dtype
+    if cfg.disagg_wire_codec != "none":
+        ratio = max(1.0, 0.5 * float(measured_ratio or 0.0))
+        kv_bytes = int(kv_bytes / ratio)
     # prompt tokens + pickle/ndarray framing + slack
     return int(kv_bytes * 1.25) + (1 << 20)
 
@@ -324,8 +516,21 @@ class DisaggLLMServer:
         self._pipe_rr = 0
         self._rid = 0
         if prefill_actors:
+            import ray_tpu
             from ray_tpu.dag import CompiledPipeline
-            cap = _handoff_channel_capacity(llm_config)
+            ratio = None
+            if llm_config.disagg_wire_codec != "none":
+                # size the channels from a MEASURED codec ratio (one real
+                # prefill on actor 0) — conservative floor inside
+                # _handoff_channel_capacity; a failed probe sizes raw
+                try:
+                    ratio = ray_tpu.get(
+                        prefill_actors[0].wire_ratio_probe.remote(),
+                        timeout=600.0)
+                except Exception:  # noqa: BLE001 — raw sizing is safe
+                    ratio = None
+            cap = _handoff_channel_capacity(llm_config,
+                                            measured_ratio=ratio)
             self._pipes = [
                 CompiledPipeline([(a, "prefill_one")], capacity=cap).compile()
                 for a in prefill_actors]
@@ -464,3 +669,61 @@ def build_disagg_openai_app(llm_config: LLMConfig | dict,
         health_check_timeout_s=600.0)
     decode_dep.route_prefix = route_prefix
     return decode_dep.bind(llm_config, prefill_dep.bind(llm_config))
+
+
+# ---------------------------------------------------------------------------
+# fleet disaggregation on the streamed KV plane (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+class FleetDecodeServer(_llm_server.LLMServer):
+    """Decode-role replica for the FLEET disagg path: a plain tier-
+    enabled ``LLMServer`` — prefix cache ON, ordinary submit path — plus
+    an ignored second init arg that anchors the prefill pool in the
+    serve bind graph (``serve.run`` deploys bound sub-apps; the decode
+    ingress never calls the prefill handle, the PROXY dispatches
+    ``prefill_stream`` through the router's disagg plan). A real
+    subclass, not a trampoline: the controller's ingress probe checks
+    the CLASS for ``handle_http``."""
+
+    def __init__(self, llm_config: LLMConfig | dict, prefill_handle=None):
+        super().__init__(llm_config)
+
+
+def build_disagg_fleet_app(llm_config: LLMConfig | dict,
+                           route_prefix: str = "/v1",
+                           num_prefill: int = 2, num_decode: int = 2,
+                           prefill_actor_options: dict | None = None,
+                           decode_actor_options: dict | None = None):
+    """Fleet-level disaggregated application (ISSUE 16): ``num_prefill``
+    prefill replicas (controller role ``prefill``) stream KV to
+    ``num_decode`` tier-enabled decode replicas through the CP
+    ``kv_tier:`` index. The decode deployment is the ingress; its config
+    carries ``disagg_prefill_deployment`` + ``disagg_prompt_threshold``,
+    which the replicas export via ``prefix_summary`` meta so the
+    router's ``disagg_plan`` can take the third placement mode."""
+    from ray_tpu import serve
+
+    if isinstance(llm_config, dict):
+        llm_config = LLMConfig(**llm_config)
+    prefill_name = f"{llm_config.name}-prefill"
+    decode_cfg = dataclasses.replace(
+        llm_config,
+        prefix_cache_enabled=True,
+        kv_tier_enabled=True,
+        disagg_prefill_deployment=prefill_name)
+    prefill_dep = serve.deployment(
+        PrefillServer, name=prefill_name,
+        num_replicas=num_prefill,
+        max_ongoing_requests=2,  # a prefill owns the chip while it runs
+        ray_actor_options=dict(prefill_actor_options or {}),
+        health_check_timeout_s=600.0)
+    prefill_dep.config.role = "prefill"
+    decode_dep = serve.deployment(
+        FleetDecodeServer, name=llm_config.name,
+        num_replicas=num_decode,
+        max_ongoing_requests=4 * llm_config.max_batch_size,
+        ray_actor_options=dict(decode_actor_options or {}),
+        health_check_timeout_s=600.0)
+    decode_dep.config.role = "decode"
+    decode_dep.route_prefix = route_prefix
+    return decode_dep.bind(decode_cfg, prefill_dep.bind(llm_config))

@@ -40,6 +40,34 @@ from ray_tpu.serve.llm.tokenizer import get_tokenizer
 logger = logging.getLogger(__name__)
 
 
+def kv_tier_namespace(cfg: LLMConfig, model_cfg, kv_dtype,
+                      rng_seed: int = 0) -> str:
+    """Cluster-index namespace for a config's KV pages. A chain digest
+    encodes the token prefix, NOT which model computed the KV — two
+    architecturally identical models would cross-restore each other's
+    pages and silently decode garbage. Scope the index to everything
+    that makes KV bytes interchangeable: model id, weights (checkpoint
+    path, or the init seed for random weights), architecture config, KV
+    dtype, page size. Shared by LLMEngine and the disagg PrefillServer
+    (ISSUE 16): both sides deriving the namespace from the same config
+    is what lets a prefill replica's spills be visible to decode
+    replicas' restores."""
+    ident = "|".join([
+        str(cfg.model_id),
+        str(cfg.checkpoint_path or f"seed:{rng_seed}"),
+        repr(model_cfg),
+        str(cfg.page_size),
+        str(kv_dtype)])
+    if cfg.kv_tier_codec == "int8":
+        # lossy pages are NOT interchangeable with exact ones: a
+        # lossless replica restoring quantized KV would silently break
+        # its bit-identity guarantee, so quantized stores index under
+        # their own namespace. none<->lossless mix freely (both decode
+        # to identical bytes).
+        ident += "|int8"
+    return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+
 @dataclass
 class _Request:
     request_id: str
@@ -93,6 +121,14 @@ class _Request:
     restore_decode_ms: float = 0.0
     restore_blocked_ms: float = 0.0
     restore_overlap_ms: float = 0.0
+    # stream ended short of its plan (peer death / chunk timeout): the
+    # landed pages were kept and the tail re-prefilled (ISSUE 16)
+    restore_partial: bool = False
+    # fleet disagg handoff (ISSUE 16): the prompt KV was prefilled by a
+    # remote prefill replica and registered in the tier before this
+    # submit — the restore this request performs IS the handoff, so its
+    # wire/overlap numbers feed the disagg engine counters
+    disagg: bool = False
     first_token_at: Optional[float] = None
     # inter-token latency: host record-time of the last token plus the
     # per-token gaps (pipelined harvests record blocks in bursts, so the
@@ -205,7 +241,9 @@ class LLMEngine:
                       "tier_hit_tokens": 0, "restore_partial": 0,
                       "spec_rounds": 0, "spec_drafted_tokens": 0,
                       "spec_accepted_tokens": 0,
-                      "failover_resumed": 0, "failover_restored_tokens": 0}
+                      "failover_resumed": 0, "failover_restored_tokens": 0,
+                      "disagg_prefills": 0, "handoff_bytes_wire": 0,
+                      "handoff_overlap_ms": 0.0}
         # Tiered KV cache (kv_tier.py): evicted cached page chains spill
         # host-side into a shm/disk tier + cluster index instead of dying,
         # and _admit extends its longest-match search past the local index
@@ -222,33 +260,14 @@ class LLMEngine:
         self._spill_req: Optional[tuple] = None
         if self._kv_tier_on:
             from ray_tpu.serve.llm import kv_tier as kvt
-            # cluster-index namespace: a chain digest encodes the token
-            # prefix, NOT which model computed the KV — two architecturally
-            # identical models would cross-restore each other's pages and
-            # silently decode garbage. Scope the index to everything that
-            # makes KV bytes interchangeable: model id, weights (checkpoint
-            # path, or the init seed for random weights), architecture
-            # config, KV dtype, page size.
-            ident = "|".join([
-                str(cfg.model_id),
-                str(cfg.checkpoint_path or f"seed:{rng_seed}"),
-                repr(self.model_cfg),
-                str(cfg.page_size),
-                str(self.kv["k"].dtype)])
-            if cfg.kv_tier_codec == "int8":
-                # lossy pages are NOT interchangeable with exact ones: a
-                # lossless replica restoring quantized KV would silently
-                # break its bit-identity guarantee, so quantized stores
-                # index under their own namespace. none<->lossless mix
-                # freely (both decode to identical bytes).
-                ident += "|int8"
             self._kv_tier = kvt.KVTierStore(
                 max_bytes=cfg.kv_tier_max_bytes,
                 disk_dir=cfg.kv_tier_disk_dir,
                 disk_max_bytes=cfg.kv_tier_disk_max_bytes,
                 ttl_s=cfg.kv_tier_ttl_s,
                 page_size=cfg.page_size,
-                namespace=hashlib.sha256(ident.encode()).hexdigest()[:16],
+                namespace=kv_tier_namespace(
+                    cfg, self.model_cfg, self.kv["k"].dtype, rng_seed),
                 codec=cfg.kv_tier_codec)
             self.allocator.spill_hook = self._spill_capture
             # restore scatter at ONE fixed shape (max_pages_per_seq,
@@ -604,7 +623,8 @@ class LLMEngine:
                top_k: Optional[int] = None,
                request_id: Optional[str] = None,
                prefix_digests: Optional[list] = None,
-               resume_tokens: Optional[list] = None) -> str:
+               resume_tokens: Optional[list] = None,
+               disagg: bool = False) -> str:
         """Enqueue a request; returns its id. Tokens stream via drain().
 
         ``resume_tokens`` is a mid-stream failover continuation (ISSUE
@@ -648,7 +668,8 @@ class LLMEngine:
             stop_token=getattr(self.tokenizer, "eos_token_id", None),
             ingress_digests=(list(prefix_digests)
                              if prefix_digests else None),
-            resume_len=resume_len)
+            resume_len=resume_len,
+            disagg=bool(disagg))
         from ray_tpu.core import deadline as request_deadline
         from ray_tpu.observability import tracing
         req.trace_ctx = tracing.inject()
@@ -808,6 +829,7 @@ class LLMEngine:
                 restore_wire_bytes=req.restore_wire_bytes,
                 restore_decode_ms=req.restore_decode_ms,
                 restore_overlap_ms=req.restore_overlap_ms,
+                restore_partial=req.restore_partial,
                 prompt_tokens=len(req.prompt_tokens),
                 generated_tokens=len(req.generated),
                 itl_s=gaps[len(gaps) // 2] if gaps else None),
@@ -1422,6 +1444,15 @@ class LLMEngine:
         planned = stream.planned or 0
         if 0 < req.restore_pages < planned:
             self.stats["restore_partial"] += 1
+            req.restore_partial = True
+        if req.disagg:
+            # fleet disagg (ISSUE 16): this restore carried a remote
+            # prefill's KV — count the handoff and its wire/overlap
+            # split regardless of whether the stream ran to plan (a
+            # partial handoff still moved bytes and hid latency)
+            self.stats["disagg_prefills"] += 1
+            self.stats["handoff_bytes_wire"] += req.restore_wire_bytes
+            self.stats["handoff_overlap_ms"] += req.restore_overlap_ms
         if req.resume_len:
             # the continuation's recovered-without-recompute accounting,
             # deferred from _admit until the restored frontier is final

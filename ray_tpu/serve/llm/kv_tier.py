@@ -252,6 +252,24 @@ class KVTierStore:
             self._pub_enqueue_locked("register", rec)
         return n
 
+    def flush_index(self, timeout_s: float = 2.0) -> bool:
+        """Barrier on the cluster-index publisher: returns once every
+        registration enqueued BEFORE this call has been pushed to the
+        CP (or the timeout passes — False). The disagg handoff (ISSUE
+        16) needs it: a prefill replica must not report its spill done
+        until the decode side's `_match_entries` can actually see the
+        pages, and the publisher is an ordered background thread."""
+        ev = threading.Event()
+        with self._lock:
+            self._pub_q.put(("flush", ev))
+            t = self._pub_thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._pub_loop, daemon=True,
+                                     name="kv-tier-pub")
+                self._pub_thread = t
+                t.start()
+        return ev.wait(timeout_s)
+
     # ---- cluster-index publisher ----------------------------------------
     def _pub_enqueue_locked(self, op: str, rec: dict) -> None:
         """Queue one register/retract for the publisher thread. Caller
@@ -286,6 +304,9 @@ class KVTierStore:
                 continue
             if op is None:  # close() sentinel
                 return
+            if op == "flush":  # flush_index barrier: queue order means
+                snap.set()     # every earlier register already ran
+                continue
             try:
                 if op == "register":
                     self._register_cp(snap)
@@ -308,6 +329,7 @@ class KVTierStore:
                        if snap["tier"] == "shm" and snap["ref"] is not None
                        else None)
             per_raw = snap["raw"] // max(1, len(snap["digests"]))
+            items = []
             for i, d in enumerate(snap["digests"]):
                 # nbytes = encoded (what travels the wire / fills the
                 # tier), raw = decoded — the CLI/dashboard ratio columns
@@ -319,10 +341,12 @@ class KVTierStore:
                          "tier": snap["tier"],
                          "ts": snap["ts"], "ttl_s": self.ttl_s,
                          "ref": ref_hex, "ns": self.namespace}
-                self._cp_call("kv_put", {
-                    "key": self._key(d),
-                    "value": json.dumps(entry).encode(),
-                    "overwrite": True})
+                items.append((self._key(d), json.dumps(entry).encode()))
+            # one RPC for the whole blob: the publisher thread is the
+            # disagg handoff's critical path (prefill_stream's
+            # flush_index waits on it), and per-page round trips stack
+            # O(pages × queued blobs) latency under load
+            self._cp_call("kv_mput", {"items": items})
         except Exception:
             logger.debug("kv-tier: CP index registration failed",
                          exc_info=True)

@@ -44,6 +44,9 @@ _EXPORTED_STATS = (
     # mid-stream failover (ISSUE 14): continuations admitted + tokens of
     # dead-replica work recovered without recompute (prefix + tier pages)
     "failover_resumed", "failover_restored_tokens",
+    # fleet disagg (ISSUE 16): remote-prefill handoffs restored here +
+    # their encoded wire bytes and decode-overlapped restore milliseconds
+    "disagg_prefills", "handoff_bytes_wire", "handoff_overlap_ms",
     # introspection scalars (ISSUE 6): compile tracker + memory gauges;
     # None-valued entries (no samples yet / cpu backend) are skipped
     "compile_events", "mid_traffic_compiles", "compile_s",
@@ -171,6 +174,12 @@ class LLMServer:
             out["temperature"] = float(payload["temperature"])
         if payload.get("top_k") is not None:
             out["top_k"] = int(payload["top_k"])
+        # Fleet disagg handoff marker (ISSUE 16): the proxy already ran
+        # the remote prefill and the chain is registered in the tier —
+        # the engine's ordinary restore path IS the handoff; the flag
+        # only routes the restore's accounting to the disagg counters.
+        if payload.get("_disagg_handoff"):
+            out["disagg"] = True
         # Ingress page-chain digests (ISSUE 10): the proxy computed them
         # once for routing; the replica carries them request-scoped
         # (serve/replica.py set the contextvar before dispatch) and the
@@ -376,6 +385,13 @@ class LLMServer:
             "kv_tier": bool(self.cfg.kv_tier_enabled
                             and self.cfg.prefix_cache_enabled),
             "model_id": self.cfg.model_id,
+            # fleet disagg placement inputs (ISSUE 16): the router's
+            # disagg_plan reads these off rs.meta — which prefill pool
+            # serves this deployment and past how many estimated
+            # prefill tokens the handoff pays
+            "disagg_prefill": self.cfg.disagg_prefill_deployment,
+            "disagg_prompt_threshold": int(
+                self.cfg.disagg_prompt_threshold or 0),
         }
         if since is not None and int(since) == version:
             return {"supported": True, "version": version,

@@ -88,7 +88,9 @@ class HTTPProxy:
         # mutated only on the proxy event loop — no lock needed
         self.stats = {"ok": 0, "errors": 0, "shed_expired": 0,
                       "shed_overload": 0, "deadline_exceeded": 0,
-                      "retries": 0, "stream_resumes": 0}
+                      "retries": 0, "stream_resumes": 0,
+                      "disagg_prefills": 0, "disagg_fallbacks": 0,
+                      "disagg_partial_restores": 0}
 
     # ---- lifecycle -----------------------------------------------------
     def start(self):
@@ -302,6 +304,87 @@ class HTTPProxy:
         except Exception:  # noqa: BLE001 — attribution must never 500 a
             pass           # request that already succeeded
 
+    async def _disagg_prefill(self, loop, router, plan: dict, subpath: str,
+                              payload: dict, rid: str, dl: float, tl):
+        """Remote-prefill leg of a disaggregated request (ISSUE 16).
+
+        Dispatches `prefill_stream` to the advertised prefill pool
+        through the SAME router path ordinary requests take (pow-2 +
+        circuit breaker), waits for the light handoff descriptor (the KV
+        itself travels replica->replica over the tier plane, never
+        through the proxy), and stamps the ordered `prefill_remote`
+        stage. Returns a join context {deployment, replica, t0} on
+        success; None on ANY failure — the request then degrades to an
+        ordinary colocated dispatch, it never fails because the prefill
+        pool is sick. A replica fault here charges the prefill replica's
+        ejection breaker exactly like a decode fault would."""
+        prefill_dep = plan["prefill_deployment"]
+        t_pre0 = time.time()
+        pctx = contextvars.copy_context()
+        try:
+            ref, pre_replica = await loop.run_in_executor(
+                None, lambda: pctx.run(
+                    router.assign_info, prefill_dep, "prefill_stream",
+                    (subpath, payload), {"_request_id": rid}))
+        except Exception:  # noqa: BLE001 — no pool/replica: colocate
+            self.stats["disagg_fallbacks"] += 1
+            return None
+        try:
+            timeout = min(120.0, max(0.001, dl - time.time()))
+            desc = await loop.run_in_executor(
+                None, lambda: ray_tpu.get(ref, timeout=timeout))
+        except Exception as e:  # noqa: BLE001 — classify, then colocate
+            if is_replica_fault(e):
+                # satellite: prefill replicas die too — charge the same
+                # breaker decode replicas answer to
+                router.record_replica_fault(prefill_dep, pre_replica)
+            self.stats["disagg_fallbacks"] += 1
+            return None
+        self.stats["disagg_prefills"] += 1
+        if tl is not None:
+            tl.stamp("prefill_remote", t_pre0, time.time(),
+                     deployment=prefill_dep,
+                     est_prefill_tokens=plan["est_prefill_tokens"],
+                     prompt_tokens=int(desc.get("plen", 0)),
+                     pages=int(desc.get("pages_registered", 0)),
+                     bytes_wire=int(desc.get("wire_bytes", 0)),
+                     prefill_ttft_s=float(desc.get("prefill_ttft_s", 0.0)))
+        return {"deployment": prefill_dep, "replica": pre_replica,
+                "t0": t_pre0}
+
+    def _disagg_join(self, router, disagg_ctx: Optional[dict],
+                     engine_meta: Optional[dict], tl) -> None:
+        """Join the decode leg's restore accounting back onto the disagg
+        handoff (ISSUE 16). Two jobs: (a) fold the decode engine's
+        restore overlap into the timeline's `prefill_remote` stamp so
+        one stage answers "what did the handoff overlap/cost on the
+        wire"; (b) a PARTIAL restore means the prefill replica died (or
+        its stream wedged) after registration — charge its ejection
+        breaker so the pool routes around it."""
+        if not disagg_ctx:
+            return
+        try:
+            restore = None
+            for st in (engine_meta or {}).get("stages") or ():
+                if isinstance(st, dict) and st.get("stage") == "restore":
+                    restore = st.get("attrs") or {}
+            if restore is None:
+                return
+            if tl is not None:
+                for st in tl.stages:
+                    if st.get("stage") == "prefill_remote":
+                        st.setdefault("attrs", {}).update(
+                            stream_overlap_ms=restore.get("overlap_ms", 0.0),
+                            restored_tokens=restore.get(
+                                "restored_tokens", 0),
+                            partial=bool(restore.get("partial")))
+            if restore.get("partial"):
+                router.record_replica_fault(disagg_ctx["deployment"],
+                                            disagg_ctx["replica"])
+                self.stats["disagg_partial_restores"] += 1
+        except Exception:  # noqa: BLE001 — accounting only, never 500
+            pass
+
     async def _handle(self, request):
         from aiohttp import web
 
@@ -434,6 +517,33 @@ class HTTPProxy:
                     tl.stamp("ingress", t_ingress0, time.time(),
                              method=request.method, path=path,
                              n_digests=len(digests or ()))
+                # Fleet disagg (ISSUE 16): third placement mode. When the
+                # deployment advertises a prefill pool and the request's
+                # ESTIMATED prefill tokens (prompt minus the decode
+                # pool's best resident match) cross the threshold, run
+                # the prompt pass on a prefill replica first — it spills
+                # the chain through the tier codec and registers it in
+                # the CP index — then dispatch the decode leg normally:
+                # the decode replica's streamed tier restore IS the
+                # handoff. Every failure degrades to colocated serving.
+                disagg_ctx = None
+                if wants_dispatch and isinstance(payload, dict):
+                    meta = router.affinity_meta(deployment)
+                    if meta.get("disagg_prefill"):
+                        n_prompt = await loop.run_in_executor(
+                            None, _affinity.prompt_tokens_for_http,
+                            subpath, payload, meta)
+                        plan = router.disagg_plan(deployment, digests,
+                                                  n_prompt)
+                        if plan is not None:
+                            disagg_ctx = await self._disagg_prefill(
+                                loop, router, plan, subpath, payload,
+                                rid, dl, tl)
+                            if disagg_ctx is not None:
+                                # marker for the decode engine's handoff
+                                # accounting (payload object is shared
+                                # with `call` — in-place on purpose)
+                                payload["_disagg_handoff"] = True
                 pctx = contextvars.copy_context()
                 if streaming:
                     ref, replica = await loop.run_in_executor(
@@ -456,7 +566,7 @@ class HTTPProxy:
                         resp = await self._stream_sse(
                             request, ref, dl, sp, rid=rid, tl=tl,
                             policy=slo_policy, t0=t0, router=router,
-                            resume_ctx=resume_ctx)
+                            resume_ctx=resume_ctx, disagg_ctx=disagg_ctx)
                         self._observe_request(
                             deployment, prefix, resp.status, t0)
                         return resp
@@ -495,6 +605,7 @@ class HTTPProxy:
         e2e_ms = (time.monotonic() - t0) * 1e3
         engine_meta = (result.get("ray_tpu")
                        if isinstance(result, dict) else None) or {}
+        self._disagg_join(router, disagg_ctx, engine_meta, tl)
         ttft_s = engine_meta.get("ttft_s")
         self._finalize_slo(
             tl, slo_policy,
@@ -525,7 +636,8 @@ class HTTPProxy:
     async def _stream_sse(self, request, ref, dl: float, sp, *,
                           rid: str = "", tl=None, policy: Optional[dict] = None,
                           t0: Optional[float] = None, router=None,
-                          resume_ctx: Optional[dict] = None):
+                          resume_ctx: Optional[dict] = None,
+                          disagg_ctx: Optional[dict] = None):
         """ObjectRefGenerator: stream each chunk to the client the moment
         the replica yields it (SSE framing; reference: proxy ASGI
         streaming). First byte goes out at first token, not at completion.
@@ -689,6 +801,8 @@ class HTTPProxy:
             ttft_ms = (first_chunk_at - t0) * 1e3
         elif engine_meta and engine_meta.get("ttft_s") is not None:
             ttft_ms = engine_meta["ttft_s"] * 1e3
+        if router is not None:
+            self._disagg_join(router, disagg_ctx, engine_meta, tl)
         self._finalize_slo(tl, policy, ttft_ms=ttft_ms,
                            e2e_ms=(time.monotonic() - t0) * 1e3,
                            engine_meta=engine_meta, error=stream_error)

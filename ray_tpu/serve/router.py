@@ -294,6 +294,21 @@ class ReplicaSet:
             n += 1
         return n
 
+    def best_match(self, digests: Optional[list]) -> int:
+        """Best leading-prefix match (pages) across ALL summaries — the
+        disagg threshold's estimate of how much prefill the decode pool
+        already holds for this request, regardless of which replica the
+        pick lands on. 0 when summaries are stale/absent (no evidence =
+        assume cold, which only errs toward the prefill pool on long
+        prompts — exactly the requests the pool exists for)."""
+        if not digests or not self._summaries_usable():
+            return 0
+        best = 0
+        for resident in self._summaries.values():
+            if resident:
+                best = max(best, self._match_len(digests, resident))
+        return best
+
     def _summaries_usable(self) -> bool:
         if self.degraded:
             return False
@@ -532,6 +547,34 @@ class Router:
         with self._lock:
             rs = self._sets.get(deployment)
             return dict(rs.meta) if rs is not None and rs.meta else {}
+
+    def disagg_plan(self, deployment: str,
+                    prefix_digests: Optional[list],
+                    prompt_tokens: int) -> Optional[dict]:
+        """Third placement mode (ISSUE 16): decide whether this request
+        should prefill on the deployment's paired prefill pool before
+        its decode dispatch. Returns None for the ordinary colocated
+        path, else ``{"prefill_deployment", "est_prefill_tokens"}``.
+
+        The estimate is the prompt length minus what the decode pool
+        already holds resident (best leading match across summaries ×
+        page_size): a long prompt whose prefix is hot decodes colocated
+        — the handoff only pays for COLD prefill FLOPs."""
+        with self._lock:
+            rs = self._sets.get(deployment)
+        if rs is None or not rs.meta:
+            return None
+        prefill_dep = rs.meta.get("disagg_prefill")
+        threshold = int(rs.meta.get("disagg_prompt_threshold") or 0)
+        if not prefill_dep or threshold <= 0 or prompt_tokens <= 0:
+            return None
+        page_size = int(rs.meta.get("page_size") or 0)
+        est = max(0, int(prompt_tokens)
+                  - rs.best_match(prefix_digests) * page_size)
+        if est <= threshold:
+            return None
+        return {"prefill_deployment": str(prefill_dep),
+                "est_prefill_tokens": est}
 
     def _maybe_refresh(self, deployment: str, force: bool = False):
         with self._lock:
