@@ -191,6 +191,15 @@ def _serve_apps() -> list[dict]:
     except Exception:  # noqa: BLE001
         return []
     rows = [{"deployment": name, **info} for name, info in status.items()]
+    # elastic fleet (ISSUE 17): compact the scale-decision flight recorder
+    # into "from->to reason" strings so the table cell stays readable —
+    # the raw records (with signals) remain on detailed_status
+    for row in rows:
+        decs = row.get("scale_decisions")
+        if decs:
+            row["scale_decisions"] = [
+                f"{d.get('from')}->{d.get('to')} {d.get('reason')}"
+                for d in decs[-5:]]
     # cache-aware routing counters (ISSUE 10) ride along per deployment:
     # summed across every router that reported to the metrics store
     try:

@@ -360,11 +360,16 @@ def aggregate_report(records: list[dict]) -> dict:
     Returns::
 
         {"count", "violations",
+         "ttft_ms": {"p50","p95","p99","count"} | None,
          "stage_ms": {stage: {"p50","p95","p99","count"}},
          "dominant_stage": {stage: n},      # over tail requests
          "replica_skew": {replica: {"count","queue_wait_p50_ms",
                                     "queue_wait_p95_ms","affinity_hit_share",
                                     "prefilled_tokens"}}}
+
+    ``ttft_ms`` percentiles (ISSUE 17) cover every record carrying a
+    ttft — the signal the controller's SLO-driven scaler and the
+    open-loop harness judge against (None when no record has one).
 
     "Tail requests" are the SLO violations when any exist, else the
     slowest-decile records by e2e — so the dominant-stage table is
@@ -389,6 +394,17 @@ def aggregate_report(records: list[dict]) -> dict:
             "p95": round(percentile(vals, 0.95), 3),
             "p99": round(percentile(vals, 0.99), 3),
             "count": len(vals),
+        }
+
+    ttfts = sorted(float(r["ttft_ms"]) for r in records
+                   if r.get("ttft_ms") is not None)
+    ttft_ms = None
+    if ttfts:
+        ttft_ms = {
+            "p50": round(percentile(ttfts, 0.50), 3),
+            "p95": round(percentile(ttfts, 0.95), 3),
+            "p99": round(percentile(ttfts, 0.99), 3),
+            "count": len(ttfts),
         }
 
     violations = [(r, d) for r, d in durs if r.get("violated")]
@@ -437,6 +453,7 @@ def aggregate_report(records: list[dict]) -> dict:
     return {
         "count": len(records),
         "violations": len(violations),
+        "ttft_ms": ttft_ms,
         "stage_ms": stage_ms,
         "dominant_stage": dominant,
         "replica_skew": replica_skew,

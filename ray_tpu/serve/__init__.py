@@ -15,6 +15,7 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     run,
     shutdown,
+    start_http_proxies,
     start_http_proxy,
     status,
 )
@@ -33,5 +34,6 @@ __all__ = [
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "batch", "delete", "deployment", "detailed_status", "get_app_handle",
     "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
-    "run", "shutdown", "start_grpc_proxy", "start_http_proxy", "status",
+    "run", "shutdown", "start_grpc_proxy", "start_http_proxies",
+    "start_http_proxy", "status",
 ]

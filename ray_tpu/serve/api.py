@@ -20,7 +20,13 @@ from ray_tpu.serve.controller import get_or_create_controller
 from ray_tpu.serve.handle import DeploymentHandle, _reset_routers
 
 _lock = threading.Lock()
-_proxy = None  # (HTTPProxy, port)
+_proxy = None  # (HTTPProxy, port) — primary ingress
+# Multi-proxy ingress (ISSUE 17): additional HTTPProxy instances behind
+# the same fleet (start_http_proxies). They share ONE router map — one
+# controller long-poll per app for the whole ingress tier — and each
+# serves its own /-/stats. All are stopped by shutdown().
+_extra_proxies: list = []
+_shared_routers: dict = {}
 
 
 class Application:
@@ -239,6 +245,13 @@ def shutdown() -> None:
         if _proxy is not None:
             _proxy[0].stop()
             _proxy = None
+        for p in _extra_proxies:
+            try:
+                p.stop()
+            except Exception:  # noqa: BLE001 — already down
+                pass
+        _extra_proxies.clear()
+        _shared_routers.clear()
     try:
         controller = ray_tpu.get_actor("_serve_controller", timeout=0.2)
         ray_tpu.get(controller.shutdown.remote(), timeout=30.0)
@@ -265,3 +278,41 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000,
             p.start()
             _proxy = (p, port)
         return _proxy[0]
+
+
+def start_http_proxies(count: int, host: str = "127.0.0.1",
+                       port: int = 8000, router_config=None) -> list:
+    """Multi-proxy ingress (ISSUE 17): `count` HTTPProxy instances behind
+    the SAME fleet. The first takes `port` (or joins an already-running
+    primary), the rest take `port+1, port+2, ...` — pass ``port=0`` for
+    OS-assigned ports on all of them. Every proxy shares one router map:
+    one controller long-poll per app for the whole ingress tier, one
+    shared retry budget and circuit breaker per app, while each proxy
+    answers its own `/-/stats` (tagged with its name/port). Put any
+    TCP-level balancer — or a client-side port list — in front; the
+    proxies are stateless beyond their shared routing cache. Returns the
+    proxy list (index 0 = primary). Idempotent: already-running proxies
+    are reused, only the missing tail is started."""
+    global _proxy
+    from ray_tpu.serve.proxy import HTTPProxy
+    out = []
+    with _lock:
+        controller = get_or_create_controller()
+        if _proxy is None:
+            p = HTTPProxy(controller, host, port,
+                          router_config=router_config, name="proxy-0",
+                          shared_routers=_shared_routers)
+            p.start()
+            _proxy = (p, p.port)
+        out.append(_proxy[0])
+        out.extend(_extra_proxies)
+        while len(out) < max(1, int(count)):
+            i = len(out)
+            p = HTTPProxy(controller, host,
+                          0 if port == 0 else port + i,
+                          router_config=router_config, name=f"proxy-{i}",
+                          shared_routers=_shared_routers)
+            p.start()
+            _extra_proxies.append(p)
+            out.append(p)
+    return out

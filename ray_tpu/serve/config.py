@@ -18,6 +18,21 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 3.0
     downscale_delay_s: float = 30.0
+    # ---- signal-driven scaling (ISSUE 17) ------------------------------
+    # Fold serve-plane signals into the queue-length policy: a window of
+    # SLO violations whose p99 TTFT is dominated by a stage more replicas
+    # actually fix ("queue" — backlog drains across more slots; "prefill"
+    # — prompt work spreads) upscales one step even while raw queue depth
+    # sits under target. Conversely, a fleet whose prefix-affinity heat is
+    # broadly spread refuses the downscale step: evicting a warm working
+    # set craters the hit rate for a small capacity win.
+    slo_upscale_enabled: bool = True
+    # dominant stages that justify a capacity step (decode/restore/ingress
+    # dominance does not parallelize across replicas)
+    slo_upscale_stages: tuple = ("queue", "prefill")
+    # block downscale while the share of replicas holding resident prefix
+    # summaries is at least this (0 disables the guard)
+    heat_downscale_guard: float = 0.5
 
     def decide(self, current: int, total_ongoing: float) -> int:
         if current == 0:
@@ -26,6 +41,37 @@ class AutoscalingConfig:
         import math
         target = int(math.ceil(desired))
         return max(self.min_replicas, min(self.max_replicas, target))
+
+    def decide_signals(self, current: int, total_ongoing: float,
+                       signals: Optional[dict] = None) -> tuple:
+        """Queue-length decision folded with serve-plane signals
+        (ISSUE 17). `signals` keys, all optional — absence degrades to the
+        pure queue policy:
+
+          slo_violations     — violating exemplars in the current window
+          dominant_stage     — PR 12 attribution of the window's p99 TTFT
+          affinity_hit_share — share of replicas holding resident summaries
+          prefill_skew       — max/mean per-replica summary-page skew
+
+        Returns ``(desired, reason)``; the reason names the deciding
+        signal and is exported through the controller's scale-decision
+        log for the dashboard and the open-loop harness."""
+        base = self.decide(current, total_ongoing)
+        sig = signals or {}
+        if (self.slo_upscale_enabled and base <= current
+                and current < self.max_replicas
+                and int(sig.get("slo_violations") or 0) > 0
+                and sig.get("dominant_stage") in self.slo_upscale_stages):
+            return current + 1, f"slo_{sig.get('dominant_stage')}"
+        if base < current:
+            share = sig.get("affinity_hit_share")
+            if (self.heat_downscale_guard > 0 and share is not None
+                    and share >= self.heat_downscale_guard):
+                return current, "heat_guard"
+            return base, "queue_idle"
+        if base > current:
+            return base, "queue_len"
+        return current, "steady"
 
 
 @dataclasses.dataclass

@@ -48,6 +48,29 @@ class _DeploymentState:
         # replacements. Retired — table flip FIRST, then graceful stop —
         # only once enough replacements are ready.
         self.draining: list = []
+        # ready (first health check passed) but still pre-populating their
+        # prefix cache from the KV tier (ISSUE 17 cache-warm scale-up):
+        # NOT routable. They join `replicas` — with the version bump in
+        # the same synchronous block — only once the warm_start RPC
+        # resolves, so the router's first sight of a scale-up replica is
+        # a warm holder, never a cold one cratering the fleet hit rate.
+        self.warming: list = []
+        # in-flight warm_start tasks keyed by replica actor-id hex
+        self._warm_tasks: dict = {}
+        # cumulative warm-start economy across this deployment's scale-ups
+        self.warm_stats: dict = {"replicas_warmed": 0, "pages": 0,
+                                 "ms": 0.0}
+        # signal-driven scale decision log (ISSUE 17): bounded ring of
+        # {ts, from, to, reason, signals} plus per-reason counters —
+        # exported through detailed_status for the dashboard and the
+        # open-loop harness
+        self.scale_decisions: list = []
+        self.scale_counters: dict[str, int] = {}
+        self._signals: dict = {}
+        self._signals_ts = 0.0
+        # True while the heat guard is continuously refusing a downscale
+        # (so the refusal is logged once per episode, not per 0.2s tick)
+        self._guard_episode = False
         self.version = 0
         self.target = config.target_replicas()
         # consecutive failed health checks per replica (actor id hex) — a
@@ -155,7 +178,11 @@ class ServeController:
                 state.replicas = existing.replicas
                 state.starting = existing.starting
                 state.draining = existing.draining
-                state.version = existing.version + 1
+                state.warming = existing.warming
+                state._warm_tasks = existing._warm_tasks
+                state.warm_stats = existing.warm_stats
+                state.scale_decisions = existing.scale_decisions
+                state.scale_counters = existing.scale_counters
                 # config change with same code → reconfigure in place
                 if d["config"].user_config is not None:
                     for r in state.replicas:
@@ -165,9 +192,16 @@ class ServeController:
                                     d["config"].user_config)), 10.0)
                         except Exception:  # noqa: BLE001
                             pass
+                # version computed AT PUBLISH time, after the awaits above:
+                # the control loop may bump existing.version while a
+                # reconfigure is in flight, and republishing at an older
+                # (or equal) version would leave long-pollers pinned on
+                # the stale table forever (ISSUE 17 atomicity fix)
+                state.version = existing.version + 1
             self._deployments[key] = state
             if d.get("is_ingress") and d.get("route_prefix") is not None:
                 self._routes[d["route_prefix"]] = (app_name, d["name"])
+        self._notify_change()
         # remove deployments of this app not in the new spec
         for key in [k for k in self._deployments
                     if k.startswith(app_name + "#") and k not in new_names]:
@@ -192,12 +226,16 @@ class ServeController:
         return True
 
     async def _drain_deployment(self, state: _DeploymentState):
-        for r in state.starting:
+        for t in state._warm_tasks.values():
+            t.cancel()
+        state._warm_tasks = {}
+        for r in state.starting + state.warming:
             try:
                 ray_tpu.kill(r)
             except Exception:  # noqa: BLE001
                 pass
         state.starting = []
+        state.warming = []
         for r in state.replicas + state.draining:
             try:
                 await asyncio.wait_for(
@@ -346,6 +384,7 @@ class ServeController:
             state.full_name(): {
                 "replicas": len(state.replicas),
                 "draining": len(state.draining),
+                "warming": len(state.warming),
                 "target": state.target,
                 "version": state.version,
                 "app": state.app,
@@ -381,6 +420,7 @@ class ServeController:
                         "prefix_evictions",
                         "spilled_pages", "restored_pages",
                         "restore_partial", "restoring",
+                        "warm_start_pages", "warm_start_ms",
                         "disagg_prefills", "handoff_bytes_wire",
                         "handoff_overlap_ms",
                         "tier_hit_tokens", "tier_bytes_shm",
@@ -428,6 +468,7 @@ class ServeController:
                 "role": state.config.role,
                 "replicas": len(state.replicas),
                 "starting": len(state.starting),
+                "warming": len(state.warming),
                 "draining": len(state.draining),
                 "target": state.target,
                 "version": state.version,
@@ -435,6 +476,13 @@ class ServeController:
                 "engine": (engines if any(e is not None for e in engines)
                            else None),
                 "latency_ms": self._latency_percentiles(state.name),
+                # elastic fleet (ISSUE 17): the scale-decision flight
+                # recorder + cache-warm scale-up economy the dashboard
+                # serve panel and the open-loop harness render
+                "scale_decisions": list(state.scale_decisions[-10:]),
+                "scale_counters": dict(state.scale_counters),
+                "warm": dict(state.warm_stats),
+                "signals": dict(state._signals),
             }
         return out
 
@@ -467,7 +515,10 @@ class ServeController:
     async def shutdown(self) -> bool:
         self._stopped = True
         for state in self._deployments.values():
-            for r in state.replicas + state.starting + state.draining:
+            for t in state._warm_tasks.values():
+                t.cancel()
+            for r in (state.replicas + state.starting + state.warming
+                      + state.draining):
                 try:
                     ray_tpu.kill(r)
                 except Exception:  # noqa: BLE001
@@ -545,7 +596,9 @@ class ServeController:
                 state.draining = left
                 state.version += 1
                 self._notify_change()
-            # a STARTING replica on a dead node will never become ready
+            # a STARTING replica on a dead node will never become ready;
+            # a WARMING one will never finish its warm_start — both are
+            # pre-table, so no version bump, just re-place via scale-up
             still = [r for r in state.starting
                      if self._replica_key(r) not in on_dead_nodes]
             if len(still) != len(state.starting):
@@ -556,6 +609,19 @@ class ServeController:
                         except Exception:  # noqa: BLE001
                             pass
                 state.starting = still
+            warm_left = [r for r in state.warming
+                         if self._replica_key(r) not in on_dead_nodes]
+            if len(warm_left) != len(state.warming):
+                for r in state.warming:
+                    if self._replica_key(r) in on_dead_nodes:
+                        t = state._warm_tasks.pop(self._replica_key(r), None)
+                        if t is not None:
+                            t.cancel()
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:  # noqa: BLE001
+                            pass
+                state.warming = warm_left
 
     async def _move_replicas_on_draining_nodes(self):
         """Drain node-DRAINING events: replicas on those nodes move
@@ -609,14 +675,20 @@ class ServeController:
                         r.prepare_to_move.remote()  # graftlint: fire-and-forget
                     except Exception:  # noqa: BLE001
                         pass
-            # STARTING replicas on a draining node would come up on a node
-            # about to disappear — kill now, scale-up re-places them
-            doomed = [r for r in state.starting
+            # STARTING/WARMING replicas on a draining node would come up
+            # on a node about to disappear — kill now, scale-up re-places
+            # them (both are pre-table: no version traffic)
+            doomed = [r for r in state.starting + state.warming
                       if self._replica_key(r) in on_draining]
             if doomed:
                 state.starting = [r for r in state.starting
                                   if self._replica_key(r) not in on_draining]
+                state.warming = [r for r in state.warming
+                                 if self._replica_key(r) not in on_draining]
                 for r in doomed:
+                    t = state._warm_tasks.pop(self._replica_key(r), None)
+                    if t is not None:
+                        t.cancel()
                     try:
                         ray_tpu.kill(r)
                     except Exception:  # noqa: BLE001
@@ -690,6 +762,139 @@ class ServeController:
                 state.summary_gen += 1
                 self._notify_change()
 
+    async def _warm_one(self, state: _DeploymentState, replica) -> dict:
+        """Cache-warm one READY but unpublished replica (ISSUE 17): a
+        single bounded warm_start RPC through the generic dispatch. The
+        replica restores the fleet's hottest KV-tier chains into its
+        prefix cache; unsupported deployments (plain callables, tier
+        off) resolve immediately. A hung warm is promoted cold by the
+        timeout rather than parked forever."""
+        try:
+            res = await asyncio.wait_for(_as_future(
+                replica.handle_request.remote("warm_start", (), {}),
+                timeout=30.0), 35.0)
+        except Exception as e:  # noqa: BLE001 — promote cold
+            cause = e.cause if isinstance(e, TaskError) else e
+            if not isinstance(cause, (AttributeError, TypeError)):
+                logger.warning("%s: warm_start failed — promoting cold: %r",
+                               state.full_name(), e)
+            return {"supported": False, "pages": 0}
+        if isinstance(res, dict) and res.get("supported"):
+            logger.info(
+                "%s: warm start landed %s pages / %s chains in %s ms",
+                state.full_name(), res.get("pages", 0),
+                res.get("chains", 0), res.get("ms", 0.0))
+            return res
+        return {"supported": False, "pages": 0}
+
+    async def _collect_scale_signals(self, state: _DeploymentState) -> dict:
+        """Serve-plane signals for decide_signals (ISSUE 17), refreshed
+        at most every 2 s and cached between refreshes. Everything
+        degrades to absence (pure queue-length policy) when the exemplar
+        store or affinity summaries aren't there."""
+        now = time.monotonic()
+        if now - state._signals_ts < 2.0:
+            return state._signals
+        state._signals_ts = now
+        sig: dict = {}
+        # affinity heat from the ISSUE-10 summaries already in hand:
+        # per-replica resident-page skew (what the router's load ×
+        # locality score is fighting) and the share of replicas holding
+        # anything (what a downscale would evict)
+        counts = [len(state.summaries.get(self._replica_key(r)) or [])
+                  for r in state.replicas]
+        if counts:
+            mean = sum(counts) / len(counts)
+            sig["prefill_skew"] = (round(max(counts) / mean, 3)
+                                   if mean > 0 else 0.0)
+            sig["affinity_hit_share"] = round(
+                sum(1 for c in counts if c > 0) / len(counts), 3)
+
+        # PR 12 attribution: violation count + dominant p99-TTFT stage
+        # for this deployment's exemplar window (CP call → executor)
+        def _report():
+            from ray_tpu.util import state as state_api
+            return state_api.slo_report(deployment=state.name)
+
+        try:
+            rep = await asyncio.get_event_loop().run_in_executor(
+                None, _report)
+        except Exception:  # noqa: BLE001 — attribution absent
+            rep = None
+        if isinstance(rep, dict) and rep.get("count"):
+            sig["slo_violations"] = int(rep.get("violations") or 0)
+            dom = rep.get("dominant_stage") or {}
+            if isinstance(dom, dict) and dom:
+                sig["dominant_stage"] = max(dom.items(),
+                                            key=lambda kv: kv[1])[0]
+        state._signals = sig
+        return sig
+
+    def _record_scale(self, state: _DeploymentState, prev: int, new: int,
+                      reason: str, signals: Optional[dict] = None):
+        """Append to the deployment's bounded scale-decision log (the
+        dashboard/harness flight recorder) and bump the reason counter."""
+        state.scale_counters[reason] = \
+            state.scale_counters.get(reason, 0) + 1
+        state.scale_decisions.append({
+            "ts": time.time(), "from": int(prev), "to": int(new),
+            "reason": reason, "signals": dict(signals or {})})
+        del state.scale_decisions[:-50]
+
+    async def _pick_downscale_victim(self, state: _DeploymentState):
+        """Coldest, least-loaded replica: fewest exported prefix-summary
+        digests first (retiring a hot holder evicts the fleet's working
+        set), then shortest live queue. An unreachable probe scores as
+        idle — the health sweep reclaims a genuinely dead replica either
+        way."""
+        scored = []
+        for i, r in enumerate(state.replicas):
+            heat = len(state.summaries.get(self._replica_key(r)) or [])
+            try:
+                q = int(await asyncio.wait_for(
+                    _as_future(r.get_queue_len.remote()), 2.0))
+            except Exception:  # noqa: BLE001
+                q = 0
+            scored.append((heat, q, i, r))
+        scored.sort(key=lambda t: (t[0], t[1], -t[2]))
+        return scored[0][3]
+
+    async def set_target_replicas(self, app_name: str,
+                                  deployment: Optional[str] = None,
+                                  target: Optional[int] = None,
+                                  delta: Optional[int] = None,
+                                  reason: str = "manual") -> dict:
+        """Imperative scale knob (bench schedules, `replica_scale` chaos
+        events, operators). Sets the reconcile target directly: scale-up
+        goes through STARTING → WARMING → one atomic publish; scale-down
+        drains the coldest replica with zero dropped requests. Clamped
+        to the autoscaling [min, max] when one is configured, and to
+        >= 1 always. Returns {full_name: target} for the touched
+        deployments."""
+        self._ensure_started()
+        out = {}
+        for state in list(self._deployments.values()):
+            if state.app != app_name:
+                continue
+            if deployment is not None and state.name != deployment:
+                continue
+            new = state.target if target is None else int(target)
+            if target is None and delta is not None:
+                new = state.target + int(delta)
+            asc = state.config.autoscaling_config
+            if asc is not None:
+                new = max(asc.min_replicas, min(asc.max_replicas, new))
+            new = max(1, new)
+            if new != state.target:
+                self._record_scale(state, state.target, new, reason,
+                                   state._signals)
+                logger.info("set_target_replicas %s: %d -> %d (%s)",
+                            state.full_name(), state.target, new, reason)
+                state.target = new
+                state._pending_target = None
+            out[state.full_name()] = state.target
+        return out
+
     async def _reconcile_once(self):
         await self._drop_replicas_on_dead_nodes()
         await self._move_replicas_on_draining_nodes()
@@ -707,7 +912,48 @@ class ServeController:
                     state.starting = [
                         r for r, ok in zip(state.starting, ready_flags)
                         if not ok]
-                    state.replicas.extend(became)
+                    # cache-warm scale-up (ISSUE 17): a ready replica is
+                    # NOT published yet — it first pre-populates its
+                    # prefix cache from the KV tier (WARMING). Promotion
+                    # below is the only way into the routing table.
+                    state.warming.extend(became)
+                    for r in became:
+                        state._warm_tasks[self._replica_key(r)] = \
+                            asyncio.ensure_future(self._warm_one(state, r))
+
+            # promote warmed replicas. The list mutation and the version
+            # bump happen in ONE synchronous block (no await between), so
+            # a long-poller can never observe a table that contains the
+            # new replica under the old version — or the bumped version
+            # without the replica (ISSUE 17 atomicity fix). Warming is
+            # best-effort: a failed/unsupported/timed-out warm promotes
+            # the replica cold rather than parking it forever.
+            if state.warming:
+                done = [r for r in state.warming
+                        if state._warm_tasks.get(
+                            self._replica_key(r), None) is None
+                        or state._warm_tasks[self._replica_key(r)].done()]
+                if done:
+                    for r in done:
+                        t = state._warm_tasks.pop(self._replica_key(r), None)
+                        res = None
+                        if t is not None and t.done() and not t.cancelled():
+                            try:
+                                res = t.result()
+                            except Exception:  # noqa: BLE001
+                                res = None
+                        if isinstance(res, dict) and res.get("supported"):
+                            state.warm_stats["replicas_warmed"] += 1
+                            state.warm_stats["pages"] += int(
+                                res.get("pages") or 0)
+                            state.warm_stats["ms"] = round(
+                                state.warm_stats["ms"]
+                                + float(res.get("ms") or 0.0), 3)
+                    done_set = {self._replica_key(r) for r in done}
+                    state.warming = [
+                        r for r in state.warming
+                        if self._replica_key(r) not in done_set]
+                    state.replicas.extend(done)
                     state.version += 1
                     self._notify_change()
 
@@ -800,7 +1046,12 @@ class ServeController:
                     except Exception:  # noqa: BLE001
                         pass
 
-            # autoscaling
+            # autoscaling: queue-length policy folded with serve-plane
+            # signals (ISSUE 17) — PR 12 SLO attribution (violations +
+            # dominant p99-TTFT stage) and PR 10/14 affinity heat (hit
+            # share, per-replica summary-page skew). Signals degrade to
+            # {} when the exemplar store or summaries are absent, which
+            # reduces decide_signals to the original queue policy.
             asc = state.config.autoscaling_config
             if asc is not None and state.replicas:
                 total = 0
@@ -810,7 +1061,9 @@ class ServeController:
                             _as_future(r.get_queue_len.remote()), 2.0)
                     except Exception:  # noqa: BLE001
                         pass
-                desired = asc.decide(len(state.replicas), total)
+                signals = await self._collect_scale_signals(state)
+                desired, reason = asc.decide_signals(
+                    len(state.replicas), total, signals)
                 now = time.monotonic()
                 if desired != state.target:
                     delay = (asc.upscale_delay_s if desired > state.target
@@ -819,17 +1072,31 @@ class ServeController:
                         state._pending_target = desired
                         state._scale_pending_since = now
                     elif now - state._scale_pending_since >= delay:
-                        logger.info("autoscaling %s: %d -> %d",
-                                    state.full_name(), state.target, desired)
+                        logger.info("autoscaling %s: %d -> %d (%s)",
+                                    state.full_name(), state.target,
+                                    desired, reason)
+                        self._record_scale(state, state.target, desired,
+                                           reason, signals)
                         state.target = desired
                         state._pending_target = None
                 else:
                     state._pending_target = None
+                    # a heat-guard refusal is a scale decision too: log
+                    # it once per continuous guard episode, not per tick
+                    if reason == "heat_guard":
+                        if not state._guard_episode:
+                            state._guard_episode = True
+                            self._record_scale(state, state.target,
+                                               state.target, reason,
+                                               signals)
+                    else:
+                        state._guard_episode = False
 
-            # scale toward target; new replicas go through STARTING and are
-            # published to routers only once ready (readiness phase above)
-            changed_any = False
-            while len(state.replicas) + len(state.starting) < state.target:
+            # scale toward target; new replicas go through STARTING (and
+            # then WARMING) and are published to routers only once warm
+            counted = (len(state.replicas) + len(state.starting)
+                       + len(state.warming))
+            while counted < state.target:
                 replica = ServeReplica.options(
                     max_concurrency=max(100, state.config.max_ongoing_requests),
                     **state.config.ray_actor_options).remote(
@@ -837,20 +1104,38 @@ class ServeController:
                     state.init_kwargs, state.config.user_config,
                     state.config.max_ongoing_requests)
                 state.starting.append(replica)
-            while len(state.replicas) + len(state.starting) > state.target:
+                counted += 1
+            while counted > state.target:
+                counted -= 1
                 # prefer killing replicas that never took traffic
                 if state.starting:
                     victim = state.starting.pop()
+                elif state.warming:
+                    victim = state.warming.pop()
+                    t = state._warm_tasks.pop(
+                        self._replica_key(victim), None)
+                    if t is not None:
+                        t.cancel()
                 else:
-                    victim = state.replicas.pop()
-                    state.version += 1
-                    changed_any = True
+                    # graceful downscale (ISSUE 17): pick the coldest,
+                    # least-loaded replica and move it to DRAINING — the
+                    # retirement block above flips the routing table
+                    # first next tick, then prepare_for_shutdown lets
+                    # its in-flight streams finish (spilling KV for any
+                    # that must resume elsewhere) before the kill. No
+                    # request is dropped, no resumed stream diverges.
+                    victim = await self._pick_downscale_victim(state)
+                    state.replicas.remove(victim)
+                    state.draining.append(victim)
+                    logger.info(
+                        "%s: downscale — draining replica %s",
+                        state.full_name(),
+                        self._replica_key(victim)[:8])
+                    continue  # still routable; retired gracefully later
                 try:
                     ray_tpu.kill(victim)
                 except Exception:  # noqa: BLE001
                     pass
-            if changed_any:
-                self._notify_change()
 
         # prefix-affinity summaries ride the reconcile loop (rate-limited
         # inside): collection must see the post-churn replica sets so a

@@ -132,6 +132,18 @@ class LLMConfig:
     kv_tier_chunk_timeout_s: float = 2.0
     kv_tier_stream_window_bytes: int = 8 * 1024 * 1024
 
+    # Cache-warm scale-up (ISSUE 17): before a freshly started replica
+    # enters the routing table, it pre-populates its prefix cache from
+    # the CP `kv_tier:` index through the compressed ChainStream —
+    # hottest chains first under the byte/time budgets below — so the
+    # router's affinity scoring sees a warm holder from the replica's
+    # first request instead of a cold one cratering the fleet hit rate.
+    # No-op unless kv_tier_enabled (there is nothing to restore from).
+    warm_start_enabled: bool = True
+    warm_start_max_bytes: int = 64 * 1024 * 1024   # wire-byte budget
+    warm_start_budget_s: float = 5.0               # time budget
+    warm_start_max_chains: int = 64                # plan cap (hottest first)
+
     # Mid-stream generation failover (ISSUE 14): a replica dying
     # mid-decode no longer drops its streams — the proxy re-dispatches
     # each one with a continuation spec (original prompt + the tokens

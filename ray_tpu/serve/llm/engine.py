@@ -243,7 +243,8 @@ class LLMEngine:
                       "spec_accepted_tokens": 0,
                       "failover_resumed": 0, "failover_restored_tokens": 0,
                       "disagg_prefills": 0, "handoff_bytes_wire": 0,
-                      "handoff_overlap_ms": 0.0}
+                      "handoff_overlap_ms": 0.0,
+                      "warm_start_pages": 0, "warm_start_ms": 0.0}
         # Tiered KV cache (kv_tier.py): evicted cached page chains spill
         # host-side into a shm/disk tier + cluster index instead of dying,
         # and _admit extends its longest-match search past the local index
@@ -258,6 +259,10 @@ class LLMEngine:
         # parks one (done_event, result_box) here and the loop performs
         # the gather+flush — the device stream has exactly one driver
         self._spill_req: Optional[tuple] = None
+        # cache-warm scale-up handshake (ISSUE 17): warm_start() parks
+        # (done_event, result_box, max_bytes, budget_s) here — same
+        # one-driver discipline; the restore injections run on the loop
+        self._warm_req: Optional[tuple] = None
         if self._kv_tier_on:
             from ray_tpu.serve.llm import kv_tier as kvt
             self._kv_tier = kvt.KVTierStore(
@@ -985,6 +990,17 @@ class LLMEngine:
                     self._kv_tier_flush()
                 finally:
                     ev.set()
+            if self._warm_req is not None:
+                # cache-warm scale-up (ISSUE 17): restore the fleet's
+                # hottest tier chains into the local prefix cache on THIS
+                # thread — the replica is pre-routing-table, so the loop
+                # has no traffic to stall
+                ev, box, w_mb, w_bs = self._warm_req
+                self._warm_req = None
+                try:
+                    box.append(self._warm_start_now(w_mb, w_bs))
+                finally:
+                    ev.set()
             # chunk dispatches count as progress: an otherwise-idle engine
             # mid-chunked-prefill must not sleep between chunks. Restore
             # progress counts too; a stream WAITING on fetches does not —
@@ -1281,6 +1297,137 @@ class LLMEngine:
             return 0
         self._spill_capture(ents)
         return len(ents)
+
+    def warm_start(self, max_bytes: Optional[int] = None,
+                   budget_s: Optional[float] = None) -> dict:
+        """Pre-populate the prefix cache from the cluster tier BEFORE the
+        first request (ISSUE 17 cache-warm scale-up): enumerate the
+        fleet's restorable chains from the CP ``kv_tier:`` index
+        (hottest first), stream them through ChainStream, inject the
+        pages and register their digests — so the router's affinity
+        scoring sees this replica as a warm holder from its very first
+        summary. Bounded by a wire-byte budget AND a time budget; every
+        failure degrades to a smaller (or empty) warm set. Thread-safe
+        via the same loop handshake as spill_inflight. Returns
+        {"supported", "pages", "chains", "wire_bytes", "ms"}."""
+        out = {"supported": False, "pages": 0, "chains": 0,
+               "wire_bytes": 0, "ms": 0.0}
+        if not self._kv_tier_on or not self.cfg.warm_start_enabled:
+            return out
+        mb = int(max_bytes if max_bytes is not None
+                 else self.cfg.warm_start_max_bytes)
+        bs = float(budget_s if budget_s is not None
+                   else self.cfg.warm_start_budget_s)
+        loop = self._loop_thread
+        if loop is None or not loop.is_alive():
+            return dict(self._warm_start_now(mb, bs), supported=True)
+        ev = threading.Event()
+        box: list = []
+        self._warm_req = (ev, box, mb, bs)
+        self._wake.set()
+        ev.wait(bs + 10.0)
+        res = box[0] if box else {"pages": 0, "chains": 0,
+                                  "wire_bytes": 0, "ms": 0.0}
+        return dict(res, supported=True)
+
+    def _warm_start_now(self, max_bytes: int, budget_s: float) -> dict:
+        """Loop-thread warm-start worker. Plans from the CP index dump,
+        restores chain by chain (each through its own ChainStream, chunk
+        budgets and all), allocs pages, injects through the ONE fixed-
+        shape donated-pool scatter and registers the digests at refcount
+        zero (parked in the cached LRU: matchable, evictable, visible to
+        prefix_summary). Page budget is capped by pool headroom (one
+        request's worth of pages stays free) and the prefix-cache cap,
+        so warming can neither starve the first admission nor trigger
+        immediate evict-respill churn."""
+        t0 = time.perf_counter()
+        out = {"pages": 0, "chains": 0, "wire_bytes": 0, "ms": 0.0}
+        deadline = t0 + max(0.1, budget_s)
+        try:
+            chains = self._kv_tier.restorable_chains(
+                self.cfg.warm_start_max_chains)
+        except Exception:  # noqa: BLE001 — warm start is best-effort
+            logger.warning("warm start: chain enumeration failed",
+                           exc_info=True)
+            chains = []
+        jnp = self._jnp
+        mp = self.max_pages_per_seq
+        for chain in chains:
+            if time.perf_counter() >= deadline \
+                    or out["wire_bytes"] >= max_bytes:
+                break
+            digs = [d for d in chain["digests"] if d]
+            start = self.allocator.match_digest_chain(digs)
+            if start >= len(digs):
+                continue
+            cs = self.allocator.cache_stats()
+            budget_pages = self.allocator.available() - mp
+            cap = self.cfg.prefix_cache_max_pages
+            if cap > 0:
+                budget_pages = min(budget_pages,
+                                   cap - cs["evictable_pages"])
+            n_take = min(len(digs) - start, budget_pages)
+            if n_take <= 0:
+                break
+            stream = None
+            try:
+                stream = self._kv_tier.open_stream(
+                    digs, start,
+                    chunk_pages=self.cfg.kv_tier_chunk_pages,
+                    window_bytes=self.cfg.kv_tier_stream_window_bytes,
+                    timeout_s=self.cfg.kv_tier_chunk_timeout_s)
+                c = start
+                got = 0
+                while got < n_take:
+                    pairs, wire, _dec = stream.take(
+                        max_pages=min(mp, n_take - got))
+                    if not pairs:
+                        if stream.exhausted \
+                                or time.perf_counter() >= deadline:
+                            break
+                        time.sleep(0.002)
+                        continue
+                    pgs = self.allocator.alloc(len(pairs))
+                    if pgs is None:
+                        break
+                    k_np = np.concatenate([k for k, _ in pairs], axis=2)
+                    v_np = np.concatenate([v for _, v in pairs], axis=2)
+                    t = len(pairs)
+                    pad = np.zeros(k_np.shape[:2] + (mp - t,)
+                                   + k_np.shape[3:], k_np.dtype)
+                    with self._prof.compile_scope(
+                            "kv_tier_inject", ("kv_tier_inject", mp),
+                            mid_traffic=self.stats["requests"] > 0):
+                        self.kv = self._tier_inject(
+                            self.kv,
+                            jnp.asarray(np.concatenate([k_np, pad],
+                                                       axis=2)),
+                            jnp.asarray(np.concatenate([v_np, pad],
+                                                       axis=2)),
+                            jnp.asarray(list(pgs) + [0] * (mp - t),
+                                        jnp.int32))
+                    self.allocator.insert_digest_chain(
+                        digs[c:c + t], pgs, list(range(c, c + t)))
+                    # decref to zero: registered pages park in the LRU,
+                    # duplicate pages fall back to the free list
+                    self.allocator.free(pgs)
+                    c += t
+                    got += t
+                    out["pages"] += t
+                    out["wire_bytes"] += wire
+                if got:
+                    out["chains"] += 1
+            except Exception:  # noqa: BLE001 — degrade to a smaller set
+                logger.warning("warm start: chain restore failed; "
+                               "continuing", exc_info=True)
+            finally:
+                if stream is not None and not stream.exhausted:
+                    stream.abort()
+        out["ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        self.stats["warm_start_pages"] += out["pages"]
+        self.stats["warm_start_ms"] = round(
+            self.stats["warm_start_ms"] + out["ms"], 3)
+        return out
 
     def _chain_digests(self, toks, limit: int,
                        ingress: Optional[list]) -> list[str]:

@@ -286,6 +286,42 @@ class PageAllocator:
                 self._version += 1
         return added
 
+    def insert_digest_chain(self, digests_hex: list[str], pages: list[int],
+                            positions: list[int]) -> int:
+        """Register pages under pre-computed chain digests — the warm-start
+        twin of ``insert_prefix`` for restores that carry digests but no
+        token ids (the CP ``kv_tier:`` index stores digests only; the
+        tokens that produced them live on whatever replica spilled them).
+        A digest uniquely determines the full token prefix it closes
+        (``_chain_digest`` chains over every token), so a digest-keyed
+        node is exactly as trustworthy as a token-keyed one.
+
+        ``positions[i]`` is the page's chain position (tokens/page_size-1
+        from the tier entry) — needed so prefix_summary's low-position-
+        wins cut and the re-spill path see the right depth. First writer
+        wins, same as insert_prefix; pages the caller alloc'd stay at
+        refcount 1 and park in the cached LRU on the caller's free().
+        Returns how many new index nodes were added."""
+        added = 0
+        with self._lock:
+            for d_hex, page, pos in zip(digests_hex, pages, positions):
+                try:
+                    digest = bytes.fromhex(d_hex)
+                except (ValueError, TypeError):
+                    continue
+                if digest in self._index:
+                    continue
+                if page == 0 or page in self._page_key:
+                    continue
+                self._index[digest] = page
+                self._page_key[page] = digest
+                self._page_pos[page] = int(pos)
+                added += 1
+            self.counters["inserted"] += added
+            if added:
+                self._version += 1
+        return added
+
     def index_version(self) -> int:
         with self._lock:
             return self._version

@@ -697,6 +697,83 @@ class KVTierStore:
         return (len(entries), np.concatenate([k for k, _ in pairs], axis=2),
                 np.concatenate([v for _, v in pairs], axis=2))
 
+    # ---- warm-start planning (ISSUE 17) ----------------------------------
+    def restorable_chains(self, max_chains: int = 64) -> list[dict]:
+        """Digest chains restorable from OTHER stores, hottest first —
+        the cache-warm scale-up planning surface. One CP index dump;
+        entries are filtered to this store's namespace, shm tier (disk
+        entries are owner-local) and foreign stores, then reassembled
+        into chains: pages spilled together share a blob and sit at
+        consecutive offsets with token counts stepping by page_size, and
+        segments from the SAME owner whose token counts continue are
+        stitched across blobs. Only ROOTED chains (first page closes
+        tokens == page_size) are returned — a mid-chain page without its
+        ancestors can never be reached by match_prefix's leading walk.
+
+        Chain identity is self-certifying: a chain digest encodes the
+        entire token prefix it closes, so a mis-stitched tail is merely
+        a chain that diverges from what any future prompt matches — the
+        pages it restores are still registered under their true digests
+        and positions. Stitching affects efficiency, never correctness.
+
+        Returns [{"digests", "tokens", "ts", "nbytes"}], newest first,
+        at most ``max_chains``.
+        """
+        try:
+            resp = self._cp_call("kv_tier_index", {}, timeout=5.0)
+        except Exception:  # noqa: BLE001 — warm start is best-effort
+            return []
+        groups: dict[tuple, list[dict]] = {}
+        for e in (resp or {}).get("entries") or []:
+            if e.get("tier") != "shm" or e.get("store") == self.store_id \
+                    or e.get("ns", "") != self.namespace:
+                continue
+            groups.setdefault((e.get("owner"), e.get("blob")), []).append(e)
+        # per-(owner, blob) segments in off order = per-spill-batch runs
+        segs: list[list[dict]] = []
+        for es in groups.values():
+            es.sort(key=lambda e: int(e.get("off", 0)))
+            run: list[dict] = []
+            for e in es:
+                if run and int(e.get("tokens", 0)) != \
+                        int(run[-1].get("tokens", 0)) + self.page_size:
+                    segs.append(run)
+                    run = []
+                run.append(e)
+            if run:
+                segs.append(run)
+        # stitch: (owner, first-token-count) -> segments starting there;
+        # extend each rooted chain with the freshest continuation
+        by_start: dict[tuple, list[list[dict]]] = {}
+        for s in segs:
+            key = (s[0].get("owner"), int(s[0].get("tokens", 0)))
+            by_start.setdefault(key, []).append(s)
+        for lst in by_start.values():
+            lst.sort(key=lambda s: s[0].get("ts", 0), reverse=True)
+        chains: list[dict] = []
+        used: set[int] = set()
+        for s in segs:
+            if int(s[0].get("tokens", 0)) != self.page_size:
+                continue  # not rooted
+            chain = list(s)
+            used.add(id(s))
+            while True:
+                key = (chain[0].get("owner"),
+                       int(chain[-1].get("tokens", 0)) + self.page_size)
+                nxt = next((c for c in by_start.get(key, [])
+                            if id(c) not in used), None)
+                if nxt is None:
+                    break
+                used.add(id(nxt))
+                chain.extend(nxt)
+            chains.append({
+                "digests": [e.get("digest", "") for e in chain],
+                "tokens": [int(e.get("tokens", 0)) for e in chain],
+                "ts": max(float(e.get("ts", 0)) for e in chain),
+                "nbytes": sum(int(e.get("nbytes", 0)) for e in chain)})
+        chains.sort(key=lambda c: c["ts"], reverse=True)
+        return chains[:max_chains]
+
     # ---- streaming restore (see ChainStream) -----------------------------
     def open_stream(self, digests: list[str], start: int, *,
                     chunk_pages: int = 8,

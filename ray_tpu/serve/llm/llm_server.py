@@ -47,6 +47,8 @@ _EXPORTED_STATS = (
     # fleet disagg (ISSUE 16): remote-prefill handoffs restored here +
     # their encoded wire bytes and decode-overlapped restore milliseconds
     "disagg_prefills", "handoff_bytes_wire", "handoff_overlap_ms",
+    # elastic fleet (ISSUE 17): cache-warm scale-up restore economy
+    "warm_start_pages", "warm_start_ms",
     # introspection scalars (ISSUE 6): compile tracker + memory gauges;
     # None-valued entries (no samples yet / cpu backend) are skipped
     "compile_events", "mid_traffic_compiles", "compile_s",
@@ -359,6 +361,18 @@ class LLMServer:
         stats = self.engine.engine_stats()
         _export_engine_stats(self.cfg.model_id, stats)
         return stats
+
+    def warm_start(self, max_bytes: Optional[int] = None,
+                   budget_s: Optional[float] = None) -> dict:
+        """Cache-warm scale-up hook (ISSUE 17): the controller calls this
+        through `handle_request` after readiness but BEFORE publishing
+        the replica into the routing table. Restores the fleet's hottest
+        tier chains into the local prefix cache under the configured
+        byte/time budgets; {"supported": False, "pages": 0} when the KV
+        tier or warm start is off (the controller then publishes
+        immediately — same unsupported idiom as prefix_summary)."""
+        return self.engine.warm_start(max_bytes=max_bytes,
+                                      budget_s=budget_s)
 
     def eager_spill(self) -> dict:
         """Drain/SIGTERM hook (ISSUE 14): spill every in-flight chain's

@@ -41,6 +41,9 @@ from ray_tpu.util import metrics as _metrics
 
 _SSE_DONE = object()  # sentinel: streaming generator exhausted
 
+# serializes Router creation when proxies share a router map (ISSUE 17)
+_router_create_lock = threading.Lock()
+
 # Built-in proxy metrics (ISSUE 4). Route is tagged with the MATCHED prefix
 # (not the raw path) so series cardinality stays bounded by the route table.
 _REQ_LATENCY = _metrics.Histogram(
@@ -62,12 +65,23 @@ def _is_deadline_error(e: BaseException) -> bool:
 class HTTPProxy:
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000,
                  max_inflight: Optional[int] = None,
-                 router_config: Optional[RouterConfig] = None):
+                 router_config: Optional[RouterConfig] = None,
+                 name: str = "",
+                 shared_routers: Optional[dict] = None):
         self._controller = controller
         self.host = host
         self.port = port
+        self.name = name or f"proxy:{port}"
         self._router_config = router_config
-        self._routers: dict[str, Router] = {}
+        # Multi-proxy ingress (ISSUE 17): N proxies in one process may
+        # share a router map — ONE controller long-poll per app for the
+        # whole ingress tier instead of one per proxy, so adding ingress
+        # capacity doesn't multiply control-plane poll load. Creation
+        # races on the shared map are serialized by the module lock at
+        # the single creation site in _handle.
+        self._routers: dict[str, Router] = (
+            shared_routers if shared_routers is not None else {})
+        self._routers_shared = shared_routers is not None
         self._http_dispatch: dict[tuple, bool] = {}
         self._req_timeout: dict[tuple, Optional[float]] = {}
         self._slo_policies: dict[tuple, Optional[dict]] = {}
@@ -397,6 +411,10 @@ class HTTPProxy:
             return web.Response(text="ok")
         if path == "/-/stats":
             out = dict(self.stats, inflight=self._inflight)
+            # per-proxy identity (ISSUE 17 multi-proxy): which ingress
+            # answered, and whether its routers are fleet-shared
+            out["proxy"] = {"name": self.name, "port": self.port,
+                            "shared_routers": self._routers_shared}
             out["routers"] = {app: r.stats_snapshot()
                               for app, r in self._routers.items()}
             # degraded = proxy serving stale routes OR any router serving
@@ -427,9 +445,15 @@ class HTTPProxy:
 
         router = self._routers.get(app_name)
         if router is None:
-            router = Router(self._controller, app_name,
-                            config=self._router_config)
-            self._routers[app_name] = router
+            # double-checked under the module lock: with a shared router
+            # map two proxies' event loops can race here, and the loser
+            # would leak a long-poll thread
+            with _router_create_lock:
+                router = self._routers.get(app_name)
+                if router is None:
+                    router = Router(self._controller, app_name,
+                                    config=self._router_config)
+                    self._routers[app_name] = router
 
         loop = asyncio.get_event_loop()
         dl, slo_policy = await loop.run_in_executor(

@@ -490,6 +490,17 @@ class Router:
                 cur = self._sets.setdefault(dep,
                                             ReplicaSet(self.config, dep))
                 cur.degraded = self._degraded
+                # version monotonicity (ISSUE 17): a stale table delivered
+                # late — a cold-start get_routing_table racing the
+                # long-poll — must not regress the replica set. Applying
+                # it could resurrect a replica the controller already
+                # flipped out for retirement, or show a pre-publish view
+                # missing a freshly warmed one. Version 0 passes: a fresh
+                # controller's rebuilt deployment starts there, and the
+                # router's set for a deleted deployment is dropped below
+                # before any rebuild is seen.
+                if 0 < version < cur.version:
+                    continue
                 if version != cur.version:
                     cur.update(replicas, version)
                 if summary is not None:

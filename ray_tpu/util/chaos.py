@@ -183,11 +183,17 @@ class FaultSchedule:
                    random one; {"prepare": True} first runs a short
                    prepare_for_shutdown (SIGTERM-with-grace: the replica
                    eager-spills in-flight KV chains) before the hard kill
+      replica_scale retarget a serve deployment mid-traffic (ISSUE 17):
+                   {"app", "deployment"} plus {"target": N} or
+                   {"delta": +/-n}. Scale-up goes through the controller's
+                   cache-warm path (STARTING -> WARMING -> atomic
+                   publish); scale-down drains the coldest replica —
+                   in-flight streams finish or resume token-identically
 
     Every event appends {"t", "kind", "ok", "detail"} to `report`."""
 
     KINDS = ("worker_kill", "node_kill", "node_drain", "cp_restart",
-             "rpc_delay", "rpc_drop", "replica_kill")
+             "rpc_delay", "rpc_drop", "replica_kill", "replica_scale")
 
     def __init__(self, cluster, events, *, seed: int = 0):
         for _, kind, _kw in events:
@@ -320,6 +326,24 @@ class FaultSchedule:
         aid = getattr(victim, "_actor_id", None)
         aid = aid.hex()[:8] if hasattr(aid, "hex") else "?"
         return f"killed replica {app}#{dep}[{aid}]{prepared}"
+
+    def _do_replica_scale(self, kw) -> str:
+        import ray_tpu
+        ctl = ray_tpu.get_actor("_serve_controller", timeout=2.0)
+        app, dep = kw.get("app"), kw.get("deployment")
+        if app is None:
+            status = ray_tpu.get(ctl.status.remote(), timeout=5.0)
+            for full in status:          # full names are "app#deployment"
+                a, d = full.split("#", 1)
+                if dep is None or d == dep:
+                    app, dep = a, d
+                    break
+        if app is None:
+            return "no serve deployments to target"
+        res = ray_tpu.get(ctl.set_target_replicas.remote(
+            app, deployment=dep, target=kw.get("target"),
+            delta=kw.get("delta"), reason="chaos"), timeout=10.0)
+        return f"retargeted {res}"
 
     # ---- driver --------------------------------------------------------
     def _loop(self):

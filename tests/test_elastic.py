@@ -1,0 +1,471 @@
+"""Elastic cache-warm serve fleet (ISSUE 17).
+
+Pins the PR's acceptance invariants:
+
+- signal-driven scaling: the queue-length policy folds in SLO attribution
+  (violations + dominant p99-TTFT stage) and affinity heat — SLO-dominant
+  queue/prefill windows upscale, a hot fleet refuses the downscale step,
+  and every decision lands in the controller's flight recorder;
+- cache-warm scale-up: `insert_digest_chain` registers restored pages
+  under pre-computed chain digests, `warm_start()` pulls the fleet's
+  hottest tier chains into a fresh engine BEFORE it takes traffic, and
+  the warmed engine's greedy output is token-identical to cold prefill;
+- warming gate atomicity: a scale-up replica is invisible to routers
+  until its warm completes, and the table mutation + version bump are
+  one atomic step — a polled table's version uniquely determines its
+  replica set (no half-published view), and a stale lower-version table
+  can never regress a router's cached set;
+- graceful downscale: retiring a BUSY replica drains it kill-free — all
+  in-flight SSE streams complete with every token exactly once, zero
+  resumes, zero dropped frames;
+- `replica_scale` chaos events retarget a deployment mid-traffic.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+import ray_tpu
+
+
+def _cfg(**kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+
+    d = dict(model_config=llama.llama_tiny(vocab_size=512),
+             max_batch_size=4, page_size=16, num_pages=64,
+             max_prompt_len=96, max_seq_len=160, max_tokens=8)
+    d.update(kw)
+    return LLMConfig(**d)
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog"
+LONG = PROMPT + " " + PROMPT                             # 87 -> 5 full pages
+
+_WANT: dict = {}
+
+
+def _want_tokens(prompt, max_tokens=8):
+    from ray_tpu.serve.llm import LLMEngine
+
+    key = (prompt, max_tokens)
+    if key not in _WANT:
+        off = LLMEngine(_cfg(prefix_cache_enabled=False), rng_seed=0)
+        off.start()
+        try:
+            _WANT[key] = off.generate(prompt, max_tokens=max_tokens,
+                                      temperature=0.0)["tokens"]
+        finally:
+            off.shutdown()
+    return _WANT[key]
+
+
+def _wait(pred, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# unit: signal-driven scaling policy
+# ---------------------------------------------------------------------------
+
+
+def test_decide_signals_queue_fallback():
+    """No signals = the original queue-length policy, reason-tagged."""
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    asc = AutoscalingConfig(min_replicas=1, max_replicas=4,
+                            target_ongoing_requests=2.0)
+    assert asc.decide_signals(2, 8.0, {}) == (4, "queue_len")
+    assert asc.decide_signals(2, 4.0, None) == (2, "steady")
+    assert asc.decide_signals(3, 0.0, {}) == (1, "queue_idle")
+
+
+def test_decide_signals_slo_upscale():
+    """An SLO-violating window dominated by a scalable stage upscales one
+    step even when raw queue depth sits under target; decode dominance
+    (more replicas would not help) does not."""
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    asc = AutoscalingConfig(min_replicas=1, max_replicas=4)
+    sig = {"slo_violations": 3, "dominant_stage": "queue"}
+    assert asc.decide_signals(2, 2.0, sig) == (3, "slo_queue")
+    sig["dominant_stage"] = "prefill"
+    assert asc.decide_signals(2, 2.0, sig) == (3, "slo_prefill")
+    # capacity won't fix a decode-dominant tail
+    sig["dominant_stage"] = "decode"
+    assert asc.decide_signals(2, 2.0, sig)[1] != "slo_decode"
+    # never past max_replicas (queue load steady at max, SLO pressing)
+    sig["dominant_stage"] = "queue"
+    assert asc.decide_signals(4, 8.0, sig) == (4, "steady")
+    # zero violations = no SLO pressure (4.0 ongoing = steady at 2)
+    assert asc.decide_signals(
+        2, 4.0, {"slo_violations": 0, "dominant_stage": "queue"}) == \
+        (2, "steady")
+    off = AutoscalingConfig(slo_upscale_enabled=False)
+    assert asc.decide_signals(2, 4.0, sig)[0] == 3
+    assert off.decide_signals(2, 4.0, sig) == (2, "steady")
+
+
+def test_decide_signals_heat_guard_blocks_downscale():
+    """A broadly warm fleet refuses the queue-idle downscale; a cold one
+    takes it. Guard disabled at 0."""
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    asc = AutoscalingConfig(min_replicas=1, max_replicas=4,
+                            heat_downscale_guard=0.5)
+    warm = {"affinity_hit_share": 0.75}
+    cold = {"affinity_hit_share": 0.25}
+    assert asc.decide_signals(3, 0.0, warm) == (3, "heat_guard")
+    assert asc.decide_signals(3, 0.0, cold) == (1, "queue_idle")
+    off = AutoscalingConfig(min_replicas=1, max_replicas=4,
+                            heat_downscale_guard=0.0)
+    assert off.decide_signals(3, 0.0, warm) == (1, "queue_idle")
+
+
+# ---------------------------------------------------------------------------
+# unit: digest-chain registration (the warm-start allocator primitive)
+# ---------------------------------------------------------------------------
+
+
+def test_insert_digest_chain_registers_matchable_pages():
+    from ray_tpu.serve.llm.kv_cache import PageAllocator
+
+    alloc = PageAllocator(num_pages=16)
+    pages = alloc.alloc(3)
+    digs = ["aa" * 16, "bb" * 16, "cc" * 16]
+    assert alloc.insert_digest_chain(digs, pages, [0, 1, 2]) == 3
+    # registered under refcount 1; caller's free parks them cached
+    alloc.free(pages)
+    assert alloc.match_digest_chain(digs) == 3
+    assert alloc.match_digest_chain(digs[:2] + ["dd" * 16]) == 2
+    # duplicates and junk are skipped, not an error
+    more = alloc.alloc(2)
+    assert alloc.insert_digest_chain(
+        ["aa" * 16, "not-hex"], more, [0, 1]) == 0
+    alloc.free(more)
+    # page 0 (trash page) can never be indexed
+    assert alloc.insert_digest_chain(["ee" * 16], [0], [0]) == 0
+    # the warm pages are evictable like any cached prefix
+    assert alloc.cache_stats()["evictable_pages"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# unit: router never regresses on a stale table
+# ---------------------------------------------------------------------------
+
+
+class _DeadController:
+    """Controller stub whose RPCs always fail: the router's long-poll
+    degrades and the test drives _apply_table directly."""
+
+    class _M:
+        def remote(self, *a, **k):
+            raise RuntimeError("controller away")
+
+    poll_routing_table = _M()
+    get_routing_table = _M()
+
+
+class _FakeReplica:
+    def __init__(self, name):
+        self._actor_id = name.encode()
+
+
+def test_apply_table_ignores_stale_lower_version():
+    """A late-delivered stale table (cold-start fetch racing the
+    long-poll) must not resurrect a retired replica or hide a freshly
+    published one."""
+    from ray_tpu.serve.router import Router
+
+    r = Router(_DeadController(), "app")
+    try:
+        r1, r2, r3 = (_FakeReplica("r1"), _FakeReplica("r2"),
+                      _FakeReplica("r3"))
+        r._apply_table({"d": ([r1, r2], 5, None)})
+        assert {x._actor_id for x in r._sets["d"].replicas} == \
+            {b"r1", b"r2"}
+        # stale view from before r2 was published and r3 retired
+        r._apply_table({"d": ([r1, r3], 4, None)})
+        assert {x._actor_id for x in r._sets["d"].replicas} == \
+            {b"r1", b"r2"}, "stale table regressed the replica set"
+        assert r._sets["d"].version == 5
+        # a genuinely newer table still applies
+        r._apply_table({"d": ([r2], 6, None)})
+        assert [x._actor_id for x in r._sets["d"].replicas] == [b"r2"]
+        # a fresh controller's version-0 rebuild is allowed through
+        r._apply_table({"d": ([r1], 0, None)})
+        assert [x._actor_id for x in r._sets["d"].replicas] == [b"r1"]
+    finally:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine: cache-warm scale-up restores the fleet's chains before traffic
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def elastic_cluster(ray_start_module):
+    yield ray_start_module
+
+
+def test_warm_start_restores_tier_chains_token_identical(elastic_cluster):
+    """Engine A spills a live chain into the tier; a FRESH engine B warm
+    starts from the CP index and its first request is a prefix hit whose
+    greedy output is token-identical to cold prefill."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    want = _want_tokens(LONG, 8)
+    cfg = _cfg(kv_tier_enabled=True)
+    a = LLMEngine(cfg, rng_seed=0)
+    a.start()
+    b = None
+    try:
+        rid = a.submit(LONG, max_tokens=64, temperature=0.0)
+        assert _wait(lambda: len(
+            (a.request_progress(rid) or {}).get("generated") or ()) >= 2,
+            timeout=120.0)
+        assert a.spill_inflight() >= 5
+        assert _wait(lambda: a.engine_stats()["spilled_pages"] >= 5)
+
+        b = LLMEngine(cfg, rng_seed=0)
+        b.start()
+        res = b.warm_start()
+        assert res["supported"] is True, res
+        assert res["pages"] >= 5, res
+        assert res["chains"] >= 1
+        assert res["wire_bytes"] > 0
+        st = b.engine_stats()
+        assert st["warm_start_pages"] >= 5
+        assert st["warm_start_ms"] > 0.0
+        # the warm pages are a real prefix match for the first request,
+        # and the decode over them is bit-identical to cold prefill
+        out = b.generate(LONG, max_tokens=8, temperature=0.0)
+        assert out["tokens"] == want, "warm-started decode diverged"
+        st2 = b.engine_stats()
+        assert st2["prefix_hit_tokens"] >= 5 * 16
+        # idempotent-ish: a second warm start finds everything resident
+        res2 = b.warm_start()
+        assert res2["supported"] is True
+        assert res2["pages"] == 0, "re-warm re-fetched resident chains"
+        a.result(rid, timeout=180.0)
+    finally:
+        a.shutdown()
+        if b is not None:
+            b.shutdown()
+
+
+def test_warm_start_off_paths():
+    """Tier off or warm disabled = unsupported no-op (the controller
+    then publishes the replica immediately)."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(_cfg(), rng_seed=0)  # tier off
+    try:
+        assert eng.warm_start()["supported"] is False
+    finally:
+        eng.shutdown()
+    eng = LLMEngine(_cfg(kv_tier_enabled=True, warm_start_enabled=False),
+                    rng_seed=0)
+    try:
+        assert eng.warm_start()["supported"] is False
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# controller: warming gate + atomic publish
+# ---------------------------------------------------------------------------
+
+
+def test_warming_replica_invisible_until_atomic_publish(elastic_cluster):
+    """A scale-up replica whose warm_start is slow stays OUT of the
+    routing table (status shows it WARMING); when the warm lands, the
+    replica and the version bump appear together — across every polled
+    view, the version uniquely determines the replica set."""
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import get_or_create_controller
+
+    serve.shutdown()
+
+    @serve.deployment(num_replicas=1, health_check_period_s=0.2)
+    class SlowWarm:
+        def __call__(self, x):
+            return x
+
+        def warm_start(self):
+            time.sleep(2.0)
+            return {"supported": True, "pages": 7, "chains": 1,
+                    "wire_bytes": 512, "ms": 2000.0}
+
+    serve.run(SlowWarm.bind(), name="el-warm", route_prefix="/el-warm")
+    ctl = get_or_create_controller()
+    full = "el-warm#SlowWarm"
+    try:
+        table0 = ray_tpu.get(ctl.get_routing_table.remote("el-warm"),
+                             timeout=10.0)
+        v0 = table0["SlowWarm"][1]
+        n0 = len(table0["SlowWarm"][0])
+        assert n0 == 1
+
+        ray_tpu.get(ctl.set_target_replicas.remote(
+            "el-warm", target=2, reason="test"), timeout=10.0)
+
+        # poll continuously through the scale-up: the invariant is that a
+        # version-v0 table NEVER contains 2 replicas, and any 2-replica
+        # table carries a newer version (atomic publish)
+        seen_warming = False
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(ctl.status.remote(), timeout=10.0)[full]
+            table = ray_tpu.get(ctl.get_routing_table.remote("el-warm"),
+                                timeout=10.0)["SlowWarm"]
+            if len(table[0]) >= 2:
+                assert table[1] > v0, \
+                    "2-replica table shipped under the old version"
+                break
+            assert table[1] == v0 and len(table[0]) == n0, \
+                f"table changed without the new replica: {table[1]}"
+            if st["warming"]:
+                seen_warming = True
+                assert len(table[0]) == 1, \
+                    "warming replica leaked into the routing table"
+            time.sleep(0.05)
+        else:
+            pytest.fail("scale-up never published the warmed replica")
+        assert seen_warming, "replica never passed through WARMING"
+
+        # the warm economy landed in the controller's books
+        det = ray_tpu.get(ctl.detailed_status.remote(), timeout=30.0)[full]
+        assert det["warm"]["replicas_warmed"] >= 1
+        assert det["warm"]["pages"] >= 7
+        assert det["scale_counters"].get("test") == 1
+        assert any(d["reason"] == "test" and d["to"] == 2
+                   for d in det["scale_decisions"])
+    finally:
+        serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# controller: kill-free downscale of a BUSY replica
+# ---------------------------------------------------------------------------
+
+
+def _read_sse(base, path, payload, rid, events, done):
+    try:
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": rid})
+        with urllib.request.urlopen(req, timeout=120.0) as r:
+            hdr = dict(r.headers)
+            for raw in r:
+                line = raw.decode().strip()
+                if line.startswith("event: "):
+                    events.append(("event", line[len("event: "):]))
+                elif line.startswith("data: "):
+                    body = line[len("data: "):]
+                    if body == "[DONE]":
+                        break
+                    events.append(("data", json.loads(body)))
+        done.append(hdr)
+    except Exception as e:  # noqa: BLE001 — the test asserts on this
+        done.append(e)
+
+
+@pytest.mark.slow
+def test_downscale_busy_replica_completes_streams(elastic_cluster):
+    """Drain-based downscale with in-flight streams on BOTH replicas:
+    the retired replica finishes its streams before the kill — every
+    token exactly once, zero resumes, zero dropped SSE frames — and the
+    fleet lands on the new target."""
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import get_or_create_controller
+    from ray_tpu.util.chaos import FaultSchedule
+
+    serve.shutdown()
+    n_tokens = 16
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.2,
+                      graceful_shutdown_timeout_s=30.0)
+    class Streamer:
+        def __init__(self):
+            self._uid = uuid.uuid4().hex[:8]
+
+        def handle_http(self, path, method, payload):
+            if isinstance(payload, dict) and payload.get("stream"):
+                return self._gen(payload)
+            return {"uid": self._uid}
+
+        async def _gen(self, payload):
+            import asyncio
+            for i in range(int(payload.get("max_tokens") or n_tokens)):
+                yield {"choices": [{"text": f"t{i};", "index": 0,
+                                    "finish_reason": None}],
+                       "rep": self._uid}
+                await asyncio.sleep(0.15)
+            yield {"choices": [{"text": "", "index": 0,
+                                "finish_reason": "stop"}]}
+
+    serve.run(Streamer.bind(), name="el-down", route_prefix="/el")
+    proxy = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{proxy.port}"
+    ctl = get_or_create_controller()
+    full = "el-down#Streamer"
+    streams = []
+    try:
+        # saturate both replicas (pow-2 splits two concurrent streams)
+        for i in range(4):
+            events, done = [], []
+            t = threading.Thread(
+                target=_read_sse,
+                args=(base, "/el/stream",
+                      {"stream": True, "max_tokens": n_tokens},
+                      f"eldown{i:04d}", events, done), daemon=True)
+            t.start()
+            streams.append((t, events, done))
+        assert _wait(lambda: all(
+            sum(1 for k, v in list(ev) if k == "data") >= 2
+            for _, ev, _d in streams), timeout=60.0)
+
+        # mid-stream downscale through the chaos event (satellite 2)
+        sched = FaultSchedule(None, [
+            (0.0, "replica_scale", {"app": "el-down",
+                                    "deployment": "Streamer",
+                                    "target": 1})])
+        sched.start()
+        report = sched.join(timeout=30.0)
+        assert report and report[0]["ok"], report
+
+        for t, _ev, _d in streams:
+            t.join(timeout=120.0)
+            assert not t.is_alive(), "stream never finished under drain"
+        for _t, events, done in streams:
+            assert done and not isinstance(done[0], Exception), \
+                f"stream failed during downscale: {done}"
+            texts = [c["choices"][0]["text"] for k, c in events
+                     if k == "data" and c.get("choices")]
+            assert "".join(texts) == \
+                "".join(f"t{i};" for i in range(n_tokens)), \
+                f"downscale dropped/duplicated frames: {texts}"
+        # kill-free: the victim drained, nothing needed to resume
+        assert proxy.stats.get("stream_resumes", 0) == 0
+
+        assert _wait(lambda: ray_tpu.get(
+            ctl.status.remote(), timeout=10.0)[full]["replicas"] == 1,
+            timeout=60.0)
+        st = ray_tpu.get(ctl.status.remote(), timeout=10.0)[full]
+        assert st["target"] == 1 and st["draining"] == 0
+        det = ray_tpu.get(ctl.detailed_status.remote(), timeout=30.0)[full]
+        assert det["scale_counters"].get("chaos") == 1
+    finally:
+        serve.shutdown()
