@@ -2021,12 +2021,17 @@ def main():
             preflight_tests.append("tests/test_attribution.py")
         if args.spec_ab:
             preflight_tests.append("tests/test_spec_decode.py")
+            # interpret-mode pallas identity + kernel equivalence: the
+            # CPU-side coverage behind the on-device backend legs
+            preflight_tests.append("tests/test_paged_kernels.py")
         if args.kv_tier_ab:
             # no -m filter here, so this includes the slow two-replica
             # cross-restore stress test — exactly the coverage a kv-tier
             # perf number needs behind it
             preflight_tests.append("tests/test_kv_tier.py")
             preflight_tests.append("tests/test_kv_codec.py")
+            if "tests/test_paged_kernels.py" not in preflight_tests:
+                preflight_tests.append("tests/test_paged_kernels.py")
         rc = subprocess.run(
             [sys.executable, "-m", "pytest", "-q", *preflight_tests],
             cwd=repo, env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
@@ -2421,11 +2426,13 @@ def main():
         def _spec_prompt(i: int) -> str:
             return "the cat sat on the mat. " * 6 + f"Q{i}: "
 
-        def spec_arm(enabled: bool) -> dict:
+        def spec_arm(enabled: bool, attn: str = "auto") -> dict:
             serve.shutdown()
-            tag = "on" if enabled else "off"
+            tag = ("on" if enabled else "off") + \
+                ("" if attn == "auto" else f"-{attn}")
             arm_app = build_openai_app(
-                _dc.replace(spec_cfg, spec_decode_enabled=enabled),
+                _dc.replace(spec_cfg, spec_decode_enabled=enabled,
+                            attention_kernel=attn),
                 route_prefix="/v1")
             serve.run(arm_app, name=f"llm-bench-spec-{tag}",
                       route_prefix="/v1")
@@ -2492,6 +2499,35 @@ def main():
                              / off_row["gen_tokens_per_s"], 2)
             if off_row["gen_tokens_per_s"] else None,
         }
+        # fused-kernel identity leg (ISSUE 18): on a TPU whose shapes the
+        # kernel tiling accepts, re-run the spec-on arm under BOTH
+        # attention backends and hard-assert greedy identity — decode AND
+        # multi-query verify both go through the pallas kernels here.
+        # Elsewhere the interpret-mode equivalent already ran in the
+        # tests/test_paged_kernels.py preflight, so the slow duplicate is
+        # skipped and recorded as such.
+        from ray_tpu.serve.llm import kv_cache as _kvc
+        if has_tpu and not args.tiny and _kvc.resolve_attention_backend(
+                "auto", spec_cfg.llama(), spec_cfg.page_size) == "pallas":
+            g_row = spec_arm(True, attn="gather")
+            p_row = spec_arm(True, attn="pallas")
+            kernels_identical = \
+                g_row["completions"] == p_row["completions"]
+            spec_decode["attention_kernel_leg"] = {
+                "greedy_identical": kernels_identical,
+                "gen_tokens_per_s_gather": g_row["gen_tokens_per_s"],
+                "gen_tokens_per_s_pallas": p_row["gen_tokens_per_s"],
+            }
+            if not kernels_identical:
+                print(json.dumps({"spec_decode": spec_decode}))
+                raise SystemExit(
+                    "pallas attention backend changed greedy output vs "
+                    "gather under speculative decoding — kernel identity "
+                    "contract broken, not benchmarking it")
+        else:
+            spec_decode["attention_kernel_leg"] = {
+                "skipped": "no TPU-tileable shapes here; interpret-mode "
+                           "identity covered by tests/test_paged_kernels.py"}
         for row in (off_row, on_row):
             row.pop("completions")
             points.append(row)
@@ -2545,11 +2581,12 @@ def main():
                              if s["stage"] == "restore"]
             return ttfts, comps, restores
 
-        def kvt_pair(codec: str) -> dict:
+        def kvt_pair(codec: str, attn: str = "auto") -> dict:
             """One seeding replica A + one cold restoring replica B under
             ``codec``; A stays alive while B restores (its shutdown
             retracts the index entries and drops the blobs B streams)."""
-            cfg = _dc.replace(kvt_cfg, kv_tier_codec=codec)
+            cfg = _dc.replace(kvt_cfg, kv_tier_codec=codec,
+                              attention_kernel=attn)
             a_eng = LLMEngine(cfg, rng_seed=0)
             a_eng.start()
             b_eng = None
@@ -2623,6 +2660,30 @@ def main():
                             if got != w)
         p50_cold = statistics.median(cold_ttfts) * 1e3
         p50_warm = lossless["p50_ttft_warm_b_ms"]
+        # fused-kernel identity leg (ISSUE 18): a cold replica restoring
+        # spilled pages and decoding through the pallas kernels must
+        # reproduce the gather tokens exactly. Only meaningful where the
+        # TPU kernel tiling accepts this arm's model; elsewhere the
+        # interpret-mode equivalent ran in the tests/test_paged_kernels.py
+        # preflight.
+        from ray_tpu.serve.llm import kv_cache as _kvc
+        if has_tpu and not args.tiny and _kvc.resolve_attention_backend(
+                "auto", kvt_cfg.llama(), kvt_cfg.page_size) == "pallas":
+            pal = kvt_pair("lossless", attn="pallas")
+            pallas_leg = {
+                "greedy_identical": want == pal["b_completions"],
+                "p50_ttft_warm_b_ms": pal["p50_ttft_warm_b_ms"],
+                "restored_pages_b": pal["restored_pages_b"],
+            }
+            if not pallas_leg["greedy_identical"]:
+                raise SystemExit(
+                    "pallas attention backend changed greedy output vs "
+                    "the cold gather control after a tier restore — "
+                    "kernel identity contract broken, not benchmarking it")
+        else:
+            pallas_leg = {
+                "skipped": "no TPU-tileable shapes here; interpret-mode "
+                           "identity covered by tests/test_paged_kernels.py"}
         for arm in arms.values():
             arm.pop("a_completions")
             arm.pop("b_completions")
@@ -2641,6 +2702,7 @@ def main():
             "ttft_vs_raw": round(
                 p50_warm / raw["p50_ttft_warm_b_ms"], 3)
             if raw["p50_ttft_warm_b_ms"] else None,
+            "attention_kernel_leg": pallas_leg,
             "codec_arms": arms,
         }
         if not (identical and raw_identical):

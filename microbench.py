@@ -6,7 +6,15 @@ BASELINE.md "Microbenchmarks") so the runtime's task/actor/object planes are
 measured, not guessed. Writes MICROBENCH.json and prints a table with the
 reference numbers alongside.
 
-Usage: python microbench.py [--quick] [--out MICROBENCH.json]
+Also hosts the serving-kernel arm (`--paged-kernels`): paged-attention
+decode/verify/chunked-prefill latency gather vs pallas (interpret mode
+off-TPU — a correctness-path timing record there, the perf claim is
+TPU-only) and KV codec MB/s per-page vs batched (`kv_codec.encode_pages`
+/ `decode_pages`). Every run MERGES its rows into the --out file by
+metric name, so arms recorded at different times coexist.
+
+Usage: python microbench.py [--quick] [--paged-kernels]
+       [--out MICROBENCH.json]
 """
 
 from __future__ import annotations
@@ -265,13 +273,137 @@ def run(quick: bool = False) -> dict:
     return results
 
 
+def run_paged_kernels(quick: bool = False) -> dict:
+    """Serving-kernel arm: paged-attention backends + KV codec batching.
+
+    Attention rows time the jitted op both ways on this host's backend
+    (pallas = interpret mode off-TPU, so treat CPU ratios as a record of
+    the correctness path, not the perf claim). Codec rows time the exact
+    spill/restore hot loops: per-page encode_page/decode_page vs the
+    batched encode_pages/decode_pages the tier now calls."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops import paged_attention as paged_ops
+    from ray_tpu.serve.llm import kv_cache, kv_codec
+
+    results: dict[str, float] = {}
+    iters = 3 if quick else 10
+
+    def best_ms(fn):
+        fn()                                  # compile/warm
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            times.append((time.perf_counter() - t0) * 1e3)
+        return min(times)
+
+    # shapes: small enough for CPU interpret, real paged geometry
+    hkv, n_rep, d, page, mp, b = 4, 2, 64, 16, 8, 8
+    h = hkv * n_rep
+    key = jax.random.PRNGKey(0)
+    k_pages = jax.random.normal(key, (hkv, mp * b + 1, page, d),
+                                jnp.float32)
+    v_pages = jax.random.normal(key, (hkv, mp * b + 1, page, d),
+                                jnp.float32)
+    page_tables = jnp.arange(1, mp * b + 1).reshape(b, mp).astype(jnp.int32)
+    pos = jnp.full((b,), mp * page - 1, jnp.int32)
+    sm = d ** -0.5
+
+    def gather_ref(q, base, limit):
+        b_, t_ = q.shape[:2]
+        max_len = mp * page
+        k_seq = jnp.moveaxis(jnp.take(k_pages, page_tables[:b_], axis=1),
+                             0, 3).reshape(b_, max_len, hkv, d)
+        v_seq = jnp.moveaxis(jnp.take(v_pages, page_tables[:b_], axis=1),
+                             0, 3).reshape(b_, max_len, hkv, d)
+        k_full = kv_cache._gqa_expand(k_seq, n_rep)
+        v_full = kv_cache._gqa_expand(v_seq, n_rep)
+        col = jnp.arange(max_len)
+        p_ = base[:, None] + jnp.arange(t_)[None, :]
+        valid = (col[None, None, :] <= p_[:, :, None]) \
+            & (col[None, None, :] < limit[:, None, None])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
+            jnp.float32) * sm
+        s = jnp.where(valid[:, None], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v_full)
+
+    full = jnp.full((b,), mp * page, jnp.int32)
+    for name, t_span, b_eff in (("decode", 1, b), ("verify", 4, b),
+                                ("chunk", 32, 1)):
+        q = jax.random.normal(jax.random.PRNGKey(1),
+                              (b_eff, t_span, h, d), jnp.float32)
+        base = (pos[:b_eff] - t_span + 1).astype(jnp.int32)
+        g = jax.jit(lambda q, base: gather_ref(q, base, full[:b_eff]))
+        p = jax.jit(lambda q, base: paged_ops.paged_attention(
+            q, k_pages, v_pages, page_tables[:b_eff], base, sm_scale=sm))
+        results[f"paged_{name}_gather_ms"] = best_ms(lambda: g(q, base))
+        results[f"paged_{name}_pallas_ms"] = best_ms(lambda: p(q, base))
+
+    # ---- codec: per-page loop vs batch entry points ---------------------
+    # small-page geometry (the engine's paged layout at test scale; also
+    # the regime where per-page python + numpy call overhead is visible —
+    # on multi-MB pages zlib dominates both paths equally)
+    rng = np.random.default_rng(0)
+    n_pages = 16 if quick else 64
+    shape = (2, 2, n_pages, 8, 16)                # [L, Hkv, n, page, D]
+    k_np = rng.standard_normal(shape, np.float32) * 0.1
+    v_np = rng.standard_normal(shape, np.float32) * 0.1
+    mb = 2 * k_np.nbytes / 1e6
+
+    def best_mbps(fn):
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return mb / min(times)
+
+    for mode in ("lossless", "int8"):
+        per_page = lambda: [
+            (kv_codec.encode_page(k_np[:, :, i:i + 1], mode),
+             kv_codec.encode_page(v_np[:, :, i:i + 1], mode))
+            for i in range(n_pages)]
+        batch = lambda: kv_codec.encode_pages(k_np, v_np, mode)
+        results[f"kv_codec_{mode}_encode_page_mbps"] = best_mbps(per_page)
+        results[f"kv_codec_{mode}_encode_batch_mbps"] = best_mbps(batch)
+        pages = batch()
+        flat = [e for pair in pages for e in pair]
+        results[f"kv_codec_{mode}_decode_page_mbps"] = best_mbps(
+            lambda: [kv_codec.decode_page(e) for e in flat])
+        results[f"kv_codec_{mode}_decode_batch_mbps"] = best_mbps(
+            lambda: kv_codec.decode_pages(flat))
+    return results
+
+
+def _merge_rows(out_path: str, rows: list) -> list:
+    """Merge new rows into an existing MICROBENCH.json by metric name:
+    re-measured metrics are replaced in place, everything else is kept."""
+    try:
+        with open(out_path) as f:
+            old = json.load(f).get("results") or []
+    except (OSError, ValueError):
+        old = []
+    fresh = {r["metric"] for r in rows}
+    return [r for r in old if r.get("metric") not in fresh] + rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--paged-kernels", action="store_true",
+                    help="run only the paged-attention + KV codec arm")
     ap.add_argument("--out", default="MICROBENCH.json")
     args = ap.parse_args()
 
-    results = run(quick=args.quick)
+    if args.paged_kernels:
+        results = run_paged_kernels(quick=args.quick)
+    else:
+        results = run(quick=args.quick)
 
     rows = []
     for key, val in results.items():
@@ -281,13 +413,15 @@ def main():
             continue
         ref = _REFERENCE.get(key)
         ratio = (val / ref) if ref else None
-        rows.append({"metric": key, "value": round(val, 1),
+        rows.append({"metric": key,
+                     "value": round(val, 1) if ref else round(val, 3),
                      "reference": ref,
                      "ratio_vs_reference": round(ratio, 3) if ratio else None})
-    payload = {"results": rows, "ts": time.time(),
+    payload = {"results": _merge_rows(args.out, rows), "ts": time.time(),
                "note": "reference numbers from BASELINE.md (m4.16xlarge, "
                        "2.49.1); this host is much smaller — ratios are "
-                       "directional, not apples-to-apples"}
+                       "directional, not apples-to-apples; paged_*_pallas "
+                       "rows ran in interpret mode unless the host is a TPU"}
     with open(args.out, "w") as f:
         json.dump(payload, f, indent=1)
 

@@ -204,6 +204,17 @@ class EngineProfiler:
             return _NOOP
         return _CompileScope(self, kind, sig, mid_traffic)
 
+    def compile_count(self, kinds) -> int:
+        """Compiled-program count for the given scope kinds (each sig's
+        first element is its kind — e.g. ("decode", w, k)). Feeds the
+        per-kernel compile counters in engine_stats(): with warmup on,
+        this number is reached before traffic and must then stay flat
+        (the compile-once contract per (width, k) tier)."""
+        kinds = tuple(kinds)
+        with self._lock:
+            return sum(1 for s in self._seen
+                       if isinstance(s, tuple) and s and s[0] in kinds)
+
     def _record_compile(self, kind: str, sig, dt: float,
                         mid_traffic: bool) -> None:
         with self._lock:

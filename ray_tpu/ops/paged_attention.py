@@ -1,0 +1,186 @@
+"""Fused paged-attention kernel family (Pallas TPU) for the serving path.
+
+The serving engine's gather attention materializes each slot's full
+[max_len, Hkv, D] K/V view from the page pool every layer of every step
+(~17 GB/step of HBM traffic for a 1.2B model at B=32 — see
+serve/llm/kv_cache.py). These kernels read the pool pages DIRECTLY via
+the slot page table (scalar-prefetch block index maps, the canonical
+TPU paged-attention pattern): the per-slot view is assembled page by
+page in VMEM scratch, never in HBM.
+
+One core kernel covers the whole family — decode (T=1), multi-query
+speculative verify (T=k+1 causal within the span), and chunked prefill
+(B=1, extra ``true_len`` bound) are the same computation with different
+query spans and masks, dispatched through thin wrappers.
+
+Identity contract: greedy TOKENS under the pallas backend must equal the
+gather backend exactly (hard-asserted in tests and the serve bench), so
+the kernel computes the SAME dense-softmax numerics as the gather path —
+fp32 logits scaled by ``sm_scale``, masked with -1e30, full-row fp32
+softmax, probabilities cast back to q.dtype, same contractions — instead
+of a flash-style streaming softmax (whose rescaling visibly changes
+float results). Raw attention outputs agree with gather to the last ULPs
+(the fused [R, L] dot and the batched einsum may order partial sums
+differently); the win is memory traffic, not math: pages stream
+HBM->VMEM once per (slot, kv-head) with no materialized gather
+intermediate.
+
+Off-TPU the kernels run in interpreter mode (pl.pallas_call
+(interpret=True)), which is how tier-1 gates them on CPU — same story as
+ops/attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+# jax renamed TPUCompilerParams -> CompilerParams across versions
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams",
+                           getattr(pltpu, "TPUCompilerParams", None))
+
+
+def _paged_attn_kernel(pt_ref, base_ref, limit_ref,     # scalar prefetch
+                       q_ref, k_ref, v_ref, o_ref, k_scr, v_scr, *,
+                       sm_scale: float, page_size: int, num_pages: int,
+                       t_span: int):
+    """Grid (B, Hkv, num_pages); one (slot, kv-head) pair accumulates its
+    pages into VMEM scratch and computes dense attention on the last page.
+
+    q_ref: [1, 1, R, D] where R = n_rep * t_span, row r = rep * t_span + t
+    (GQA heads grouped per kv head, query positions innermost — matches
+    ``_gqa_expand``'s kv-major head order). k_ref/v_ref: this grid step's
+    pool page [1, 1, page, D], selected by the block index map through the
+    scalar-prefetched page table — the read IS the gather.
+    """
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    k_scr[pl.ds(p * page_size, page_size)] = k_ref[0, 0]
+    v_scr[pl.ds(p * page_size, page_size)] = v_ref[0, 0]
+
+    @pl.when(p == num_pages - 1)
+    def _compute():
+        q = q_ref[0, 0]                                       # [R, D]
+        # q.dtype contraction then fp32 scale — exactly the gather path's
+        # einsum(...).astype(f32) * sm
+        s = jax.lax.dot_general(
+            q, k_scr[:], (((1,), (1,)), ((), ())))            # [R, L]
+        s = s.astype(jnp.float32) * sm_scale
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % t_span
+        pos = base_ref[b] + t
+        valid = (col <= pos) & (col < limit_ref[b])
+        s = jnp.where(valid, s, _NEG_INF)
+        w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o_ref[0, 0] = jax.lax.dot_general(
+            w, v_scr[:], (((1,), (0,)), ((), ())))            # [R, D]
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, base, limit=None, *,
+                    sm_scale: float | None = None,
+                    interpret: bool | None = None):
+    """Fused paged attention over the whole query span.
+
+    q: [B, T, H, D] — query position of q[:, t] is ``base + t`` (causal
+    within the span, full attention over the paged cache below it).
+    k_pages/v_pages: [Hkv, P, page, D] pool. page_tables: [B, max_pages].
+    base: [B] int32 first-query positions. limit: [B] int32 exclusive key
+    bound (None = the whole table span) — chunked prefill passes
+    ``true_len`` so padded tail pages stay masked.
+    Returns [B, T, H, D] in q.dtype.
+    """
+    b, t, h, d = q.shape
+    hkv = k_pages.shape[0]
+    n_rep = h // hkv
+    page_size = k_pages.shape[2]
+    max_pages = page_tables.shape[1]
+    max_len = max_pages * page_size
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if limit is None:
+        limit = jnp.full((b,), max_len, jnp.int32)
+    r = n_rep * t
+    # [B, T, H, D] -> [B, Hkv, n_rep*T, D]: kv-major head split (matches
+    # _gqa_expand), query positions innermost so the kernel recovers t as
+    # row % t_span
+    qg = q.reshape(b, t, hkv, n_rep, d).transpose(0, 2, 3, 1, 4).reshape(
+        b, hkv, r, d)
+
+    kernel = functools.partial(
+        _paged_attn_kernel, sm_scale=sm_scale, page_size=page_size,
+        num_pages=max_pages, t_span=t)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, hkv, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, r, d),
+                             lambda bi, hi, pi, pt, bs, lim: (bi, hi, 0, 0)),
+                # the paged read: block index pt[bi, pi] picks the pool
+                # page straight off the scalar-prefetched table
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda bi, hi, pi, pt, bs, lim:
+                             (hi, pt[bi, pi], 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda bi, hi, pi, pt, bs, lim:
+                             (hi, pt[bi, pi], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, r, d),
+                lambda bi, hi, pi, pt, bs, lim: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((max_len, d), k_pages.dtype),
+                pltpu.VMEM((max_len, d), v_pages.dtype),
+            ]),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, r, d), q.dtype),
+        compiler_params=_COMPILER_PARAMS(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), base.astype(jnp.int32),
+      limit.astype(jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(b, hkv, n_rep, t, d).transpose(0, 3, 1, 2, 4).reshape(
+        b, t, h, d)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_tables, pos, *,
+                           sm_scale: float | None = None,
+                           interpret: bool | None = None):
+    """Single-token decode attention: q [B, H, D], new token at position
+    ``pos[b]`` (attends 0..pos inclusive — its own k/v is already written
+    to the pool). Returns [B, H, D]."""
+    out = paged_attention(q[:, None], k_pages, v_pages, page_tables, pos,
+                          sm_scale=sm_scale, interpret=interpret)
+    return out[:, 0]
+
+
+def paged_verify_attention(q, k_pages, v_pages, page_tables, seq_lens, *,
+                           sm_scale: float | None = None,
+                           interpret: bool | None = None):
+    """Multi-query speculative verify: q [B, T, H, D], T = k+1 draft span
+    per slot, q[b, t] at position ``seq_lens[b] + t`` — causal within the
+    span, full attention over the slot's cached pages (all T spans' k/v
+    are pre-written). Returns [B, T, H, D]."""
+    return paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
+                           sm_scale=sm_scale, interpret=interpret)
+
+
+def paged_chunk_attention(q, k_pages, v_pages, page_table, start, true_len,
+                          *, sm_scale: float | None = None,
+                          interpret: bool | None = None):
+    """Chunked-prefill attention for ONE slot: q [1, C, H, D] chunk whose
+    first token sits at position ``start``; keys are the slot's whole
+    paged view (earlier chunks + this one, pre-written) bounded by
+    ``true_len``. Returns [1, C, H, D]."""
+    base = jnp.reshape(start, (1,)).astype(jnp.int32)
+    limit = jnp.reshape(true_len, (1,)).astype(jnp.int32)
+    return paged_attention(q, k_pages, v_pages, page_table[None], base,
+                           limit, sm_scale=sm_scale, interpret=interpret)

@@ -434,6 +434,9 @@ class ServeController:
                         "decode_block_effective", "pending_pipeline_depth",
                         "spec_rounds", "spec_drafted_tokens",
                         "spec_accepted_tokens",
+                        "attention_backend", "attn_backend_pallas",
+                        "attn_kernel_compiles", "attn_decode_dispatches",
+                        "attn_verify_dispatches", "attn_chunk_dispatches",
                         "itl_s", "compile_events", "mid_traffic_compiles",
                         "compile_s", "weights_bytes", "kv_pool_bytes",
                         "kv_page_occupancy", "device_bytes_in_use",

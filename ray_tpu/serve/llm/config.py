@@ -34,6 +34,17 @@ class LLMConfig:
     # interleaved with decode blocks (chunked prefill): a long admission
     # stalls active generations by at most one chunk, not the whole prompt
     prefill_chunk: int = 512
+    # Paged-attention backend (serve/llm/kv_cache.py +
+    # ops/paged_attention.py): "pallas" runs the fused kernel family —
+    # decode, multi-query speculative verify, and chunked prefill all
+    # read K/V pages directly from the pool via the slot page table
+    # (no materialized gather per layer per step) with numerics
+    # bit-identical to the gather path; "gather" materializes the full
+    # per-slot view + dense softmax. "auto" (default) resolves to pallas
+    # on TPU when the kernel tiling accepts the model's shapes and
+    # gather elsewhere; tests force "pallas" on CPU, where the kernels
+    # run in Pallas interpreter mode.
+    attention_kernel: str = "auto"    # "auto" | "gather" | "pallas"
     # decode steps fused into one dispatched program when the batch is
     # steady (multi-step decode): token cost ~ dispatch_RTT/decode_block,
     # which matters enormously when the chip sits behind a network tunnel.

@@ -176,6 +176,12 @@ class LLMEngine:
         self._jax = jax
         self._jnp = jnp
         self._kvc = kvc
+        # Paged-attention backend, resolved ONCE (ops/paged_attention.py
+        # fused kernels vs the materialized-gather path). Static for the
+        # engine's lifetime: it's baked into every compiled program, and
+        # resolving here keeps the jitted impls free of backend probing.
+        self._attn_backend = kvc.resolve_attention_backend(
+            cfg.attention_kernel, self.model_cfg, cfg.page_size)
 
         if params is None:
             if cfg.checkpoint_path:
@@ -244,7 +250,16 @@ class LLMEngine:
                       "failover_resumed": 0, "failover_restored_tokens": 0,
                       "disagg_prefills": 0, "handoff_bytes_wire": 0,
                       "handoff_overlap_ms": 0.0,
-                      "warm_start_pages": 0, "warm_start_ms": 0.0}
+                      "warm_start_pages": 0, "warm_start_ms": 0.0,
+                      # per-kernel dispatch counters (ISSUE 18): how many
+                      # decode / verify / chunk programs — each containing
+                      # the resolved attention backend's kernels — this
+                      # engine dispatched; paired with attention_backend
+                      # so a fleet mixing gather/pallas replicas is
+                      # visible per replica
+                      "attn_decode_dispatches": 0,
+                      "attn_verify_dispatches": 0,
+                      "attn_chunk_dispatches": 0}
         # Tiered KV cache (kv_tier.py): evicted cached page chains spill
         # host-side into a shm/disk tier + cluster index instead of dying,
         # and _admit extends its longest-match search past the local index
@@ -385,7 +400,7 @@ class LLMEngine:
             key, sub = jax.random.split(key)
             logits, kv_c, lens = self._kvc.paged_decode_step(
                 params, kv_c, pt, lens, toks, self.model_cfg,
-                self.cfg.page_size)
+                self.cfg.page_size, self._attn_backend)
             toks = self._kvc.sample_tokens(
                 logits, sub, temps, self.cfg.top_k)
             return (kv_c, lens, toks, key), toks
@@ -434,7 +449,7 @@ class LLMEngine:
         rng, sub = jax.random.split(rng)
         logits, kv, new_lens = self._kvc.paged_verify_step(
             params, kv, pt, lens0, tokens, self.model_cfg,
-            self.cfg.page_size)
+            self.cfg.page_size, self._attn_backend)
         t = tokens.shape[1]
         out = self._kvc.sample_tokens(
             logits.reshape(-1, logits.shape[-1]), sub,
@@ -494,7 +509,8 @@ class LLMEngine:
                      temp):
                 logits, kv = self._kvc.paged_prefill_chunk(
                     params, kv, page_table, tokens, start, true_len,
-                    self.model_cfg, self.cfg.page_size)
+                    self.model_cfg, self.cfg.page_size,
+                    self._attn_backend)
                 tok = self._kvc.sample_tokens(
                     logits[None, :], rng, temp, top_k)
                 return tok[0], kv
@@ -914,6 +930,15 @@ class LLMEngine:
         out["compile_events"] = self._prof.compile_events
         out["mid_traffic_compiles"] = self._prof.mid_traffic_compiles
         out["compile_s"] = round(self._prof.compile_s, 3)
+        # paged-attention backend surface (ISSUE 18): which kernel family
+        # this replica compiled in (string + a numeric twin exporters can
+        # gauge), plus how many attention-bearing programs — decode /
+        # verify / chunk tiers — have been compiled so far. The dispatch
+        # counters live in self.stats above.
+        out["attention_backend"] = self._attn_backend
+        out["attn_backend_pallas"] = int(self._attn_backend == "pallas")
+        out["attn_kernel_compiles"] = self._prof.compile_count(
+            ("decode", "verify", "chunk"))
         out.update(self._prof.memory_stats(
             used_pages=self.cfg.num_pages - free,
             total_pages=self.cfg.num_pages))
@@ -1704,6 +1729,7 @@ class LLMEngine:
                     self.params, self.kv, jnp.asarray(table),
                     jnp.asarray(toks), jnp.int32(start), jnp.int32(plen),
                     sub, jnp.asarray([req.temperature], jnp.float32))
+            self.stats["attn_chunk_dispatches"] += 1
             req.prefill_pos = min(start + clen, plen)
             if req.prefill_pos >= plen:
                 with self._lock:
@@ -1900,6 +1926,7 @@ class LLMEngine:
         self._start_fetch(all_toks)
         self._pending.append((all_toks, snapshot, k))
         self.stats["steps"] += k
+        self.stats["attn_decode_dispatches"] += 1
         if self._prof.enabled:
             self._prof.record("decode_dispatch", time.perf_counter() - t0)
         if len(self._pending) > self.PIPELINE_DEPTH:
@@ -1958,6 +1985,7 @@ class LLMEngine:
         self._start_fetch(all_toks)
         self._pending.append((all_toks, entry, ("spec", k)))
         self.stats["steps"] += k + 1
+        self.stats["attn_verify_dispatches"] += 1
         if self._prof.enabled:
             self._prof.record("verify_dispatch", time.perf_counter() - t0)
 

@@ -9,17 +9,23 @@ ONCE and every iteration reuses the same XLA program — the crucial property
 on TPU, where recompilation would dwarf the step itself.
 
 Design choices:
-- decode attention runs the stock Pallas paged-attention kernel on TPU
-  (jax.experimental.pallas.ops.tpu.paged_attention — reads only each
-  sequence's live pages); the pool layout [L, Hkv, P, page, D] is the
-  kernel's native shape. A gather + dense-softmax fallback covers CPU and
-  kernel-incompatible shapes — it materializes the full per-slot view
-  (measured 84 ms/step vs the kernel's 25 ms for a 1.2B model at B=32);
+- attention over the paged pool dispatches through ONE backend switch
+  (``LLMConfig.attention_kernel``, resolved once by
+  :func:`resolve_attention_backend`): ``"pallas"`` runs the fused kernel
+  family in ray_tpu/ops/paged_attention.py — decode, multi-query verify,
+  and chunked prefill all read K/V pages directly from the pool via the
+  slot page table (scalar-prefetch block index maps; no materialized
+  gather per layer per step) and reproduce the gather path's dense-softmax
+  numerics bit-exactly; ``"gather"`` materializes the full per-slot view
+  + dense softmax (measured 84 ms/step vs a paged kernel's 25 ms for a
+  1.2B model at B=32). Auto resolution picks pallas on TPU (when the
+  kernel's tiling accepts the shapes) and gather elsewhere; tests force
+  the pallas backend in interpreter mode on CPU;
 - writes are scatters at (page, offset) index pairs; inactive slots write to
   a reserved trash page (page 0), keeping the step free of dynamic shapes
   and `lax.cond`s;
-- prefill (full and chunked) stays gather-based: it runs at B=1 per
-  admission, where the materialized view is small.
+- full (non-chunked) prefill stays dense within the prompt: it runs at
+  B=1 per admission with no cached prefix to read back.
 
 Page 0 is RESERVED as the trash page; the allocator never hands it out.
 """
@@ -404,8 +410,9 @@ def _write_token_kv(k_cache, v_cache, k_new, v_new, page_idx, offset):
 
 def _use_pallas_decode(cfg=None, page_size: int = 0) -> bool:
     """Kernel path gate: TPU backend + shapes the Pallas paged-attention
-    kernel's tiling accepts (head_dim a multiple of 128, page a multiple of
-    8). Tiny test models (head_dim 16-64) fall back to the gather path."""
+    kernels' tiling accepts (head_dim a multiple of 128, page a multiple of
+    8). Tiny test models (head_dim 16-64) fall back to the gather path on
+    real TPUs; in interpreter mode (CPU) every shape runs."""
     if jax.default_backend() != "tpu":
         return False
     if cfg is None:
@@ -413,30 +420,53 @@ def _use_pallas_decode(cfg=None, page_size: int = 0) -> bool:
     return cfg.head_dim % 128 == 0 and page_size % 8 == 0
 
 
-def _decode_attention(q, k_cache, v_cache, page_tables, pos, cfg, page_size):
+def resolve_attention_backend(choice, cfg=None, page_size: int = 0) -> str:
+    """Resolve ``LLMConfig.attention_kernel`` to a concrete backend.
+
+    ``"auto"`` (default) picks ``"pallas"`` on TPU when the kernel tiling
+    accepts the model's shapes and ``"gather"`` everywhere else (the
+    interpreter-mode kernels are a correctness vehicle, not a CPU win).
+    An explicit ``"pallas"`` is honored off-TPU (interpret mode — how
+    tests gate the kernels on CPU) but degrades to ``"gather"`` on a TPU
+    whose shapes the kernel can't tile, with a warning — serving a model
+    beats serving an error."""
+    if choice in (None, "", "auto"):
+        return "pallas" if _use_pallas_decode(cfg, page_size) else "gather"
+    if choice not in ("gather", "pallas"):
+        raise ValueError(
+            f"attention_kernel must be 'auto', 'gather' or 'pallas', "
+            f"got {choice!r}")
+    if choice == "pallas" and jax.default_backend() == "tpu" \
+            and not _use_pallas_decode(cfg, page_size):
+        logger.warning(
+            "attention_kernel='pallas' requested but head_dim=%s/"
+            "page_size=%s don't satisfy the kernel tiling; falling back "
+            "to the gather backend", getattr(cfg, "head_dim", "?"),
+            page_size)
+        return "gather"
+    return choice
+
+
+def _decode_attention(q, k_cache, v_cache, page_tables, pos, cfg, page_size,
+                      attn_backend: str = "gather"):
     """Single-token attention over the paged KV for all slots.
 
     q: [B, H, D]; k_cache/v_cache: [Hkv, P, page, D]; pos: [B] (the new
-    token's position — attend over 0..pos inclusive). On TPU this is the
-    Pallas paged-attention kernel (reads only each sequence's live pages);
-    elsewhere a gather + dense softmax fallback. The gather path
-    materializes the full [B, max_len] view — measured 84 ms/step for a
-    1.2B model at B=32 on one v5e (~17 GB/step of HBM traffic), which is
-    why the kernel path exists."""
+    token's position — attend over 0..pos inclusive). The pallas backend
+    runs the fused paged kernel (ops/paged_attention.py — reads only each
+    sequence's live pages through the page table, same dense-softmax
+    numerics as the gather path); the gather backend materializes the full
+    [B, max_len] view — measured 84 ms/step for a 1.2B model at B=32 on
+    one v5e (~17 GB/step of HBM traffic), which is why the kernel path
+    exists."""
     b = q.shape[0]
     max_pages = page_tables.shape[1]
     max_len = max_pages * page_size
-    if _use_pallas_decode(cfg, page_size):
-        from jax.experimental.pallas.ops.tpu.paged_attention import (
-            paged_attention as _pa)
-        blk = max_pages
-        while blk > 8 and max_pages % (blk // 2) == 0 and blk // 2 >= 8:
-            blk //= 2
-        # the kernel applies NO softmax scale (qk = q·k raw) — pre-scale q
-        return _pa(
-            (q * (cfg.head_dim ** -0.5)).astype(q.dtype),
-            k_cache, v_cache, pos + 1, page_tables,
-            pages_per_compute_block=blk)
+    if attn_backend == "pallas":
+        from ray_tpu.ops import paged_attention as paged_ops
+        return paged_ops.paged_decode_attention(
+            q, k_cache, v_cache, page_tables, pos,
+            sm_scale=cfg.head_dim ** -0.5)
     n_rep = q.shape[1] // k_cache.shape[0]
     sm = cfg.head_dim ** -0.5
     # gather: [Hkv, B, MP, page, D] -> [B, MP, page, Hkv, D] -> [B, L, Hkv, D]
@@ -457,7 +487,8 @@ def _decode_attention(q, k_cache, v_cache, page_tables, pos, cfg, page_size):
 
 
 def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
-                      cfg: LlamaConfig, page_size: int):
+                      cfg: LlamaConfig, page_size: int,
+                      attn_backend: str = "gather"):
     """One fused decode step for all slots.
 
     tokens: [B] current token ids; seq_lens: [B] tokens already in cache
@@ -487,7 +518,7 @@ def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
             k_cache, v_cache, k[:, 0], v[:, 0], page_idx, offset)
         attn = _decode_attention(
             q[:, 0], k_cache, v_cache, page_tables, pos, cfg,
-            page_size)                                            # [B,H,D]
+            page_size, attn_backend)                              # [B,H,D]
         x = x + jnp.einsum("bhk,hkd->bd", attn, layer["attn"]["wo"])[:, None]
         h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
@@ -503,7 +534,8 @@ def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
 
 
 def paged_verify_step(params, kv, page_tables, seq_lens, tokens,
-                      cfg: LlamaConfig, page_size: int):
+                      cfg: LlamaConfig, page_size: int,
+                      attn_backend: str = "gather"):
     """Speculative verify: T tokens per slot in ONE fused pass.
 
     tokens: [B, T] — slot b's current token followed by its T-1 drafted
@@ -516,10 +548,12 @@ def paged_verify_step(params, kv, page_tables, seq_lens, tokens,
     produce after consuming tokens[b, :t+1] sequentially, which is what
     makes greedy speculative acceptance bit-identical to baseline decode.
 
-    Uses the gather attention path on every backend: the Pallas paged-
-    attention kernel is single-query (a multi-query speculative variant
-    is the TPU follow-up), and the gather view here is [B, T, L] — T
-    times the decode fallback's traffic, bounded by small T (draft_len+1).
+    The pallas backend runs the fused MULTI-QUERY paged kernel — all k+1
+    query positions per slot in one kernel launch, causal within the
+    span, pages read through the page table (the TPU follow-up the
+    single-query stock kernel deferred since PR 5). The gather backend
+    materializes the [B, T, L] view — T times the decode fallback's
+    traffic, bounded by small T (draft_len+1).
     Returns (logits [B, T, vocab], new_kv, seq_lens + T).
     """
     b, t = tokens.shape
@@ -555,19 +589,24 @@ def paged_verify_step(params, kv, page_tables, seq_lens, tokens,
             jnp.moveaxis(k, 2, 0).astype(k_cache.dtype))
         v_cache = v_cache.at[:, page_idx, offset].set(
             jnp.moveaxis(v, 2, 0).astype(v_cache.dtype))
-        k_seq = jnp.moveaxis(
-            jnp.take(k_cache, page_tables, axis=1), 0, 3).reshape(
-            b, max_len, cfg.n_kv_heads, cfg.head_dim)
-        v_seq = jnp.moveaxis(
-            jnp.take(v_cache, page_tables, axis=1), 0, 3).reshape(
-            b, max_len, cfg.n_kv_heads, cfg.head_dim)
-        k_full = _gqa_expand(k_seq, n_rep)
-        v_full = _gqa_expand(v_seq, n_rep)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
-            jnp.float32) * sm
-        logits = jnp.where(valid[:, None], logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+        if attn_backend == "pallas":
+            from ray_tpu.ops import paged_attention as paged_ops
+            attn = paged_ops.paged_verify_attention(
+                q, k_cache, v_cache, page_tables, seq_lens, sm_scale=sm)
+        else:
+            k_seq = jnp.moveaxis(
+                jnp.take(k_cache, page_tables, axis=1), 0, 3).reshape(
+                b, max_len, cfg.n_kv_heads, cfg.head_dim)
+            v_seq = jnp.moveaxis(
+                jnp.take(v_cache, page_tables, axis=1), 0, 3).reshape(
+                b, max_len, cfg.n_kv_heads, cfg.head_dim)
+            k_full = _gqa_expand(k_seq, n_rep)
+            v_full = _gqa_expand(v_seq, n_rep)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
+                jnp.float32) * sm
+            logits = jnp.where(valid[:, None], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
         x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
         h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
@@ -644,7 +683,8 @@ def paged_prefill(params, kv, page_table, tokens, true_len,
 
 
 def paged_prefill_chunk(params, kv, page_table, tokens, start, true_len,
-                        cfg: LlamaConfig, page_size: int):
+                        cfg: LlamaConfig, page_size: int,
+                        attn_backend: str = "gather"):
     """One CHUNK of a long prompt's prefill (chunked prefill: the engine
     interleaves prompt chunks with decode blocks so a long admission never
     stalls active generations for the whole prompt pass — the scheduling
@@ -654,7 +694,10 @@ def paged_prefill_chunk(params, kv, page_table, tokens, start, true_len,
     tokens: [1, C] the chunk (bucket-padded); start: scalar position of the
     chunk's first token; true_len: scalar total prompt length. The chunk's
     queries attend to every cached position < start (earlier chunks, read
-    back through the page pool) plus causally within the chunk. Returns
+    back through the page pool) plus causally within the chunk. Under the
+    pallas backend the cached prefix is read page-by-page inside the fused
+    chunk kernel instead of gathering the full paged view every chunk —
+    the long-prompt suffix-prefill-after-tier-restore hot path. Returns
     (last-token logits [vocab] — meaningful only on the final chunk, new_kv).
     """
     b = 1
@@ -692,19 +735,25 @@ def paged_prefill_chunk(params, kv, page_table, tokens, start, true_len,
             jnp.swapaxes(k[0], 0, 1).astype(k_cache.dtype))
         v_cache = v_cache.at[:, page_idx, offset].set(
             jnp.swapaxes(v[0], 0, 1).astype(v_cache.dtype))
-        k_seq = jnp.swapaxes(
-            jnp.take(k_cache, page_table, axis=1).reshape(
-                cfg.n_kv_heads, max_len, cfg.head_dim), 0, 1)[None]
-        v_seq = jnp.swapaxes(
-            jnp.take(v_cache, page_table, axis=1).reshape(
-                cfg.n_kv_heads, max_len, cfg.head_dim), 0, 1)[None]
-        k_full = _gqa_expand(k_seq, n_rep)
-        v_full = _gqa_expand(v_seq, n_rep)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
-            jnp.float32) * sm
-        logits = jnp.where(valid[None, None], logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+        if attn_backend == "pallas":
+            from ray_tpu.ops import paged_attention as paged_ops
+            attn = paged_ops.paged_chunk_attention(
+                q, k_cache, v_cache, page_table, start, true_len,
+                sm_scale=sm)
+        else:
+            k_seq = jnp.swapaxes(
+                jnp.take(k_cache, page_table, axis=1).reshape(
+                    cfg.n_kv_heads, max_len, cfg.head_dim), 0, 1)[None]
+            v_seq = jnp.swapaxes(
+                jnp.take(v_cache, page_table, axis=1).reshape(
+                    cfg.n_kv_heads, max_len, cfg.head_dim), 0, 1)[None]
+            k_full = _gqa_expand(k_seq, n_rep)
+            v_full = _gqa_expand(v_seq, n_rep)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
+                jnp.float32) * sm
+            logits = jnp.where(valid[None, None], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
         x = x + jnp.einsum("bthk,hkd->btd", attn, layer["attn"]["wo"])
         h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])

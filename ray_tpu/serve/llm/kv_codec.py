@@ -28,8 +28,18 @@ spill time and undoes at restore:
   so a codec rollout can mix replicas: the tier's read path accepts
   both raw and encoded blobs regardless of its own write mode.
 
-Pages encode independently (one call per [L, Hkv, 1, page, D] slice) so
-a chunked restore stream can decode exactly the pages that landed.
+Pages encode independently (one payload per [L, Hkv, 1, page, D] slice)
+so a chunked restore stream can decode exactly the pages that landed.
+The BATCH entry points (:func:`encode_pages` / :func:`decode_pages` —
+what the tier's spill flush and the ChainStream chunk decode call) keep
+that per-page payload contract but vectorize all the numpy work across
+the whole page batch: one page-major relayout, one fp32 cast + one
+(layer, kv-head)-grid amax/quant pass, and ONE byte-plane transpose per
+batch instead of one of each per page. Only the entropy-coder call stays
+per page — per-page DEFLATE streams are what keep every payload
+independently decodable (mixed-codec replica interop, partial chunk
+restores), and the match search is a minority of encode time once the
+array work is batched. Payloads are byte-identical either way.
 Everything here is host-side numpy + zlib — no device work, no locks;
 callers keep codec work off the engine and store locks.
 """
@@ -123,3 +133,107 @@ def decode_page(enc: dict) -> np.ndarray:
 def encoded_nbytes(enc: dict) -> int:
     """Stored/wire footprint of one encoded page payload."""
     return len(enc["data"]) + len(enc.get("scale") or b"")
+
+
+# ---------------------------------------------------------------------------
+# batch entry points (ISSUE 18): vectorized twins of encode/decode_page
+# ---------------------------------------------------------------------------
+
+
+def _encode_batch(a: np.ndarray, mode: str) -> list[dict]:
+    """Encode every page of ``a`` ([L, Hkv, n, page, D]) — payloads
+    byte-identical to ``encode_page(a[:, :, i:i+1], mode)`` per page, but
+    the relayout / cast / quant / byte-plane shuffle each run ONCE over
+    the batch."""
+    n = a.shape[2]
+    # page-major contiguous copy: pm[i] holds exactly the bytes of
+    # a[:, :, i:i+1] in C order (one relayout for the whole batch)
+    pm = np.ascontiguousarray(np.moveaxis(a, 2, 0))     # [n, L, Hkv, pg, D]
+    page_shape = (a.shape[0], a.shape[1], 1) + a.shape[3:]
+    base = {"shape": page_shape, "dtype": str(a.dtype),
+            "raw": int(a.nbytes // n)}
+    if mode == "int8" and np.issubdtype(a.dtype, np.floating):
+        f = pm.astype(np.float32)
+        # same per-(layer, kv-head) groups as encode_page's axes (2..) on
+        # the [L, Hkv, 1, page, D] slice — here (page, D) per batch entry
+        s = np.max(np.abs(f), axis=(3, 4), keepdims=True)  # [n,L,Hkv,1,1]
+        s = np.where(s == 0.0, 1.0, s).astype(np.float32)
+        q = np.clip(np.rint(f / s * 127.0), -127, 127).astype(np.int8)
+        sshape = (a.shape[0], a.shape[1], 1, 1, 1)
+        return [{**base, "mode": "int8",
+                 "data": zlib.compress(q[i], _ZLEVEL),
+                 "scale": s[i].tobytes(), "sshape": sshape}
+                for i in range(n)]
+    if mode == "int8":
+        mode = "lossless"   # integer KV: quantization buys nothing
+    if mode == "lossless":
+        # ONE byte-plane transpose for the whole batch (zero-copy uint8
+        # view, no tobytes round-trip); per-page slices of the result are
+        # the exact _planes() bytes of that page
+        itemsize = a.dtype.itemsize
+        buf = pm.view(np.uint8).reshape(n, -1, itemsize)
+        planes = np.ascontiguousarray(buf.transpose(0, 2, 1))
+        return [{**base, "mode": "lossless",
+                 "data": zlib.compress(planes[i], _ZLEVEL)}
+                for i in range(n)]
+    return [{**base, "mode": "none", "data": pm[i].tobytes()}
+            for i in range(n)]
+
+
+def encode_pages(k_np: np.ndarray, v_np: np.ndarray,
+                 mode: str) -> list[tuple[dict, dict]]:
+    """Batch-encode a spilled chain: k_np/v_np are [L, Hkv, n, page, D];
+    returns ``[(ek, ev), ...]`` of length n, each payload byte-identical
+    to the per-page :func:`encode_page` of that page slice."""
+    if mode not in MODES:
+        raise ValueError(f"unknown KV codec mode {mode!r}")
+    ks = _encode_batch(np.ascontiguousarray(k_np), mode)
+    vs = _encode_batch(np.ascontiguousarray(v_np), mode)
+    return list(zip(ks, vs))
+
+
+def decode_pages(encs: list[dict]) -> list[np.ndarray]:
+    """Invert a batch of :func:`encode_page` payloads — same arrays as
+    ``[decode_page(e) for e in encs]``, with the un-shuffle / dequant
+    vectorized across the batch when the payloads are homogeneous (the
+    tier always spills chains that way; a mixed batch — e.g. raw blobs
+    from a pre-codec replica next to encoded ones — falls back to the
+    per-page path)."""
+    if not encs:
+        return []
+    first = encs[0]
+    homogeneous = all(
+        e["mode"] == first["mode"] and e["dtype"] == first["dtype"]
+        and tuple(e["shape"]) == tuple(first["shape"])
+        and tuple(e.get("sshape") or ()) == tuple(first.get("sshape") or ())
+        for e in encs)
+    if not homogeneous or first["mode"] == "none":
+        return [decode_page(e) for e in encs]
+    n = len(encs)
+    dt = _dtype(first["dtype"])
+    shape = tuple(first["shape"])
+    if first["mode"] == "lossless":
+        elems = int(np.prod(shape))
+        # un-shuffle by strided write straight into the output buffer —
+        # each page's transpose lands in place, then one zero-copy dtype
+        # view (the per-page path pays an extra contiguous+tobytes copy)
+        flat = np.empty((n, elems, dt.itemsize), np.uint8)
+        for i, e in enumerate(encs):
+            flat[i] = np.frombuffer(
+                zlib.decompress(e["data"]), np.uint8).reshape(
+                dt.itemsize, elems).T
+        out = flat.reshape(n, elems * dt.itemsize).view(dt).reshape(
+            (n,) + shape)
+        return [out[i] for i in range(n)]
+    if first["mode"] == "int8":
+        q = np.empty((n,) + shape, np.int8)
+        s = np.empty((n,) + tuple(first["sshape"]), np.float32)
+        for i, e in enumerate(encs):
+            q[i] = np.frombuffer(zlib.decompress(e["data"]),
+                                 np.int8).reshape(shape)
+            s[i] = np.frombuffer(e["scale"], np.float32).reshape(
+                e["sshape"])
+        # ONE vectorized dequant across the (layer, kv-head) grid
+        out = (q.astype(np.float32) * (s / 127.0)).astype(dt)
+        return [out[i] for i in range(n)]
+    return [decode_page(e) for e in encs]

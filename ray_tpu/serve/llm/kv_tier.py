@@ -198,9 +198,11 @@ class KVTierStore:
         are page i's chain digest (hex) and its cumulative token length.
         Returns how many pages were registered (0 when the batch doesn't
         fit the shm cap at all). With a codec configured the pages are
-        encoded HERE — outside every lock, per page so a chunked restore
-        can decode them independently — and all caps, LRU accounting and
-        index entries run on encoded bytes."""
+        encoded HERE — outside every lock, through the BATCH codec entry
+        point (kv_codec.encode_pages: one relayout / cast / quant / byte-
+        plane transpose for the whole spill batch) into per-page payloads
+        a chunked restore can still decode independently — and all caps,
+        LRU accounting and index entries run on encoded bytes."""
         raw_nbytes = int(k_np.nbytes) + int(v_np.nbytes)
         if not digests:
             return 0
@@ -213,9 +215,7 @@ class KVTierStore:
             enc_ms = None
         else:
             t0 = time.perf_counter()
-            pages = [(kv_codec.encode_page(k_np[:, :, i:i + 1], self.codec),
-                      kv_codec.encode_page(v_np[:, :, i:i + 1], self.codec))
-                     for i in range(n)]
+            pages = kv_codec.encode_pages(k_np, v_np, self.codec)
             enc_ms = (time.perf_counter() - t0) * 1e3 / n
             sizes = [kv_codec.encoded_nbytes(ek) + kv_codec.encoded_nbytes(ev)
                      for ek, ev in pages]
@@ -489,6 +489,33 @@ class KVTierStore:
             return kv_codec.decode_page(ek), kv_codec.decode_page(ev)
         return blob["k"][:, :, off:off + 1], blob["v"][:, :, off:off + 1]
 
+    @staticmethod
+    def _blob_pages(blobs: dict, run: list) -> list:
+        """Decoded ``(k, v)`` pages for every ``(blob-id, off)`` in
+        ``run`` — the batch twin of :meth:`_blob_page`. Every encoded
+        payload in the run decodes through ONE
+        :func:`kv_codec.decode_pages` call (vectorized un-shuffle /
+        dequant across the whole restore run) while raw PR 7 blobs
+        slice directly; order is preserved."""
+        out: list = [None] * len(run)
+        enc_k, enc_v, enc_at = [], [], []
+        for j, (bid, off) in enumerate(run):
+            blob = blobs[bid]
+            pages = blob.get("pages")
+            if pages is not None:
+                ek, ev = pages[off]
+                enc_k.append(ek)
+                enc_v.append(ev)
+                enc_at.append(j)
+            else:
+                out[j] = (blob["k"][:, :, off:off + 1],
+                          blob["v"][:, :, off:off + 1])
+        if enc_at:
+            for j, k, v in zip(enc_at, kv_codec.decode_pages(enc_k),
+                               kv_codec.decode_pages(enc_v)):
+                out[j] = (k, v)
+        return out
+
     def _note_decode(self, ms_per_page: float) -> None:
         with self._lock:
             self._dec_ms.append(ms_per_page)
@@ -527,8 +554,7 @@ class KVTierStore:
                 blobs = {bid: self._load_handle(h)
                          for bid, h in handles.items()}
                 t0 = time.perf_counter()
-                pairs = [self._blob_page(blobs[bid], off)
-                         for bid, off in run]
+                pairs = self._blob_pages(blobs, run)
                 dec_ms = (time.perf_counter() - t0) * 1e3 / len(run)
                 with self._lock:
                     self.counters["local_hits"] += len(run)
@@ -687,8 +713,8 @@ class KVTierStore:
                          timeout=_REMOTE_FETCH_TIMEOUT_S)
         blobs = dict(zip(refs.keys(), fetched))
         t0 = time.perf_counter()
-        pairs = [self._blob_page(blobs[e["ref"]], int(e["off"]))
-                 for e in entries]
+        pairs = self._blob_pages(
+            blobs, [(e["ref"], int(e["off"])) for e in entries])
         dec_ms = (time.perf_counter() - t0) * 1e3 / len(entries)
         with self._lock:
             self.counters["remote_hits"] += len(entries)
@@ -922,17 +948,25 @@ class ChainStream:
         if not grabbed:
             return [], 0, 0.0
         t0 = time.perf_counter()
-        pairs = []
+        # batch-decode every encoded page in the chunk through ONE
+        # kv_codec.decode_pages call (vectorized un-shuffle / dequant);
+        # raw pages pass through untouched, order preserved
+        pairs: list = [None] * len(grabbed)
+        enc_k, enc_v, enc_at = [], [], []
         wire = 0
-        n_enc = 0
-        for pk, pv, enc, nb, _src in grabbed:
+        for j, (pk, pv, enc, nb, _src) in enumerate(grabbed):
             if enc:
-                pairs.append((kv_codec.decode_page(pk),
-                              kv_codec.decode_page(pv)))
-                n_enc += 1
+                enc_k.append(pk)
+                enc_v.append(pv)
+                enc_at.append(j)
             else:
-                pairs.append((pk, pv))
+                pairs[j] = (pk, pv)
             wire += nb
+        n_enc = len(enc_at)
+        if enc_at:
+            for j, k, v in zip(enc_at, kv_codec.decode_pages(enc_k),
+                               kv_codec.decode_pages(enc_v)):
+                pairs[j] = (k, v)
         dec_ms = (time.perf_counter() - t0) * 1e3
         if n_enc:
             self._store._note_decode(dec_ms / n_enc)

@@ -49,6 +49,13 @@ _EXPORTED_STATS = (
     "disagg_prefills", "handoff_bytes_wire", "handoff_overlap_ms",
     # elastic fleet (ISSUE 17): cache-warm scale-up restore economy
     "warm_start_pages", "warm_start_ms",
+    # paged-attention kernel family (ISSUE 18): resolved backend (string
+    # — exported as a one-hot stat tag; numeric twin alongside) + per-
+    # kernel compile/dispatch counters, so a fleet mixing gather/pallas
+    # replicas is visible in `ray-tpu` status and on the dashboard
+    "attention_backend", "attn_backend_pallas", "attn_kernel_compiles",
+    "attn_decode_dispatches", "attn_verify_dispatches",
+    "attn_chunk_dispatches",
     # introspection scalars (ISSUE 6): compile tracker + memory gauges;
     # None-valued entries (no samples yet / cpu backend) are skipped
     "compile_events", "mid_traffic_compiles", "compile_s",
@@ -71,11 +78,21 @@ def _export_engine_stats(model_id: str, stats: dict) -> None:
         rt = api._try_get_runtime()
         replica = rt.worker_id.hex()[:8] if rt is not None else "local"
         for key in _EXPORTED_STATS:
-            if stats.get(key) is not None:
+            val = stats.get(key)
+            if val is None:
+                continue
+            if isinstance(val, str):
+                # string-valued stats (attention_backend) export as a
+                # one-hot gauge keyed "stat:value" — a float() here would
+                # raise and silently drop every later key's export
                 _ENGINE_GAUGE.set(
-                    float(stats[key]),
-                    tags={"model": model_id, "replica": replica,
-                          "stat": key})
+                    1.0, tags={"model": model_id, "replica": replica,
+                               "stat": f"{key}:{val}"})
+                continue
+            _ENGINE_GAUGE.set(
+                float(val),
+                tags={"model": model_id, "replica": replica,
+                      "stat": key})
         # immediate flush (not the 10s interval): dashboards scrape engine
         # gauges right after probing stats, so they must be current
         metrics.flush_now()

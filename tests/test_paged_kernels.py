@@ -1,0 +1,340 @@
+"""Pallas paged-attention kernel family (ISSUE 18).
+
+Pins the PR's acceptance invariants:
+- every kernel in the family (decode / multi-query verify / chunked
+  prefill) matches the gather path's dense-softmax math across (width, k)
+  tiers and ragged per-slot page counts — same op sequence, dtypes and
+  masking, so results agree to the last ULPs (the fused [R, L] dot and
+  the batched einsum may accumulate partial sums in different orders;
+  greedy TOKEN identity is the hard bitwise contract, asserted
+  end-to-end below);
+- end-to-end greedy tokens under ``attention_kernel="pallas"`` equal the
+  gather engine exactly with prefix cache + speculative decoding + KV
+  tier restore all on (the full hot path through the kernels);
+- programs compile once per (width, k) tier at warmup — no mid-traffic
+  compiles under pallas;
+- ``resolve_attention_backend`` picks gather off-TPU on auto, honors an
+  explicit pallas (interpret mode — this file's whole execution story on
+  CPU), degrades pallas to gather on TPU-unfriendly shapes, and rejects
+  unknown names;
+- the backend and its dispatch/compile counters are exported through
+  ``engine_stats()`` -> llm_server ``_EXPORTED_STATS`` -> controller
+  ``_ENGINE_KEYS``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops import paged_attention as paged_ops
+from ray_tpu.serve.llm import kv_cache
+
+
+# ---------------------------------------------------------------------------
+# kernel-level bit-equivalence vs the gather math
+# ---------------------------------------------------------------------------
+
+
+def _rand_pool(key, hkv, pool_pages, page, d, dtype):
+    kk, kv_ = jax.random.split(key)
+    k_pages = jax.random.normal(kk, (hkv, pool_pages, page, d), dtype)
+    v_pages = jax.random.normal(kv_, (hkv, pool_pages, page, d), dtype)
+    return k_pages, v_pages
+
+
+def _ref_attention(q, k_pages, v_pages, page_tables, base, limit, sm):
+    """The gather path's exact op sequence (see kv_cache._decode_attention
+    / paged_verify_step), generalized to the kernel's unified semantics:
+    row t of slot b attends keys ``col <= base[b] + t`` and
+    ``col < limit[b]``."""
+    b, t, h, d = q.shape
+    hkv = k_pages.shape[0]
+    n_rep = h // hkv
+    page = k_pages.shape[2]
+    max_len = page_tables.shape[1] * page
+    k_seq = jnp.moveaxis(jnp.take(k_pages, page_tables, axis=1),
+                         0, 3).reshape(b, max_len, hkv, d)
+    v_seq = jnp.moveaxis(jnp.take(v_pages, page_tables, axis=1),
+                         0, 3).reshape(b, max_len, hkv, d)
+    k_full = kv_cache._gqa_expand(k_seq, n_rep)
+    v_full = kv_cache._gqa_expand(v_seq, n_rep)
+    col = jnp.arange(max_len)
+    pos = base[:, None] + jnp.arange(t)[None, :]                  # [B,T]
+    valid = (col[None, None, :] <= pos[:, :, None]) \
+        & (col[None, None, :] < limit[:, None, None])             # [B,T,L]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_full).astype(
+        jnp.float32) * sm
+    logits = jnp.where(valid[:, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v_full)
+
+
+def _assert_matches(got, want):
+    """Same dtype, same values to the last ULPs. Contraction accumulation
+    order is the only permitted difference (fused [R, L] dot vs batched
+    einsum), so tolerances are a few ULPs of the output dtype — any
+    masking, scaling or dtype divergence blows well past them."""
+    assert got.dtype == want.dtype
+    g = np.asarray(got, np.float32)
+    w = np.asarray(want, np.float32)
+    tol = 1e-5 if got.dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(g, w, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("b,t", [(1, 1), (4, 1), (2, 2), (4, 4), (3, 5)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_kernel_matches_gather_across_width_and_span(b, t, dtype):
+    """(width, k) tier sweep: decode is t=1, verify is t=k+1. Ragged
+    positions per slot (different live page counts) and a permuted page
+    table — outputs must match the gather math."""
+    hkv, n_rep, d, page, mp = 2, 2, 16, 8, 4
+    h = hkv * n_rep
+    key = jax.random.PRNGKey(b * 131 + t)
+    kq, kp, kt = jax.random.split(key, 3)
+    k_pages, v_pages = _rand_pool(kp, hkv, mp * b + 1, page, d, dtype)
+    q = jax.random.normal(kq, (b, t, h, d), dtype)
+    # ragged: slot i's span ends at a different depth into its pages
+    base = jnp.asarray([(page * (i % mp)) + (i * 3) % page
+                        for i in range(b)], jnp.int32)
+    page_tables = jax.random.permutation(
+        kt, mp * b) .reshape(b, mp).astype(jnp.int32) + 1
+    limit = jnp.full((b,), mp * page, jnp.int32)
+    sm = d ** -0.5
+
+    got = paged_ops.paged_attention(q, k_pages, v_pages, page_tables,
+                                    base, sm_scale=sm)
+    want = _ref_attention(q, k_pages, v_pages, page_tables, base, limit,
+                          sm)
+    _assert_matches(got, want)
+
+
+def test_decode_wrapper_matches_decode_attention_integration():
+    """The integration point the engine actually calls: gather vs pallas
+    through kv_cache._decode_attention must agree."""
+    import types
+
+    hkv, h, d, page, mp, b = 2, 4, 16, 8, 4, 4
+    key = jax.random.PRNGKey(0)
+    k_pages, v_pages = _rand_pool(key, hkv, mp * b + 1, page, d,
+                                  jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (b, h, d), jnp.float32)
+    page_tables = jnp.arange(1, mp * b + 1).reshape(b, mp).astype(
+        jnp.int32)
+    pos = jnp.asarray([0, 7, 13, 30], jnp.int32)
+    cfg = types.SimpleNamespace(head_dim=d)
+    gather = kv_cache._decode_attention(q, k_pages, v_pages, page_tables,
+                                        pos, cfg, page, "gather")
+    pallas = kv_cache._decode_attention(q, k_pages, v_pages, page_tables,
+                                        pos, cfg, page, "pallas")
+    _assert_matches(pallas, gather)
+
+
+def test_chunk_kernel_masks_padded_tail():
+    """Chunked prefill: limit=true_len must hide the padded tail pages —
+    same result as the gather reference with the same bound, and NOT the
+    same as an unbounded kernel when padding exists."""
+    hkv, n_rep, d, page, mp = 2, 2, 16, 8, 4
+    h = hkv * n_rep
+    c = 16                  # bucket-padded chunk: rows past the prompt
+    k_pages, v_pages = _rand_pool(jax.random.PRNGKey(2), hkv, mp + 1,
+                                  page, d, jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(3), (1, c, h, d),
+                          jnp.float32)
+    page_table = jnp.arange(1, mp + 1, dtype=jnp.int32)
+    # prompt ends at 19: rows 0..10 are real, 11..15 are padding whose
+    # causal mask would otherwise see keys past the prompt
+    start, true_len = 8, 19
+    got = paged_ops.paged_chunk_attention(
+        q, k_pages, v_pages, page_table,
+        jnp.int32(start), jnp.int32(true_len), sm_scale=d ** -0.5)
+    want = _ref_attention(
+        q, k_pages, v_pages, page_table[None],
+        jnp.asarray([start], jnp.int32), jnp.asarray([true_len], jnp.int32),
+        d ** -0.5)
+    _assert_matches(got, want)
+    unbounded = paged_ops.paged_chunk_attention(
+        q, k_pages, v_pages, page_table,
+        jnp.int32(start), jnp.int32(mp * page), sm_scale=d ** -0.5)
+    assert not np.array_equal(np.asarray(got), np.asarray(unbounded))
+
+
+def test_kernel_matches_gather_under_jit():
+    """Same contract inside jit — how the engine's compiled step programs
+    run the kernel."""
+    hkv, n_rep, d, page, mp, b, t = 2, 2, 16, 8, 4, 2, 3
+    h = hkv * n_rep
+    k_pages, v_pages = _rand_pool(jax.random.PRNGKey(5), hkv, mp * b + 1,
+                                  page, d, jnp.float32)
+    q = jax.random.normal(jax.random.PRNGKey(6), (b, t, h, d), jnp.float32)
+    page_tables = jnp.arange(1, mp * b + 1).reshape(b, mp).astype(jnp.int32)
+    base = jnp.asarray([5, 17], jnp.int32)
+    limit = jnp.full((b,), mp * page, jnp.int32)
+    sm = d ** -0.5
+    got = jax.jit(lambda *a: paged_ops.paged_attention(*a, sm_scale=sm))(
+        q, k_pages, v_pages, page_tables, base)
+    want = _ref_attention(q, k_pages, v_pages, page_tables, base, limit, sm)
+    _assert_matches(got, want)
+
+
+# ---------------------------------------------------------------------------
+# backend resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_auto_is_gather_off_tpu():
+    assert kv_cache.resolve_attention_backend("auto") == "gather"
+    assert kv_cache.resolve_attention_backend(None) == "gather"
+    assert kv_cache.resolve_attention_backend("") == "gather"
+
+
+def test_resolve_explicit_pallas_honored_off_tpu():
+    """CPU pallas = interpret mode — the test-gating story. It must NOT
+    silently degrade to gather."""
+    assert kv_cache.resolve_attention_backend("pallas") == "pallas"
+    assert kv_cache.resolve_attention_backend("gather") == "gather"
+
+
+def test_resolve_unknown_raises():
+    with pytest.raises(ValueError, match="attention_kernel"):
+        kv_cache.resolve_attention_backend("flash")
+
+
+def test_resolve_on_tpu_shape_gate(monkeypatch):
+    """On TPU, auto picks pallas only when the kernel tiling fits; an
+    explicit pallas on unfriendly shapes degrades to gather (warned)."""
+    import types
+
+    monkeypatch.setattr(kv_cache.jax, "default_backend", lambda: "tpu")
+    good = types.SimpleNamespace(head_dim=128)
+    tiny = types.SimpleNamespace(head_dim=16)
+    assert kv_cache.resolve_attention_backend("auto", good, 16) == "pallas"
+    assert kv_cache.resolve_attention_backend("auto", tiny, 16) == "gather"
+    assert kv_cache.resolve_attention_backend("pallas", tiny, 16) \
+        == "gather"
+    assert kv_cache.resolve_attention_backend("pallas", good, 16) \
+        == "pallas"
+    assert kv_cache.resolve_attention_backend("auto", good, 7) == "gather"
+
+
+# ---------------------------------------------------------------------------
+# engine: end-to-end greedy identity + compile economy + telemetry
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig
+
+    d = dict(model_config=llama.llama_tiny(vocab_size=512),
+             max_batch_size=4, page_size=8, num_pages=64,
+             max_prompt_len=64, max_seq_len=128, max_tokens=16,
+             prefill_chunk=16)
+    d.update(kw)
+    return LLMConfig(**d)
+
+
+def _run(cfg, prompts, max_tokens=16):
+    from ray_tpu.serve.llm import LLMEngine
+
+    eng = LLMEngine(cfg, rng_seed=0)
+    eng.start()
+    try:
+        rids = [eng.submit(p, max_tokens=max_tokens, temperature=0.0)
+                for p in prompts]
+        outs = [eng.result(r, timeout=120.0) for r in rids]
+        stats = eng.engine_stats()
+    finally:
+        eng.shutdown()
+    return outs, stats
+
+
+SHARED = "the quick brown fox jumps over the lazy dog again and again"
+PROMPTS = [SHARED + " once", SHARED + " twice",
+           "abc abc abc abc abc abc"]        # repetitive: spec drafts fire
+
+
+def test_engine_greedy_identity_pallas_vs_gather_full_stack():
+    """The acceptance invariant: greedy tokens bit-identical across
+    backends with prefix cache + speculative decoding + KV tier ALL on —
+    every kernel in the family on the hot path (decode, verify, chunked
+    prefill via the shared-prefix long prompts)."""
+    kw = dict(spec_decode_enabled=True, kv_tier_enabled=True)
+    base, gstats = _run(_cfg(attention_kernel="gather", **kw), PROMPTS)
+    pall, pstats = _run(_cfg(attention_kernel="pallas", **kw), PROMPTS)
+    assert all(o["error"] is None for o in base + pall)
+    assert [o["tokens"] for o in pall] == [o["tokens"] for o in base]
+    assert gstats["attention_backend"] == "gather"
+    assert pstats["attention_backend"] == "pallas"
+    assert pstats["attn_backend_pallas"] == 1
+    assert pstats["attn_decode_dispatches"] > 0
+    assert pstats["attn_verify_dispatches"] > 0
+    assert pstats["attn_chunk_dispatches"] > 0
+    assert pstats["spec_rounds"] > 0
+
+
+def test_engine_pallas_compile_once_per_tier():
+    """Warmup pre-compiles the pallas decode/verify programs per (width,
+    k) tier and traffic must not add any; a second identical traffic wave
+    must add ZERO programs of any kind (prefill/chunk buckets compile
+    lazily on first use by pre-existing engine design, then stay warm)."""
+    cfg = _cfg(attention_kernel="pallas", spec_decode_enabled=True,
+               warmup_compile=True)
+    from ray_tpu.serve.llm import LLMEngine
+
+    def wave(eng):
+        rids = [eng.submit("abc abc abc abc abc", max_tokens=12,
+                           temperature=0.0) for _ in range(3)]
+        outs = [eng.result(r, timeout=120.0) for r in rids]
+        assert all(o["error"] is None for o in outs)
+
+    eng = LLMEngine(cfg, rng_seed=0)
+    eng.start()
+    try:
+        warm_dv = eng._prof.compile_count(("decode", "verify"))
+        assert warm_dv > 0            # warmup compiled the kernel tiers
+        wave(eng)
+        assert eng._prof.compile_count(("decode", "verify")) == warm_dv
+        after_first = eng.engine_stats()["attn_kernel_compiles"]
+        wave(eng)
+        assert eng.engine_stats()["attn_kernel_compiles"] == after_first
+    finally:
+        eng.shutdown()
+
+
+def test_engine_gather_fallback_still_serves():
+    """attention_kernel='gather' pins the reference path; backend
+    telemetry must say so."""
+    outs, stats = _run(_cfg(attention_kernel="gather"), ["hello world"],
+                       max_tokens=8)
+    assert outs[0]["error"] is None
+    assert stats["attention_backend"] == "gather"
+    assert stats["attn_backend_pallas"] == 0
+    assert stats["attn_decode_dispatches"] > 0
+
+
+def test_backend_stats_exported_through_serve_plane():
+    """New keys must ride every hop of the export chain (the README table
+    is drift-guarded separately in test_profiling). The controller's
+    _ENGINE_KEYS tuple is function-local, so it is checked in source."""
+    import inspect
+
+    from ray_tpu.serve import controller
+    from ray_tpu.serve.llm import llm_server
+
+    keys = {"attention_backend", "attn_backend_pallas",
+            "attn_kernel_compiles", "attn_decode_dispatches",
+            "attn_verify_dispatches", "attn_chunk_dispatches"}
+    assert keys <= set(llm_server._EXPORTED_STATS)
+    src = inspect.getsource(controller)
+    engine_keys = src.split("_ENGINE_KEYS = (", 1)[1]
+    for k in keys:
+        assert f'"{k}"' in engine_keys, k
+
+
+def test_unknown_attention_kernel_fails_engine_construction():
+    from ray_tpu.serve.llm import LLMEngine
+
+    with pytest.raises(ValueError, match="attention_kernel"):
+        LLMEngine(_cfg(attention_kernel="flash"), rng_seed=0)
