@@ -309,6 +309,75 @@ def _chaos_scenario(name, events, duration_s, min_rate, *, seed,
             raise SystemExit(
                 f"chaos suite [{name}]: successful responses exceeded "
                 f"deadline+grace: {slow}")
+
+        # fault→symptom causal adjacency (ISSUE 19): every injected
+        # fault must be on the journal as a chaos_fault ground-truth
+        # event, followed within the adjacency window by the symptom
+        # events that fault should cause. Polled: worker-side emitters
+        # (controller, engines) batch-flush on events_flush_interval_s.
+        symptom_kinds = {
+            "worker_kill": ("replica_death", "replica_ejected",
+                            "failover_resume"),
+            "replica_kill": ("replica_death", "replica_ejected",
+                             "failover_resume"),
+            "node_kill": ("node_dead", "replica_death",
+                          "replica_ejected", "failover_resume"),
+            "node_drain": ("node_drain", "node_dead"),
+            "cp_restart": ("cp_restart",),
+            "replica_scale": ("replica_scale",),
+        }
+        adjacency_window_s = 10.0
+        from ray_tpu.util import state as _state
+        journal: list = []
+        pairs: list = []
+        missing = ["journal not polled yet"]
+        poll_deadline = time.monotonic() + 15.0
+        while missing and time.monotonic() < poll_deadline:
+            try:
+                journal = _state.list_events(limit=500)
+            except Exception:  # noqa: BLE001 — CP mid-restart
+                journal = []
+            faults = [e for e in journal if e.get("kind") == "chaos_fault"]
+            missing, pairs = [], []
+            for _, fkind, _kw in events:
+                fev = next(
+                    (e for e in faults
+                     if (e.get("attrs") or {}).get("kind") == fkind), None)
+                if fev is None:
+                    missing.append(f"{fkind}: no chaos_fault event")
+                    continue
+                want = symptom_kinds.get(fkind)
+                if want is None:
+                    continue
+                fts = float(fev.get("ts") or 0.0)
+                syms = [e for e in journal
+                        if e.get("kind") in want
+                        and fts <= float(e.get("ts") or 0.0)
+                        <= fts + adjacency_window_s]
+                if not syms:
+                    missing.append(
+                        f"{fkind}: none of {want} within "
+                        f"{adjacency_window_s}s of the fault event")
+                    continue
+                pairs.append({
+                    "fault": fkind, "fault_ts": fts,
+                    "symptoms": sorted({s["kind"] for s in syms}),
+                    "first_symptom_lag_s": round(
+                        min(float(s.get("ts") or 0.0) - fts
+                            for s in syms), 3)})
+            if missing:
+                time.sleep(0.5)
+        row["fault_symptom_pairs"] = pairs
+        # the postmortem surface must tell the same story in one call
+        postmortem = _state.events_postmortem(
+            window_s=duration_s + 60.0)
+        row["postmortem_items"] = len(postmortem.get("items") or [])
+        if missing:
+            print(json.dumps({"chaos_scenario": row}))
+            raise SystemExit(
+                f"chaos suite [{name}]: fault→symptom causal adjacency "
+                f"FAILED: {missing}; journal held {len(journal)} "
+                f"event(s): {[e.get('kind') for e in journal][:40]}")
         try:
             stats = json.loads(urllib.request.urlopen(
                 f"{base}/-/stats", timeout=10).read())
@@ -1838,6 +1907,12 @@ def main():
                          "headline point with metrics_enabled=False on a "
                          "fresh cluster and assert the p50 TTFT delta is "
                          "within noise (ISSUE 4 overhead bound)")
+    ap.add_argument("--events-ab", action="store_true",
+                    help="A/B the flight-recorder event journal: rerun "
+                         "the headline point with events_enabled=False on "
+                         "a fresh cluster and assert the p50 TTFT delta "
+                         "is within noise (ISSUE 19 overhead bound); "
+                         "merges into --out under extra.events")
     ap.add_argument("--chaos-suite", action="store_true",
                     help="run the deterministic multi-fault chaos suite "
                          "(worker kill, node kill, node drain, CP restart) "
@@ -1914,7 +1989,24 @@ def main():
 
     if args.chaos_suite:
         # the chaos suite is a robustness harness, not a perf number: it
-        # runs a plain (non-LLM) app, so the LLM preflight doesn't apply
+        # runs a plain (non-LLM) app, so the LLM preflight doesn't apply.
+        # Flight-recorder coverage does: the suite hard-asserts
+        # fault→symptom causal adjacency out of the event journal, which
+        # is only as good as the store/flusher/emitters behind it.
+        if not args.no_preflight:
+            import os
+            import subprocess
+            import sys
+            repo = os.path.dirname(os.path.abspath(__file__))
+            chaos_tests = ["tests/test_events.py"]
+            rc = subprocess.run(
+                [sys.executable, "-m", "pytest", "-q", *chaos_tests],
+                cwd=repo,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
+            if rc != 0:
+                sys.exit(f"preflight failed: pytest -q "
+                         f"{' '.join(chaos_tests)} exited {rc} "
+                         f"(--no-preflight to override)")
         _run_chaos_suite(args)
         return
 
@@ -1937,11 +2029,14 @@ def main():
             # elastic coverage rides along: the fleet window is now an
             # open-loop arrival process over an elastically-scalable
             # controller, so the warm-start/drain/scale races must hold
+            # flight-recorder coverage too: the fleet's scale/failover
+            # story is debugged through the event journal
             fleet_tests = ["tests/test_affinity_routing.py",
                            "tests/test_attribution.py",
                            "tests/test_failover.py",
                            "tests/test_serve_disagg.py",
-                           "tests/test_elastic.py"]
+                           "tests/test_elastic.py",
+                           "tests/test_events.py"]
             rc = subprocess.run(
                 [sys.executable, "-m", "pytest", "-q", *fleet_tests],
                 cwd=repo,
@@ -2049,11 +2144,15 @@ def main():
     # Logical CPUs: serving actors (controller + replicas) are IO-bound hosts
     # around the chip-bound engine; don't let a small host starve scheduling.
     bench_cpus = max(8, (__import__("os").cpu_count() or 1))
-    # metrics A/B: the "on" arm flushes aggressively (1 s vs the 10 s
-    # default) so the pipeline is actually exercised during a short run
-    ray_tpu.init(num_cpus=bench_cpus, _system_config=(
-        {"metrics_enabled": True, "metrics_flush_interval_s": 1.0}
-        if args.metrics_ab else None))
+    # metrics/events A/B: the "on" arm flushes aggressively (1 s / 0.5 s
+    # vs the defaults) so the pipeline is actually exercised during a
+    # short run
+    _ab_cfg = None
+    if args.metrics_ab:
+        _ab_cfg = {"metrics_enabled": True, "metrics_flush_interval_s": 1.0}
+    elif args.events_ab:
+        _ab_cfg = {"events_enabled": True, "events_flush_interval_s": 0.5}
+    ray_tpu.init(num_cpus=bench_cpus, _system_config=_ab_cfg)
     has_tpu = any(n.get("resources", {}).get("TPU", 0) > 0
                   for n in ray_tpu.nodes())
 
@@ -2236,6 +2335,46 @@ def main():
             raise SystemExit(
                 f"metrics pipeline overhead out of bounds: p50 TTFT "
                 f"+{delta_ms}ms with the flusher on (tolerance {tol_ms}ms)")
+
+    # flight-recorder A/B (ISSUE 19): the headline point above ran with
+    # the event journal on (emitters + batch flusher live); rerun the
+    # same point on a fresh cluster with events_enabled=False and bound
+    # the p50 TTFT overhead. Same noise-sized tolerance as the metrics
+    # A/B — a healthy serving run emits a handful of events total, so
+    # any measurable delta is a regression in the emit fast path.
+    events_overhead = None
+    if args.events_ab:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=bench_cpus,
+                     _system_config={"events_enabled": False})
+        app = build_openai_app(llm_cfg, route_prefix="/v1")
+        serve.run(app, name="llm-bench-noevents", route_prefix="/v1")
+        proxy = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{proxy.port}/v1/completions"
+        _post(base, {"prompt": prompt, "max_tokens": 4})
+        _post_stream(base, {"prompt": prompt, "max_tokens": 4})
+        off_row = run_point(args.concurrency, args.requests,
+                            label="events_journal_off")
+        points.append(off_row)
+        delta_ms = round(head["p50_ttft_ms"] - off_row["p50_ttft_ms"], 2)
+        tol_ms = round(max(0.25 * off_row["p50_ttft_ms"], 30.0), 2)
+        events_overhead = {
+            "journal_on": {k: head[k] for k in
+                           ("p50_ttft_ms", "p90_ttft_ms", "req_per_s",
+                            "proxy_cpu_share")},
+            "journal_off": {k: off_row[k] for k in
+                            ("p50_ttft_ms", "p90_ttft_ms", "req_per_s",
+                             "proxy_cpu_share")},
+            "p50_delta_ms": delta_ms,
+            "tolerance_ms": tol_ms,
+            "within_noise": delta_ms <= tol_ms,
+        }
+        if not events_overhead["within_noise"]:
+            print(json.dumps({"events_overhead": events_overhead}))
+            raise SystemExit(
+                f"event journal overhead out of bounds: p50 TTFT "
+                f"+{delta_ms}ms with the journal on (tolerance {tol_ms}ms)")
 
     # phase-timer A/B (ISSUE 6): the headline point ran with the engine
     # profiler on (the default); redeploy the same engine with
@@ -2739,8 +2878,12 @@ def main():
         result["extra"]["profiling_overhead"] = profiling_overhead
     if slo_overhead is not None:
         result["extra"]["slo_overhead"] = slo_overhead
+    if events_overhead is not None:
+        result["extra"]["events"] = events_overhead
+    # events rides the file merge too: `--events-ab` alone must land in
+    # SERVE_BENCH.json extra.events without clobbering earlier rows
     mergeable = {"prefix_cache": prefix_cache, "spec_decode": spec_decode,
-                 "kv_tier": kv_tier}
+                 "kv_tier": kv_tier, "events": events_overhead}
     mergeable = {k: v for k, v in mergeable.items() if v is not None}
     if mergeable:
         result["extra"].update(mergeable)

@@ -189,6 +189,18 @@ class Config:
     # older half dropped) instead of hard-truncated.
     metrics_retention_s: float = 3600.0
     metrics_max_points_per_series: int = 1024
+    # Flight recorder (observability/events.py): structured cluster
+    # events batch-flushed to a bounded CP journal. Emit is a host-side
+    # dict append + queue push (A/B-bounded by `bench_serve.py
+    # --events-ab`); the flusher keeps unsent batches across CP outages,
+    # bounded to this many payloads with oldest-first eviction.
+    events_enabled: bool = True
+    events_flush_interval_s: float = 2.0
+    events_flush_buffer_max: int = 64
+    # CP journal retention: past the cap, older INFOs downsample first
+    # (every other one of the older half drops), then the oldest
+    # non-ERROR evicts — ERRORs outlive chatty INFO streams.
+    events_max_records: int = 2048
 
     # --- misc ---
     worker_register_timeout_s: float = 30.0

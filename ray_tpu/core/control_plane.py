@@ -29,6 +29,7 @@ from ray_tpu.core.rpc import ClientPool, RpcServer
 from ray_tpu.core.scheduler import NodeView, add, pick_node, place_bundles, place_slice_bundles, subtract
 from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.exceptions import PlacementGroupSchedulingError
+from ray_tpu.observability import events as _events
 from ray_tpu.util import metrics as _metrics
 
 # cluster prefix-index namespace for the tiered KV cache
@@ -166,6 +167,11 @@ class ControlPlane:
         # baseline, append order = age; oldest evicted past
         # slo_exemplar_max_records and on owner death (worker/node GC)
         self._slo_exemplars: list[dict] = []
+        # flight-recorder journal (observability/events.py sink): one
+        # bounded list in arrival order with severity-tiered retention —
+        # past events_max_records, older INFOs downsample first, then the
+        # oldest non-ERROR evicts, so sparse ERRORs outlive chatty INFOs
+        self._events: list[dict] = []
         # time-series store (util/metrics.py flusher sink; Monarch-shaped:
         # per-series bounded ring, delta reports accumulated CP-side into
         # cumulative points so queries never re-derive counter state)
@@ -183,6 +189,13 @@ class ControlPlane:
             store_path if store_path is not None
             else (get_config().cp_store_path or None))
         self._restore()
+        # the CP hosts the journal: its own emitters (node state machine,
+        # restart marker below) deposit directly, no RPC hop. Install
+        # before the restart marker so head-mode co-residents share it.
+        _events.set_local_sink(self._event_sink)
+        self._emit_cp_event(
+            "cp_restart", "WARNING", reason="control plane started",
+            attrs={"epoch": self._epoch})
         self._server = RpcServer(
             self._handle, host=host, port=port, name="controlplane",
             blocking_methods={"resolve_actor", "pg_ready", "get_actor_by_name", "pubsub_poll",
@@ -384,6 +397,8 @@ class ControlPlane:
             except Exception:  # noqa: BLE001 - heartbeat will deliver it
                 pass
             self._publish("node", {"event": "draining", "node_id": node_id})
+            self._emit_cp_event("node_drain", "WARNING",
+                                node=node_id.hex(), reason=reason)
             finisher.start()
         if body.get("wait") and finisher is not None:
             finisher.join(timeout=get_config().drain_deadline_s + 30.0)
@@ -913,6 +928,182 @@ class ControlPlane:
             rid = r.get("request_id")
             if rid not in live:
                 self._h_kv_del({"key": f"slo_exemplar:{rid}"})
+
+    # ---- flight recorder (observability/events.py journal) -------------
+    def _event_sink(self, ev: dict) -> None:
+        """Local deposit path for events emitted inside the CP process
+        (installed as the observability.events sink in __init__)."""
+        if not isinstance(ev, dict) or ev.get("kind") not in _events.KINDS:
+            return
+        with self._lock:
+            self._events.append(ev)
+            self._trim_events_locked()
+
+    def _emit_cp_event(self, kind: str, severity: str = "INFO",
+                       **fields) -> None:
+        """Journal one CP-side event (node state machine, restart
+        marker). Malformed emits are dropped, never raised — the node
+        lifecycle must not depend on the flight recorder."""
+        try:
+            if not get_config().events_enabled:
+                return
+            self._event_sink(_events.make_event(kind, severity, **fields))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _trim_events_locked(self) -> None:
+        """Severity-tiered retention (caller holds self._lock). Past
+        events_max_records: (1) downsample INFOs in the older half of
+        the journal (every other one drops — the metrics-store
+        downsample, applied by severity), (2) evict the oldest
+        non-ERROR, (3) only then let the oldest ERRORs go (hard bound)."""
+        cap = max(8, int(get_config().events_max_records))
+        if len(self._events) <= cap:
+            return
+        half = len(self._events) // 2
+        kept, drop_next = [], True
+        for i, ev in enumerate(self._events):
+            if i < half and ev.get("severity", "INFO") == "INFO":
+                drop_next = not drop_next
+                if drop_next:
+                    continue
+            kept.append(ev)
+        overflow = len(kept) - cap
+        if overflow > 0:
+            survivors = []
+            for ev in kept:
+                if overflow > 0 and ev.get("severity") != "ERROR":
+                    overflow -= 1
+                    continue
+                survivors.append(ev)
+            kept = survivors
+        self._events[:] = kept
+        while len(self._events) > cap:
+            self._events.pop(0)
+
+    def _h_report_events(self, body):
+        """Accept one batch from a worker's EventFlusher. Events outside
+        the fixed taxonomy are dropped record-by-record (the batch still
+        acks — a single bad emit site must not wedge a worker's backlog
+        forever); batches from retracted workers are rejected whole like
+        late metric flushes."""
+        body = body or {}
+        evs = body.get("events")
+        source = str(body.get("source") or "")
+        if not isinstance(evs, list):
+            return {"ok": False, "error": "malformed batch"}
+        accepted = 0
+        with self._lock:
+            if source and source in self._dead_workers:
+                return {"ok": False, "error": "source retracted"}
+            for ev in evs:
+                if not isinstance(ev, dict) or \
+                        ev.get("kind") not in _events.KINDS:
+                    continue
+                ev = dict(ev)
+                if source and not ev.get("source"):
+                    ev["source"] = source
+                self._events.append(ev)
+                accepted += 1
+            self._trim_events_locked()
+        return {"ok": True, "accepted": accepted}
+
+    @staticmethod
+    def _event_matches(ev: dict, kind, severity, entity,
+                       since, until) -> bool:
+        if kind is not None and ev.get("kind") != kind:
+            return False
+        if severity is not None:
+            rank = _events.SEVERITY_RANK
+            if rank.get(ev.get("severity", "INFO"), 0) < \
+                    rank.get(severity, 0):
+                return False
+        ts = float(ev.get("ts") or 0.0)
+        if since is not None and ts < since:
+            return False
+        if until is not None and ts > until:
+            return False
+        if entity:
+            hay = (ev.get("node"), ev.get("deployment"), ev.get("replica"),
+                   ev.get("request_id"), ev.get("source"))
+            if not any(entity in h for h in hay if h):
+                return False
+        return True
+
+    def _h_list_events(self, body):
+        """Journal query, newest first. Filters: kind (exact), severity
+        (minimum — ERROR shows only errors, WARNING hides INFO), entity
+        (substring over node/deployment/replica/request_id/source),
+        since/until (unix ts), limit."""
+        body = body or {}
+        kind = body.get("kind")
+        severity = body.get("severity")
+        entity = body.get("entity")
+        since = body.get("since")
+        until = body.get("until")
+        since = None if since is None else float(since)
+        until = None if until is None else float(until)
+        limit = max(1, int(body.get("limit") or 100))
+        with self._lock:
+            out = [dict(ev) for ev in reversed(self._events)
+                   if self._event_matches(ev, kind, severity, entity,
+                                          since, until)]
+        return out[:limit]
+
+    def _h_events_postmortem(self, body):
+        """One ordered incident timeline for a window: every journal
+        event, every SLO-violation exemplar, and a per-series spike
+        summary of the metric timeseries, merged by timestamp — "what
+        happened around this p99 spike" in a single response."""
+        body = body or {}
+        try:
+            window = float(body.get("window_s") or 300.0)
+        except (TypeError, ValueError):
+            window = 300.0
+        until = body.get("until")
+        until = time.time() if until is None else float(until)
+        since = until - window
+        items: list[dict] = []
+        metric_items: list[dict] = []
+        with self._lock:
+            for ev in self._events:
+                ts = float(ev.get("ts") or 0.0)
+                if since <= ts <= until:
+                    it = dict(ev)
+                    it["type"] = "event"
+                    items.append(it)
+            for r in self._slo_exemplars:
+                if r.get("kind") != "violation":
+                    continue
+                ts = float(r.get("ts") or 0.0)
+                if since <= ts <= until:
+                    items.append({
+                        "type": "exemplar", "ts": ts,
+                        "request_id": r.get("request_id"),
+                        "deployment": r.get("deployment"),
+                        "replica": r.get("replica"),
+                        "violated": r.get("violated"),
+                        "ttft_ms": r.get("ttft_ms"),
+                        "e2e_ms": r.get("e2e_ms")})
+            for (name, tags, source), ser in self._metric_series.items():
+                pts = [(t, v) for t, v in ser["points"]
+                       if since <= t <= until and isinstance(v, (int, float))]
+                if not pts:
+                    continue
+                peak_ts, peak = max(pts, key=lambda p: p[1])
+                metric_items.append({
+                    "type": "metric", "ts": peak_ts, "name": name,
+                    "source": source, "tags": list(tags),
+                    "points": len(pts), "peak": peak,
+                    "first": pts[0][1], "last": pts[-1][1]})
+        # one spike summary per series, loudest movers only — the
+        # timeline is for reading, not for re-plotting the whole store
+        metric_items.sort(
+            key=lambda m: abs(m["peak"] - m["first"]), reverse=True)
+        items.extend(metric_items[:40])
+        items.sort(key=lambda x: float(x.get("ts") or 0.0))
+        return {"since": since, "until": until, "window_s": window,
+                "items": items}
 
     # ---- metrics time-series store (util/metrics.py flusher sink) ------
     def _h_metrics_report(self, body):
@@ -1807,6 +1998,9 @@ class ControlPlane:
             # every kv_tier entry spilled from this node is unservable
             self._retract_kv_tier_locked(nhex=nhex)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        self._emit_cp_event(
+            "node_dead", "INFO" if reason == "drained" else "ERROR",
+            node=node_id.hex(), reason=reason)
         self._publish("node", {"event": "dead", "node_id": node_id})
         for aid in victims:
             self._on_actor_down(aid, f"node died: {reason}", clean=False)
@@ -1822,6 +2016,8 @@ class ControlPlane:
 
     def stop(self):
         self._stopped.set()
+        # conditional: a restarted CP may already own the sink
+        _events.clear_local_sink(self._event_sink)
         _metrics.stop_flusher(self._metrics_flusher, final=False)
         self._wake_scheduler()
         self._server.stop()

@@ -90,7 +90,7 @@ async function refresh() {
     sparkline(ts, "memory_percent_avg", "cluster mem %") +
     sparkline(ts, "logical_cpus_in_use", "logical CPUs in use") +
     sparkline(ts, "object_store_used_bytes", "object store bytes");
-  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks", "traces", "kvtier", "slo"];
+  const sections = ["nodes", "train", "serve", "autoscaler", "actors", "pgs", "jobs", "tasks", "traces", "kvtier", "slo", "events"];
   let html = "";
   for (const s of sections) {
     const rows = await (await fetch("/api/" + s)).json();
@@ -112,6 +112,13 @@ async function refresh() {
           if (s === "slo" && c === "request_id" && typeof r[c] === "string") {
             cell = "<a href='/slo/" + encodeURIComponent(r[c]) + "'>" +
                    cell + "</a>";
+          }
+          if (s === "events" &&
+              ["node", "deployment", "replica", "request_id"].includes(c) &&
+              typeof r[c] === "string") {
+            // per-entity drill-down: every event touching this entity
+            cell = "<a href='/events?entity=" + encodeURIComponent(r[c]) +
+                   "'>" + cell + "</a>";
           }
           return "<td>" + cell + "</td>";
         }).join("") + "</tr>";
@@ -456,6 +463,7 @@ class Dashboard:
         app.router.add_get("/trace/{trace_id}", self._trace_view)
         app.router.add_get("/api/slo/report", self._slo_report)
         app.router.add_get("/slo/{request_id}", self._slo_exemplar_view)
+        app.router.add_get("/events", self._events_view)
         app.router.add_get("/api/metrics/query", self._metrics_query)
         app.router.add_get("/api/metrics/series", self._metrics_series)
         app.router.add_get("/api/{section}", self._api)
@@ -577,6 +585,15 @@ class Dashboard:
                 # --exemplars` renders); request_id cells link to the
                 # per-request stage waterfall at /slo/<request_id>
                 return state.list_slo_exemplars(limit=100)
+            if section == "events":
+                # flight-recorder journal rows (same CP query `ray-tpu
+                # events` renders); entity cells link to the /events
+                # drill-down panel
+                return state.list_events(
+                    kind=request.query.get("kind"),
+                    severity=request.query.get("severity"),
+                    entity=request.query.get("entity"),
+                    limit=int(request.query.get("limit", "200")))
             if section == "kvtier":
                 # tiered-KV prefix index rows (same CP query `ray-tpu
                 # kvtier` renders); the generic section loop tables them.
@@ -725,6 +742,31 @@ class Dashboard:
         return web.Response(text=_render_waterfall(trace),
                             content_type="text/html")
 
+    async def _events_view(self, request):
+        """Flight-recorder panel: the journal filtered by
+        ?entity=/&kind=/&severity=, newest first, with per-entity
+        drill-down links (ISSUE 19)."""
+        from aiohttp import web
+
+        kind = request.query.get("kind")
+        severity = request.query.get("severity")
+        entity = request.query.get("entity")
+        loop = asyncio.get_event_loop()
+
+        def fetch():
+            from ray_tpu.util import state
+            try:
+                return state.list_events(kind=kind, severity=severity,
+                                         entity=entity, limit=500)
+            except Exception:  # noqa: BLE001 — CP down
+                return []
+
+        rows = await loop.run_in_executor(None, fetch)
+        return web.Response(
+            text=_render_events(rows, kind=kind, severity=severity,
+                                entity=entity),
+            content_type="text/html")
+
     async def _profile(self, request):
         """On-demand profiling. Default: repeatedly snapshot cluster (or
         one worker's) stacks for ``duration`` seconds and return collapsed
@@ -860,6 +902,49 @@ class Dashboard:
         apps, arts = await loop.run_in_executor(None, fetch)
         return web.Response(text=_render_profiling(apps, arts),
                             content_type="text/html")
+
+
+def _render_events(rows: list[dict], kind=None, severity=None,
+                   entity=None) -> str:
+    """HTML for the /events panel (same server-rendered idiom as the
+    profiling panel). Entity cells self-link so any event pivots to
+    that entity's full history."""
+    import html as _html
+    import time as _time
+
+    filt = " ".join(f"{k}={v}" for k, v in
+                    (("kind", kind), ("severity", severity),
+                     ("entity", entity)) if v)
+    head = (f"<h1>flight recorder</h1><p>{len(rows)} event(s)"
+            f"{' — filter: ' + _html.escape(filt) if filt else ''}"
+            f" · <a href='/events'>clear filters</a>"
+            f" · <a href='/'>dashboard</a></p>")
+    cols = ("ts", "severity", "kind", "node", "deployment", "replica",
+            "request_id", "reason", "attrs")
+    parts = [head, "<table border=1 cellspacing=0 cellpadding=3><tr>"]
+    parts.extend(f"<th>{c}</th>" for c in cols)
+    parts.append("</tr>")
+    for ev in rows:
+        parts.append("<tr>")
+        for c in cols:
+            v = ev.get(c)
+            if c == "ts" and v:
+                v = _time.strftime("%H:%M:%S",
+                                   _time.localtime(float(v))) \
+                    + f".{int(float(v) * 1000) % 1000:03d}"
+            cell = _html.escape("" if v is None else
+                                (json.dumps(v) if isinstance(v, dict)
+                                 else str(v)))
+            if c in ("node", "deployment", "replica", "request_id") \
+                    and ev.get(c):
+                from urllib.parse import quote
+                cell = (f"<a href='/events?entity={quote(str(ev[c]))}'>"
+                        f"{cell}</a>")
+            parts.append(f"<td>{cell}</td>")
+        parts.append("</tr>")
+    parts.append("</table>")
+    return ("<html><head><title>flight recorder</title></head><body>"
+            + "".join(parts) + "</body></html>")
 
 
 def _render_profiling(apps: list[dict], artifacts: list[dict]) -> str:

@@ -233,6 +233,17 @@ class EngineProfiler:
                 "mid-traffic compile: kind=%s sig=%s took %.2fs — every "
                 "active generation stalled for it (warm this program at "
                 "startup, see engine warmup_compile)", kind, sig, dt)
+            # off-box visibility (ISSUE 19): a WARNING journal event
+            # carrying the compile signature. Warmup compiles
+            # (mid_traffic=False) emit nothing — the regression test
+            # holds that line.
+            from ray_tpu.observability import events as _fr
+            _fr.emit("mid_traffic_compile", "WARNING",
+                     reason=kind,
+                     attrs={"kind": kind,
+                            "sig": list(sig) if isinstance(
+                                sig, (tuple, list)) else [str(sig)],
+                            "seconds": round(float(dt), 4)})
 
     # ---- memory accounting ---------------------------------------------
     def set_memory_layout(self, weights_bytes: int,

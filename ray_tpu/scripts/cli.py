@@ -523,6 +523,78 @@ def _print_exemplar_waterfall(rec: dict, spans: list) -> None:
               f"{dur_ms:>9.2f} ms  {note}")
 
 
+def _fmt_hms(ts: float) -> str:
+    import datetime
+    return datetime.datetime.fromtimestamp(
+        float(ts or 0.0)).strftime("%H:%M:%S.%f")[:-3]
+
+
+def _fmt_event_line(ev: dict) -> str:
+    ent = " ".join(f"{k}={ev[k]}" for k in
+                   ("node", "deployment", "replica", "request_id")
+                   if ev.get(k))
+    attrs = ev.get("attrs") or {}
+    note = " ".join(f"{k}={v}" for k, v in attrs.items())
+    reason = ev.get("reason") or ""
+    tail = " | ".join(x for x in (ent, reason, note) if x)
+    return (f"{_fmt_hms(ev.get('ts'))} {ev.get('severity', 'INFO'):<7} "
+            f"{ev.get('kind', '?'):<20} {tail}")
+
+
+def cmd_events(args) -> None:
+    """Flight recorder (ISSUE 19): tail the cluster event journal, or
+    render one postmortem incident timeline joining events + metric
+    spikes + SLO exemplars."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=_read_address(args.address))
+
+    if args.postmortem is not None:
+        pm = state.events_postmortem(window_s=args.postmortem)
+        if args.json:
+            print(json.dumps(pm, indent=2))
+            return
+        items = pm.get("items") or []
+        print(f"# postmortem window {pm.get('window_s')}s "
+              f"({_fmt_hms(pm.get('since'))} → {_fmt_hms(pm.get('until'))})"
+              f", {len(items)} item(s)", file=sys.stderr)
+        for it in items:
+            typ = it.get("type")
+            if typ == "event":
+                print("EV  " + _fmt_event_line(it))
+            elif typ == "exemplar":
+                print(f"SLO {_fmt_hms(it.get('ts'))} VIOLATION "
+                      f"request_id={it.get('request_id')} "
+                      f"deployment={it.get('deployment') or '-'} "
+                      f"violated={','.join(it.get('violated') or [])} "
+                      f"ttft_ms={it.get('ttft_ms')} "
+                      f"e2e_ms={it.get('e2e_ms')}")
+            elif typ == "metric":
+                tags = ",".join(it.get("tags") or [])
+                print(f"MET {_fmt_hms(it.get('ts'))} peak    "
+                      f"{it.get('name')}"
+                      f"{('{' + tags + '}') if tags else ''} "
+                      f"first={it.get('first')} peak={it.get('peak')} "
+                      f"last={it.get('last')} "
+                      f"points={it.get('points')} "
+                      f"source={it.get('source')}")
+        return
+
+    since = (time.time() - args.since) if args.since else None
+    rows = state.list_events(kind=args.kind, severity=args.severity,
+                             entity=args.entity, since=since,
+                             limit=args.tail)
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return
+    for ev in reversed(rows):  # store answers newest first; print in order
+        print(_fmt_event_line(ev))
+    print(f"# {len(rows)} event(s); `ray-tpu events --postmortem 300` "
+          f"joins the last 5 minutes against metrics + SLO exemplars",
+          file=sys.stderr)
+
+
 def _parse_tags(spec: str | None) -> dict | None:
     tags = _parse_labels(spec)
     return tags or None
@@ -726,6 +798,31 @@ def main(argv=None) -> None:
                          "instead of the text waterfall")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_slo)
+
+    sp = sub.add_parser(
+        "events",
+        help="flight recorder: tail the cluster event journal / render "
+             "a postmortem timeline (observability/events.py)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--tail", type=int, default=50, metavar="N",
+                    help="show the last N matching events (default 50)")
+    sp.add_argument("--since", type=float, default=None, metavar="SECONDS",
+                    help="only events from the last SECONDS")
+    sp.add_argument("--kind", default=None,
+                    help="filter by event kind (e.g. replica_death)")
+    sp.add_argument("--entity", default=None,
+                    help="substring match over node/deployment/replica/"
+                         "request id")
+    sp.add_argument("--severity", default=None,
+                    choices=("INFO", "WARNING", "ERROR"),
+                    help="minimum severity (WARNING hides INFO)")
+    sp.add_argument("--postmortem", type=float, default=None,
+                    metavar="WINDOW_S",
+                    help="render one ordered incident timeline for the "
+                         "trailing window: events + metric spikes + SLO "
+                         "exemplars")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser(
         "lint",

@@ -19,6 +19,7 @@ from typing import Any, Optional
 
 import ray_tpu
 from ray_tpu.exceptions import TaskError
+from ray_tpu.observability import events as _fr
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.replica import ServeReplica
 
@@ -843,6 +844,12 @@ class ServeController:
             "ts": time.time(), "from": int(prev), "to": int(new),
             "reason": reason, "signals": dict(signals or {})})
         del state.scale_decisions[:-50]
+        # full history rides the journal — the CP's severity-tiered
+        # store outlives the last-50 local window above (ISSUE 19)
+        _fr.emit("replica_scale", "INFO",
+                 deployment=state.full_name(), reason=reason,
+                 attrs={"from": int(prev), "to": int(new),
+                        "signals": dict(signals or {})})
 
     async def _pick_downscale_victim(self, state: _DeploymentState):
         """Coldest, least-loaded replica: fewest exported prefix-summary
@@ -952,6 +959,14 @@ class ServeController:
                             state.warm_stats["ms"] = round(
                                 state.warm_stats["ms"]
                                 + float(res.get("ms") or 0.0), 3)
+                            _fr.emit(
+                                "warm_start", "INFO",
+                                deployment=state.full_name(),
+                                replica=self._replica_key(r),
+                                attrs={
+                                    "pages": int(res.get("pages") or 0),
+                                    "chains": int(res.get("chains") or 0),
+                                    "ms": float(res.get("ms") or 0.0)})
                     done_set = {self._replica_key(r) for r in done}
                     state.warming = [
                         r for r in state.warming
@@ -959,6 +974,11 @@ class ServeController:
                     state.replicas.extend(done)
                     state.version += 1
                     self._notify_change()
+                    _fr.emit("table_publish", "INFO",
+                             deployment=state.full_name(),
+                             reason="warmed replicas promoted",
+                             attrs={"version": state.version,
+                                    "replicas": len(state.replicas)})
 
             # health: drop replicas only after `health_check_failure_threshold`
             # CONSECUTIVE failures (one transient miss must not cost a
@@ -985,6 +1005,10 @@ class ServeController:
                         alive.append(r)
                         continue
                     state.health_fails.pop(key, None)
+                    _fr.emit("replica_death", "ERROR",
+                             deployment=state.full_name(), replica=key,
+                             reason=f"{fails} consecutive failed "
+                                    "health checks")
                     try:
                         ray_tpu.kill(r)
                     except Exception:  # noqa: BLE001
@@ -993,6 +1017,11 @@ class ServeController:
                 state.replicas = alive
                 state.version += 1
                 self._notify_change()
+                _fr.emit("table_publish", "INFO",
+                         deployment=state.full_name(),
+                         reason="dead replicas removed",
+                         attrs={"version": state.version,
+                                "replicas": len(state.replicas)})
 
             # draining replicas are still routable, so they get the same
             # health policy — one that dies mid-drain must leave the table

@@ -34,6 +34,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ray_tpu.observability import events as _fr
 from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.tokenizer import get_tokenizer
 
@@ -709,6 +710,14 @@ class LLMEngine:
             self.stats["requests"] += 1
             if resume_len:
                 self.stats["failover_resumed"] += 1
+        if resume_len:
+            # a failed replica's stream is being spliced onto this one —
+            # journal it under the same request id so the postmortem
+            # timeline joins it against the chaos fault that caused it
+            _fr.emit("failover_resume", "WARNING",
+                     request_id=req.request_id,
+                     attrs={"resume_len": int(resume_len),
+                            "model": str(self.cfg.model_id)})
         self._wake.set()
         return req.request_id
 
@@ -1617,6 +1626,10 @@ class LLMEngine:
         if 0 < req.restore_pages < planned:
             self.stats["restore_partial"] += 1
             req.restore_partial = True
+            _fr.emit("restore_partial", "WARNING",
+                     request_id=req.request_id,
+                     attrs={"restored_pages": int(req.restore_pages),
+                            "planned_pages": int(planned)})
         if req.disagg:
             # fleet disagg (ISSUE 16): this restore carried a remote
             # prefill's KV — count the handoff and its wire/overlap

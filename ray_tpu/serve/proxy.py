@@ -34,6 +34,7 @@ from ray_tpu.core import deadline as request_deadline
 from ray_tpu.core.config import get_config
 from ray_tpu.exceptions import DeadlineExceededError, TaskError
 from ray_tpu.observability import attribution, tracing
+from ray_tpu.observability import events as _fr
 from ray_tpu.serve import affinity as _affinity
 from ray_tpu.serve.config import RouterConfig
 from ray_tpu.serve.router import Router, is_replica_fault
@@ -310,6 +311,18 @@ class HTTPProxy:
                 rate = pol.get("slo_sample_rate")
                 if random.random() >= (0.01 if rate is None else rate):
                     return
+            if violated:
+                # journal twin of the exemplar: joins the postmortem
+                # timeline by request/trace id (full timeline stays in
+                # the exemplar store — the event is the pointer)
+                _fr.emit("slo_violation", "WARNING",
+                         deployment=tl.deployment or None,
+                         replica=tl.replica or None,
+                         request_id=tl.request_id,
+                         trace_id=tl.trace_id or None,
+                         reason=",".join(violated),
+                         attrs={"ttft_ms": ttft_ms, "e2e_ms": e2e_ms,
+                                "error": error})
             attribution.ship_record(attribution.build_record(
                 tl, kind="violation" if violated else "baseline",
                 violated=violated,
@@ -342,6 +355,9 @@ class HTTPProxy:
                     (subpath, payload), {"_request_id": rid}))
         except Exception:  # noqa: BLE001 — no pool/replica: colocate
             self.stats["disagg_fallbacks"] += 1
+            _fr.emit("disagg_fallback", "WARNING",
+                     deployment=prefill_dep, request_id=rid,
+                     reason="no prefill replica assignable")
             return None
         try:
             timeout = min(120.0, max(0.001, dl - time.time()))
@@ -353,6 +369,10 @@ class HTTPProxy:
                 # breaker decode replicas answer to
                 router.record_replica_fault(prefill_dep, pre_replica)
             self.stats["disagg_fallbacks"] += 1
+            _fr.emit("disagg_fallback", "WARNING",
+                     deployment=prefill_dep, request_id=rid,
+                     reason="prefill leg failed",
+                     attrs={"replica_fault": is_replica_fault(e)})
             return None
         self.stats["disagg_prefills"] += 1
         if tl is not None:
@@ -759,6 +779,17 @@ class HTTPProxy:
                     gen = iter(new_ref)
                     failover_at = t_fault
                     self.stats["stream_resumes"] += 1
+                    # the splice view of the failover: which deployment,
+                    # which survivor, which attempt. The target engine
+                    # emits its own failover_resume under the same
+                    # request id — the journal joins them.
+                    _fr.emit("failover_resume", "WARNING",
+                             deployment=resume_ctx["deployment"],
+                             replica=str(new_replica),
+                             request_id=(tl.request_id
+                                         if tl is not None else None),
+                             reason="mid-stream splice",
+                             attrs={"attempt": resumes})
                     if sp is not None:
                         sp["attrs"]["stream_resumes"] = resumes
                     await resp.write(

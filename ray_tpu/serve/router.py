@@ -30,6 +30,7 @@ from typing import Optional
 import ray_tpu
 from ray_tpu.core import deadline as request_deadline
 from ray_tpu.observability import attribution
+from ray_tpu.observability import events as _fr
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 DeadlineExceededError, GetTimeoutError,
@@ -208,6 +209,7 @@ class ReplicaSet:
         failover redispatch must land on a SURVIVOR on the first try, not
         spend a retry-budget token rediscovering the corpse."""
         key = self._key(replica)
+        ejected = False
         with self._cb_lock:
             n = self._fails.get(key, 0) + 1
             self._fails[key] = n
@@ -216,8 +218,16 @@ class ReplicaSet:
                     and key not in self._ejected:
                 self._ejected[key] = time.monotonic()
                 self.ejections += 1
-                return True
-        return False
+                ejected = True
+        if ejected:
+            # journal outside the breaker lock — emit is a queue push,
+            # but nothing on the routing path waits on it
+            _fr.emit("replica_ejected", "WARNING",
+                     deployment=self.name, replica=key,
+                     reason=f"{n} consecutive replica faults",
+                     attrs={"threshold":
+                            int(self.config.ejection_threshold)})
+        return ejected
 
     def _routable(self) -> list:
         """(replica, key) pairs not currently ejected; cooled-down ejectees
@@ -253,6 +263,9 @@ class ReplicaSet:
                 else:
                     self._ejected[key] = time.monotonic()  # re-arm cooldown
             if ok:
+                _fr.emit("replica_readmitted", "INFO",
+                         deployment=self.name, replica=key,
+                         reason="health probe passed after cooldown")
                 out.append((r, key))
         return out
 

@@ -355,6 +355,7 @@ class FaultSchedule:
             if self._stop.is_set():
                 return
             entry = {"t": offset, "kind": kind}
+            t_inject = time.time()
             try:
                 entry["detail"] = getattr(self, "_do_" + kind)(
                     dict(kw or {}))
@@ -363,6 +364,19 @@ class FaultSchedule:
                 entry["detail"] = repr(e)
                 entry["ok"] = False
             self.report.append(entry)
+            # ground-truth journal event (ISSUE 19): every injected fault
+            # is on the record, stamped at INJECTION time so its symptom
+            # events (replica_death/node_dead/...) sort after it. Emitted
+            # AFTER the injection returns — a cp_restart's event must land
+            # in the restarted CP, and the flusher backlog carries it
+            # across any outage window either way.
+            from ray_tpu.observability import events as _fr
+            _fr.emit("chaos_fault",
+                     "WARNING" if entry["ok"] else "ERROR",
+                     reason=kind, ts=t_inject,
+                     attrs={"kind": kind, "kwargs": dict(kw or {}),
+                            "ok": entry["ok"],
+                            "detail": str(entry["detail"])[:500]})
 
     def start(self) -> "FaultSchedule":
         if self._thread is None:

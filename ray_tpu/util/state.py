@@ -352,6 +352,43 @@ def get_slo_exemplar(request_id: str) -> Optional[dict]:
                       timeout=10.0)
 
 
+def list_events(kind: Optional[str] = None,
+                severity: Optional[str] = None,
+                entity: Optional[str] = None,
+                since: Optional[float] = None,
+                until: Optional[float] = None,
+                limit: int = 100) -> list[dict]:
+    """Flight-recorder journal (observability/events.py), newest first.
+    `kind` filters exactly, `severity` is a minimum (WARNING hides
+    INFO), `entity` substring-matches node/deployment/replica/request
+    id/source, `since`/`until` are unix timestamps. The `ray-tpu events`
+    CLI and the dashboard events panel render this."""
+    body: dict[str, Any] = {"limit": limit}
+    if kind:
+        body["kind"] = kind
+    if severity:
+        body["severity"] = severity
+    if entity:
+        body["entity"] = entity
+    if since is not None:
+        body["since"] = since
+    if until is not None:
+        body["until"] = until
+    return _cp().call("list_events", body, timeout=10.0) or []
+
+
+def events_postmortem(window_s: float = 300.0,
+                      until: Optional[float] = None) -> dict:
+    """One ordered incident timeline for the trailing window: journal
+    events + SLO-violation exemplars + per-series metric spike
+    summaries, merged by timestamp (`ray-tpu events --postmortem`)."""
+    body: dict[str, Any] = {"window_s": window_s}
+    if until is not None:
+        body["until"] = until
+    return _cp().call("events_postmortem", body, timeout=15.0) or {
+        "since": 0.0, "until": 0.0, "window_s": window_s, "items": []}
+
+
 def kv_tier_gc() -> dict:
     """Drop expired kv_tier index entries (owners retract their own on
     demotion/shutdown; this sweeps entries whose owner is wedged).

@@ -1,0 +1,579 @@
+"""Cluster flight recorder tests (ISSUE 19).
+
+Pins the journal's acceptance invariants:
+
+- taxonomy is closed: unknown kinds/severities are rejected at
+  `make_event` and dropped record-by-record at the CP accept filter;
+- the CP store is bounded with SEVERITY-TIERED retention — past
+  `events_max_records` old INFOs downsample first, non-ERRORs evict
+  next, and ERRORs go last (an incident's interesting tail outlives
+  the routine chatter);
+- the EventFlusher keeps the acknowledged-batch contract (ISSUE 4/8):
+  a CP outage buffers payloads with their ORIGINAL timestamps,
+  recovery delivers oldest-first, the buffer is bounded with
+  oldest-first eviction, and a mid-drain failure re-queues the unsent
+  suffix in order;
+- query filters: kind exact, severity MINIMUM (WARNING hides INFO),
+  entity substring over node/deployment/replica/request_id/source,
+  since/until, newest-first;
+- emitter round-trips: controller scale decisions (full history in the
+  journal, `detailed_status` keeps its backward-compatible last-10
+  window), router ejection/readmission, chaos fault ground truth,
+  engine failover resume, mid-traffic-compile WARNING (and the warmup
+  regression: pre-traffic compiles emit NOTHING);
+- `events_postmortem` joins events + SLO exemplars + metric spike
+  summaries into one timestamp-ordered timeline;
+- README taxonomy table drift-guarded both directions.
+"""
+
+import os
+import re
+import time
+import types
+
+import pytest
+
+import ray_tpu
+from ray_tpu.observability import events
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# event construction: closed taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_make_event_taxonomy_closed():
+    ev = events.make_event(
+        "replica_ejected", "WARNING", node="n1", deployment="app#D",
+        replica="r9", request_id="req-1", trace_id="t-1",
+        reason="3 consecutive faults", attrs={"threshold": 3}, ts=123.5)
+    assert ev["ts"] == 123.5 and ev["kind"] == "replica_ejected"
+    assert ev["severity"] == "WARNING"
+    assert ev["deployment"] == "app#D" and ev["replica"] == "r9"
+    assert ev["request_id"] == "req-1" and ev["trace_id"] == "t-1"
+    assert ev["attrs"] == {"threshold": 3}
+
+    # None fields are OMITTED, not serialized as nulls
+    lean = events.make_event("warm_start")
+    assert set(lean) == {"ts", "kind", "severity"}
+
+    with pytest.raises(ValueError, match="unknown event kind"):
+        events.make_event("made_up_kind")
+    with pytest.raises(ValueError, match="unknown severity"):
+        events.make_event("warm_start", "FATAL")
+
+    # emit() swallows the malformed case (a bad emit site must not 500
+    # a request path) and honors the kill switch
+    assert events.emit("made_up_kind") is None
+
+
+def test_emit_routes_to_local_sink_and_respects_kill_switch(monkeypatch):
+    from ray_tpu.core.config import get_config
+
+    cap = []
+    events.set_local_sink(cap.append)
+    try:
+        ev = events.emit("table_publish", "INFO", reason="unit")
+        assert ev is not None and cap and cap[-1]["kind"] == "table_publish"
+
+        monkeypatch.setattr(get_config(), "events_enabled", False)
+        assert events.emit("table_publish") is None
+        assert len(cap) == 1  # nothing new landed
+    finally:
+        events.clear_local_sink()
+
+
+# ---------------------------------------------------------------------------
+# flusher: acknowledged batches, outage backlog, bounded buffer
+# ---------------------------------------------------------------------------
+
+
+def test_flusher_backlog_across_send_outage(monkeypatch):
+    """A CP outage must not tear a hole in the journal: every payload
+    buffers with its ORIGINAL timestamps and delivers oldest-first on
+    recovery; the buffer is bounded by `events_flush_buffer_max` with
+    oldest-first eviction (counted in `dropped`)."""
+    from ray_tpu.core.config import get_config
+
+    sent, down = [], [True]
+
+    def send(payload):
+        if down[0]:
+            raise ConnectionError("cp down")
+        sent.append(payload)
+
+    f = events.EventFlusher(send, source="unit", interval_s=999.0)
+    for i in range(5):
+        f.emit(events.make_event("warm_start", ts=float(i)))
+        f.flush()
+    assert sent == [] and len(f._backlog) == 5
+
+    down[0] = False
+    f.flush()
+    assert len(sent) == 5 and not f._backlog
+    got = [p["events"][0]["ts"] for p in sent]
+    assert got == [0.0, 1.0, 2.0, 3.0, 4.0]  # original ts, oldest first
+    assert f.shipped == 5 and f.dropped == 0
+
+    # bounded: oldest payloads evicted past the cap, eviction counted
+    monkeypatch.setattr(get_config(), "events_flush_buffer_max", 3)
+    down[0] = True
+    for i in range(6):
+        f.emit(events.make_event("warm_start", ts=10.0 + i))
+        f.flush()
+    assert len(f._backlog) == 3
+    down[0] = False
+    f.flush()
+    assert not f._backlog
+    kept = sent[5:]
+    assert [p["events"][0]["ts"] for p in kept] == [13.0, 14.0, 15.0]
+    assert f.dropped == 3
+    f.stop(final=True)
+
+
+def test_flusher_midstream_failure_preserves_order():
+    """A failure partway through a multi-payload drain stops the send
+    (later payloads would arrive out of order) and re-queues the unsent
+    suffix AHEAD of anything enqueued meanwhile."""
+    sent, fail_at = [], [1.0]
+
+    def send(payload):
+        if payload["events"][0]["ts"] == fail_at[0]:
+            raise ConnectionError("flaky")
+        sent.append(payload)
+
+    f = events.EventFlusher(send, source="unit", interval_s=999.0)
+    for i in range(3):
+        f.emit(events.make_event("warm_start", ts=float(i)))
+        # force one payload per event: flush while the send for ts==1.0
+        # fails leaves [1.0, 2.0] queued after shipping [0.0]
+        f.flush()
+    assert [p["events"][0]["ts"] for p in sent] == [0.0]
+    assert [p["events"][0]["ts"] for p in f._backlog] == [1.0, 2.0]
+
+    fail_at[0] = -1.0
+    f.emit(events.make_event("warm_start", ts=3.0))
+    f.flush()
+    assert [p["events"][0]["ts"] for p in sent] == [0.0, 1.0, 2.0, 3.0]
+    f.stop(final=True)
+
+
+# ---------------------------------------------------------------------------
+# CP store: accept filter, tiered retention, query filters, postmortem
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cp():
+    ray_tpu.shutdown()
+    from ray_tpu.core.control_plane import ControlPlane
+
+    c = ControlPlane(port=0)
+    try:
+        yield c
+    finally:
+        c.stop()
+        events.clear_local_sink()
+
+
+def _batch(cp_inst, evs, source="w-test"):
+    return cp_inst._h_report_events({"source": source, "ts": time.time(),
+                                     "events": evs})
+
+
+def test_store_accepts_taxonomy_rejects_garbage(cp):
+    # the CP's own restart marker is already on the record
+    marks = [e for e in cp._events if e["kind"] == "cp_restart"]
+    assert marks and marks[0]["severity"] == "WARNING"
+    assert marks[0]["attrs"]["epoch"] == cp._epoch
+
+    r = _batch(cp, [events.make_event("warm_start"),
+                    {"kind": "not_a_kind", "ts": 1.0},
+                    "not even a dict",
+                    events.make_event("slo_violation", "WARNING")])
+    assert r["ok"] and r["accepted"] == 2  # bad records drop, batch acks
+    assert all(e["kind"] in events.KINDS for e in cp._events)
+    # worker-shipped events are source-stamped for entity queries
+    assert [e for e in cp._events
+            if e.get("source") == "w-test"][0]["kind"] == "warm_start"
+
+    assert _batch(cp, "nope") == {"ok": False, "error": "malformed batch"}
+
+    # a retracted worker's late batches are rejected whole, like late
+    # metric flushes
+    with cp._lock:
+        cp._dead_workers.add("w-dead")
+    r = _batch(cp, [events.make_event("warm_start")], source="w-dead")
+    assert r == {"ok": False, "error": "source retracted"}
+
+
+def test_store_severity_tiered_retention(cp, monkeypatch):
+    from ray_tpu.core.config import get_config
+
+    monkeypatch.setattr(get_config(), "events_max_records", 40)
+    with cp._lock:
+        del cp._events[:]  # drop the restart marker for exact accounting
+
+    evs = []
+    for i in range(200):
+        sev = "ERROR" if i % 20 == 0 else "INFO"   # 10 ERRORs in the flood
+        evs.append(events.make_event("warm_start", sev, ts=float(i),
+                                     reason=f"n{i}"))
+    _batch(cp, evs)
+
+    with cp._lock:
+        kept = list(cp._events)
+    assert len(kept) <= 40
+    errors = [e for e in kept if e["severity"] == "ERROR"]
+    assert len(errors) == 10, "tiered retention must keep every ERROR"
+    # the fresh tail survives downsampling (newest INFO still present)
+    assert any(e["reason"] == "n199" for e in kept)
+    # order is preserved through the trim
+    tss = [e["ts"] for e in kept]
+    assert tss == sorted(tss)
+
+    # ERRORs are not immortal: an all-ERROR flood still hard-bounds
+    _batch(cp, [events.make_event("node_dead", "ERROR", ts=1000.0 + i)
+                for i in range(100)])
+    with cp._lock:
+        assert len(cp._events) <= 40
+
+
+def test_list_events_filters(cp):
+    with cp._lock:
+        del cp._events[:]
+    t0 = 1000.0
+    _batch(cp, [
+        events.make_event("replica_scale", "INFO", ts=t0 + 1,
+                          deployment="app#Echo"),
+        events.make_event("replica_ejected", "WARNING", ts=t0 + 2,
+                          deployment="app#Echo", replica="r1"),
+        events.make_event("node_dead", "ERROR", ts=t0 + 3, node="nodeA"),
+        events.make_event("slo_violation", "WARNING", ts=t0 + 4,
+                          request_id="req-42"),
+    ])
+
+    # newest first, full journal
+    kinds = [e["kind"] for e in cp._h_list_events({})]
+    assert kinds == ["slo_violation", "node_dead", "replica_ejected",
+                     "replica_scale"]
+
+    # kind is exact
+    assert [e["kind"] for e in cp._h_list_events({"kind": "node_dead"})] \
+        == ["node_dead"]
+
+    # severity is a MINIMUM: WARNING hides INFO, keeps ERROR
+    sevs = {e["severity"]
+            for e in cp._h_list_events({"severity": "WARNING"})}
+    assert sevs == {"WARNING", "ERROR"}
+    assert len(cp._h_list_events({"severity": "ERROR"})) == 1
+
+    # entity is a substring across node/deployment/replica/request_id
+    assert len(cp._h_list_events({"entity": "app#Echo"})) == 2
+    assert [e["node"] for e in cp._h_list_events({"entity": "nodeA"})] \
+        == ["nodeA"]
+    assert [e["request_id"]
+            for e in cp._h_list_events({"entity": "req-42"})] == ["req-42"]
+
+    # time range + limit
+    mid = cp._h_list_events({"since": t0 + 2, "until": t0 + 3})
+    assert [e["kind"] for e in mid] == ["node_dead", "replica_ejected"]
+    assert len(cp._h_list_events({"limit": 2})) == 2
+
+
+def test_postmortem_joins_and_orders_all_sources(cp):
+    """One timeline: journal events + SLO-violation exemplars + metric
+    spike summaries, merged and sorted by timestamp."""
+    with cp._lock:
+        del cp._events[:]
+    t0 = time.time() - 50.0
+    _batch(cp, [
+        events.make_event("chaos_fault", "WARNING", ts=t0 + 1,
+                          reason="worker_kill"),
+        events.make_event("replica_death", "ERROR", ts=t0 + 5,
+                          deployment="app#Echo"),
+    ])
+    cp._h_report_slo_exemplar({"record": {
+        "request_id": "pm-1", "kind": "violation", "ts": t0 + 3,
+        "deployment": "app#Echo", "replica": "r1",
+        "violated": ["ttft_p99_ms"], "ttft_ms": 900.0, "e2e_ms": 1200.0}})
+    # a sampled non-violation exemplar must NOT pollute the timeline
+    cp._h_report_slo_exemplar({"record": {
+        "request_id": "pm-2", "kind": "sample", "ts": t0 + 3.5}})
+    cp._h_metrics_report({
+        "source": "w1", "ts": t0 + 2,
+        "metrics": [{"name": "pm_queue_depth", "kind": "gauge",
+                     "tag_keys": [],
+                     "series": [{"tags": [], "value": 1.0}]}]})
+    cp._h_metrics_report({
+        "source": "w1", "ts": t0 + 4,
+        "metrics": [{"name": "pm_queue_depth", "kind": "gauge",
+                     "tag_keys": [],
+                     "series": [{"tags": [], "value": 9.0}]}]})
+
+    pm = cp._h_events_postmortem({"window_s": 60.0, "until": t0 + 10})
+    assert pm["window_s"] == 60.0
+    items = pm["items"]
+    tss = [it["ts"] for it in items]
+    assert tss == sorted(tss), "postmortem timeline must be ts-ordered"
+
+    by_type = {}
+    for it in items:
+        by_type.setdefault(it["type"], []).append(it)
+    assert [e["kind"] for e in by_type["event"]] \
+        == ["chaos_fault", "replica_death"]
+    assert [x["request_id"] for x in by_type["exemplar"]] == ["pm-1"]
+    spikes = [m for m in by_type["metric"] if m["name"] == "pm_queue_depth"]
+    assert spikes and spikes[0]["peak"] == 9.0 \
+        and spikes[0]["ts"] == pytest.approx(t0 + 4)
+    # interleave check: fault < metric-spike? no — spike ts is the peak
+    # (t0+4), exemplar at t0+3, death at t0+5: fault first, death last
+    assert items[0]["type"] == "event" \
+        and items[0]["kind"] == "chaos_fault"
+    assert items[-1]["kind"] == "replica_death"
+
+
+# ---------------------------------------------------------------------------
+# emitter round-trips (local sink capture — no cluster)
+# ---------------------------------------------------------------------------
+
+
+class _FakeActorId:
+    def __init__(self, h):
+        self._h = h
+
+    def hex(self):
+        return self._h
+
+
+class _FakeReplica:
+    def __init__(self, key):
+        self._actor_id = _FakeActorId(key)
+        self.check_health = types.SimpleNamespace(remote=lambda: object())
+
+
+def test_router_ejection_and_readmission_events(monkeypatch):
+    from ray_tpu.serve import router as rmod
+
+    cap = []
+    events.set_local_sink(cap.append)
+    try:
+        cfg = rmod.RouterConfig(ejection_threshold=2,
+                                ejection_cooldown_s=0.0)
+        rs = rmod.ReplicaSet(cfg, name="app#Echo")
+        r = _FakeReplica("replica-abc")
+        rs.update([r], version=1)
+
+        assert rs.record_failure(r) is False
+        assert not [e for e in cap if e["kind"] == "replica_ejected"]
+        assert rs.record_failure(r) is True
+        ej = [e for e in cap if e["kind"] == "replica_ejected"]
+        assert len(ej) == 1 and ej[0]["severity"] == "WARNING"
+        assert ej[0]["deployment"] == "app#Echo"
+        assert ej[0]["replica"] == "replica-abc"
+        assert ej[0]["attrs"]["threshold"] == 2
+
+        # cooldown elapsed (0s) + passing health probe -> readmitted
+        monkeypatch.setattr(rmod.ray_tpu, "get", lambda *a, **k: True)
+        routable = rs._routable()
+        assert [k for _, k in routable] == ["replica-abc"]
+        re_ev = [e for e in cap if e["kind"] == "replica_readmitted"]
+        assert len(re_ev) == 1 and re_ev[0]["severity"] == "INFO"
+        assert re_ev[0]["replica"] == "replica-abc"
+    finally:
+        events.clear_local_sink()
+
+
+@pytest.mark.slow  # tier-1 guard: chaos-harness tests sit outside tier-1
+def test_chaos_faults_land_in_journal(monkeypatch):
+    """Every injected fault is on the record — stamped at INJECTION time
+    (symptoms sort after it), severity tracking the injection outcome.
+    Runs in the --chaos-suite / --fleet preflights (no mark filter)."""
+    from ray_tpu.util import chaos
+
+    cap = []
+    events.set_local_sink(cap.append)
+    try:
+        sched = chaos.FaultSchedule(None, [(0.0, "worker_kill", {}),
+                                           (0.0, "cp_restart",
+                                            {"down_s": 0.1})])
+        monkeypatch.setattr(chaos.FaultSchedule, "_do_worker_kill",
+                            lambda self, kw: "killed w1")
+
+        def boom(self, kw):
+            raise RuntimeError("no cp to restart")
+        monkeypatch.setattr(chaos.FaultSchedule, "_do_cp_restart", boom)
+
+        t_before = time.time()
+        sched._loop()          # offsets are 0: runs synchronously
+        t_after = time.time()
+
+        faults = [e for e in cap if e["kind"] == "chaos_fault"]
+        assert len(faults) == 2
+        ok, bad = faults
+        assert ok["severity"] == "WARNING" and ok["attrs"]["ok"] is True
+        assert ok["attrs"]["kind"] == "worker_kill"
+        assert ok["attrs"]["detail"] == "killed w1"
+        assert t_before <= ok["ts"] <= t_after
+        assert bad["severity"] == "ERROR" and bad["attrs"]["ok"] is False
+        assert "no cp to restart" in bad["attrs"]["detail"]
+        # and the schedule's own report stayed intact
+        assert [r["ok"] for r in sched.report] == [True, False]
+    finally:
+        events.clear_local_sink()
+
+
+def test_mid_traffic_compile_event_and_warmup_regression():
+    """Satellite 3: a compile AFTER traffic started emits one WARNING
+    carrying the jit signature; warmup compiles (mid_traffic=False) emit
+    NOTHING — the warmed-fleet journal stays quiet."""
+    from ray_tpu.observability.profiling import EngineProfiler
+
+    cap = []
+    events.set_local_sink(cap.append)
+    try:
+        prof = EngineProfiler(enabled=True)
+        # warmup: three signatures compiled before any request
+        for sig in (("decode", 8, 0), ("prefill", 32), ("verify", 8, 2)):
+            prof._record_compile(sig[0], sig, 0.3, mid_traffic=False)
+        assert prof.compile_events == 3 and prof.mid_traffic_compiles == 0
+        assert not [e for e in cap if e["kind"] == "mid_traffic_compile"]
+
+        prof._record_compile("decode", ("decode", 16, 0), 0.7,
+                             mid_traffic=True)
+        evs = [e for e in cap if e["kind"] == "mid_traffic_compile"]
+        assert len(evs) == 1 and evs[0]["severity"] == "WARNING"
+        assert evs[0]["attrs"]["sig"] == ["decode", 16, 0]
+        assert evs[0]["attrs"]["kind"] == "decode"
+        assert evs[0]["attrs"]["seconds"] == pytest.approx(0.7)
+
+        # duplicate signature: already seen, no second event
+        prof._record_compile("decode", ("decode", 16, 0), 0.7,
+                             mid_traffic=True)
+        assert len([e for e in cap
+                    if e["kind"] == "mid_traffic_compile"]) == 1
+    finally:
+        events.clear_local_sink()
+
+
+def test_engine_continuation_emits_failover_resume():
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig, LLMEngine
+
+    cap = []
+    events.set_local_sink(cap.append)
+    eng = LLMEngine(LLMConfig(
+        model_config=llama.llama_tiny(vocab_size=512), max_batch_size=2,
+        page_size=16, num_pages=64, max_prompt_len=96, max_seq_len=160,
+        max_tokens=8), rng_seed=0)
+    eng.start()
+    try:
+        rid = eng.submit("the quick brown fox", max_tokens=2,
+                         temperature=0.0)
+        eng.result(rid, timeout=180.0)
+        assert not [e for e in cap if e["kind"] == "failover_resume"], \
+            "a fresh (non-resume) submit must not journal a resume"
+
+        rid = eng.submit("the quick brown fox", resume_tokens=[5, 6, 7],
+                         max_tokens=2, temperature=0.0)
+        evs = [e for e in cap if e["kind"] == "failover_resume"]
+        assert len(evs) == 1 and evs[0]["severity"] == "WARNING"
+        assert evs[0]["request_id"] == rid
+        assert evs[0]["attrs"]["resume_len"] == 3
+        eng.result(rid, timeout=180.0)
+    finally:
+        eng.shutdown()
+        events.clear_local_sink()
+
+
+# ---------------------------------------------------------------------------
+# controller round-trip: journal outlives the local scale-decision window
+# ---------------------------------------------------------------------------
+
+
+def test_controller_scale_journal_and_detailed_status_compat():
+    """Satellite 1: every scale decision rides the journal (full history,
+    CP-tiered) while `detailed_status` keeps its backward-compatible
+    bounded `scale_decisions` window — both surfaces asserted."""
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import get_or_create_controller
+    from ray_tpu.util import state
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, _system_config={
+        "events_flush_interval_s": 0.2,
+        "health_check_period_s": 0.5,
+    })
+    try:
+        @serve.deployment(num_replicas=1)
+        class Echo:
+            def __call__(self, x):
+                return x
+
+        serve.run(Echo.bind(), name="ev-scale", route_prefix="/ev-scale")
+        ctl = get_or_create_controller()
+        flips = 56  # > the controller's local last-50 window
+        for i in range(flips):
+            ray_tpu.get(ctl.set_target_replicas.remote(
+                "ev-scale", target=2 if i % 2 == 0 else 1,
+                reason=f"flip-{i}"), timeout=30.0)
+
+        # journal (controller -> flusher -> CP) holds MORE than the
+        # local window: the flight recorder is the full history
+        _wait(lambda: len(state.list_events(
+            kind="replica_scale", entity="ev-scale", limit=500)) > 50,
+            timeout=30.0, msg="journal to outgrow the last-50 window")
+        journal = state.list_events(kind="replica_scale",
+                                    entity="ev-scale", limit=500)
+        assert all(e["severity"] == "INFO" for e in journal)
+        reasons = {e["reason"] for e in journal}
+        assert {"flip-0", f"flip-{flips - 1}"} <= reasons
+        ev = journal[0]
+        assert ev["deployment"] == "ev-scale#Echo"
+        assert set(ev["attrs"]) >= {"from", "to", "signals"}
+
+        # detailed_status shape is unchanged: bounded list, same keys
+        det = ray_tpu.get(ctl.detailed_status.remote(),
+                          timeout=30.0)["ev-scale#Echo"]
+        dec = det["scale_decisions"]
+        assert isinstance(dec, list) and 0 < len(dec) <= 10
+        for d in dec:
+            assert set(d) == {"ts", "from", "to", "reason", "signals"}
+        assert det["scale_counters"].get(f"flip-{flips - 1}") == 1
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# README drift guard
+# ---------------------------------------------------------------------------
+
+
+def test_readme_taxonomy_table_matches_kinds():
+    """Every kind in events.KINDS is documented in the README flight
+    recorder table, and every documented kind exists — both directions."""
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    section = readme.split("### Flight recorder (`ray-tpu events`)")[1]
+    table = section.split("\n## ")[0]
+    documented = set()
+    for row in re.findall(r"^\|([^|]+)\|", table, flags=re.M):
+        documented.update(re.findall(r"`([a-z0-9_]+)`", row))
+
+    live = set(events.KINDS)
+    missing_docs = live - documented
+    assert not missing_docs, \
+        f"event kinds missing from README table: {sorted(missing_docs)}"
+    stale_docs = documented - live
+    assert not stale_docs, \
+        f"README documents kinds events.py no longer has: {sorted(stale_docs)}"
