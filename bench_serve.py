@@ -1191,6 +1191,169 @@ def _run_fleet_disagg(args):
         json.dump(merged, f)
 
 
+def _run_tp_ab(args):
+    """--tp-ab: tensor-parallel serving A/B (ISSUE 20).
+
+    In-process TP=1 vs TP=2 engine pair on the deeper cpu-tiny model
+    (heads/ffn/vocab all divide 2), full serving stack on — prefix
+    cache, speculative decoding, kv-tier spill/restore (lossless). Each
+    arm also brings up a COLD same-degree replica B that restores arm
+    A's spilled shared prefix through the tier, so the TP=2 leg drives
+    the per-shard blob wire end to end.
+
+    HARD asserts: greedy completions identical across TP=1 A, TP=2 A,
+    and TP=2 B-after-sharded-restore (the lossless-path bit-identity
+    acceptance criterion); TP=2 must actually spill mode="shards"
+    payloads and B must restore pages. Reports decode throughput and
+    restore wall time per arm; merges into --out under extra.tp.
+
+    Off-TPU the arm forces 2 virtual host CPU devices (the same
+    XLA_FLAGS mechanism tests/conftest.py uses) so the sharded programs
+    are genuinely partitioned.
+    """
+    import dataclasses as _dc
+    import glob as _glob
+    import os
+
+    if not os.environ.get("JAX_PLATFORMS") and \
+            not _glob.glob("/dev/accel*") and not _glob.glob("/dev/vfio/*"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig, LLMEngine
+
+    if len(jax.devices()) < 2:
+        raise SystemExit(
+            f"--tp-ab needs 2 devices, have {len(jax.devices())} "
+            f"(off-TPU it forces 2 virtual host devices — is XLA_FLAGS "
+            f"overridden?)")
+
+    tp_cfg = LLMConfig(
+        model_id="llama-tiny-d256",
+        model_config=llama.llama_tiny(
+            vocab_size=2048, dim=256, n_layers=4, n_heads=8,
+            n_kv_heads=4, ffn_dim=1024),
+        max_batch_size=4, page_size=32, num_pages=128,
+        max_prompt_len=704, max_seq_len=768, max_tokens=16,
+        warmup_compile=True, prefix_cache_max_pages=2,
+        kv_tier_enabled=True, spec_decode_enabled=True)
+    shared = "shared context " * 40             # 600 tokens ~ 18 pages
+    prompts = [shared + f"Q{i}: " for i in range(4)]
+
+    def run_prompts(eng):
+        comps, restores = [], []
+        t0 = time.monotonic()
+        toks = 0
+        for p in prompts:
+            out = eng.generate(p, max_tokens=16, temperature=0.0)
+            if out["error"]:
+                raise SystemExit(f"tp A/B request failed: {out['error']}")
+            comps.append((out["text"], len(out["tokens"])))
+            toks += len(out["tokens"])
+            restores += [s["attrs"] for s in out.get("stages") or ()
+                         if s["stage"] == "restore"]
+        return comps, toks / (time.monotonic() - t0), restores
+
+    def arm(tp: int) -> dict:
+        cfg = _dc.replace(tp_cfg, tp_degree=tp)
+        a = LLMEngine(cfg, rng_seed=0)
+        a.start()
+        b = None
+        try:
+            a_comps, a_tps, _ = run_prompts(a)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and \
+                    a.engine_stats()["spilled_pages"] < 1:
+                time.sleep(0.05)
+            a_st = a.engine_stats()
+            if a_st["spilled_pages"] < 1:
+                raise SystemExit(f"tp A/B [tp={tp}]: replica A spilled "
+                                 f"nothing — not benchmarking it")
+            if tp > 1:
+                # the acceptance criterion's wire shape: per-shard
+                # payloads under the unchanged chain digests
+                for rec in a._kv_tier._blobs.values():
+                    for ek, _ev in rec["data"]["pages"]:
+                        if ek.get("mode") != "shards" or \
+                                len(ek["shards"]) != tp:
+                            raise SystemExit(
+                                f"tp A/B [tp={tp}]: spilled payload is "
+                                f"not split per shard: {ek.get('mode')}")
+            b = LLMEngine(cfg, rng_seed=0)
+            b.start()
+            b_comps, _b_tps, b_restores = run_prompts(b)
+            b_st = b.engine_stats()
+        finally:
+            a.shutdown()
+            if b is not None:
+                b.shutdown()
+        if b_st["restored_pages"] < 1:
+            raise SystemExit(f"tp A/B [tp={tp}]: cold replica B restored "
+                             f"nothing — the sharded tier path is inert")
+        n_r = max(1, len(b_restores))
+        return {
+            "tp_degree": tp,
+            "mesh_shape": a_st["mesh_shape"],
+            "a_completions": a_comps, "b_completions": b_comps,
+            "gen_tokens_per_s_a": round(a_tps, 1),
+            "spilled_pages_a": a_st["spilled_pages"],
+            "restored_pages_b": b_st["restored_pages"],
+            "restore_partial_b": b_st["restore_partial"],
+            "spec_rounds_a": a_st["spec_rounds"],
+            "kv_shard_pool_bytes": a_st["kv_shard_pool_bytes"],
+            "restore_ms_mean_b": round(sum(
+                r["restore_ms"] for r in b_restores) / n_r, 2),
+        }
+
+    one = arm(1)
+    two = arm(2)
+    identical = (one["a_completions"] == two["a_completions"]
+                 == two["b_completions"] == one["b_completions"])
+    tp_res = {
+        "label": "tp_shard_ab",
+        "model": tp_cfg.model_id,
+        "env": ("cpu-tiny" if os.environ.get(
+            "JAX_PLATFORMS", "").startswith("cpu") else "tpu"),
+        "requests": len(prompts),
+        "shared_prefix_tokens": len(shared),
+        "greedy_identical": identical,
+        "decode_speedup": round(
+            two["gen_tokens_per_s_a"] / one["gen_tokens_per_s_a"], 2)
+        if one["gen_tokens_per_s_a"] else None,
+        "arms": {},
+    }
+    for row in (one, two):
+        row.pop("a_completions")
+        row.pop("b_completions")
+        tp_res["arms"][f"tp{row['tp_degree']}"] = row
+    print(json.dumps({"tp": tp_res}))
+    if not identical:
+        raise SystemExit(
+            "tp A/B: sharding the engine changed greedy output on the "
+            "lossless path — per-head attention and the row-parallel "
+            "psums must be token-exact; not benchmarking a broken mesh")
+
+    merged = {"metric": "serve_tp_ab", "value":
+              two["gen_tokens_per_s_a"], "unit": "tokens_per_s",
+              "extra": {"tp": tp_res}}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                merged = json.load(f)
+            merged.setdefault("extra", {})["tp"] = tp_res
+        except ValueError:
+            pass
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+
+
 def _run_failover(args):
     """--failover-ab: mid-stream generation failover harness (ISSUE 14).
 
@@ -1892,6 +2055,15 @@ def main():
                          "through the CP index vs cold prefill, "
                          "hard-asserts token identity; merges the result "
                          "into --out")
+    ap.add_argument("--tp-ab", action="store_true",
+                    help="A/B tensor-parallel serving (ISSUE 20): "
+                         "in-process TP=1 vs TP=2 engine pairs (full "
+                         "stack: prefix cache + spec decode + sharded "
+                         "kv-tier restore), hard-asserts greedy token "
+                         "identity on the lossless path, reports decode "
+                         "throughput + restore time per arm; merges into "
+                         "--out under extra.tp and skips the LLM "
+                         "headline bench")
     ap.add_argument("--profile-ab", action="store_true",
                     help="A/B the engine phase timers (profiling_enabled "
                          "on vs off) on the headline point; exits nonzero "
@@ -2010,6 +2182,30 @@ def main():
         _run_chaos_suite(args)
         return
 
+    if args.tp_ab:
+        if not args.no_preflight:
+            import os
+            import subprocess
+            import sys
+            repo = os.path.dirname(os.path.abspath(__file__))
+            # sharding coverage first: a TP throughput number over a mesh
+            # that silently changes tokens is a lie — the identity tests
+            # run the same host-device mesh this arm uses, and the
+            # partition-rule unit tests stand behind the weight shardings
+            tp_tests = ["tests/test_tp_serving.py",
+                        "tests/test_parallel.py",
+                        "tests/test_paged_kernels.py"]
+            rc = subprocess.run(
+                [sys.executable, "-m", "pytest", "-q", *tp_tests],
+                cwd=repo,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}).returncode
+            if rc != 0:
+                sys.exit(f"preflight failed: pytest -q "
+                         f"{' '.join(tp_tests)} exited {rc} "
+                         f"(--no-preflight to override)")
+        _run_tp_ab(args)
+        return
+
     if args.fleet:
         if not args.no_preflight:
             import os
@@ -2031,12 +2227,16 @@ def main():
             # controller, so the warm-start/drain/scale races must hold
             # flight-recorder coverage too: the fleet's scale/failover
             # story is debugged through the event journal
+            # TP coverage rides along (ISSUE 20): a fleet may mix
+            # tp_degree replicas, and the namespace/identity guarantees
+            # those tests pin are what keep mixed fleets coherent
             fleet_tests = ["tests/test_affinity_routing.py",
                            "tests/test_attribution.py",
                            "tests/test_failover.py",
                            "tests/test_serve_disagg.py",
                            "tests/test_elastic.py",
-                           "tests/test_events.py"]
+                           "tests/test_events.py",
+                           "tests/test_tp_serving.py"]
             rc = subprocess.run(
                 [sys.executable, "-m", "pytest", "-q", *fleet_tests],
                 cwd=repo,
@@ -2125,6 +2325,10 @@ def main():
             # perf number needs behind it
             preflight_tests.append("tests/test_kv_tier.py")
             preflight_tests.append("tests/test_kv_codec.py")
+            # sharded-blob coverage (ISSUE 20): the tier wire format now
+            # has a per-shard payload mode, and a tier perf number is
+            # only as good as the reassembly + namespace tests behind it
+            preflight_tests.append("tests/test_tp_serving.py")
             if "tests/test_paged_kernels.py" not in preflight_tests:
                 preflight_tests.append("tests/test_paged_kernels.py")
         rc = subprocess.run(

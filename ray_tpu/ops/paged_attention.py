@@ -28,6 +28,15 @@ intermediate.
 Off-TPU the kernels run in interpreter mode (pl.pallas_call
 (interpret=True)), which is how tier-1 gates them on CPU — same story as
 ops/attention.py.
+
+Tensor parallelism (ISSUE 20): a pallas_call is opaque to GSPMD, so on a
+TP mesh the serving engine runs these kernels under ``shard_map`` with
+the canonical per-KV-head partitioning from :func:`tp_shard_specs` —
+pool axis 0 (Hkv) and q's H axis split by the "tensor" mesh axis. The
+kv-major GQA head order above is what makes that split clean: each
+shard's kernel invocation is exactly a single-chip call over Hkv/tp
+kv heads with their n_rep q heads, no kernel-internal changes and no
+in-kernel collectives.
 """
 
 from __future__ import annotations
@@ -38,11 +47,31 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 _NEG_INF = -1e30
 # jax renamed TPUCompilerParams -> CompilerParams across versions
 _COMPILER_PARAMS = getattr(pltpu, "CompilerParams",
                            getattr(pltpu, "TPUCompilerParams", None))
+
+
+def tp_shard_specs(q_rank: int, n_replicated: int, axis: str = "tensor"):
+    """Canonical ``shard_map`` partition specs for this kernel family on a
+    tensor-parallel mesh.
+
+    Operand order is the family's wrapper signature: ``(q, k_pages,
+    v_pages, <n_replicated trailing operands>)`` — page tables and scalar
+    position/length operands are replicated. q of rank ``q_rank`` is split
+    on its H axis (second-to-last); the pools on axis 0 (Hkv). Because
+    ``paged_attention`` derives ``hkv``/``n_rep`` from operand shapes and
+    splits heads kv-major, each shard's launch is a self-consistent
+    single-chip call over its Hkv/tp kv-head groups.
+
+    Returns ``(in_specs, out_spec)``; the output follows q's split.
+    """
+    q_spec = P(*([None] * (q_rank - 2) + [axis, None]))
+    in_specs = (q_spec, P(axis), P(axis)) + (P(),) * n_replicated
+    return in_specs, q_spec
 
 
 def _paged_attn_kernel(pt_ref, base_ref, limit_ref,     # scalar prefetch

@@ -14,6 +14,8 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import shard_map_compat as shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -85,9 +87,9 @@ def moe_layer(x, gate_w, expert_fn: Callable, expert_params, mesh: Mesh, *,
             return full  # [E, C, D]
 
         param_specs = jax.tree.map(lambda _: P(axis_name), expert_params)
-        expert_out = jax.shard_map(
+        expert_out = shard_map(
             sharded, mesh=mesh, in_specs=(P(), param_specs), out_specs=P(),
-            check_vma=False)(expert_in, expert_params)
+            check=False)(expert_in, expert_params)
     else:
         expert_out = jax.vmap(expert_fn)(expert_params, expert_in)
 
@@ -131,7 +133,7 @@ def moe_layer_tokens_sharded(x, gate_w, expert_fn: Callable, expert_params,
         return res.reshape(b, s, d)
 
     param_specs = jax.tree.map(lambda _: P(axis_name), expert_params)
-    return jax.shard_map(
+    return shard_map(
         sharded, mesh=mesh,
         in_specs=(P(axis_name), P(), param_specs), out_specs=P(axis_name),
-        check_vma=False)(x, gate_w, expert_params)
+        check=False)(x, gate_w, expert_params)

@@ -18,6 +18,8 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import shard_map_compat as shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -79,7 +81,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh, *,
         return jax.lax.psum(out_buf, axis_name)
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
-    out = jax.shard_map(
+    out = shard_map(
         sharded, mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
-        check_vma=False)(stage_params, xs)
+        check=False)(stage_params, xs)
     return out.reshape(batch, *x.shape[1:])

@@ -20,6 +20,8 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import shard_map_compat as shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -105,9 +107,9 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "context",
     seq_spec = P(None, axis_name, None, None)
     fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale, block_fn=block_fn)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(seq_spec, seq_spec, seq_spec),
-        out_specs=seq_spec, check_vma=False)(q, k, v)
+        out_specs=seq_spec, check=False)(q, k, v)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "context",
@@ -146,5 +148,5 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "context",
         return heads_to_seq(out)
 
     seq_spec = P(None, axis_name, None, None)
-    return jax.shard_map(inner, mesh=mesh, in_specs=(seq_spec,) * 3,
-                         out_specs=seq_spec, check_vma=False)(q, k, v)
+    return shard_map(inner, mesh=mesh, in_specs=(seq_spec,) * 3,
+                         out_specs=seq_spec, check=False)(q, k, v)

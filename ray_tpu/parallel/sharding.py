@@ -17,11 +17,29 @@ Two APIs:
 
 from __future__ import annotations
 
+import re
 from typing import Any, Callable
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # shard_map moved out of jax.experimental in newer releases
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SHARD_MAP_CHECK_KW = "check_rep"
+except ImportError:  # pragma: no cover - newer jax
+    from jax import shard_map as _shard_map
+    _SHARD_MAP_CHECK_KW = "check_vma"
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check=False):
+    """``shard_map`` across jax versions: the replication-check kwarg was
+    renamed ``check_rep`` → ``check_vma`` when shard_map left
+    jax.experimental. Callers pass ``check=``; we translate to whatever
+    this jax spells it."""
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs,
+                      **{_SHARD_MAP_CHECK_KW: check})
 
 # default logical-axis rule table (megatron-style TP + fsdp weight sharding)
 DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
@@ -113,6 +131,80 @@ def infer_fsdp_sharding(params_shapes, mesh: Mesh, axis: str = "fsdp",
         return NamedSharding(mesh, P(*spec))
 
     return jax.tree.map(one, params_shapes)
+
+
+def _path_name(path) -> str:
+    """Pytree key path → a slash-joined name regex rules match against
+    (dict keys and sequence indices both render: ``layers/attn/wq``)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, params):
+    """Regex partition rules → tree of PartitionSpecs (the T5X/EasyLM
+    idiom). ``rules`` is an ordered sequence of ``(pattern, spec)``; the
+    FIRST pattern that ``re.search``-matches a leaf's slash-joined tree
+    path wins. Scalars always get ``P()`` (nothing to shard); every
+    non-scalar leaf must match some rule — a silent replicate-by-default
+    hides typos in the rule table, so an unmatched leaf raises.
+
+    Shared by train (``spmd.state_shardings(partition_rules=...)``) and
+    serve (the TP engine's weight shardings): one implementation, one
+    set of semantics for how a param name selects its layout."""
+    rules = tuple((pat, spec if isinstance(spec, P) else P(*spec))
+                  for pat, spec in rules)
+
+    def get_spec(path, leaf):
+        name = _path_name(path)
+        if not getattr(leaf, "shape", ()):
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        raise ValueError(f"partition rule not found for param: {name}")
+
+    return jax.tree_util.tree_map_with_path(get_spec, params)
+
+
+def prune_spec(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes of size 1 (or absent) from a PartitionSpec, so one
+    rule table serves any mesh — the regex-rule twin of the dropping
+    ``spec_from_logical`` does for logical-axis rules."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        axes = tuple(a for a in axes
+                     if a in mesh.axis_names and mesh.shape[a] > 1)
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def rule_shardings(rules, params, mesh: Mesh):
+    """``match_partition_rules`` + mesh application in one call: tree of
+    params (or ShapeDtypeStructs) → tree of NamedShardings."""
+    specs = match_partition_rules(rules, params)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, prune_spec(s, mesh)),
+        specs, is_leaf=lambda x: isinstance(x, P))
 
 
 def batch_sharding(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:

@@ -438,6 +438,8 @@ class ServeController:
                         "attention_backend", "attn_backend_pallas",
                         "attn_kernel_compiles", "attn_decode_dispatches",
                         "attn_verify_dispatches", "attn_chunk_dispatches",
+                        "tp_degree", "mesh_shape", "kv_shard_pool_bytes",
+                        "kv_shard_page_occupancy",
                         "itl_s", "compile_events", "mid_traffic_compiles",
                         "compile_s", "weights_bytes", "kv_pool_bytes",
                         "kv_page_occupancy", "device_bytes_in_use",

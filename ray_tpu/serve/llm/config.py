@@ -45,6 +45,20 @@ class LLMConfig:
     # gather elsewhere; tests force "pallas" on CPU, where the kernels
     # run in Pallas interpreter mode.
     attention_kernel: str = "auto"    # "auto" | "gather" | "pallas"
+    # Tensor parallelism (ISSUE 20): one engine replica spans tp_degree
+    # chips along the mesh "tensor" axis — Megatron-style intra-layer
+    # sharding (attention heads / KV heads / ffn hidden / vocab split;
+    # wo and w_down row-parallel), the paged KV pool sharded per-KV-head,
+    # and every compiled program (fused decode, chunked prefill,
+    # verify-k, the Pallas paged-attention family) partitioned under
+    # pjit/shard_map. tp_degree=1 (default) builds no mesh and is
+    # bit-identical to the single-chip engine. Requires n_kv_heads,
+    # n_heads, ffn_dim and vocab_size all divisible by tp_degree, and
+    # tp_degree visible devices. KV pages spilled by a TP engine are
+    # per-shard-encoded and namespace-isolated by layout (the `|tp{N}`
+    # rule — see engine.kv_tier_namespace), so TP=1 and TP=2 stores
+    # never exchange incompatible pages.
+    tp_degree: int = 1
     # decode steps fused into one dispatched program when the batch is
     # steady (multi-step decode): token cost ~ dispatch_RTT/decode_block,
     # which matters enormously when the chip sits behind a network tunnel.

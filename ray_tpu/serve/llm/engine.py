@@ -66,6 +66,15 @@ def kv_tier_namespace(cfg: LLMConfig, model_cfg, kv_dtype,
         # their own namespace. none<->lossless mix freely (both decode
         # to identical bytes).
         ident += "|int8"
+    if getattr(cfg, "tp_degree", 1) > 1:
+        # sharding layout is part of the codec identity (ISSUE 20), same
+        # precedent as |int8: a TP engine writes mode="shards" blobs
+        # split per-KV-head at its tp_degree, and replicas with
+        # different layouts index under different namespaces so byte
+        # accounting, AB comparisons and fleet warm-starts never mix
+        # blob layouts. TP=1 omits the suffix so existing single-chip
+        # namespaces — and every already-spilled blob — stay valid.
+        ident += f"|tp{int(cfg.tp_degree)}"
     return hashlib.sha256(ident.encode()).hexdigest()[:16]
 
 
@@ -197,6 +206,19 @@ class LLMEngine:
         self.max_pages_per_seq = -(-cfg.max_seq_len // cfg.page_size)
         self.kv = kvc.init_paged_cache(
             self.model_cfg, cfg.num_pages, cfg.page_size)
+        # Tensor parallelism (ISSUE 20): one engine process drives a
+        # tp_degree-chip "tensor" mesh. Weights get Megatron-style
+        # partition-rule shardings (parallel/sharding.py — the SAME
+        # match_partition_rules train/spmd.py uses), the page pool is
+        # split per-KV-head, and everything else about the engine — the
+        # loop, the allocator, page tables, the tier — keeps operating on
+        # whole-replica logical state. tp_degree=1 builds no mesh and
+        # compiles the exact single-chip programs (bit-identical to a
+        # pre-TP engine).
+        self._tp = max(1, int(getattr(cfg, "tp_degree", 1)))
+        self._mesh = None
+        if self._tp > 1:
+            self._mesh = self._setup_tp_mesh()
         # performance introspection (observability/profiling.py): phase
         # timers + ITL ring gate on cfg.profiling_enabled; compile-event
         # tracking is always on (work only on first-dispatch-per-shape).
@@ -289,7 +311,11 @@ class LLMEngine:
                 page_size=cfg.page_size,
                 namespace=kv_tier_namespace(
                     cfg, self.model_cfg, self.kv["k"].dtype, rng_seed),
-                codec=cfg.kv_tier_codec)
+                codec=cfg.kv_tier_codec,
+                # per-shard encoded sub-payloads under ONE chain digest
+                # (ISSUE 20): the namespace above already carries |tp{N}
+                # so layouts never mix across stores
+                shards=self._tp)
             self.allocator.spill_hook = self._spill_capture
             # restore scatter at ONE fixed shape (max_pages_per_seq,
             # trash-page padded) — same donated-pool pattern as disagg's
@@ -337,6 +363,16 @@ class LLMEngine:
         self._pt_dev = jnp.zeros((b + 1, self.max_pages_per_seq), jnp.int32)
         self._sl_dev = jnp.zeros((b + 1,), jnp.int32)
         self._temps_dev = jnp.zeros((b + 1,), jnp.float32)
+        if self._mesh is not None:
+            # replicate-commit the small decode state on the TP mesh so
+            # the donated state buffers keep one deterministic layout
+            # step to step (uncommitted operands would let each program's
+            # first compile pick, and donation would then pin whatever it
+            # guessed)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self._mesh, P())
+            self._pt_dev, self._sl_dev, self._temps_dev = jax.device_put(
+                (self._pt_dev, self._sl_dev, self._temps_dev), rep)
         self._dirty_slots: dict[int, tuple] = {}  # slot -> (seq_len, temp)
 
         # jitted programs. The KV pool is DONATED: it's the dominant HBM
@@ -377,6 +413,68 @@ class LLMEngine:
             donate_argnums=(0,))
         self._zero_tok = None  # device int32(0), padding for override stacks
 
+    # ---- tensor parallelism (ISSUE 20) ---------------------------------
+    @staticmethod
+    def tp_partition_rules():
+        """Serve-side Megatron TP rules, consumed by
+        parallel.sharding.rule_shardings (ordered; first re.search match
+        wins). Column-parallel qkv/gate/up, row-parallel wo/w_down (their
+        contractions psum across the axis), vocab-sharded lm_head (argmax
+        composes exactly across shards), everything else — embed, norms,
+        scalars — replicated. The attention split rides the kv-major GQA
+        head order: H/tp query heads are exactly (Hkv/tp) whole kv-head
+        groups, so per-head attention math never crosses a shard."""
+        from jax.sharding import PartitionSpec as P
+        return (
+            (r"layers/attn/w[qkv]$", P(None, None, "tensor", None)),
+            (r"layers/attn/wo$", P(None, "tensor", None, None)),
+            (r"layers/mlp/w_(gate|up)$", P(None, None, "tensor")),
+            (r"layers/mlp/w_down$", P(None, "tensor", None)),
+            (r"lm_head$", P(None, "tensor")),
+            (r".*", P()),
+        )
+
+    def _setup_tp_mesh(self):
+        """Build the tp_degree-device "tensor" mesh and commit the engine's
+        device state to it: params via the partition rules, the KV pool
+        split per-KV-head (axis 1 of [L, Hkv, P, page, D]). Committed
+        (device_put) shardings are what make every later jit — decode /
+        verify / prefill / tier-inject — compile as a partitioned program
+        without per-call annotations; donation then keeps the buffers
+        sharded in place across steps. Small host-born operands (token
+        patches, restore blobs) stay uncommitted and are resharded by the
+        compiled programs' input layouts."""
+        jax = self._jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.parallel import sharding as shd
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        tp = self._tp
+        mc = self.model_cfg
+        for name, val in (("n_kv_heads", mc.n_kv_heads),
+                          ("n_heads", mc.n_heads),
+                          ("ffn_dim", mc.ffn_dim),
+                          ("vocab_size", mc.vocab_size)):
+            if val % tp:
+                raise ValueError(
+                    f"tp_degree={tp} must divide model {name}={val}")
+        devices = jax.devices()
+        if len(devices) < tp:
+            raise ValueError(
+                f"tp_degree={tp} needs {tp} devices, have {len(devices)}")
+        mesh = build_mesh(MeshSpec(tensor=tp), devices[:tp])
+        self.params = jax.device_put(
+            self.params,
+            shd.rule_shardings(self.tp_partition_rules(), self.params,
+                               mesh))
+        self.kv = jax.device_put(
+            self.kv, NamedSharding(mesh, P(None, "tensor")))
+        logger.info("TP mesh up: %s over %d devices (pool %d kv heads"
+                    " -> %d per shard)", dict(mesh.shape), tp,
+                    mc.n_kv_heads, mc.n_kv_heads // tp)
+        return mesh
+
     # ---- compiled impls ------------------------------------------------
     def _decode_impl(self, params, kv, pt_full, sl_full, toks_full, rng,
                      temps_full, idx, num_steps: int = 1):
@@ -401,7 +499,7 @@ class LLMEngine:
             key, sub = jax.random.split(key)
             logits, kv_c, lens = self._kvc.paged_decode_step(
                 params, kv_c, pt, lens, toks, self.model_cfg,
-                self.cfg.page_size, self._attn_backend)
+                self.cfg.page_size, self._attn_backend, mesh=self._mesh)
             toks = self._kvc.sample_tokens(
                 logits, sub, temps, self.cfg.top_k)
             return (kv_c, lens, toks, key), toks
@@ -450,7 +548,7 @@ class LLMEngine:
         rng, sub = jax.random.split(rng)
         logits, kv, new_lens = self._kvc.paged_verify_step(
             params, kv, pt, lens0, tokens, self.model_cfg,
-            self.cfg.page_size, self._attn_backend)
+            self.cfg.page_size, self._attn_backend, mesh=self._mesh)
         t = tokens.shape[1]
         out = self._kvc.sample_tokens(
             logits.reshape(-1, logits.shape[-1]), sub,
@@ -511,7 +609,7 @@ class LLMEngine:
                 logits, kv = self._kvc.paged_prefill_chunk(
                     params, kv, page_table, tokens, start, true_len,
                     self.model_cfg, self.cfg.page_size,
-                    self._attn_backend)
+                    self._attn_backend, mesh=self._mesh)
                 tok = self._kvc.sample_tokens(
                     logits[None, :], rng, temp, top_k)
                 return tok[0], kv
@@ -948,6 +1046,24 @@ class LLMEngine:
         out["attn_backend_pallas"] = int(self._attn_backend == "pallas")
         out["attn_kernel_compiles"] = self._prof.compile_count(
             ("decode", "verify", "chunk"))
+        # tensor-parallel surface (ISSUE 20), stable-key contract: degree
+        # + mesh shape (string — exporters one-hot it like
+        # attention_backend) are always emitted ("none"/1 single-chip),
+        # and the byte gauges give ONE chip's slice of the pool — page
+        # counts everywhere else stay whole-replica logical pages (see
+        # PageAllocator), so dashboards sizing a chip's HBM read these
+        # two instead of dividing counts themselves.
+        out["tp_degree"] = self._tp
+        # only live axes: build_mesh materializes every canonical axis at
+        # size 1, which is noise in a gauge tag
+        out["mesh_shape"] = ("none" if self._mesh is None else ",".join(
+            f"{a}={n}" for a, n in dict(self._mesh.shape).items()
+            if n > 1))
+        pool_bytes = int(self.kv["k"].nbytes + self.kv["v"].nbytes)
+        out["kv_shard_pool_bytes"] = pool_bytes // self._tp
+        out["kv_shard_page_occupancy"] = (
+            (self.cfg.num_pages - free) * pool_bytes
+            // (self.cfg.num_pages * self._tp))
         out.update(self._prof.memory_stats(
             used_pages=self.cfg.num_pages - free,
             total_pages=self.cfg.num_pages))

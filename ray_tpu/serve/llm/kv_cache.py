@@ -25,7 +25,16 @@ Design choices:
   a reserved trash page (page 0), keeping the step free of dynamic shapes
   and `lax.cond`s;
 - full (non-chunked) prefill stays dense within the prompt: it runs at
-  B=1 per admission with no cached prefix to read back.
+  B=1 per admission with no cached prefix to read back;
+- tensor parallelism (ISSUE 20): every step function takes an optional
+  ``mesh``. With a live "tensor" axis the pool is sharded per-KV-head
+  (axis 1) and the q heads split into exactly the matching kv-head
+  groups (GQA head order is kv-major), so per-head attention has ZERO
+  cross-shard communication; only the wo/w_down row-parallel psums and
+  the vocab-sharded argmax cross chips. The gather backend partitions
+  under plain GSPMD/pjit; the Pallas kernels are opaque to GSPMD and run
+  under ``shard_map`` — each shard's kernel invocation is shape-wise
+  identical to the single-chip call on a pool with Hkv/tp heads.
 
 Page 0 is RESERVED as the trash page; the allocator never hands it out.
 """
@@ -92,6 +101,14 @@ class PageAllocator:
 
     Mirrors vLLM's BlockAllocator role; plain Python because allocation
     happens between steps, never inside the compiled program.
+
+    Page counts here are WHOLE-REPLICA logical pages: under tensor
+    parallelism (ISSUE 20) each page physically spans every shard
+    (1/tp_degree of its bytes per chip), but the allocator, the page
+    tables and every occupancy/free gauge derived from them count the
+    logical page once. Per-shard byte views (dashboards sizing one
+    chip's HBM) divide the replica's pool bytes by tp_degree — the
+    engine exports that as ``kv_shard_pool_bytes``.
 
     Prefix caching: pages are REFCOUNTED, and full pages of prompt tokens
     can be registered in a hash-chained index (one node per full page,
@@ -221,7 +238,8 @@ class PageAllocator:
         cached. NOT the same as ``cache_stats()["free_pages"]`` — an
         evictable page still holds restorable KV content (and, with the
         kv tier on, spills on eviction); see cache_stats() for the
-        three-way occupancy breakdown."""
+        three-way occupancy breakdown. Whole-replica logical pages
+        (shard-count-independent; see the class docstring)."""
         with self._lock:
             return len(self._free) + len(self._lru)
 
@@ -366,6 +384,11 @@ class PageAllocator:
     def cache_stats(self) -> dict:
         """Snapshot for engine stats / metrics export.
 
+        All counts are WHOLE-REPLICA logical pages: a TP engine's page
+        spans every shard, but it is one page here — free/evictable/live
+        never multiply (or divide) by tp_degree. Dashboards wanting one
+        chip's view scale the engine's byte gauges, not these counts.
+
         Three distinct occupancy numbers — dashboards must not conflate
         them (eviction is non-destructive once spilling is on):
 
@@ -447,8 +470,28 @@ def resolve_attention_backend(choice, cfg=None, page_size: int = 0) -> str:
     return choice
 
 
+def tp_degree(mesh) -> int:
+    """Live tensor-parallel degree of a serving mesh (1 = no TP: no mesh,
+    or a mesh whose "tensor" axis is size 1 — both compile the exact
+    single-chip program)."""
+    if mesh is None or "tensor" not in mesh.axis_names:
+        return 1
+    return int(mesh.shape["tensor"])
+
+
+def _tp_pallas(fn, mesh, in_specs, out_specs):
+    """Wrap a Pallas paged-attention call for a TP mesh: GSPMD cannot
+    partition an opaque pallas_call, so the kernel family runs under
+    ``shard_map`` with the pool split per-KV-head and q split into the
+    matching kv-head groups. check=False: the kernel writes nothing
+    replicated, and rep inference can't see through pallas anyway."""
+    from ray_tpu.parallel.sharding import shard_map_compat
+    return shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check=False)
+
+
 def _decode_attention(q, k_cache, v_cache, page_tables, pos, cfg, page_size,
-                      attn_backend: str = "gather"):
+                      attn_backend: str = "gather", mesh=None):
     """Single-token attention over the paged KV for all slots.
 
     q: [B, H, D]; k_cache/v_cache: [Hkv, P, page, D]; pos: [B] (the new
@@ -464,9 +507,21 @@ def _decode_attention(q, k_cache, v_cache, page_tables, pos, cfg, page_size,
     max_len = max_pages * page_size
     if attn_backend == "pallas":
         from ray_tpu.ops import paged_attention as paged_ops
-        return paged_ops.paged_decode_attention(
-            q, k_cache, v_cache, page_tables, pos,
-            sm_scale=cfg.head_dim ** -0.5)
+
+        def kernel(q, k_cache, v_cache, page_tables, pos):
+            return paged_ops.paged_decode_attention(
+                q, k_cache, v_cache, page_tables, pos,
+                sm_scale=cfg.head_dim ** -0.5)
+
+        if tp_degree(mesh) > 1:
+            # q's H axis splits into whole kv-head groups (kv-major GQA
+            # order), so each shard's kernel sees a self-contained
+            # (Hkv/tp heads, n_rep q-heads each) problem — no collective
+            in_specs, out_spec = paged_ops.tp_shard_specs(
+                q_rank=3, n_replicated=2)
+            return _tp_pallas(kernel, mesh, in_specs, out_spec)(
+                q, k_cache, v_cache, page_tables, pos)
+        return kernel(q, k_cache, v_cache, page_tables, pos)
     n_rep = q.shape[1] // k_cache.shape[0]
     sm = cfg.head_dim ** -0.5
     # gather: [Hkv, B, MP, page, D] -> [B, MP, page, Hkv, D] -> [B, L, Hkv, D]
@@ -488,7 +543,7 @@ def _decode_attention(q, k_cache, v_cache, page_tables, pos, cfg, page_size,
 
 def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
                       cfg: LlamaConfig, page_size: int,
-                      attn_backend: str = "gather"):
+                      attn_backend: str = "gather", mesh=None):
     """One fused decode step for all slots.
 
     tokens: [B] current token ids; seq_lens: [B] tokens already in cache
@@ -518,7 +573,7 @@ def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
             k_cache, v_cache, k[:, 0], v[:, 0], page_idx, offset)
         attn = _decode_attention(
             q[:, 0], k_cache, v_cache, page_tables, pos, cfg,
-            page_size, attn_backend)                              # [B,H,D]
+            page_size, attn_backend, mesh)                        # [B,H,D]
         x = x + jnp.einsum("bhk,hkd->bd", attn, layer["attn"]["wo"])[:, None]
         h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jax.nn.silu(h2 @ layer["mlp"]["w_gate"])
@@ -535,7 +590,7 @@ def paged_decode_step(params, kv, page_tables, seq_lens, tokens,
 
 def paged_verify_step(params, kv, page_tables, seq_lens, tokens,
                       cfg: LlamaConfig, page_size: int,
-                      attn_backend: str = "gather"):
+                      attn_backend: str = "gather", mesh=None):
     """Speculative verify: T tokens per slot in ONE fused pass.
 
     tokens: [B, T] — slot b's current token followed by its T-1 drafted
@@ -591,8 +646,19 @@ def paged_verify_step(params, kv, page_tables, seq_lens, tokens,
             jnp.moveaxis(v, 2, 0).astype(v_cache.dtype))
         if attn_backend == "pallas":
             from ray_tpu.ops import paged_attention as paged_ops
-            attn = paged_ops.paged_verify_attention(
-                q, k_cache, v_cache, page_tables, seq_lens, sm_scale=sm)
+
+            def kernel(q, k_cache, v_cache, page_tables, seq_lens):
+                return paged_ops.paged_verify_attention(
+                    q, k_cache, v_cache, page_tables, seq_lens,
+                    sm_scale=sm)
+
+            if tp_degree(mesh) > 1:
+                in_specs, out_spec = paged_ops.tp_shard_specs(
+                    q_rank=4, n_replicated=2)
+                attn = _tp_pallas(kernel, mesh, in_specs, out_spec)(
+                    q, k_cache, v_cache, page_tables, seq_lens)
+            else:
+                attn = kernel(q, k_cache, v_cache, page_tables, seq_lens)
         else:
             k_seq = jnp.moveaxis(
                 jnp.take(k_cache, page_tables, axis=1), 0, 3).reshape(
@@ -684,7 +750,7 @@ def paged_prefill(params, kv, page_table, tokens, true_len,
 
 def paged_prefill_chunk(params, kv, page_table, tokens, start, true_len,
                         cfg: LlamaConfig, page_size: int,
-                        attn_backend: str = "gather"):
+                        attn_backend: str = "gather", mesh=None):
     """One CHUNK of a long prompt's prefill (chunked prefill: the engine
     interleaves prompt chunks with decode blocks so a long admission never
     stalls active generations for the whole prompt pass — the scheduling
@@ -737,9 +803,20 @@ def paged_prefill_chunk(params, kv, page_table, tokens, start, true_len,
             jnp.swapaxes(v[0], 0, 1).astype(v_cache.dtype))
         if attn_backend == "pallas":
             from ray_tpu.ops import paged_attention as paged_ops
-            attn = paged_ops.paged_chunk_attention(
-                q, k_cache, v_cache, page_table, start, true_len,
-                sm_scale=sm)
+
+            def kernel(q, k_cache, v_cache, page_table, start, true_len):
+                return paged_ops.paged_chunk_attention(
+                    q, k_cache, v_cache, page_table, start, true_len,
+                    sm_scale=sm)
+
+            if tp_degree(mesh) > 1:
+                in_specs, out_spec = paged_ops.tp_shard_specs(
+                    q_rank=4, n_replicated=3)
+                attn = _tp_pallas(kernel, mesh, in_specs, out_spec)(
+                    q, k_cache, v_cache, page_table, start, true_len)
+            else:
+                attn = kernel(q, k_cache, v_cache, page_table, start,
+                              true_len)
         else:
             k_seq = jnp.swapaxes(
                 jnp.take(k_cache, page_table, axis=1).reshape(

@@ -30,6 +30,16 @@ spill time and undoes at restore:
 
 Pages encode independently (one payload per [L, Hkv, 1, page, D] slice)
 so a chunked restore stream can decode exactly the pages that landed.
+Tensor-parallel engines (ISSUE 20) spill the pool per-KV-head-sharded:
+``encode_pages(..., shards=N)`` splits every page along the KV-head axis
+into N independently-encoded sub-payloads carried inside ONE page
+payload (``mode="shards"``) under one chain digest — a restoring TP
+engine decodes each shard's bytes separately and lands them on the
+owning chip, while decode_page/decode_pages reassemble the full page
+for anyone who wants the unsharded view. Shard payloads only ever meet
+readers that understand them: the tier namespace embeds the sharding
+layout (`|tp{N}`, engine.kv_tier_namespace), the same isolation rule
+``|int8`` applies to quantized pages.
 The BATCH entry points (:func:`encode_pages` / :func:`decode_pages` —
 what the tier's spill flush and the ChainStream chunk decode call) keep
 that per-page payload contract but vectorize all the numpy work across
@@ -113,10 +123,15 @@ def encode_page(arr: np.ndarray, mode: str) -> dict:
 
 def decode_page(enc: dict) -> np.ndarray:
     """Invert :func:`encode_page`. Bit-exact for none/lossless; int8
-    reconstructs within ``scale/127`` per element."""
+    reconstructs within ``scale/127`` per element. A ``"shards"``
+    payload (TP spill) decodes each per-shard sub-payload and
+    reassembles the full page along the KV-head axis."""
+    mode = enc["mode"]
+    if mode == "shards":
+        return np.concatenate(
+            [decode_page(s) for s in enc["shards"]], axis=1)
     dt = _dtype(enc["dtype"])
     shape = tuple(enc["shape"])
-    mode = enc["mode"]
     if mode == "none":
         return np.frombuffer(enc["data"], dt).reshape(shape)
     if mode == "lossless":
@@ -132,6 +147,8 @@ def decode_page(enc: dict) -> np.ndarray:
 
 def encoded_nbytes(enc: dict) -> int:
     """Stored/wire footprint of one encoded page payload."""
+    if enc.get("mode") == "shards":
+        return sum(encoded_nbytes(s) for s in enc["shards"])
     return len(enc["data"]) + len(enc.get("scale") or b"")
 
 
@@ -180,15 +197,47 @@ def _encode_batch(a: np.ndarray, mode: str) -> list[dict]:
             for i in range(n)]
 
 
+def _shard_wrap(per_shard: list[list[dict]], full_shape, dtype,
+                raw: int) -> list[dict]:
+    """Zip per-shard payload lists into one ``mode="shards"`` payload per
+    page: ``per_shard[s][i]`` is shard s of page i."""
+    n = len(per_shard[0])
+    return [{"mode": "shards", "shape": tuple(full_shape),
+             "dtype": str(dtype), "raw": int(raw),
+             "shards": [ps[i] for ps in per_shard]}
+            for i in range(n)]
+
+
 def encode_pages(k_np: np.ndarray, v_np: np.ndarray,
-                 mode: str) -> list[tuple[dict, dict]]:
+                 mode: str, shards: int = 1) -> list[tuple[dict, dict]]:
     """Batch-encode a spilled chain: k_np/v_np are [L, Hkv, n, page, D];
     returns ``[(ek, ev), ...]`` of length n, each payload byte-identical
-    to the per-page :func:`encode_page` of that page slice."""
+    to the per-page :func:`encode_page` of that page slice.
+
+    ``shards > 1`` (tensor-parallel spill, ISSUE 20) splits the KV-head
+    axis into that many per-shard sub-payloads, each independently
+    encoded/decodable, carried inside one ``mode="shards"`` page payload
+    — one chain digest, per-shard blobs."""
     if mode not in MODES:
         raise ValueError(f"unknown KV codec mode {mode!r}")
-    ks = _encode_batch(np.ascontiguousarray(k_np), mode)
-    vs = _encode_batch(np.ascontiguousarray(v_np), mode)
+    k = np.ascontiguousarray(k_np)
+    v = np.ascontiguousarray(v_np)
+    if shards <= 1:
+        return list(zip(_encode_batch(k, mode), _encode_batch(v, mode)))
+    if k.shape[1] % shards != 0:
+        raise ValueError(
+            f"{k.shape[1]} KV heads not divisible by {shards} shards")
+    h = k.shape[1] // shards
+    page_shape = (k.shape[0], k.shape[1], 1) + k.shape[3:]
+    raw = k.nbytes // k.shape[2]
+    ks = _shard_wrap(
+        [_encode_batch(np.ascontiguousarray(
+            k[:, s * h:(s + 1) * h]), mode) for s in range(shards)],
+        page_shape, k.dtype, raw)
+    vs = _shard_wrap(
+        [_encode_batch(np.ascontiguousarray(
+            v[:, s * h:(s + 1) * h]), mode) for s in range(shards)],
+        page_shape, v.dtype, raw)
     return list(zip(ks, vs))
 
 
@@ -202,6 +251,19 @@ def decode_pages(encs: list[dict]) -> list[np.ndarray]:
     if not encs:
         return []
     first = encs[0]
+    if first.get("mode") == "shards":
+        # homogeneous sharded batch: vectorize per shard position, then
+        # reassemble each page along the KV-head axis. A mixed batch
+        # can't occur in practice (the namespace isolates layouts) but
+        # degrades to the per-page path like any other mix.
+        if all(e.get("mode") == "shards"
+               and len(e["shards"]) == len(first["shards"])
+               for e in encs):
+            parts = [decode_pages([e["shards"][s] for e in encs])
+                     for s in range(len(first["shards"]))]
+            return [np.concatenate([p[i] for p in parts], axis=1)
+                    for i in range(len(encs))]
+        return [decode_page(e) for e in encs]
     homogeneous = all(
         e["mode"] == first["mode"] and e["dtype"] == first["dtype"]
         and tuple(e["shape"]) == tuple(first["shape"])

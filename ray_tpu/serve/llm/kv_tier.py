@@ -115,13 +115,23 @@ class KVTierStore:
 
     ``namespace`` scopes the cluster index to one model identity; the
     engine passes a hash of (model id, checkpoint, architecture, KV
-    dtype, page size). Empty namespace (unit tests, standalone stores)
-    means un-scoped keys.
+    dtype, page size, sharding layout). Empty namespace (unit tests,
+    standalone stores) means un-scoped keys.
+
+    ``shards`` (tensor-parallel engines, ISSUE 20): pages are split
+    along the KV-head axis into this many independently-encoded
+    sub-payloads at put time (kv_codec ``mode="shards"``) — still ONE
+    blob per chain run under one digest sequence, so ChainStream plans
+    exactly once per chain and fans the per-shard bytes out at decode.
+    The engine pairs shards>1 with a `|tp{N}` namespace suffix, so a
+    sharded store's entries are never offered to a differently-laid-out
+    reader.
     """
 
     def __init__(self, max_bytes: int, disk_dir: Optional[str],
                  disk_max_bytes: int, ttl_s: float, page_size: int,
-                 namespace: str = "", codec: str = "none"):
+                 namespace: str = "", codec: str = "none",
+                 shards: int = 1):
         if codec not in kv_codec.MODES:
             raise ValueError(f"unknown KV codec {codec!r}")
         self.max_bytes = int(max_bytes)
@@ -131,6 +141,7 @@ class KVTierStore:
         self.page_size = int(page_size)
         self.namespace = str(namespace)
         self.codec = str(codec)
+        self.shards = max(1, int(shards))
         # distinct from the worker id: several engines (serve replicas,
         # tests) can share one worker process, and "is this entry mine"
         # must mean THIS store, while death-GC keys on the worker
@@ -207,15 +218,20 @@ class KVTierStore:
         if not digests:
             return 0
         n = len(digests)
-        if self.codec == "none":
+        if self.codec == "none" and self.shards <= 1:
             blob = {"k": k_np, "v": v_np, "page_size": self.page_size,
                     "digests": list(digests), "tokens": list(tokens)}
             nbytes = raw_nbytes
             sizes = [raw_nbytes // n] * n
             enc_ms = None
         else:
+            # a sharded store always writes the per-page payload layout
+            # (even codec "none"): the shard split lives inside each
+            # page payload, so chain digests and blob structure are
+            # identical to the unsharded store's
             t0 = time.perf_counter()
-            pages = kv_codec.encode_pages(k_np, v_np, self.codec)
+            pages = kv_codec.encode_pages(k_np, v_np, self.codec,
+                                          shards=self.shards)
             enc_ms = (time.perf_counter() - t0) * 1e3 / n
             sizes = [kv_codec.encoded_nbytes(ek) + kv_codec.encoded_nbytes(ev)
                      for ek, ev in pages]

@@ -56,6 +56,11 @@ _EXPORTED_STATS = (
     "attention_backend", "attn_backend_pallas", "attn_kernel_compiles",
     "attn_decode_dispatches", "attn_verify_dispatches",
     "attn_chunk_dispatches",
+    # tensor parallelism (ISSUE 20): sharding degree + mesh shape (string
+    # — one-hot export like attention_backend) and one chip's slice of
+    # the KV pool in bytes (page counts elsewhere stay whole-replica)
+    "tp_degree", "mesh_shape", "kv_shard_pool_bytes",
+    "kv_shard_page_occupancy",
     # introspection scalars (ISSUE 6): compile tracker + memory gauges;
     # None-valued entries (no samples yet / cpu backend) are skipped
     "compile_events", "mid_traffic_compiles", "compile_s",

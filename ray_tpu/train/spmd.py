@@ -31,6 +31,7 @@ from ray_tpu.parallel.sharding import (
     infer_fsdp_sharding,
     logical_to_shardings,
     replicated,
+    rule_shardings,
 )
 
 
@@ -83,13 +84,21 @@ def default_optimizer(learning_rate: float = 3e-4,
 
 def state_shardings(params_logical_axes, params_shape, mesh,
                     optimizer: optax.GradientTransformation,
-                    rules: dict | None = None):
+                    rules: dict | None = None,
+                    partition_rules=None):
     """Shardings for a full TrainState.
 
-    Optimizer state shards like the params it mirrors (adam mu/nu are
-    param-shaped); scalars/schedules replicate.
+    Param shardings come from ONE of three sources, in priority order:
+    regex ``partition_rules`` ((pattern, PartitionSpec) pairs matched
+    against slash-joined param paths via the shared
+    ``parallel.sharding.match_partition_rules`` — the same machinery the
+    TP serving engine uses), logical-axis annotations, or shape-driven
+    FSDP inference. Optimizer state shards like the params it mirrors
+    (adam mu/nu are param-shaped); scalars/schedules replicate.
     """
-    if params_logical_axes is not None:
+    if partition_rules is not None:
+        p_sh = rule_shardings(partition_rules, params_shape, mesh)
+    elif params_logical_axes is not None:
         p_sh = logical_to_shardings(params_logical_axes, mesh, rules)
     else:
         p_sh = infer_fsdp_sharding(params_shape, mesh)
@@ -119,12 +128,13 @@ def state_shardings(params_logical_axes, params_shape, mesh,
 def sharded_create_state(init_params_fn: Callable[[], Any],
                          optimizer: optax.GradientTransformation,
                          mesh, params_logical_axes=None,
-                         rules: dict | None = None) -> tuple[TrainState, Any]:
+                         rules: dict | None = None,
+                         partition_rules=None) -> tuple[TrainState, Any]:
     """Initialize a TrainState directly sharded on the mesh (ZeRO-style init:
     no replicated materialization). Returns (state, state_shardings)."""
     params_shape = jax.eval_shape(init_params_fn)
     sh = state_shardings(params_logical_axes, params_shape, mesh, optimizer,
-                         rules)
+                         rules, partition_rules)
 
     def init():
         params = init_params_fn()
